@@ -1,0 +1,78 @@
+"""``pose_estimation`` decoder: 14-keypoint heatmaps → skeleton overlay.
+
+Analog of ``ext/nnstreamer/tensor_decoder/tensordec-pose.c``: input is one
+heatmap tensor shaped (grid_h, grid_w, 14) (NNS ``14:w:h``, asserted at
+``:218``); per keypoint, decode takes the argmax cell (``:473-493``), then
+draws the 13-edge skeleton (``:401-437``) scaled into an RGBA canvas.
+
+option1 = output ``W:H``; option2 = input grid ``W:H``.
+Keypoints ride in ``meta["pose"]`` as (x, y, prob) triples in grid coords.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+
+from ..buffer import Frame
+from ..elements.decoder import DecoderPlugin, register_decoder
+from ..spec import TensorSpec, TensorsSpec
+from . import draw
+from .bounding_boxes import _parse_wh
+
+POSE_SIZE = 14
+# The reference's skeleton edges (tensordec-pose.c:401-437), 0-indexed:
+# top(0)-neck(1), neck-shoulders-elbows-wrists, neck-hips-knees-ankles.
+EDGES = [
+    (0, 1),
+    (1, 2), (2, 3), (3, 4),      # right arm
+    (1, 5), (5, 6), (6, 7),      # left arm
+    (1, 8), (8, 9), (9, 10),     # right leg
+    (1, 11), (11, 12), (12, 13), # left leg
+]
+
+
+@register_decoder("pose_estimation")
+class PoseEstimation(DecoderPlugin):
+    def init(self, options: List[str]) -> None:
+        opts = list(options) + [""] * (2 - len(options))
+        self.width, self.height = _parse_wh(opts[0], 640, 480)
+        self.i_width, self.i_height = _parse_wh(opts[1], 0, 0)
+
+    def out_spec(self, in_spec: TensorsSpec) -> TensorsSpec:
+        t = in_spec.tensors[0]
+        if t.shape is None or t.shape[-1] != POSE_SIZE:
+            raise ValueError(
+                f"pose_estimation needs (h, w, {POSE_SIZE}) heatmaps, got {t}"
+            )
+        return TensorsSpec(
+            tensors=(TensorSpec(dtype=np.uint8, shape=(self.height, self.width, 4)),),
+            rate=in_spec.rate,
+        )
+
+    def decode(self, frame: Frame, in_spec: TensorsSpec) -> Frame:
+        del in_spec
+        hm = np.asarray(frame.tensor(0), dtype=np.float32)
+        hm = hm.reshape(-1, hm.shape[-2], hm.shape[-1]) if hm.ndim > 3 else hm
+        grid_h, grid_w = hm.shape[0], hm.shape[1]
+        i_w = self.i_width or grid_w
+        i_h = self.i_height or grid_h
+        # argmax per keypoint channel (vectorized over all 14 at once)
+        flat = hm.reshape(-1, POSE_SIZE)
+        idx = flat.argmax(axis=0)
+        probs = flat[idx, np.arange(POSE_SIZE)]
+        ys, xs = np.unravel_index(idx, (grid_h, grid_w))
+        keypoints = [(int(x), int(y), float(p)) for x, y, p in zip(xs, ys, probs)]
+
+        canvas = draw.new_canvas(self.width, self.height)
+        sx = self.width / i_w
+        sy = self.height / i_h
+        pts = [(int(x * sx), int(y * sy)) for x, y, _ in keypoints]
+        for a, b in EDGES:
+            draw.draw_line(canvas, pts[a][0], pts[a][1], pts[b][0], pts[b][1], draw.WHITE)
+        for x, y in pts:
+            draw.draw_dot(canvas, x, y, draw.WHITE)
+        out = frame.with_tensors((canvas,))
+        out.meta["pose"] = keypoints
+        return out

@@ -1,0 +1,186 @@
+"""Shared N-way fan-in collection with time synchronization.
+
+The analog of ``GstCollectPads`` + the reference's tensor time-sync engine
+(``tensor_common.h:59-107``, impl ``tensor_common.c:1150-1266+``) used by
+both ``tensor_mux`` and ``tensor_merge``.  Three policies, matching
+``tensor_time_sync_mode``:
+
+- ``nosync``  — pop whatever is at each pad's head.
+- ``slowest`` — sync point is the most-lagging pad's head timestamp; each
+  pad contributes its buffer closest to that point (old buffers discarded).
+- ``basepad`` — follow pad K's timestamps within a tolerance; option string
+  ``"K:duration_ns"`` like the reference's ``sync-option``.
+
+Arrival is serialized by the base ``Node`` lock; a collection round fires
+whenever every non-EOS pad has a candidate buffer.
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Dict, List, Optional, Tuple
+
+from ..buffer import Event, Frame, NONE_TS, is_valid_ts
+from ..graph.node import Node, Pad
+
+
+class CollectNode(Node):
+    """Base for mux/merge: collects one frame per linked sink pad, time-
+    synchronized, then calls :meth:`combine`."""
+
+    REQUEST_SINK_PADS = True
+
+    def __init__(
+        self,
+        name: Optional[str] = None,
+        sync_mode: str = "slowest",
+        sync_option: str = "",
+    ):
+        super().__init__(name)
+        self.add_src_pad("src")
+        self.sync_mode = str(sync_mode)
+        if self.sync_mode not in ("nosync", "slowest", "basepad"):
+            raise ValueError(f"unknown sync-mode {self.sync_mode!r}")
+        self.sync_option = str(sync_option)
+        self._base_pad_idx = 0
+        self._base_tolerance = NONE_TS
+        if self.sync_mode == "basepad" and self.sync_option:
+            parts = self.sync_option.split(":")
+            self._base_pad_idx = int(parts[0])
+            if len(parts) > 1:
+                self._base_tolerance = int(parts[1])
+        self._queues: Dict[str, collections.deque] = {}
+
+    # -- collection ---------------------------------------------------------
+
+    def _pad_order(self) -> List[str]:
+        return sorted(self._queues, key=lambda n: (len(n), n))  # sink_0 < sink_1 < sink_10
+
+    def _handle_frame(self, pad: Pad, frame: Frame) -> None:
+        self._queues.setdefault(pad.name, collections.deque()).append(frame)
+        self._try_collect()
+
+    def _ready(self) -> bool:
+        for pad in self.sink_pads.values():
+            if pad.peer is None:
+                continue
+            q = self._queues.get(pad.name)
+            if q:
+                continue
+            if not pad.eos:
+                return False
+        return True
+
+    def _active_queues(self) -> List[Tuple[str, collections.deque]]:
+        """Queues that still have data (EOS+empty pads drop out of sync)."""
+        out = []
+        for name in self._pad_order():
+            q = self._queues[name]
+            if q:
+                out.append((name, q))
+        return out
+
+    def _sync_point(self, active) -> int:
+        if self.sync_mode == "basepad":
+            order = self._pad_order()
+            if self._base_pad_idx < len(order):
+                base_name = order[self._base_pad_idx]
+                q = self._queues.get(base_name)
+                if q:
+                    return q[0].pts
+            return NONE_TS
+        # slowest: the max of head timestamps — wait for the laggard
+        # (gst_tensor_time_sync_get_current_time, tensor_common.c).
+        ts = NONE_TS
+        for _, q in active:
+            if is_valid_ts(q[0].pts):
+                ts = max(ts, q[0].pts)
+        return ts
+
+    def _try_collect(self) -> None:
+        while self._ready():
+            active = self._active_queues()
+            if not active:
+                return
+            if self.sync_mode == "nosync":
+                chosen = [(name, q.popleft()) for name, q in active]
+            else:
+                base_ts = self._sync_point(active)
+                if base_ts == NONE_TS:
+                    chosen = [(name, q.popleft()) for name, q in active]
+                else:
+                    chosen = []
+                    need_buffer = False
+                    for name, q in active:
+                        pad = self.sink_pads[name]
+                        # advance to the buffer closest to base_ts
+                        while len(q) >= 2 and self._closer(q[1].pts, q[0].pts, base_ts):
+                            q.popleft()
+                        head = q[0]
+                        if (
+                            len(q) == 1
+                            and not pad.eos
+                            and is_valid_ts(head.pts)
+                            and self._ends_before(head, base_ts)
+                        ):
+                            need_buffer = True  # laggard: wait for newer data
+                            break
+                        chosen.append((name, head))
+                    if need_buffer:
+                        return
+                    for name, _ in chosen:
+                        self._queues[name].popleft()
+                    if self._base_tolerance != NONE_TS:
+                        chosen = [
+                            (n, f)
+                            for (n, f) in chosen
+                            if not is_valid_ts(f.pts)
+                            or abs(f.pts - base_ts) <= self._base_tolerance
+                        ]
+            if not chosen:
+                return
+            frames = dict(chosen)
+            out = self.combine(frames)
+            if out is not None:
+                self._emit(out)
+
+    @staticmethod
+    def _closer(candidate_ts: int, current_ts: int, base_ts: int) -> bool:
+        if not is_valid_ts(candidate_ts):
+            return False
+        if not is_valid_ts(current_ts):
+            return True
+        return abs(candidate_ts - base_ts) <= abs(current_ts - base_ts)
+
+    @staticmethod
+    def _ends_before(frame: Frame, ts: int) -> bool:
+        end = frame.end_ts
+        ref = end if is_valid_ts(end) else frame.pts
+        return ref < ts
+
+    def _handle_event(self, pad: Pad, event: Event) -> None:
+        if event.kind == "eos":
+            pad.eos = True
+            # An EOS pad may unblock a pending collection round.
+            self._try_collect()
+            if all(p.eos for p in self.sink_pads.values() if p.peer is not None):
+                self._on_eos()
+        else:
+            self.on_event(pad, event)
+
+    # -- to be provided by subclasses ---------------------------------------
+
+    def combine(self, frames: Dict[str, Frame]):
+        """Merge one synchronized set (pad name → frame) into output frames."""
+        raise NotImplementedError
+
+    @staticmethod
+    def output_timing(frames: Dict[str, Frame]) -> Tuple[int, int]:
+        pts = min(
+            (f.pts for f in frames.values() if is_valid_ts(f.pts)), default=NONE_TS
+        )
+        dur = min(
+            (f.duration for f in frames.values() if is_valid_ts(f.duration)),
+            default=NONE_TS,
+        )
+        return pts, dur

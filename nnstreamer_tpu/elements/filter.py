@@ -1,0 +1,145 @@
+"""``tensor_filter``: the central element — invokes an NN model on the stream.
+
+Analog of ``gst/nnstreamer/tensor_filter/tensor_filter.c`` (the
+GstBaseTransform at ``:132``):
+
+- ``framework=`` selects a backend from the registry (lazy import — the
+  ``dlopen`` analog, ``nnstreamer_subplugin.c:74-103``);
+- the model opens on start (``:873-888``);
+- negotiation reconciles model metadata, user ``input``/``inputtype``/
+  ``output``/``outputtype`` property overrides, and the upstream stream spec
+  (``load_tensor_info``/``configure_tensor``, ``:442-505,513-623``),
+  failing loudly on mismatch;
+- steady state maps input tensors → backend ``invoke`` → output frame
+  (``:316-436``); device-resident backends keep outputs on TPU (the
+  ``allocate_in_invoke`` generalization).
+
+Per-invoke wall time is recorded when profiling is enabled
+(:mod:`nnstreamer_tpu.utils.profiling`).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, Optional
+
+from ..backends.base import FilterBackend, get_backend
+from ..buffer import Frame
+from ..graph.node import NegotiationError, Node, Pad
+from ..graph.registry import register_element
+from ..spec import TensorSpec, TensorsSpec
+
+
+@register_element("tensor_filter")
+class TensorFilter(Node):
+    def __init__(
+        self,
+        name: Optional[str] = None,
+        framework: str = "",
+        model: object = None,
+        custom: str = "",
+        input: str = "",
+        inputtype: str = "",
+        output: str = "",
+        outputtype: str = "",
+        backend: Optional[FilterBackend] = None,
+    ):
+        super().__init__(name)
+        self.add_sink_pad("sink")
+        self.add_src_pad("src")
+        if backend is not None:
+            self.backend = backend
+        else:
+            if not framework:
+                raise ValueError("tensor_filter requires framework=")
+            self.backend = get_backend(framework)
+        self.framework = framework or self.backend.name
+        self.model = model
+        self.custom = str(custom)
+        self._prop_in = self._parse_spec_props(input, inputtype)
+        self._prop_out = self._parse_spec_props(output, outputtype)
+        self._opened = False
+        self.invoke_ns: list = []  # per-invoke latency when profiling
+
+    @staticmethod
+    def _parse_spec_props(dims: str, types: str) -> Optional[TensorsSpec]:
+        """Parse reference-style ``input=3:224:224:1.1:10`` + ``inputtype=...``
+        property pairs (``tensor_filter_common.c:261-292``; '.' separates
+        multiple tensors)."""
+        if not dims and not types:
+            return None
+        dim_list = [d for d in str(dims).split(".") if d] if dims else []
+        type_list = [t for t in str(types).split(",") if t] if types else []
+        n = max(len(dim_list), len(type_list))
+        tensors = []
+        for i in range(n):
+            d = dim_list[i] if i < len(dim_list) else None
+            t = type_list[i] if i < len(type_list) else None
+            if d is not None:
+                tensors.append(TensorSpec.from_dims_string(d, t))
+            else:
+                from ..spec import dtype_from_name
+
+                tensors.append(TensorSpec(dtype=dtype_from_name(t)))
+        return TensorsSpec(tensors=tuple(tensors))
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> None:
+        super().start()
+        if not self._opened:
+            self.backend.open(self.model, self.custom)
+            self._opened = True
+
+    def stop(self) -> None:
+        if self._opened:
+            self.backend.close()
+            self._opened = False
+        super().stop()
+
+    # -- negotiation --------------------------------------------------------
+
+    def sink_spec(self, pad_name: str) -> TensorsSpec:
+        del pad_name
+        spec = self.backend.input_spec() if self._opened else None
+        if spec is not None and self._prop_in is not None:
+            merged = spec.intersect(self._prop_in)
+            if merged is None:
+                raise NegotiationError(
+                    f"{self.name}: input property {self._prop_in} conflicts "
+                    f"with model spec {spec}"
+                )
+            return merged
+        return self._prop_in or spec or TensorsSpec()
+
+    def configure(self, in_specs: Dict[str, TensorsSpec]) -> Dict[str, TensorsSpec]:
+        in_spec = in_specs["sink"]
+        out_spec = self.backend.reconfigure(in_spec)
+        if self._prop_out is not None:
+            merged = out_spec.intersect(self._prop_out)
+            if merged is None:
+                raise NegotiationError(
+                    f"{self.name}: model output {out_spec} conflicts with "
+                    f"output property {self._prop_out}"
+                )
+            out_spec = merged
+        if in_spec.rate is not None and out_spec.rate is None:
+            out_spec = TensorsSpec(tensors=out_spec.tensors, rate=in_spec.rate)
+        return {"src": out_spec}
+
+    # -- hot loop -----------------------------------------------------------
+
+    def process(self, pad: Pad, frame: Frame):
+        del pad
+        from ..utils import profiling
+
+        if profiling.enabled():
+            t0 = time.perf_counter_ns()
+            outs = self.backend.invoke(frame.tensors)
+            profiling.block_outputs(outs)
+            dt = time.perf_counter_ns() - t0
+            self.invoke_ns.append(dt)
+            profiling.record(self.name, dt)
+        else:
+            outs = self.backend.invoke(frame.tensors)
+        return frame.with_tensors(outs)

@@ -1,0 +1,142 @@
+"""``tensor_src_iio``: Linux IIO sensor source.
+
+Analog of ``gst/nnstreamer/tensor_src_iio/tensor_src_iio.c`` (reads
+industrial-IO sensors from ``/sys/bus/iio/devices``, ``:163-164``): scans
+device dirs, parses channels, polls raw values, applies scale/offset, and
+merges enabled channels into one float32 tensor per sample.
+
+Like the reference's tests (``unittest_src_iio.cpp:52-120``), ``base_dir``
+redirects the sysfs root so a fake device tree under ``$TMPDIR`` exercises
+the element without hardware.  Supported properties: ``device`` (name) or
+``device_number``, ``frequency`` (Hz poll rate; 0 = as fast as possible),
+``num_buffers``, ``base_dir``.  One-shot mode = ``num_buffers=1``.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import time
+from fractions import Fraction
+from typing import Iterable, List, Optional
+
+import numpy as np
+
+from ..buffer import SECOND, Frame
+from ..graph.node import SourceNode
+from ..graph.registry import register_element
+from ..spec import TensorSpec, TensorsSpec
+
+DEFAULT_BASE_DIR = "/sys/bus/iio/devices"
+_CHANNEL_RE = re.compile(r"^in_(.+)_raw$")
+
+
+class _Channel:
+    def __init__(self, path: str, name: str):
+        self.path = path
+        self.name = name
+        base = path[: -len("_raw")]
+        self.scale = _read_float(base + "_scale", 1.0)
+        self.offset = _read_float(base + "_offset", 0.0)
+
+    def read(self) -> float:
+        with open(self.path, "r") as f:
+            raw = float(f.read().strip() or 0)
+        return (raw + self.offset) * self.scale
+
+
+def _read_float(path: str, default: float) -> float:
+    try:
+        with open(path, "r") as f:
+            return float(f.read().strip())
+    except (OSError, ValueError):
+        return default
+
+
+@register_element("tensor_src_iio")
+class TensorSrcIIO(SourceNode):
+    def __init__(
+        self,
+        name: Optional[str] = None,
+        device: str = "",
+        device_number: int = -1,
+        frequency: float = 0.0,
+        num_buffers: int = -1,
+        base_dir: str = DEFAULT_BASE_DIR,
+    ):
+        super().__init__(name)
+        self.device = str(device)
+        self.device_number = int(device_number)
+        self.frequency = float(frequency)
+        self.num_buffers = int(num_buffers)
+        self.base_dir = os.fspath(base_dir)
+        self._channels: List[_Channel] = []
+        self._dev_dir: Optional[str] = None
+
+    # -- device discovery ---------------------------------------------------
+
+    def _find_device(self) -> str:
+        if not os.path.isdir(self.base_dir):
+            raise FileNotFoundError(f"IIO base dir not found: {self.base_dir}")
+        candidates = sorted(
+            d for d in os.listdir(self.base_dir) if d.startswith("iio:device")
+        )
+        for d in candidates:
+            path = os.path.join(self.base_dir, d)
+            num = int(d.replace("iio:device", ""))
+            dev_name = ""
+            try:
+                with open(os.path.join(path, "name")) as f:
+                    dev_name = f.read().strip()
+            except OSError:
+                pass
+            if self.device and dev_name == self.device:
+                return path
+            if self.device_number >= 0 and num == self.device_number:
+                return path
+            if not self.device and self.device_number < 0:
+                return path  # first device
+        raise FileNotFoundError(
+            f"IIO device not found (device={self.device!r}, "
+            f"number={self.device_number}) under {self.base_dir}"
+        )
+
+    def _scan_channels(self, dev_dir: str) -> List[_Channel]:
+        chans = []
+        for fname in sorted(os.listdir(dev_dir)):
+            m = _CHANNEL_RE.match(fname)
+            if m:
+                chans.append(_Channel(os.path.join(dev_dir, fname), m.group(1)))
+        if not chans:
+            raise ValueError(f"IIO device {dev_dir} has no in_*_raw channels")
+        return chans
+
+    def start(self) -> None:
+        super().start()
+        self._dev_dir = self._find_device()
+        self._channels = self._scan_channels(self._dev_dir)
+
+    # -- streaming ----------------------------------------------------------
+
+    def output_spec(self) -> TensorsSpec:
+        n = len(self._channels)
+        rate = Fraction(self.frequency).limit_denominator() if self.frequency else None
+        return TensorsSpec(
+            tensors=(TensorSpec(dtype=np.float32, shape=(n,)),), rate=rate
+        )
+
+    def frames(self) -> Iterable[Frame]:
+        period = 1.0 / self.frequency if self.frequency > 0 else 0.0
+        dur = int(period * SECOND) if period else 0
+        idx = 0
+        while self.num_buffers < 0 or idx < self.num_buffers:
+            if self.stopped:
+                return
+            t0 = time.monotonic()
+            sample = np.array([c.read() for c in self._channels], dtype=np.float32)
+            yield Frame.of(sample, pts=idx * dur if dur else 0, duration=dur)
+            idx += 1
+            if period:
+                left = period - (time.monotonic() - t0)
+                if left > 0:
+                    time.sleep(left)

@@ -1,0 +1,91 @@
+"""``queue``: the thread-decoupling element.
+
+In the reference, GStreamer ``queue`` elements give each pipeline segment its
+own streaming thread — the core of its single-node pipeline parallelism
+(``README.md:41-44``: converter/filter run while the sink consumes).  This
+node reproduces that: ``_dispatch`` enqueues into a bounded buffer (returning
+immediately to the upstream thread, or blocking when full = backpressure),
+and a dedicated worker thread drains the buffer into the downstream chain.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+from typing import List, Optional
+
+from ..buffer import Event, Frame
+from ..graph.node import Node, Pad
+from ..graph.registry import register_element
+
+
+@register_element("queue")
+class Queue(Node):
+    def __init__(
+        self,
+        name: Optional[str] = None,
+        max_size_buffers: int = 200,
+        leaky: str = "no",
+    ):
+        super().__init__(name)
+        self.add_sink_pad("sink")
+        self.add_src_pad("src")
+        self.max_size = int(max_size_buffers)
+        self.leaky = str(leaky)  # "no" | "downstream" (drop newest when full)
+        self._buf = collections.deque()
+        self._cv = threading.Condition()
+        self._shutdown = False
+
+    def _dispatch(self, pad: Pad, item) -> None:
+        del pad
+        with self._cv:
+            if self.leaky == "downstream":
+                # GStreamer leaky=downstream: leak the *oldest* queued frame
+                # so live pipelines stay current; events are never dropped.
+                if len(self._buf) >= self.max_size and isinstance(item, Frame):
+                    for i, queued in enumerate(self._buf):
+                        if isinstance(queued, Frame):
+                            del self._buf[i]
+                            break
+            elif self.leaky == "upstream":
+                if len(self._buf) >= self.max_size and isinstance(item, Frame):
+                    return  # drop the newest incoming frame
+            else:
+                while len(self._buf) >= self.max_size and not self._shutdown:
+                    self._cv.wait(0.1)
+            if self._shutdown:
+                return
+            self._buf.append(item)
+            self._cv.notify_all()
+
+    def spawn_threads(self) -> List[threading.Thread]:
+        self._shutdown = False
+        return [threading.Thread(target=self._worker, name=f"queue:{self.name}")]
+
+    def _worker(self) -> None:
+        while True:
+            with self._cv:
+                while not self._buf and not self._shutdown:
+                    self._cv.wait(0.1)
+                if self._shutdown and not self._buf:
+                    return
+                item = self._buf.popleft()
+                self._cv.notify_all()
+            if isinstance(item, Event):
+                if item.kind == "eos":
+                    self.sink_pads["sink"].eos = True
+                    self._on_eos()
+                    return
+                self.on_event(self.sink_pads["sink"], item)
+            else:
+                try:
+                    self.push(item)
+                except BaseException as exc:  # noqa: BLE001
+                    if self.pipeline is not None:
+                        self.pipeline.post_error(self, exc)
+                    return
+
+    def interrupt(self) -> None:
+        with self._cv:
+            self._shutdown = True
+            self._cv.notify_all()

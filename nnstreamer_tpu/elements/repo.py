@@ -1,0 +1,186 @@
+"""``tensor_repo`` + ``tensor_reposink`` / ``tensor_reposrc``: recurrence.
+
+Analog of ``gst/nnstreamer/tensor_repo/`` — the reference's feedback
+mechanism for cyclic (LSTM/RNN) topologies that a dataflow graph otherwise
+forbids (survey §3.4):
+
+- a **process-global repository** of slots, each a single-frame mailbox with
+  a mutex + condvars (``tensor_repo.h:77-103``);
+- ``tensor_reposink slot-index=N`` publishes every frame into slot N
+  (``gst_tensor_repo_set_buffer``);
+- ``tensor_reposrc slot-index=N`` is a source that, on its **first** create,
+  emits a zeroed dummy frame shaped by its ``caps`` property — bootstrapping
+  the cycle — then blocks on the slot condvar for each subsequent frame
+  (``tensor_reposrc.c:312-325``);
+- slot payloads carry their spec as metadata (the ``GstMetaRepo`` analog,
+  ``tensor_repo.h:37-54``) and are re-validated on the src side;
+- slot indices are runtime-changeable → dynamic graph rewiring
+  (``tests/nnstreamer_repo_dynamicity/``), via :meth:`set_slot`.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Iterable, Optional, Tuple
+
+import numpy as np
+
+from ..buffer import Frame
+from ..graph.node import Pad, SinkTerminal, SourceNode
+from ..graph.registry import register_element
+from ..spec import TensorsSpec
+
+
+class _Slot:
+    __slots__ = ("cond", "frame", "spec", "seq", "eos")
+
+    def __init__(self):
+        self.cond = threading.Condition()
+        self.frame: Optional[Frame] = None
+        self.spec: Optional[TensorsSpec] = None
+        self.seq = 0
+        self.eos = False
+
+
+class TensorRepo:
+    """Process-global slot registry (the ``_GstTensorRepo`` singleton)."""
+
+    def __init__(self):
+        self._slots: Dict[int, _Slot] = {}
+        self._lock = threading.Lock()
+
+    def slot(self, idx: int) -> _Slot:
+        with self._lock:
+            if idx not in self._slots:
+                self._slots[idx] = _Slot()
+            return self._slots[idx]
+
+    def set_buffer(self, idx: int, frame: Frame, spec: Optional[TensorsSpec]) -> None:
+        s = self.slot(idx)
+        with s.cond:
+            s.frame = frame
+            s.spec = spec
+            s.seq += 1
+            s.cond.notify_all()
+
+    def get_buffer(
+        self, idx: int, last_seq: int, timeout: Optional[float] = None
+    ) -> Tuple[Optional[Frame], Optional[TensorsSpec], int, bool]:
+        """Block until a frame newer than ``last_seq`` or EOS.
+        Returns (frame, spec, seq, eos)."""
+        s = self.slot(idx)
+        with s.cond:
+            while s.seq <= last_seq and not s.eos:
+                if not s.cond.wait(timeout if timeout is not None else 0.1):
+                    if timeout is not None:
+                        return None, None, last_seq, s.eos
+            if s.eos and s.seq <= last_seq:
+                return None, None, last_seq, True
+            return s.frame, s.spec, s.seq, False
+
+    def set_eos(self, idx: int) -> None:
+        s = self.slot(idx)
+        with s.cond:
+            s.eos = True
+            s.cond.notify_all()
+
+    def reset(self, idx: Optional[int] = None) -> None:
+        with self._lock:
+            if idx is None:
+                self._slots.clear()
+            else:
+                self._slots.pop(idx, None)
+
+
+# The process-global repository (matches the reference's global `_repo`).
+GLOBAL_REPO = TensorRepo()
+
+
+@register_element("tensor_reposink")
+class TensorRepoSink(SinkTerminal):
+    def __init__(
+        self,
+        name: Optional[str] = None,
+        slot_index: int = 0,
+        signal_rate: int = 0,
+        repo: Optional[TensorRepo] = None,
+    ):
+        super().__init__(name)
+        del signal_rate  # accepted for launch-string parity
+        self.slot_index = int(slot_index)
+        self.repo = repo or GLOBAL_REPO
+        self._spec: Optional[TensorsSpec] = None
+
+    def set_slot(self, idx: int) -> None:
+        self.slot_index = int(idx)
+
+    def configure(self, in_specs):
+        self._spec = in_specs["sink"]
+        return {}
+
+    def process(self, pad: Pad, frame: Frame):
+        del pad
+        self.repo.set_buffer(self.slot_index, frame, self._spec)
+        return None
+
+    def drain(self):
+        self.repo.set_eos(self.slot_index)
+        return None
+
+
+@register_element("tensor_reposrc")
+class TensorRepoSrc(SourceNode):
+    def __init__(
+        self,
+        name: Optional[str] = None,
+        slot_index: int = 0,
+        caps: str = "",
+        repo: Optional[TensorRepo] = None,
+    ):
+        super().__init__(name)
+        self.slot_index = int(slot_index)
+        self.repo = repo or GLOBAL_REPO
+        if isinstance(caps, TensorsSpec):
+            self._spec = caps
+        elif caps:
+            self._spec = TensorsSpec.from_caps_string(caps)
+        else:
+            raise ValueError("tensor_reposrc requires caps= (cycle bootstrap spec)")
+
+    def set_slot(self, idx: int) -> None:
+        self.slot_index = int(idx)
+
+    def output_spec(self) -> TensorsSpec:
+        return self._spec.fixate() if not self._spec.is_fixed else self._spec
+
+    def _dummy_frame(self) -> Frame:
+        spec = self.output_spec()
+        arrays = tuple(
+            np.zeros(t.shape, dtype=t.dtype) for t in spec.tensors
+        )
+        return Frame(tensors=arrays, pts=0, duration=0)
+
+    def frames(self) -> Iterable[Frame]:
+        # Cycle bootstrap: first create emits zeros (tensor_reposrc.c:312-325).
+        yield self._dummy_frame()
+        seq = 0
+        my_spec = self.output_spec()
+        while not self.stopped:
+            frame, spec, seq, eos = self.repo.get_buffer(
+                self.slot_index, seq, timeout=0.1
+            )
+            if eos:
+                return
+            if frame is None:
+                continue  # poll timeout; re-check stop flag
+            if spec is not None and my_spec.intersect(spec) is None:
+                raise ValueError(
+                    f"{self.name}: repo slot {self.slot_index} spec {spec} "
+                    f"incompatible with caps {my_spec}"
+                )
+            yield frame
+
+    def interrupt(self) -> None:
+        self.request_stop()
+        # wake any waiter
+        self.repo.set_eos(self.slot_index)

@@ -1,0 +1,259 @@
+"""Pipeline: node container, spec negotiation, and the streaming scheduler.
+
+The analog of a GStreamer pipeline bin + its state machine, rebuilt as an
+explicit graph object:
+
+- :meth:`Pipeline.add` / :meth:`Pipeline.link` build the graph.
+- :meth:`Pipeline.start` opens resources, runs **topological two-phase spec
+  negotiation** (the analog of PAUSED-state caps negotiation,
+  ``tensor_filter.c:666-839``), then spawns one streaming thread per source
+  (GStreamer gives every source its own task thread, ``README.md:41-44``).
+- EOS from every leaf marks completion; :meth:`Pipeline.wait` blocks on it.
+- An exception in any node's chain posts an error and halts the graph
+  (``GST_ELEMENT_ERROR`` semantics, ``tensor_filter.c:413-435``).
+
+Cycles are allowed in the *link* graph only through repo slots
+(reposrc/reposink pairs share a slot out-of-band, §3.4 of the survey), so
+the negotiation pass always sees a DAG.
+"""
+
+from __future__ import annotations
+
+import threading
+import traceback
+from typing import Dict, List, Optional, Union
+
+from ..buffer import Event, Frame
+from .node import NegotiationError, Node, Pad, SourceNode
+
+
+class PipelineError(Exception):
+    pass
+
+
+class Pipeline:
+    def __init__(self, name: str = "pipeline"):
+        self.name = name
+        self.nodes: Dict[str, Node] = {}
+        self.state = "NULL"  # NULL → PLAYING → STOPPED
+        self.threads: List[threading.Thread] = []
+        self._eos_leaves: set = set()
+        self._leaves: set = set()
+        self._done = threading.Event()
+        self._error: Optional[BaseException] = None
+        self._error_node: Optional[str] = None
+        self._lock = threading.Lock()
+
+    # -- graph construction -------------------------------------------------
+
+    def add(self, *nodes: Node) -> Union[Node, tuple]:
+        for node in nodes:
+            if node.name in self.nodes:
+                raise ValueError(f"duplicate node name {node.name!r}")
+            self.nodes[node.name] = node
+            node.pipeline = self
+        return nodes[0] if len(nodes) == 1 else nodes
+
+    def __getitem__(self, name: str) -> Node:
+        return self.nodes[name]
+
+    def _resolve(self, ref: Union[Node, str]) -> (Node, Optional[str]):
+        """Resolve 'node' or 'node.pad' references."""
+        if isinstance(ref, Node):
+            return ref, None
+        if "." in ref:
+            node_name, _, pad_name = ref.partition(".")
+            return self.nodes[node_name], pad_name
+        return self.nodes[ref], None
+
+    def link(self, src: Union[Node, str], dst: Union[Node, str]) -> None:
+        """Link src's src pad to dst's sink pad; 'name.pad' selects pads."""
+        src_node, src_pad = self._resolve(src)
+        dst_node, dst_pad = self._resolve(dst)
+        src_node.get_src_pad(src_pad).link(dst_node.get_sink_pad(dst_pad))
+
+    def link_chain(self, *nodes: Union[Node, str]) -> None:
+        for a, b in zip(nodes, nodes[1:]):
+            self.link(a, b)
+
+    # -- negotiation --------------------------------------------------------
+
+    def negotiate(self) -> None:
+        """Topological two-phase spec negotiation over the whole graph."""
+        pending = set(self.nodes.values())
+        configured: set = set()
+
+        def linked_sinks(node: Node) -> List[Pad]:
+            return [p for p in node.sink_pads.values() if p.peer is not None]
+
+        progress = True
+        while pending and progress:
+            progress = False
+            for node in list(pending):
+                sinks = linked_sinks(node)
+                if any(p.spec is None for p in sinks):
+                    continue
+                in_specs = {}
+                for pad in sinks:
+                    template = node.sink_spec(pad.name)
+                    merged = template.intersect(pad.spec)
+                    if merged is None:
+                        raise NegotiationError(
+                            f"{pad.full_name}: upstream spec {pad.spec} not accepted "
+                            f"(template {template})"
+                        )
+                    in_specs[pad.name] = merged
+                out_specs = node.configure(in_specs)
+                for pad_name, pad in node.src_pads.items():
+                    if pad.peer is None:
+                        continue
+                    spec = out_specs.get(pad_name)
+                    if spec is None:
+                        raise NegotiationError(
+                            f"{node.name}: configure() returned no spec for linked "
+                            f"src pad {pad_name!r}"
+                        )
+                    pad.spec = spec
+                    pad.peer.spec = spec
+                pending.discard(node)
+                configured.add(node)
+                progress = True
+        if pending:
+            names = ", ".join(sorted(n.name for n in pending))
+            raise NegotiationError(
+                f"negotiation stalled (cycle or dangling inputs): {names}"
+            )
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> "Pipeline":
+        if self.state == "PLAYING":
+            return self
+        self._done.clear()
+        self._error = None
+        self._eos_leaves.clear()
+        for node in self.nodes.values():
+            for pad in list(node.sink_pads.values()) + list(node.src_pads.values()):
+                pad.eos = False
+        started = []
+        try:
+            for node in self.nodes.values():
+                node.start()
+                started.append(node)
+            self.negotiate()
+        except BaseException:
+            for node in started:
+                try:
+                    node.stop()
+                except Exception:
+                    pass
+            raise
+        self._leaves = {
+            n.name
+            for n in self.nodes.values()
+            if not any(p.peer is not None for p in n.src_pads.values())
+        }
+        if not self._leaves:
+            raise PipelineError("pipeline has no leaf (sink) nodes")
+        self.state = "PLAYING"
+        # Spawn worker threads requested by nodes (queues), then sources.
+        for node in self.nodes.values():
+            spawn = getattr(node, "spawn_threads", None)
+            if spawn is not None:
+                for t in spawn():
+                    t.daemon = True
+                    self.threads.append(t)
+                    t.start()
+        for node in self.nodes.values():
+            if isinstance(node, SourceNode):
+                t = threading.Thread(
+                    target=self._source_loop, args=(node,), name=f"src:{node.name}",
+                    daemon=True,
+                )
+                self.threads.append(t)
+                t.start()
+        return self
+
+    def _source_loop(self, node: SourceNode) -> None:
+        try:
+            for frame in node.frames():
+                if node.stopped or self.state != "PLAYING":
+                    break
+                node.push(frame)
+            for pad in node.src_pads.values():
+                pad.push(Event.eos())
+        except BaseException as exc:  # noqa: BLE001 - report any node failure
+            self.post_error(node, exc)
+
+    def post_error(self, node: Node, exc: BaseException) -> None:
+        with self._lock:
+            if self._error is None:
+                self._error = exc
+                self._error_node = node.name if node else None
+        traceback.print_exception(type(exc), exc, exc.__traceback__)
+        self._done.set()
+
+    def _node_eos(self, node: Node) -> None:
+        """Called by a node whose every sink pad saw EOS and which has no
+        linked src pads (a leaf)."""
+        if any(p.peer is not None for p in node.src_pads.values()):
+            return
+        with self._lock:
+            self._eos_leaves.add(node.name)
+            if self._leaves and self._eos_leaves >= self._leaves:
+                self._done.set()
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        """Block until EOS on all leaves (or error).  Returns True on EOS,
+        raises on error, False on timeout."""
+        finished = self._done.wait(timeout)
+        if self._error is not None:
+            raise PipelineError(
+                f"error in node {self._error_node!r}: {self._error!r}"
+            ) from self._error
+        return finished
+
+    def stop(self) -> None:
+        if self.state != "PLAYING":
+            self.state = "STOPPED"
+            return
+        self.state = "STOPPED"
+        for node in self.nodes.values():
+            if isinstance(node, SourceNode):
+                node.request_stop()
+            interrupt = getattr(node, "interrupt", None)
+            if interrupt is not None:
+                interrupt()
+        for t in self.threads:
+            t.join(timeout=5.0)
+        self.threads.clear()
+        for node in self.nodes.values():
+            node.stop()
+
+    def run(self, timeout: Optional[float] = None) -> None:
+        """start() + wait() + stop() — convenience for finite streams."""
+        self.start()
+        try:
+            if not self.wait(timeout):
+                raise PipelineError(f"pipeline did not finish within {timeout}s")
+        finally:
+            self.stop()
+
+    # -- introspection ------------------------------------------------------
+
+    def to_dot(self) -> str:
+        """Graphviz dump of the graph with negotiated specs — the analog of
+        GST_DEBUG_DUMP_DOT_DIR pipeline dumps (``tools/debugging/``)."""
+        lines = [f'digraph "{self.name}" {{', "  rankdir=LR;", "  node [shape=box];"]
+        for node in self.nodes.values():
+            lines.append(f'  "{node.name}" [label="{node.name}\\n{type(node).__name__}"];')
+        for node in self.nodes.values():
+            for pad in node.src_pads.values():
+                if pad.peer is not None:
+                    label = str(pad.spec) if pad.spec is not None else ""
+                    lines.append(
+                        f'  "{node.name}" -> "{pad.peer.node.name}" '
+                        f'[label="{pad.name}→{pad.peer.name}\\n{label}"];'
+                    )
+        lines.append("}")
+        return "\n".join(lines)

@@ -1,0 +1,1 @@
+from .mesh import batch_sharding, make_mesh, replicated  # noqa: F401

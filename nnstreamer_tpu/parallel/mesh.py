@@ -1,0 +1,46 @@
+"""Device-mesh helpers: the TPU-native replacement for the reference's
+per-backend accelerator offload (survey §2.6).
+
+The reference never shards — one Interpreter per element, NNAPI/Movidius
+offload per frame.  Here parallel invocation is first-class: a
+:func:`make_mesh` over the chip's cores (or a CPU-device mesh in tests via
+``XLA_FLAGS=--xla_force_host_platform_device_count=N``), batch sharding via
+``NamedSharding`` and XLA-inserted collectives over ICI.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_mesh(
+    shape: Optional[Sequence[int]] = None,
+    axis_names: Tuple[str, ...] = ("dp",),
+    devices=None,
+) -> Mesh:
+    """Build a mesh over available devices.  Default: 1-D data-parallel mesh
+    over all devices."""
+    devices = list(devices if devices is not None else jax.devices())
+    if shape is None:
+        shape = (len(devices),) + (1,) * (len(axis_names) - 1)
+    n = 1
+    for s in shape:
+        n *= s
+    if n > len(devices):
+        raise ValueError(f"mesh shape {shape} needs {n} devices, have {len(devices)}")
+    import numpy as np
+
+    arr = np.array(devices[:n]).reshape(shape)
+    return Mesh(arr, axis_names)
+
+
+def batch_sharding(mesh: Mesh, rank: int, axis: str = "dp") -> NamedSharding:
+    """Shard the leading (batch) dim over ``axis``, replicate the rest."""
+    return NamedSharding(mesh, P(axis, *([None] * (rank - 1))))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())

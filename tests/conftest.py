@@ -1,0 +1,43 @@
+"""Test configuration: CPU-backed JAX with a virtual 8-device mesh.
+
+The reference runs its whole test suite without special hardware (survey §4);
+our analog is ``XLA_FLAGS=--xla_force_host_platform_device_count=8`` +
+``JAX_PLATFORMS=cpu`` so sharding/mux-batching tests exercise real
+multi-device code paths in CI without TPUs.  Must be set before jax imports.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+# The environment may import jax before this file runs (sitecustomize
+# registering a PJRT plugin); env vars alone are then too late, but the
+# config API still works as long as no backend has initialized.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(42)
+
+
+@pytest.fixture(autouse=True)
+def _reset_global_state():
+    """Isolate tests from the process-global repo slots / profiling."""
+    yield
+    from nnstreamer_tpu.elements.repo import GLOBAL_REPO
+    from nnstreamer_tpu.utils import profiling
+
+    GLOBAL_REPO.reset()
+    profiling.reset()
+    profiling.enable(False)

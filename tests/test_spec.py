@@ -1,0 +1,132 @@
+"""Type-system tests: the analog of the reference's ``unittest_common.cpp``
+(parse/print dims, types, caps equality/intersection, ``:26-215``)."""
+
+from fractions import Fraction
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.spec import (
+    NNS_TENSOR_SIZE_LIMIT,
+    TensorSpec,
+    TensorsSpec,
+    dtype_from_name,
+    dtype_name,
+    supported_dtypes,
+)
+
+
+class TestDtypes:
+    def test_all_reference_dtypes_supported(self):
+        # the reference's 10 types (tensor_typedef.h:85-99)
+        for name in (
+            "int8", "uint8", "int16", "uint16", "int32", "uint32",
+            "int64", "uint64", "float32", "float64",
+        ):
+            assert dtype_name(dtype_from_name(name)) == name
+
+    def test_tpu_dtypes(self):
+        assert "bfloat16" in supported_dtypes()
+        assert "float16" in supported_dtypes()
+
+    def test_unknown_dtype_rejected(self):
+        with pytest.raises(ValueError):
+            dtype_from_name("complex64")
+
+
+class TestDimStrings:
+    def test_parse_dims_innermost_first(self):
+        # NNS "3:224:224:1" == numpy (224, 224, 3)
+        t = TensorSpec.from_dims_string("3:224:224:1", "uint8")
+        assert t.shape == (224, 224, 3)
+        assert t.dtype == np.uint8
+
+    def test_roundtrip_padded_to_rank4(self):
+        t = TensorSpec.from_dims_string("3:224:224:1")
+        assert t.dims_string() == "3:224:224:1"
+
+    def test_trailing_ones_squeezed(self):
+        t = TensorSpec.from_dims_string("10:1:1:1")
+        assert t.shape == (10,)
+        assert t.dims_string() == "10:1:1:1"
+
+    def test_bad_dims_rejected(self):
+        with pytest.raises(ValueError):
+            TensorSpec.from_dims_string("3:0:2")
+        with pytest.raises(ValueError):
+            TensorSpec.from_dims_string("1:2:3:4:5")
+        with pytest.raises(ValueError):
+            TensorSpec.from_dims_string("")
+
+    def test_nbytes(self):
+        t = TensorSpec.from_dims_string("3:4:2", "float32")
+        assert t.num_elements == 24
+        assert t.nbytes == 96
+
+
+class TestIntersection:
+    def test_partial_dims_merge(self):
+        a = TensorSpec(dtype=np.float32, shape=(None, 224, 3))
+        b = TensorSpec(shape=(1, 224, None))
+        m = a.intersect(b)
+        assert m.shape == (1, 224, 3)
+        assert m.dtype == np.float32
+
+    def test_conflicting_dims(self):
+        a = TensorSpec(shape=(224,))
+        b = TensorSpec(shape=(225,))
+        assert a.intersect(b) is None
+
+    def test_conflicting_dtype(self):
+        a = TensorSpec(dtype=np.float32)
+        b = TensorSpec(dtype=np.uint8)
+        assert a.intersect(b) is None
+
+    def test_rank_mismatch(self):
+        a = TensorSpec(shape=(2, 3))
+        b = TensorSpec(shape=(2, 3, 4))
+        assert a.intersect(b) is None
+
+    def test_fixate(self):
+        t = TensorSpec(dtype=None, shape=(None, 4)).fixate()
+        assert t.is_fixed
+        assert t.shape == (1, 4)
+
+
+class TestTensorsSpec:
+    def test_limit_16(self):
+        with pytest.raises(ValueError):
+            TensorsSpec(tensors=tuple(TensorSpec() for _ in range(17)))
+        TensorsSpec(tensors=tuple(TensorSpec() for _ in range(NNS_TENSOR_SIZE_LIMIT)))
+
+    def test_caps_roundtrip_single(self):
+        s = TensorsSpec.of(
+            TensorSpec.from_dims_string("3:224:224:1", "uint8"), rate=Fraction(30)
+        )
+        caps = s.to_caps_string()
+        assert "other/tensor" in caps and "3:224:224:1" in caps
+        back = TensorsSpec.from_caps_string(caps)
+        assert back == s
+
+    def test_caps_roundtrip_multi(self):
+        s = TensorsSpec.of(
+            TensorSpec.from_dims_string("4:1917:1:1", "float32"),
+            TensorSpec.from_dims_string("91:1917:1:1", "float32"),
+            rate=Fraction(0),
+        )
+        caps = s.to_caps_string()
+        assert "other/tensors" in caps and "num_tensors=(int)2" in caps
+        back = TensorsSpec.from_caps_string(caps)
+        assert back == s
+
+    def test_intersect_rate(self):
+        a = TensorsSpec.of(TensorSpec(dtype=np.uint8), rate=Fraction(30))
+        b = TensorsSpec.of(TensorSpec(dtype=np.uint8))
+        assert a.intersect(b).rate == Fraction(30)
+        c = TensorsSpec.of(TensorSpec(dtype=np.uint8), rate=Fraction(15))
+        assert a.intersect(c) is None
+
+    def test_from_arrays(self):
+        s = TensorsSpec.from_arrays([np.zeros((2, 3), np.int16)])
+        assert s.tensors[0].shape == (2, 3)
+        assert s.tensors[0].dtype == np.int16
