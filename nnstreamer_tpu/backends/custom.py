@@ -89,7 +89,20 @@ class _ObjectBackend(FilterBackend):
         setter = getattr(self.obj, "set_input_spec", None)
         if setter is not None:
             return setter(in_spec)
-        return super().reconfigure(in_spec)
+        if self.output_spec() is not None:
+            return super().reconfigure(in_spec)
+        # No spec info at all (bare callable): probe with a zero frame —
+        # the ergonomic equivalent of requiring setInputDim in the
+        # reference's custom vtable.
+        import numpy as np
+
+        if not in_spec.is_fixed:
+            in_spec = in_spec.fixate()
+        dummies = tuple(
+            np.zeros(t.shape, dtype=t.dtype) for t in in_spec.tensors
+        )
+        outs = self.invoke(dummies)
+        return TensorsSpec.from_arrays(outs)
 
     def invoke(self, tensors: Tuple) -> Tuple:
         return _wrap_outputs(self.obj.invoke(*tensors))

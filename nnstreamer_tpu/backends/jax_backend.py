@@ -155,7 +155,7 @@ class JaxBackend(FilterBackend):
     def output_spec(self) -> Optional[TensorsSpec]:
         if self._out_spec is not None:
             return self._out_spec
-        if self._in_spec is not None and self._in_spec.is_fixed:
+        if self._in_spec is not None and self._in_spec.tensors_fixed:
             outs = jax.eval_shape(self._fn, *_as_shape_structs(self._in_spec))
             self._out_spec = _spec_from_outputs(
                 outs if isinstance(outs, (tuple, list)) else (outs,)
@@ -189,7 +189,7 @@ class JaxBackend(FilterBackend):
                     f"model spec {mine}"
                 )
             in_spec = merged
-        if not in_spec.is_fixed:
+        if not in_spec.tensors_fixed:
             in_spec = in_spec.fixate()
         return self._compile(in_spec)
 

@@ -50,29 +50,46 @@ class CollectNode(Node):
             if len(parts) > 1:
                 self._base_tolerance = int(parts[1])
         self._queues: Dict[str, collections.deque] = {}
+        self._finished = False
 
     # -- collection ---------------------------------------------------------
 
     def _pad_order(self) -> List[str]:
         return sorted(self._queues, key=lambda n: (len(n), n))  # sink_0 < sink_1 < sink_10
 
+    def _linked_sinks(self) -> List[Pad]:
+        return [p for p in self.sink_pads.values() if p.peer is not None]
+
     def _handle_frame(self, pad: Pad, frame: Frame) -> None:
+        if self._finished:
+            return  # stream already ended (a pad ran dry)
         self._queues.setdefault(pad.name, collections.deque()).append(frame)
         self._try_collect()
 
     def _ready(self) -> bool:
-        for pad in self.sink_pads.values():
-            if pad.peer is None:
-                continue
-            q = self._queues.get(pad.name)
-            if q:
-                continue
-            if not pad.eos:
+        for pad in self._linked_sinks():
+            if not self._queues.get(pad.name):
                 return False
         return True
 
+    def _exhausted(self) -> bool:
+        """A pad at EOS with an empty queue can never complete another set —
+        the muxed stream ends (gst_tensor_mux_collected's NULL-buffer EOS)."""
+        return any(
+            pad.eos and not self._queues.get(pad.name)
+            for pad in self._linked_sinks()
+        )
+
+    def _finish_stream(self) -> None:
+        if self._finished:
+            return
+        self._finished = True
+        for spad in self.src_pads.values():
+            spad.push(Event.eos())
+        if self.pipeline is not None:
+            self.pipeline._node_eos(self)  # no-op unless we are a leaf
+
     def _active_queues(self) -> List[Tuple[str, collections.deque]]:
-        """Queues that still have data (EOS+empty pads drop out of sync)."""
         out = []
         for name in self._pad_order():
             q = self._queues[name]
@@ -98,7 +115,12 @@ class CollectNode(Node):
         return ts
 
     def _try_collect(self) -> None:
-        while self._ready():
+        while True:
+            if self._exhausted():
+                self._finish_stream()
+                return
+            if not self._ready():
+                return
             active = self._active_queues()
             if not active:
                 return
@@ -161,12 +183,19 @@ class CollectNode(Node):
     def _handle_event(self, pad: Pad, event: Event) -> None:
         if event.kind == "eos":
             pad.eos = True
-            # An EOS pad may unblock a pending collection round.
-            self._try_collect()
-            if all(p.eos for p in self.sink_pads.values() if p.peer is not None):
-                self._on_eos()
+            # An EOS pad may unblock a pending collection round (a laggard
+            # check waiting for newer data) before ending the stream.
+            if not self._finished:
+                self._try_collect()
+            if all(p.eos for p in self._linked_sinks()):
+                self._finish_stream()
         else:
             self.on_event(pad, event)
+
+    def start(self) -> None:
+        super().start()
+        self._finished = False
+        self._queues.clear()
 
     # -- to be provided by subclasses ---------------------------------------
 

@@ -32,18 +32,23 @@ from ..spec import TensorsSpec
 
 
 class _Slot:
-    __slots__ = ("cond", "frame", "spec", "seq", "eos")
+    __slots__ = ("cond", "frame", "spec", "eos")
 
     def __init__(self):
         self.cond = threading.Condition()
         self.frame: Optional[Frame] = None
         self.spec: Optional[TensorsSpec] = None
-        self.seq = 0
         self.eos = False
 
 
 class TensorRepo:
-    """Process-global slot registry (the ``_GstTensorRepo`` singleton)."""
+    """Process-global slot registry (the ``_GstTensorRepo`` singleton).
+
+    Each slot is a lossless single-frame handoff: ``set_buffer`` blocks while
+    an unconsumed frame is pending (the push condvar) and ``get_buffer``
+    blocks until one arrives (the pull condvar) — the two-condition discipline
+    of ``tensor_repo.h:77-92`` that makes cycles flow frame-for-frame.
+    """
 
     def __init__(self):
         self._slots: Dict[int, _Slot] = {}
@@ -55,33 +60,61 @@ class TensorRepo:
                 self._slots[idx] = _Slot()
             return self._slots[idx]
 
-    def set_buffer(self, idx: int, frame: Frame, spec: Optional[TensorsSpec]) -> None:
+    def set_buffer(
+        self,
+        idx: int,
+        frame: Frame,
+        spec: Optional[TensorsSpec],
+        poll: float = 0.1,
+        should_abort=None,
+    ) -> bool:
+        """Publish one frame; blocks until the previous one is consumed.
+        Returns False if the slot reached EOS instead."""
         s = self.slot(idx)
         with s.cond:
+            while s.frame is not None and not s.eos:
+                s.cond.wait(poll)
+                if should_abort is not None and should_abort():
+                    return False
+            if s.eos:
+                return False
             s.frame = frame
             s.spec = spec
-            s.seq += 1
             s.cond.notify_all()
+            return True
 
     def get_buffer(
-        self, idx: int, last_seq: int, timeout: Optional[float] = None
-    ) -> Tuple[Optional[Frame], Optional[TensorsSpec], int, bool]:
-        """Block until a frame newer than ``last_seq`` or EOS.
-        Returns (frame, spec, seq, eos)."""
+        self, idx: int, timeout: Optional[float] = None
+    ) -> Tuple[Optional[Frame], Optional[TensorsSpec], bool]:
+        """Consume the pending frame (blocking).  Returns (frame, spec, eos);
+        (None, None, False) on poll timeout."""
         s = self.slot(idx)
         with s.cond:
-            while s.seq <= last_seq and not s.eos:
+            while s.frame is None and not s.eos:
                 if not s.cond.wait(timeout if timeout is not None else 0.1):
                     if timeout is not None:
-                        return None, None, last_seq, s.eos
-            if s.eos and s.seq <= last_seq:
-                return None, None, last_seq, True
-            return s.frame, s.spec, s.seq, False
+                        return None, None, s.eos
+            if s.frame is None and s.eos:
+                return None, None, True
+            frame, spec = s.frame, s.spec
+            s.frame = None
+            s.cond.notify_all()
+            return frame, spec, False
 
     def set_eos(self, idx: int) -> None:
         s = self.slot(idx)
         with s.cond:
             s.eos = True
+            s.cond.notify_all()
+
+    def clear(self, idx: int) -> None:
+        """Reset a slot for a fresh run (the reference removes repo data on
+        element stop); EOS from a previous run must not poison the next."""
+        s = self.slot(idx)
+        with s.cond:
+            s.frame = None
+            s.spec = None
+            s.eos = False
             s.cond.notify_all()
 
     def reset(self, idx: Optional[int] = None) -> None:
@@ -118,14 +151,41 @@ class TensorRepoSink(SinkTerminal):
         self._spec = in_specs["sink"]
         return {}
 
+    def start(self) -> None:
+        super().start()
+        self.repo.clear(self.slot_index)
+        self.dropped = 0
+
     def process(self, pad: Pad, frame: Frame):
         del pad
-        self.repo.set_buffer(self.slot_index, frame, self._spec)
+        ok = self.repo.set_buffer(
+            self.slot_index,
+            frame,
+            self._spec,
+            should_abort=lambda: self.pipeline is not None
+            and self.pipeline.state == "STOPPED",
+        )
+        if not ok:
+            # Consumer side ended (slot at EOS) or we aborted: the frame was
+            # NOT published.  Surface it rather than vanish silently.
+            self.dropped += 1
+            if self.dropped == 1:
+                import warnings
+
+                warnings.warn(
+                    f"{self.name}: repo slot {self.slot_index} is at EOS; "
+                    "dropping published frames",
+                    RuntimeWarning,
+                    stacklevel=2,
+                )
         return None
 
     def drain(self):
         self.repo.set_eos(self.slot_index)
         return None
+
+    def interrupt(self) -> None:
+        self.repo.set_eos(self.slot_index)
 
 
 @register_element("tensor_reposrc")
@@ -150,6 +210,15 @@ class TensorRepoSrc(SourceNode):
     def set_slot(self, idx: int) -> None:
         self.slot_index = int(idx)
 
+    def start(self) -> None:
+        super().start()
+        # Un-poison EOS left by a previous run's interrupt(); keep any
+        # pending frame (a producer may legitimately have published already).
+        s = self.repo.slot(self.slot_index)
+        with s.cond:
+            s.eos = False
+            s.cond.notify_all()
+
     def output_spec(self) -> TensorsSpec:
         return self._spec.fixate() if not self._spec.is_fixed else self._spec
 
@@ -163,12 +232,9 @@ class TensorRepoSrc(SourceNode):
     def frames(self) -> Iterable[Frame]:
         # Cycle bootstrap: first create emits zeros (tensor_reposrc.c:312-325).
         yield self._dummy_frame()
-        seq = 0
         my_spec = self.output_spec()
         while not self.stopped:
-            frame, spec, seq, eos = self.repo.get_buffer(
-                self.slot_index, seq, timeout=0.1
-            )
+            frame, spec, eos = self.repo.get_buffer(self.slot_index, timeout=0.1)
             if eos:
                 return
             if frame is None:

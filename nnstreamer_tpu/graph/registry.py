@@ -42,15 +42,17 @@ def _lazy_builtin(name: str, module: str) -> None:
     _BUILTIN_MODULES[name] = module
 
 
-def make(name: str, element_name: Optional[str] = None, **props) -> Node:
-    """Instantiate an element by registered name (``gst_element_factory_make``)."""
-    factory = _FACTORIES.get(name)
-    if factory is None and name in _BUILTIN_MODULES:
-        importlib.import_module(_BUILTIN_MODULES[name])
-        factory = _FACTORIES.get(name)
+def make(factory_name: str, /, element_name: Optional[str] = None, **props) -> Node:
+    """Instantiate an element by registered name (``gst_element_factory_make``).
+    The instance name may come as ``name=`` (gst-property style) or
+    ``element_name=``."""
+    factory = _FACTORIES.get(factory_name)
+    if factory is None and factory_name in _BUILTIN_MODULES:
+        importlib.import_module(_BUILTIN_MODULES[factory_name])
+        factory = _FACTORIES.get(factory_name)
     if factory is None:
         raise ValueError(
-            f"unknown element {name!r}; known: {sorted(known_elements())}"
+            f"unknown element {factory_name!r}; known: {sorted(known_elements())}"
         )
     if element_name is not None:
         props["name"] = element_name

@@ -292,12 +292,13 @@ class TensorsSpec:
         return len(self.tensors)
 
     @property
+    def tensors_fixed(self) -> bool:
+        """All tensor dtypes/shapes concrete (rate may stay open)."""
+        return len(self.tensors) > 0 and all(t.is_fixed for t in self.tensors)
+
+    @property
     def is_fixed(self) -> bool:
-        return (
-            len(self.tensors) > 0
-            and all(t.is_fixed for t in self.tensors)
-            and self.rate is not None
-        )
+        return self.tensors_fixed and self.rate is not None
 
     def intersect(self, other: "TensorsSpec") -> Optional["TensorsSpec"]:
         if self.tensors and other.tensors:

@@ -1,0 +1,171 @@
+"""Decoder subplugin tests — the analog of the SSAT ``decoder*`` dirs:
+golden outputs computed with independent numpy, per survey §4."""
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu import Pipeline
+from nnstreamer_tpu.buffer import Frame
+from nnstreamer_tpu.elements.decoder import TensorDecoder, known_decoders
+from nnstreamer_tpu.elements.sink import TensorSink
+from nnstreamer_tpu.elements.testsrc import DataSrc
+
+
+def run_decoder(data, mode, **options):
+    p = Pipeline()
+    src = p.add(DataSrc(data=data))
+    dec = p.add(TensorDecoder(mode=mode, **options))
+    sink = p.add(TensorSink(collect=True))
+    p.link_chain(src, dec, sink)
+    p.run(timeout=20)
+    return sink
+
+
+class TestImageLabeling:
+    def test_argmax_label(self, tmp_path):
+        labels = tmp_path / "labels.txt"
+        labels.write_text("cat\ndog\nbird\n")
+        scores = np.array([0.1, 0.9, 0.3], np.float32)
+        sink = run_decoder([scores], "image_labeling", option1=str(labels))
+        f = sink.frames[0]
+        assert f.meta["label"] == "dog"
+        assert f.meta["label_index"] == 1
+        assert bytes(f.tensor(0)).decode() == "dog"
+
+    def test_no_label_file_uses_index(self):
+        scores = np.array([5, 1, 2], np.uint8)
+        sink = run_decoder([scores], "image_labeling")
+        assert sink.frames[0].meta["label"] == "0"
+
+
+class TestBoundingBoxes:
+    @pytest.fixture
+    def priors_file(self, tmp_path):
+        # 4 rows (ycenter, xcenter, h, w) × 4 boxes on a unit grid
+        f = tmp_path / "priors.txt"
+        rows = [
+            "0.25 0.25 0.75 0.75",  # ycenter
+            "0.25 0.75 0.25 0.75",  # xcenter
+            "0.5 0.5 0.5 0.5",      # h
+            "0.5 0.5 0.5 0.5",      # w
+        ]
+        f.write_text("\n".join(rows))
+        return str(f)
+
+    def test_tflite_ssd_decode(self, priors_file):
+        # box 2 (ycenter .75, xcenter .25) detects class 1 strongly:
+        # raw score 4.0 → sigmoid ≈ .982; others far below threshold
+        locations = np.zeros((4, 4), np.float32)  # centered on priors
+        scores = np.full((4, 3), -10.0, np.float32)
+        scores[2, 1] = 4.0
+        sink = run_decoder(
+            [Frame.of(locations, scores)],
+            "bounding_boxes",
+            option1="tflite-ssd",
+            option3=priors_file,
+            option4="100:100",
+            option5="100:100",
+        )
+        f = sink.frames[0]
+        objs = f.meta["objects"]
+        assert len(objs) == 1
+        o = objs[0]
+        assert o.class_id == 1
+        # golden: ymin = .75 - .25 = .5 → y=50; xmin = .25-.25=0 → x=0
+        assert (o.x, o.y, o.width, o.height) == (0, 50, 50, 50)
+        assert abs(o.prob - 1 / (1 + np.exp(-4.0))) < 1e-6
+        # overlay canvas has the rect border drawn
+        canvas = f.tensor(0)
+        assert canvas.shape == (100, 100, 4)
+        assert canvas[50, 25, 3] == 255  # top border pixel opaque
+        assert canvas[0, 0, 3] == 0  # background transparent
+
+    def test_nms_suppresses_overlaps(self, priors_file):
+        # two boxes at the same prior location, same class → NMS keeps 1
+        locations = np.zeros((4, 4), np.float32)
+        scores = np.full((4, 3), -10.0, np.float32)
+        scores[0, 1] = 4.0
+        scores[1, 1] = 3.0
+        # make box 1 sit on box 0's prior (offset toward it)
+        # prior0 (y.25,x.25), prior1 (y.25,x.75): move box1 left by 0.5
+        # xcenter = loc/X_SCALE * w_prior + prior_x → loc = (0.25-0.75)*10/0.5 = -10
+        locations[1, 1] = -10.0
+        sink = run_decoder(
+            [Frame.of(locations, scores)],
+            "bounding_boxes",
+            option1="tflite-ssd",
+            option3=priors_file,
+            option4="100:100",
+            option5="100:100",
+        )
+        objs = sink.frames[0].meta["objects"]
+        assert len(objs) == 1
+        assert abs(objs[0].prob - 1 / (1 + np.exp(-4.0))) < 1e-6
+
+    def test_tf_ssd_decode(self):
+        num = np.array([2], np.float32)
+        classes = np.array([1, 3], np.float32)
+        scores = np.array([0.9, 0.2], np.float32)  # second below threshold
+        boxes = np.array([[0.125, 0.25, 0.5, 0.625], [0, 0, 1, 1]], np.float32)
+        sink = run_decoder(
+            [Frame.of(num, classes, scores, boxes)],
+            "bounding_boxes",
+            option1="tf-ssd",
+            option4="200:200",
+            option5="100:100",
+        )
+        objs = sink.frames[0].meta["objects"]
+        assert len(objs) == 1
+        o = objs[0]
+        assert o.class_id == 1
+        assert (o.x, o.y, o.width, o.height) == (25, 12, 37, 37)
+
+
+class TestPose:
+    def test_keypoint_argmax_and_skeleton(self):
+        grid = np.zeros((16, 16, 14), np.float32)
+        # place each keypoint k at (x=k, y=k)
+        for k in range(14):
+            grid[k, k, k] = 1.0
+        sink = run_decoder(
+            [grid], "pose_estimation", option1="64:64", option2="16:16"
+        )
+        f = sink.frames[0]
+        kps = f.meta["pose"]
+        assert [(x, y) for x, y, _ in kps] == [(k, k) for k in range(14)]
+        canvas = f.tensor(0)
+        assert canvas.shape == (64, 64, 4)
+        # the diagonal skeleton edge 0-1 passes through scaled points
+        assert canvas[0, 0, 3] == 255
+        assert canvas[4, 4, 3] == 255
+
+
+class TestDirectVideo:
+    def test_rgb_passthrough(self, rng):
+        img = rng.integers(0, 255, (8, 8, 3), dtype=np.uint8)
+        sink = run_decoder([img], "direct_video")
+        f = sink.frames[0]
+        np.testing.assert_array_equal(f.tensor(0), img)
+        assert f.meta["media"].format == "RGB"
+
+    def test_bad_dtype_fails(self):
+        from nnstreamer_tpu import NegotiationError
+
+        p = Pipeline()
+        src = p.add(DataSrc(data=[np.zeros((4, 4, 3), np.float32)]))
+        dec = p.add(TensorDecoder(mode="direct_video"))
+        sink = p.add(TensorSink())
+        p.link_chain(src, dec, sink)
+        with pytest.raises(NegotiationError):
+            p.start()
+        p.stop()
+
+
+def test_known_decoders():
+    for mode in ("direct_video", "image_labeling", "bounding_boxes", "pose_estimation"):
+        assert mode in known_decoders()
+
+
+def test_unknown_mode_rejected():
+    with pytest.raises(ValueError):
+        TensorDecoder(mode="nope")

@@ -1,0 +1,235 @@
+"""``tensor_filter`` + backend tests: custom filters, the JAX/XLA backend,
+spec reconciliation — the analog of the SSAT ``filter_*`` dirs and the
+single-element filter cases in ``unittest_sink.cpp``."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from nnstreamer_tpu import NegotiationError, Pipeline
+from nnstreamer_tpu.backends.base import get_backend, known_backends
+from nnstreamer_tpu.backends.custom import (
+    CustomFilterBase,
+    register_custom_easy,
+    unregister_custom_easy,
+)
+from nnstreamer_tpu.backends.jax_backend import JaxModel
+from nnstreamer_tpu.buffer import Frame
+from nnstreamer_tpu.elements.filter import TensorFilter
+from nnstreamer_tpu.elements.sink import TensorSink
+from nnstreamer_tpu.elements.testsrc import DataSrc
+from nnstreamer_tpu.spec import TensorSpec, TensorsSpec
+
+
+def run_filter(data, **filter_kwargs):
+    p = Pipeline()
+    src = p.add(DataSrc(data=data))
+    filt = p.add(TensorFilter(**filter_kwargs))
+    sink = p.add(TensorSink(collect=True))
+    p.link_chain(src, filt, sink)
+    p.run(timeout=30)
+    return sink
+
+
+class TestCustomBackends:
+    def test_callable_passthrough(self, rng):
+        x = rng.standard_normal((4,)).astype(np.float32)
+        sink = run_filter([x], framework="custom", model=lambda t: t * 2)
+        np.testing.assert_allclose(sink.frames[0].tensor(0), x * 2, rtol=1e-6)
+
+    def test_object_with_specs(self, rng):
+        class Scaler(CustomFilterBase):
+            def get_input_spec(self):
+                return TensorsSpec.of(TensorSpec(dtype=np.float32, shape=(2, 2)))
+
+            def get_output_spec(self):
+                return TensorsSpec.of(TensorSpec(dtype=np.float32, shape=(2, 2)))
+
+            def invoke(self, x):
+                return x + 1
+
+        x = rng.standard_normal((2, 2)).astype(np.float32)
+        sink = run_filter([x], framework="custom", model=Scaler())
+        np.testing.assert_allclose(sink.frames[0].tensor(0), x + 1, rtol=1e-6)
+
+    def test_spec_mismatch_fails_negotiation(self, rng):
+        class Picky(CustomFilterBase):
+            def get_input_spec(self):
+                return TensorsSpec.of(TensorSpec(dtype=np.float32, shape=(7,)))
+
+            def get_output_spec(self):
+                return TensorsSpec.of(TensorSpec(dtype=np.float32, shape=(7,)))
+
+            def invoke(self, x):
+                return x
+
+        p = Pipeline()
+        src = p.add(DataSrc(data=[np.zeros((3,), np.float32)]))
+        filt = p.add(TensorFilter(framework="custom", model=Picky()))
+        sink = p.add(TensorSink())
+        p.link_chain(src, filt, sink)
+        with pytest.raises(NegotiationError):
+            p.start()
+        p.stop()
+
+    def test_custom_python_script(self, tmp_path, rng):
+        script = tmp_path / "filter.py"
+        script.write_text(
+            "import numpy as np\n"
+            "class CustomFilter:\n"
+            "    def set_input_spec(self, in_spec):\n"
+            "        return in_spec\n"
+            "    def invoke(self, x):\n"
+            "        return np.asarray(x)[::-1].copy()\n"
+        )
+        x = np.arange(5, dtype=np.float32)
+        sink = run_filter([x], framework="custom-python", model=str(script))
+        np.testing.assert_array_equal(sink.frames[0].tensor(0), x[::-1])
+
+    def test_custom_easy(self, rng):
+        spec = TensorsSpec.of(TensorSpec(dtype=np.float32, shape=(3,)))
+        register_custom_easy("negate", lambda x: -x, spec, spec)
+        try:
+            x = rng.standard_normal((3,)).astype(np.float32)
+            sink = run_filter([x], framework="custom-easy", model="negate")
+            np.testing.assert_allclose(sink.frames[0].tensor(0), -x, rtol=1e-6)
+        finally:
+            unregister_custom_easy("negate")
+
+    def test_multi_io(self, rng):
+        class TwoInOneOut(CustomFilterBase):
+            def set_input_spec(self, in_spec):
+                assert in_spec.num_tensors == 2
+                return TensorsSpec.of(in_spec.tensors[0])
+
+            def invoke(self, a, b):
+                return a + b
+
+        a = rng.standard_normal((3,)).astype(np.float32)
+        b = rng.standard_normal((3,)).astype(np.float32)
+        sink = run_filter(
+            [Frame.of(a, b)], framework="custom", model=TwoInOneOut()
+        )
+        np.testing.assert_allclose(sink.frames[0].tensor(0), a + b, rtol=1e-6)
+
+
+class TestJaxBackend:
+    def test_mlp_invoke(self, rng):
+        W = jnp.asarray(rng.standard_normal((8, 4)).astype(np.float32))
+        model = JaxModel(
+            apply=lambda p, x: jnp.tanh(x @ p),
+            params=W,
+            input_spec=TensorsSpec.of(TensorSpec(dtype=np.float32, shape=(2, 8))),
+        )
+        x = rng.standard_normal((2, 8)).astype(np.float32)
+        sink = run_filter([x], framework="jax", model=model)
+        out = np.asarray(sink.frames[0].tensor(0))
+        np.testing.assert_allclose(out, np.tanh(x @ np.asarray(W)), rtol=1e-4, atol=1e-6)
+
+    def test_output_spec_from_tracing(self):
+        model = JaxModel(
+            apply=lambda p, x: (x.sum(axis=-1), x * 2),
+            input_spec=TensorsSpec.of(TensorSpec(dtype=np.float32, shape=(3, 5))),
+        )
+        backend = get_backend("jax")
+        backend.open(model)
+        out = backend.output_spec()
+        assert out.num_tensors == 2
+        assert out.tensors[0].shape == (3,)
+        assert out.tensors[1].shape == (3, 5)
+
+    def test_polymorphic_batch_fixed_by_stream(self, rng):
+        # model leaves batch dim open; the stream's spec fixes it
+        model = JaxModel(
+            apply=lambda p, x: x.mean(axis=1),
+            input_spec=TensorsSpec.of(
+                TensorSpec(dtype=np.float32, shape=(None, 6))
+            ),
+        )
+        x = rng.standard_normal((4, 6)).astype(np.float32)
+        sink = run_filter([x], framework="jax", model=model)
+        assert sink.frames[0].tensor(0).shape == (4,)
+
+    def test_device_resident_output(self, rng):
+        import jax
+
+        model = JaxModel(
+            apply=lambda p, x: x + 1,
+            input_spec=TensorsSpec.of(TensorSpec(dtype=np.float32, shape=(4,))),
+        )
+        x = rng.standard_normal((4,)).astype(np.float32)
+        sink = run_filter([x], framework="jax", model=model)
+        out = sink.frames[0].tensor(0)
+        assert isinstance(out, jax.Array)  # stayed on device
+
+    def test_py_file_model(self, tmp_path, rng):
+        script = tmp_path / "model.py"
+        script.write_text(
+            "import numpy as np\n"
+            "import jax.numpy as jnp\n"
+            "from nnstreamer_tpu.backends.jax_backend import JaxModel\n"
+            "from nnstreamer_tpu.spec import TensorSpec, TensorsSpec\n"
+            "def get_model():\n"
+            "    return JaxModel(\n"
+            "        apply=lambda p, x: x * 3,\n"
+            "        input_spec=TensorsSpec.of(\n"
+            "            TensorSpec(dtype=np.float32, shape=(2,))),\n"
+            "    )\n"
+        )
+        x = rng.standard_normal((2,)).astype(np.float32)
+        sink = run_filter([x], framework="jax", model=str(script))
+        np.testing.assert_allclose(
+            np.asarray(sink.frames[0].tensor(0)), x * 3, rtol=1e-6
+        )
+
+
+class TestShardedBackend:
+    def test_batch_shards_across_mesh(self, rng):
+        import jax
+
+        n = len(jax.devices())
+        assert n == 8, "conftest must provide 8 virtual devices"
+        W = jnp.asarray(rng.standard_normal((6, 3)).astype(np.float32))
+        model = JaxModel(
+            apply=lambda p, x: x @ p,
+            params=W,
+            input_spec=TensorsSpec.of(TensorSpec(dtype=np.float32, shape=(8, 6))),
+        )
+        x = rng.standard_normal((8, 6)).astype(np.float32)
+        sink = run_filter(
+            [x], framework="jax-sharded", model=model, custom="devices=8,axis=dp"
+        )
+        out = sink.frames[0].tensor(0)
+        assert len(out.sharding.device_set) == 8
+        np.testing.assert_allclose(np.asarray(out), x @ np.asarray(W), rtol=1e-5)
+
+
+class TestTorchBackend:
+    def test_torch_module(self, rng):
+        import torch
+
+        class Net(torch.nn.Module):
+            def forward(self, x):
+                return x * 2 + 1
+
+        x = rng.standard_normal((3, 4)).astype(np.float32)
+        sink = run_filter([x], framework="torch", model=Net())
+        np.testing.assert_allclose(sink.frames[0].tensor(0), x * 2 + 1, rtol=1e-6)
+
+
+def test_property_spec_parsing():
+    f = TensorFilter(
+        framework="custom",
+        model=lambda x: x,
+        input="3:224:224:1",
+        inputtype="uint8",
+    )
+    spec = f._prop_in
+    assert spec.tensors[0].shape == (224, 224, 3)
+    assert spec.tensors[0].dtype == np.uint8
+
+
+def test_known_backends_listed():
+    for name in ("jax", "jax-sharded", "custom", "custom-python", "custom-easy", "torch"):
+        assert name in known_backends()

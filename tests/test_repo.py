@@ -1,0 +1,168 @@
+"""Recurrence tests: repo slots, cycles, dynamic rewiring, valve/selector —
+the analogs of ``tests/nnstreamer_repo*`` and the C-API's switch/valve
+controls."""
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu import Pipeline
+from nnstreamer_tpu.buffer import Frame, SECOND
+from nnstreamer_tpu.elements.filter import TensorFilter
+from nnstreamer_tpu.elements.mux import TensorMux
+from nnstreamer_tpu.elements.demux import TensorDemux
+from nnstreamer_tpu.elements.repo import GLOBAL_REPO, TensorRepoSink, TensorRepoSrc
+from nnstreamer_tpu.elements.selector import InputSelector, OutputSelector
+from nnstreamer_tpu.elements.sink import TensorSink
+from nnstreamer_tpu.elements.testsrc import DataSrc
+from nnstreamer_tpu.elements.valve import Valve
+from nnstreamer_tpu.backends.custom import CustomFilterBase
+from nnstreamer_tpu.spec import TensorSpec, TensorsSpec
+
+
+def caps_f32(*nns_dims: str):
+    return TensorsSpec(
+        tensors=tuple(TensorSpec.from_dims_string(d, "float32") for d in nns_dims)
+    )
+
+
+class TestRepoBasics:
+    def test_slot_mailbox(self):
+        assert GLOBAL_REPO.set_buffer(3, Frame.of(np.ones(2, np.float32)), None)
+        frame, spec, eos = GLOBAL_REPO.get_buffer(3)
+        assert not eos
+        np.testing.assert_array_equal(frame.tensor(0), [1, 1])
+        # consumed: a second get polls out empty
+        frame2, _, eos2 = GLOBAL_REPO.get_buffer(3, timeout=0.05)
+        assert frame2 is None and not eos2
+
+    def test_sink_to_src_pipeline_pair(self):
+        """Two pipelines communicating through a slot (the cross-pipeline
+        channel, survey §1)."""
+        data = [np.full((2,), i, np.float32) for i in range(4)]
+        p1 = Pipeline("producer")
+        src = p1.add(DataSrc(data=data, name="d"))
+        rsink = p1.add(TensorRepoSink(slot_index=7))
+        p1.link(src, rsink)
+
+        p2 = Pipeline("consumer")
+        rsrc = p2.add(TensorRepoSrc(slot_index=7, caps=caps_f32("2:1:1:1")))
+        sink = p2.add(TensorSink(collect=True))
+        p2.link(rsrc, sink)
+
+        p2.start()
+        p1.run(timeout=10)
+        p2.wait(timeout=10)
+        p2.stop()
+        # first frame is the bootstrap dummy (zeros), then the published data
+        got = [list(np.asarray(f.tensor(0))) for f in sink.frames]
+        assert got[0] == [0.0, 0.0]
+        assert [g[0] for g in got[1:]] == [0.0, 1.0, 2.0, 3.0]
+
+
+class _DummyLSTM(CustomFilterBase):
+    """The recurrence fixture: mirrors the behavior of the reference's
+    ``custom_example_LSTM/dummy_LSTM.c`` (two state tensors in, two out,
+    tanh mixing) whose golden is np.tanh per
+    ``tests/nnstreamer_repo_lstm/generateTestCase.py:40-60``."""
+
+    def set_input_spec(self, in_spec):
+        assert in_spec.num_tensors == 3  # h_state, c_state, x
+        t = in_spec.tensors[0]
+        return TensorsSpec.of(t, t)
+
+    def invoke(self, h, c, x):
+        c_new = np.tanh(np.asarray(c) + np.asarray(x))
+        h_new = np.tanh(np.asarray(h) * 0.5 + c_new * 0.5)
+        return h_new, c_new
+
+
+def lstm_golden(xs):
+    h = np.zeros_like(xs[0])
+    c = np.zeros_like(xs[0])
+    outs = []
+    for x in xs:
+        c = np.tanh(c + x)
+        h = np.tanh(h * 0.5 + c * 0.5)
+        outs.append(h.copy())
+    return outs
+
+
+class TestLSTMCycle:
+    def test_recurrent_topology(self):
+        """The LSTM test topology (runTest.sh:10-22): repo_src:0/1 + data →
+        mux → filter(LSTM) → demux → repo_sink:0/1, cycle through slots."""
+        n = 5
+        xs = [np.full((4,), 0.1 * (i + 1), np.float32) for i in range(n)]
+        dur = SECOND // 30
+        data = [Frame.of(x, pts=i * dur, duration=dur) for i, x in enumerate(xs)]
+
+        p = Pipeline("lstm")
+        h_src = p.add(TensorRepoSrc(name="h_src", slot_index=10, caps=caps_f32("4:1:1:1")))
+        c_src = p.add(TensorRepoSrc(name="c_src", slot_index=11, caps=caps_f32("4:1:1:1")))
+        x_src = p.add(DataSrc(name="x_src", data=data))
+        mux = p.add(TensorMux(sync_mode="nosync"))
+        filt = p.add(TensorFilter(framework="custom", model=_DummyLSTM()))
+        demux = p.add(TensorDemux())
+        h_sink = p.add(TensorRepoSink(name="h_sink", slot_index=10))
+        c_sink = p.add(TensorRepoSink(name="c_sink", slot_index=11))
+        tee = p.add(__import__("nnstreamer_tpu.elements.tee", fromlist=["Tee"]).Tee())
+        out = p.add(TensorSink(collect=True))
+
+        p.link(h_src, f"{mux.name}.sink_0")
+        p.link(c_src, f"{mux.name}.sink_1")
+        p.link(x_src, f"{mux.name}.sink_2")
+        p.link(mux, filt)
+        p.link(filt, demux)
+        # h output feeds both the h repo sink and the observable sink
+        p.link(f"{demux.name}.src_0", tee)
+        p.link(tee, h_sink)
+        p.link(tee, out)
+        p.link(f"{demux.name}.src_1", c_sink)
+
+        p.start()
+        assert out.wait_eos(timeout=20)
+        p.stop()
+
+        golden = lstm_golden(xs)
+        got = [np.asarray(f.tensor(0)) for f in out.frames]
+        assert len(got) == n
+        for g, ref in zip(got, golden):
+            np.testing.assert_allclose(g, ref, rtol=1e-5)
+
+
+class TestDynamicControl:
+    def test_valve_gates_flow(self):
+        data = [np.full((1,), i, np.float32) for i in range(10)]
+        p = Pipeline()
+        src = p.add(DataSrc(data=data))
+        valve = p.add(Valve(drop=True))
+        sink = p.add(TensorSink(collect=True))
+        p.link_chain(src, valve, sink)
+        p.run(timeout=10)
+        assert sink.num_frames == 0
+
+    def test_output_selector_routing(self):
+        data = [np.full((1,), i, np.float32) for i in range(4)]
+        p = Pipeline()
+        src = p.add(DataSrc(data=data))
+        sel = p.add(OutputSelector(active_pad="src_0"))
+        a = p.add(TensorSink(name="a", collect=True))
+        b = p.add(TensorSink(name="b", collect=True))
+        p.link(src, sel)
+        p.link(f"{sel.name}.src_0", a)
+        p.link(f"{sel.name}.src_1", b)
+        p.run(timeout=10)
+        assert a.num_frames == 4 and b.num_frames == 0
+
+    def test_input_selector(self):
+        p = Pipeline()
+        s0 = p.add(DataSrc(name="s0", data=[np.zeros((2,), np.float32)] * 3))
+        s1 = p.add(DataSrc(name="s1", data=[np.ones((2,), np.float32)] * 3))
+        sel = p.add(InputSelector(active_pad="sink_1"))
+        sink = p.add(TensorSink(collect=True))
+        p.link(s0, f"{sel.name}.sink_0")
+        p.link(s1, f"{sel.name}.sink_1")
+        p.link(sel, sink)
+        p.run(timeout=10)
+        assert sink.num_frames == 3
+        assert all(f.tensor(0)[0] == 1.0 for f in sink.frames)

@@ -1,0 +1,111 @@
+"""``tensor_transform`` tests: every mode × dtype combo against independent
+numpy goldens — the analog of ``unittest_plugins.cpp`` transform cases
+(``:316-428``) and the SSAT ``transform_*`` dirs."""
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu import Pipeline
+from nnstreamer_tpu.elements.sink import TensorSink
+from nnstreamer_tpu.elements.testsrc import DataSrc
+from nnstreamer_tpu.elements.transform import TensorTransform
+
+
+def run_transform(data, mode, option, acceleration=False):
+    p = Pipeline()
+    src = p.add(DataSrc(data=[data]))
+    tr = p.add(TensorTransform(mode=mode, option=option, acceleration=acceleration))
+    sink = p.add(TensorSink(collect=True))
+    p.link_chain(src, tr, sink)
+    p.run(timeout=20)
+    return np.asarray(sink.frames[0].tensor(0))
+
+
+@pytest.mark.parametrize("accel", [False, True], ids=["host", "xla"])
+class TestModes:
+    def test_typecast(self, accel, rng):
+        x = rng.integers(0, 255, (4, 5), dtype=np.uint8)
+        out = run_transform(x, "typecast", "float32", accel)
+        assert out.dtype == np.float32
+        np.testing.assert_array_equal(out, x.astype(np.float32))
+
+    def test_typecast_narrowing(self, accel, rng):
+        x = rng.standard_normal((8,)).astype(np.float32) * 300
+        out = run_transform(x, "typecast", "int8", accel)
+        assert out.dtype == np.int8
+
+    def test_arithmetic_chain(self, accel, rng):
+        # the canonical mobilenet normalize: typecast+add+div
+        x = rng.integers(0, 255, (2, 3, 3), dtype=np.uint8)
+        out = run_transform(
+            x, "arithmetic", "typecast:float32,add:-127.5,div:127.5", accel
+        )
+        np.testing.assert_allclose(
+            out, (x.astype(np.float32) - 127.5) / 127.5, rtol=1e-6
+        )
+
+    def test_arithmetic_mul(self, accel, rng):
+        x = rng.standard_normal((10,)).astype(np.float32)
+        out = run_transform(x, "arithmetic", "mul:2.5", accel)
+        np.testing.assert_allclose(out, x * 2.5, rtol=1e-6)
+
+    def test_transpose(self, accel, rng):
+        # NNS option "1:0:2:3" on (h,w,c) swaps the two innermost NNS dims
+        # (c and w): numpy (4,5,3) -> transpose over padded rank-4.
+        x = rng.standard_normal((4, 5, 3)).astype(np.float32)
+        out = run_transform(x, "transpose", "1:0:2:3", accel)
+        # independent golden: pad to (1,4,5,3), NNS perm [1,0,2,3] ->
+        # numpy perm: out numpy axis j takes in axis 3 - P[3-j]
+        golden = x.reshape(1, 4, 5, 3).transpose(0, 1, 3, 2).reshape(4, 3, 5)
+        np.testing.assert_array_equal(out, golden)
+
+    def test_dimchg(self, accel, rng):
+        # dimchg 0:2 on (h,w,c): NNS c:w:h -> w:h:c i.e. numpy (c,h,w)
+        x = rng.integers(0, 255, (4, 5, 3), dtype=np.uint8)
+        out = run_transform(x, "dimchg", "0:2", accel)
+        golden = np.moveaxis(x.reshape(1, 4, 5, 3), 3, 1).reshape(3, 4, 5)
+        np.testing.assert_array_equal(out, golden)
+
+    def test_stand_default(self, accel, rng):
+        x = rng.integers(0, 255, (6, 6), dtype=np.uint8)
+        out = run_transform(x, "stand", "default", accel)
+        xf = x.astype(np.float32)
+        golden = (xf - xf.mean()) / (xf.std() + 1e-10)
+        np.testing.assert_allclose(out, golden, rtol=1e-4, atol=1e-5)
+
+    def test_clamp(self, accel, rng):
+        x = rng.standard_normal((20,)).astype(np.float32) * 10
+        out = run_transform(x, "clamp", "-1.0:1.0", accel)
+        np.testing.assert_array_equal(out, np.clip(x, -1.0, 1.0))
+
+
+def test_multi_tensor_frame_per_tensor_fns(rng):
+    """Shape-dependent modes must compile per-tensor (frames may carry
+    tensors of different shapes)."""
+    from nnstreamer_tpu.buffer import Frame
+
+    a = rng.standard_normal((4, 5, 3)).astype(np.float32)
+    b = rng.standard_normal((2, 7, 1)).astype(np.float32)
+    p = Pipeline()
+    src = p.add(DataSrc(data=[Frame.of(a, b)]))
+    tr = p.add(TensorTransform(mode="transpose", option="1:0:2:3", acceleration=False))
+    sink = p.add(TensorSink(collect=True))
+    p.link_chain(src, tr, sink)
+    p.run(timeout=20)
+    f = sink.frames[0]
+    assert f.tensor(0).shape == (4, 3, 5)
+    assert f.tensor(1).shape == (2, 1, 7)
+
+
+def test_bad_mode_rejected():
+    with pytest.raises(ValueError):
+        TensorTransform(mode="nope", option="")
+
+
+def test_bad_arith_option_rejected(rng):
+    x = rng.standard_normal((4,)).astype(np.float32)
+    tr = TensorTransform(mode="arithmetic", option="pow:2")
+    from nnstreamer_tpu.spec import TensorsSpec
+
+    with pytest.raises(ValueError):
+        tr.configure({"sink": TensorsSpec.from_arrays([x])})
