@@ -1,0 +1,111 @@
+#!/usr/bin/env python
+"""Benchmark: MobileNet-v2 streaming-pipeline throughput, TPU vs tflite-CPU.
+
+North-star metric (BASELINE.md / BASELINE.json): frames/sec/chip through the
+``tensor_filter`` invoke path on the image-labeling pipeline, with tflite-CPU
+(the reference's flagship backend) as ``vs_baseline``.  Target ≥4×.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "frames/sec/chip", "vs_baseline": N}
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import numpy as np  # noqa: E402
+
+
+NORMALIZE = "typecast:float32,add:-127.5,div:127.5"
+
+
+def run_pipeline_fps(framework, model, frames, warmup=3, normalize=True):
+    """Stream frames through datasrc → transform(normalize) → tensor_filter →
+    sink; frames/sec.  On the jax path the transform fuses into the model's
+    XLA program, so raw uint8 crosses host→device."""
+    from nnstreamer_tpu import Pipeline
+    from nnstreamer_tpu.elements.filter import TensorFilter
+    from nnstreamer_tpu.elements.sink import TensorSink
+    from nnstreamer_tpu.elements.testsrc import DataSrc
+    from nnstreamer_tpu.elements.transform import TensorTransform
+
+    state = {"first": None, "out": None, "count": 0}
+
+    def sink_cb(frame):
+        state["count"] += 1
+        state["out"] = frame.tensors[0]
+        if state["first"] is None:
+            state["first"] = time.perf_counter()
+
+    def run(n):
+        state.update(first=None, out=None, count=0)
+        p = Pipeline()
+        src = p.add(DataSrc(data=frames[:n]))
+        chain = [src]
+        if normalize:
+            chain.append(p.add(TensorTransform(mode="arithmetic", option=NORMALIZE)))
+        chain.append(p.add(TensorFilter(framework=framework, model=model)))
+        chain.append(p.add(TensorSink(callback=sink_cb)))
+        p.link_chain(*chain)
+        p.run(timeout=600)
+        out = state["out"]
+        if out is not None and hasattr(out, "block_until_ready"):
+            out.block_until_ready()  # drain async device work before timing
+        dt = time.perf_counter() - state["first"]
+        # steady-state rate: frames after the first (which pays compile/
+        # startup) over the time since the first arrived
+        return (state["count"] - 1) / dt
+
+    run(warmup)  # compile + cache
+    return run(len(frames))
+
+
+def main():
+    rng = np.random.default_rng(0)
+    image_u8 = rng.integers(0, 256, (224, 224, 3)).astype(np.uint8)
+
+    # -- TPU path: JAX MobileNet-v2, bf16, XLA-compiled, fused normalize ----
+    from nnstreamer_tpu.models import mobilenet_v2
+    from nnstreamer_tpu.spec import TensorSpec, TensorsSpec
+
+    jax_model = mobilenet_v2.build(num_classes=1001, image_size=224)
+    n_tpu = int(os.environ.get("BENCH_FRAMES", "400"))
+    tpu_frames = [image_u8.copy() for _ in range(n_tpu)]
+    tpu_fps = run_pipeline_fps("jax", jax_model, tpu_frames)
+
+    # -- Baseline: tflite-CPU MobileNetV2 (the reference's stack) -----------
+    vs_baseline = None
+    try:
+        os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "3")
+        import tensorflow as tf
+
+        keras_model = tf.keras.applications.MobileNetV2(
+            weights=None, input_shape=(224, 224, 3), classes=1000
+        )
+        n_cpu = int(os.environ.get("BENCH_BASELINE_FRAMES", "30"))
+        cpu_frames = [image_u8[None].copy() for _ in range(n_cpu)]
+        cpu_fps = run_pipeline_fps(
+            "tensorflow-lite", keras_model, cpu_frames, normalize=True
+        )
+        vs_baseline = tpu_fps / cpu_fps
+    except Exception as exc:  # baseline unavailable: report TPU number alone
+        print(f"# baseline failed: {exc!r}", file=sys.stderr)
+
+    print(
+        json.dumps(
+            {
+                "metric": "mobilenet_v2_224 image-labeling pipeline throughput "
+                          "(tensor_filter invoke, batch=1 streaming)",
+                "value": round(tpu_fps, 2),
+                "unit": "frames/sec/chip",
+                "vs_baseline": round(vs_baseline, 2) if vs_baseline else None,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

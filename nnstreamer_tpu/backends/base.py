@@ -77,6 +77,8 @@ _BUILTIN_MODULES = {
     "custom": "nnstreamer_tpu.backends.custom",
     "torch": "nnstreamer_tpu.backends.torch_backend",
     "torch-cpu": "nnstreamer_tpu.backends.torch_backend",
+    "tensorflow-lite": "nnstreamer_tpu.backends.tf_backend",
+    "tensorflow": "nnstreamer_tpu.backends.tf_backend",
 }
 
 

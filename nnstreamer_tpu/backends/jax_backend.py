@@ -115,6 +115,7 @@ class JaxBackend(FilterBackend):
     def __init__(self):
         self.model: Optional[JaxModel] = None
         self._fn: Optional[Callable] = None
+        self._wrapper: Optional[Callable] = None  # fn → fused fn (optimize.py)
         self._compiled = None
         self._in_spec: Optional[TensorsSpec] = None
         self._out_spec: Optional[TensorsSpec] = None
@@ -164,13 +165,32 @@ class JaxBackend(FilterBackend):
 
     # -- compilation (the "interpreter build") ------------------------------
 
+    def set_wrapper(self, wrapper: Optional[Callable]) -> None:
+        """Install a fn→fn wrapper (transform fusion): the wrapped function
+        compiles as one XLA program (``graph/optimize.py``)."""
+        self._wrapper = wrapper
+        self._compiled = None
+
+    def trace_output_spec(self, in_spec: TensorsSpec) -> TensorsSpec:
+        """Model-only output spec via tracing (no compile, no wrapper)."""
+        outs = jax.eval_shape(self._fn, *_as_shape_structs(in_spec))
+        return _spec_from_outputs(outs if isinstance(outs, (tuple, list)) else (outs,))
+
+    @property
+    def _effective_fn(self) -> Callable:
+        return self._wrapper(self._fn) if self._wrapper is not None else self._fn
+
     def _compile(self, in_spec: TensorsSpec) -> TensorsSpec:
         self._in_spec = in_spec
         structs = _as_shape_structs(in_spec)
-        jitted = self._jit(self._fn)
-        lowered = jitted.lower(*structs)
-        self._compiled = lowered.compile()
-        outs = jax.eval_shape(self._fn, *structs)
+        jitted = self._jit(self._effective_fn)
+        # AOT-lower for early error surfacing + warm cache, but keep the
+        # *jitted* callable for the hot loop: jit's C++ dispatch fast path
+        # overlaps host→device transfers with compute, which the AOT
+        # executable's __call__ does not (measured ~2× on a tunneled chip).
+        jitted.lower(*structs).compile()
+        self._compiled = jitted
+        outs = jax.eval_shape(self._effective_fn, *structs)
         self._single_output = not isinstance(outs, (tuple, list))
         out_spec = _spec_from_outputs(outs if not self._single_output else (outs,))
         self._out_spec = out_spec
@@ -178,6 +198,14 @@ class JaxBackend(FilterBackend):
 
     def _jit(self, fn):
         return jax.jit(fn)
+
+    def reconfigure_fused(self, raw_spec: TensorsSpec) -> TensorsSpec:
+        """Compile against the raw stream spec (the fused program's inputs);
+        model-spec reconciliation already happened against the pre-transform
+        chain's output (``TensorFilter._install_fusion``)."""
+        if not raw_spec.tensors_fixed:
+            raw_spec = raw_spec.fixate()
+        return self._compile(raw_spec)
 
     def reconfigure(self, in_spec: TensorsSpec) -> TensorsSpec:
         mine = self._in_spec

@@ -59,7 +59,15 @@ class TensorFilter(Node):
         self._prop_in = self._parse_spec_props(input, inputtype)
         self._prop_out = self._parse_spec_props(output, outputtype)
         self._opened = False
+        self._fused_pre: list = []  # TensorTransforms folded in (optimize.py)
+        self._fused_post: list = []
         self.invoke_ns: list = []  # per-invoke latency when profiling
+
+    def set_fused_transforms(self, pre: list, post: list) -> None:
+        """Install transforms fused into this filter's XLA program (called
+        by the graph optimizer, ``graph/optimize.py``)."""
+        self._fused_pre = list(pre)
+        self._fused_post = list(post)
 
     @staticmethod
     def _parse_spec_props(dims: str, types: str) -> Optional[TensorsSpec]:
@@ -101,6 +109,11 @@ class TensorFilter(Node):
 
     def sink_spec(self, pad_name: str) -> TensorsSpec:
         del pad_name
+        if self._fused_pre:
+            # the stream spec is pre-transform; the model spec (and any
+            # input= property, which describes the MODEL input) only applies
+            # after the fused pre-ops run — checked in _install_fusion
+            return TensorsSpec()
         spec = self.backend.input_spec() if self._opened else None
         if spec is not None and self._prop_in is not None:
             merged = spec.intersect(self._prop_in)
@@ -114,8 +127,17 @@ class TensorFilter(Node):
 
     def configure(self, in_specs: Dict[str, TensorsSpec]) -> Dict[str, TensorsSpec]:
         in_spec = in_specs["sink"]
-        out_spec = self.backend.reconfigure(in_spec)
-        if self._prop_out is not None:
+        if self._fused_pre or self._fused_post:
+            self._install_fusion(in_spec)  # validates model spec vs chain
+            # compile against the RAW stream spec: the fused program's
+            # entry point consumes pre-transform frames
+            out_spec = self.backend.reconfigure_fused(in_spec)
+        else:
+            out_spec = self.backend.reconfigure(in_spec)
+        # output= property describes the MODEL output; with fused post-
+        # transforms the pad spec is post-transform, so the check happened
+        # against the model output inside _install_fusion instead.
+        if self._prop_out is not None and not self._fused_post:
             merged = out_spec.intersect(self._prop_out)
             if merged is None:
                 raise NegotiationError(
@@ -126,6 +148,60 @@ class TensorFilter(Node):
         if in_spec.rate is not None and out_spec.rate is None:
             out_spec = TensorsSpec(tensors=out_spec.tensors, rate=in_spec.rate)
         return {"src": out_spec}
+
+    def _install_fusion(self, in_spec: TensorsSpec) -> TensorsSpec:
+        """Compose fused pre/post transforms around the backend fn so the
+        whole chain compiles as ONE XLA program.  Returns the spec the model
+        actually sees (post-pre-transforms)."""
+        import jax.numpy as jnp
+
+        pre_stages = []
+        spec_cur = in_spec
+        for tr in self._fused_pre:
+            pre_stages.append([tr.build_fn(t) for t in spec_cur.tensors])
+            spec_cur = TensorsSpec(
+                tensors=tuple(tr.out_spec_for(t) for t in spec_cur.tensors),
+                rate=spec_cur.rate,
+            )
+        model_spec = self.backend.input_spec()
+        if model_spec is not None and model_spec.intersect(spec_cur) is None:
+            raise NegotiationError(
+                f"{self.name}: fused pre-transform output {spec_cur} is "
+                f"incompatible with model spec {model_spec}"
+            )
+        post_stages = []
+        if self._fused_post:
+            spec_o = self.backend.trace_output_spec(spec_cur)
+            if self._prop_out is not None and self._prop_out.intersect(spec_o) is None:
+                raise NegotiationError(
+                    f"{self.name}: model output {spec_o} conflicts with "
+                    f"output property {self._prop_out}"
+                )
+            for tr in self._fused_post:
+                post_stages.append([tr.build_fn(t) for t in spec_o.tensors])
+                spec_o = TensorsSpec(
+                    tensors=tuple(tr.out_spec_for(t) for t in spec_o.tensors),
+                    rate=spec_o.rate,
+                )
+
+        def wrapper(orig):
+            def fn(*xs):
+                for stage in pre_stages:
+                    xs = tuple(f(x, jnp) for f, x in zip(stage, xs))
+                out = orig(*xs)
+                single = not isinstance(out, (tuple, list))
+                outs = (out,) if single else tuple(out)
+                for stage in post_stages:
+                    outs = tuple(f(x, jnp) for f, x in zip(stage, outs))
+                if single:
+                    return outs[0]
+                if hasattr(out, "_fields"):  # namedtuple output
+                    return type(out)(*outs)
+                return type(out)(outs)
+            return fn
+
+        self.backend.set_wrapper(wrapper)
+        return spec_cur
 
     # -- hot loop -----------------------------------------------------------
 

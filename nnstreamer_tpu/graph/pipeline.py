@@ -35,6 +35,7 @@ class Pipeline:
     def __init__(self, name: str = "pipeline"):
         self.name = name
         self.nodes: Dict[str, Node] = {}
+        self.auto_fuse = True  # fold transforms into XLA filters on start
         self.state = "NULL"  # NULL → PLAYING → STOPPED
         self.threads: List[threading.Thread] = []
         self._eos_leaves: set = set()
@@ -132,6 +133,11 @@ class Pipeline:
         self._done.clear()
         self._error = None
         self._eos_leaves.clear()
+        fuse_undos = []
+        if self.auto_fuse:
+            from .optimize import fuse_transforms
+
+            fuse_undos = fuse_transforms(self)
         for node in self.nodes.values():
             for pad in list(node.sink_pads.values()) + list(node.src_pads.values()):
                 pad.eos = False
@@ -147,6 +153,8 @@ class Pipeline:
                     node.stop()
                 except Exception:
                     pass
+            for undo in reversed(fuse_undos):
+                undo()
             raise
         self._leaves = {
             n.name

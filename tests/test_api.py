@@ -1,0 +1,139 @@
+"""Application API tests: SingleShot (ml_single_*) and PipelineHandle
+(ml_pipeline_*) — the analog of ``unittest_tizen_capi.cpp``."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.api import InvokeTimeout, PipelineHandle, SingleShot
+from nnstreamer_tpu.backends.jax_backend import JaxModel
+from nnstreamer_tpu.spec import TensorSpec, TensorsSpec
+
+
+def _model(shape=(4,)):
+    return JaxModel(
+        apply=lambda p, x: x * 2 + 1,
+        input_spec=TensorsSpec.of(TensorSpec(dtype=np.float32, shape=shape)),
+    )
+
+
+class TestSingleShot:
+    def test_open_invoke_close(self):
+        with SingleShot(framework="jax", model=_model()) as s:
+            x = np.arange(4, dtype=np.float32)
+            (out,) = s.invoke(x)
+            np.testing.assert_allclose(np.asarray(out), x * 2 + 1)
+
+    def test_specs_exposed(self):
+        with SingleShot(framework="jax", model=_model((2, 3))) as s:
+            assert s.input_spec().tensors[0].shape == (2, 3)
+            assert s.output_spec().tensors[0].shape == (2, 3)
+
+    def test_set_input_spec_reconfigures(self):
+        model = JaxModel(apply=lambda p, x: x.sum(axis=-1))
+        with SingleShot(framework="jax", model=model) as s:
+            out = s.set_input_spec(
+                TensorsSpec.of(TensorSpec(dtype=np.float32, shape=(5, 7)))
+            )
+            assert out.tensors[0].shape == (5,)
+
+    def test_timeout_fires(self):
+        class Slow:
+            def invoke(self, x):
+                time.sleep(2.0)
+                return x
+
+            def set_input_spec(self, spec):
+                return spec
+
+        s = SingleShot(framework="custom", model=Slow(), timeout=0.2)
+        with pytest.raises(InvokeTimeout):
+            s.invoke(np.zeros((2,), np.float32))
+        s.close()
+
+    def test_custom_backend_single(self):
+        with SingleShot(framework="custom", model=lambda x: x + 5) as s:
+            (out,) = s.invoke(np.zeros((3,), np.float32))
+            np.testing.assert_array_equal(out, [5, 5, 5])
+
+    def test_closed_raises(self):
+        s = SingleShot(framework="custom", model=lambda x: x)
+        s.close()
+        with pytest.raises(RuntimeError):
+            s.invoke(np.zeros((1,), np.float32))
+
+
+class TestPipelineHandle:
+    CAPS = (
+        "other/tensor, dimension=(string)4:1:1:1, type=(string)float32, "
+        "framerate=(fraction)0/1"
+    )
+
+    def test_construct_indexes_elements(self):
+        h = PipelineHandle.construct(
+            f"appsrc name=in caps='{self.CAPS}' ! valve name=v ! "
+            "tensor_sink name=out"
+        )
+        assert "in" in h.sources
+        assert "v" in h.valves
+        assert "out" in h.sinks
+
+    def test_src_input_to_sink_callback(self):
+        h = PipelineHandle.construct(
+            f"appsrc name=in caps='{self.CAPS}' ! tensor_sink name=out"
+        )
+        got = []
+        h.sink_register("out", lambda f: got.append(np.asarray(f.tensor(0))))
+        with h:
+            h.start()
+            for i in range(3):
+                h.src_input("in", np.full((4,), i, np.float32))
+            h.src_eos("in")
+            assert h.wait(10)
+        assert [g[0] for g in got] == [0, 1, 2]
+
+    def test_valve_control(self):
+        h = PipelineHandle.construct(
+            f"appsrc name=in caps='{self.CAPS}' ! valve name=v drop=true ! "
+            "tensor_sink name=out collect=true"
+        )
+        with h:
+            h.start()
+            h.src_input("in", np.zeros((4,), np.float32))
+            time.sleep(0.2)
+            h.valve_set_open("v", True)
+            h.src_input("in", np.ones((4,), np.float32))
+            h.src_eos("in")
+            assert h.wait(10)
+            sink = h.sinks["out"]
+            assert sink.num_frames == 1
+            assert sink.frames[0].tensor(0)[0] == 1.0
+
+    def test_switch_select(self):
+        h = PipelineHandle.construct(
+            f"appsrc name=in caps='{self.CAPS}' ! output-selector name=sel "
+            "sel.src_0 ! tensor_sink name=a collect=true "
+            "sel.src_1 ! tensor_sink name=b collect=true"
+        )
+        with h:
+            h.start()
+            assert set(h.switch_pads("sel")) == {"src_0", "src_1"}
+            h.src_input("in", np.zeros((4,), np.float32))
+            time.sleep(0.2)
+            h.switch_select("sel", "src_1")
+            h.src_input("in", np.ones((4,), np.float32))
+            h.src_eos("in")
+            assert h.wait(10)
+            assert h.sinks["a"].num_frames == 1
+            assert h.sinks["b"].num_frames == 1
+
+    def test_unknown_names_raise(self):
+        h = PipelineHandle.construct(
+            f"appsrc name=in caps='{self.CAPS}' ! tensor_sink name=out"
+        )
+        with pytest.raises(KeyError):
+            h.sink_register("nope", lambda f: None)
+        with pytest.raises(KeyError):
+            h.valve_set_open("nope", True)

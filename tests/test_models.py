@@ -1,0 +1,191 @@
+"""Model zoo tests: shapes, decoder-contract compatibility, and end-to-end
+pipelines for each benchmark config (tiny sizes — CI runs on CPU)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from nnstreamer_tpu import Pipeline
+from nnstreamer_tpu.buffer import Frame
+from nnstreamer_tpu.elements.decoder import TensorDecoder
+from nnstreamer_tpu.elements.filter import TensorFilter
+from nnstreamer_tpu.elements.sink import TensorSink
+from nnstreamer_tpu.elements.testsrc import DataSrc
+from nnstreamer_tpu.models import lstm, mobilenet_v2, posenet, ssd_mobilenet
+
+
+# CPU tests use float32 (bfloat16 works but is slow on host).
+DT = jnp.float32
+
+
+class TestMobileNetV2:
+    def test_forward_shapes(self):
+        model = mobilenet_v2.build(
+            num_classes=10, width_mult=0.35, image_size=96, dtype=DT
+        )
+        x = np.zeros((96, 96, 3), np.float32)
+        out = model.apply(model.params, x)
+        assert out.shape == (10,)
+        batched = model.apply(model.params, np.zeros((2, 96, 96, 3), np.float32))
+        assert batched.shape == (2, 10)
+
+    def test_labeling_pipeline(self, tmp_path):
+        labels = tmp_path / "labels.txt"
+        labels.write_text("\n".join(f"label{i}" for i in range(10)))
+        model = mobilenet_v2.build(
+            num_classes=10, width_mult=0.35, image_size=64, dtype=DT
+        )
+        x = np.random.default_rng(0).random((64, 64, 3), np.float32)
+        p = Pipeline()
+        src = p.add(DataSrc(data=[x]))
+        filt = p.add(TensorFilter(framework="jax", model=model))
+        dec = p.add(TensorDecoder(mode="image_labeling", option1=str(labels)))
+        sink = p.add(TensorSink(collect=True))
+        p.link_chain(src, filt, dec, sink)
+        p.run(timeout=120)
+        assert sink.frames[0].meta["label"].startswith("label")
+
+
+class TestSSD:
+    def test_priors_count(self):
+        priors = ssd_mobilenet.generate_priors()
+        assert priors.shape == (4, 1917)
+        assert (priors[2] > 0).all() and (priors[3] > 0).all()
+
+    def test_forward_contract(self):
+        model = ssd_mobilenet.build(num_labels=5, image_size=300, dtype=DT)
+        boxes, scores = model.apply(
+            model.params, np.zeros((300, 300, 3), np.float32)
+        )
+        assert boxes.shape == (1917, 4)
+        assert scores.shape == (1917, 5)
+
+    def test_boundingbox_pipeline(self, tmp_path):
+        priors_path = ssd_mobilenet.write_priors_file(str(tmp_path / "priors.txt"))
+        model = ssd_mobilenet.build(num_labels=5, image_size=300, dtype=DT)
+        x = np.random.default_rng(0).random((300, 300, 3), np.float32)
+        p = Pipeline()
+        src = p.add(DataSrc(data=[x]))
+        filt = p.add(TensorFilter(framework="jax", model=model))
+        dec = p.add(
+            TensorDecoder(
+                mode="bounding_boxes",
+                option1="tflite-ssd",
+                option3=priors_path,
+                option4="300:300",
+                option5="300:300",
+            )
+        )
+        sink = p.add(TensorSink(collect=True))
+        p.link_chain(src, filt, dec, sink)
+        p.run(timeout=180)
+        f = sink.frames[0]
+        assert f.tensor(0).shape == (300, 300, 4)
+        assert "objects" in f.meta  # detections list (may be empty: random net)
+
+
+class TestPoseNet:
+    def test_pose_pipeline(self):
+        model = posenet.build(image_size=96, dtype=DT)
+        grid = posenet.grid_size(96)
+        x = np.random.default_rng(0).random((96, 96, 3), np.float32)
+        p = Pipeline()
+        src = p.add(DataSrc(data=[x]))
+        filt = p.add(TensorFilter(framework="jax", model=model))
+        dec = p.add(
+            TensorDecoder(
+                mode="pose_estimation",
+                option1="96:96",
+                option2=f"{grid}:{grid}",
+            )
+        )
+        sink = p.add(TensorSink(collect=True))
+        p.link_chain(src, filt, dec, sink)
+        p.run(timeout=120)
+        f = sink.frames[0]
+        assert f.tensor(0).shape == (96, 96, 4)
+        assert len(f.meta["pose"]) == 14
+
+
+class TestLSTM:
+    def test_cell_golden(self):
+        """Cell math against an independent numpy implementation."""
+        model = lstm.build_cell(input_size=8, hidden_size=8)
+        rng = np.random.default_rng(1)
+        h = rng.standard_normal((8,)).astype(np.float32)
+        c = rng.standard_normal((8,)).astype(np.float32)
+        x = rng.standard_normal((8,)).astype(np.float32)
+        h2, c2 = model.apply(model.params, h, c, x)
+
+        def sigmoid(v):
+            return 1 / (1 + np.exp(-v))
+
+        p = model.params
+        gates = (
+            x @ np.asarray(p["wx"]["w"]) + np.asarray(p["wx"]["b"])
+            + h @ np.asarray(p["wh"]["w"]) + np.asarray(p["wh"]["b"])
+        )
+        i, f, g, o = np.split(gates, 4)
+        c_ref = sigmoid(f + 1.0) * c + sigmoid(i) * np.tanh(g)
+        h_ref = sigmoid(o) * np.tanh(c_ref)
+        np.testing.assert_allclose(np.asarray(c2), c_ref, rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(h2), h_ref, rtol=1e-5, atol=1e-6)
+
+    def test_sequence_matches_stepped_cell(self):
+        params = lstm.init_params(jax.random.PRNGKey(0), 4, 6)
+        seq = lstm.build_sequence(4, 6, seq_len=5, params=params)
+        cell = lstm.build_cell(4, 6, params=params)
+        xs = np.random.default_rng(2).standard_normal((5, 4)).astype(np.float32)
+        out_seq = np.asarray(seq.apply(params, xs))
+        h = np.zeros((6,), np.float32)
+        c = np.zeros((6,), np.float32)
+        for t in range(5):
+            h, c = cell.apply(params, h, c, xs[t])
+        np.testing.assert_allclose(out_seq[-1], np.asarray(h), rtol=1e-5, atol=1e-6)
+
+    def test_cell_in_recurrent_pipeline(self):
+        """The full repo-slot LSTM topology with the real JAX cell."""
+        from nnstreamer_tpu.elements.demux import TensorDemux
+        from nnstreamer_tpu.elements.mux import TensorMux
+        from nnstreamer_tpu.elements.repo import TensorRepoSink, TensorRepoSrc
+        from nnstreamer_tpu.elements.tee import Tee
+        from nnstreamer_tpu.spec import TensorSpec, TensorsSpec
+
+        H = 4
+        model = lstm.build_cell(input_size=H, hidden_size=H)
+        n = 3
+        xs = [np.full((H,), 0.1 * (i + 1), np.float32) for i in range(n)]
+        caps = TensorsSpec.of(TensorSpec.from_dims_string(f"{H}:1:1:1", "float32"))
+
+        p = Pipeline()
+        h_src = p.add(TensorRepoSrc(name="h_src", slot_index=20, caps=caps))
+        c_src = p.add(TensorRepoSrc(name="c_src", slot_index=21, caps=caps))
+        x_src = p.add(DataSrc(name="x_src", data=xs))
+        mux = p.add(TensorMux(sync_mode="nosync"))
+        filt = p.add(TensorFilter(framework="jax", model=model))
+        demux = p.add(TensorDemux())
+        tee = p.add(Tee())
+        h_sink = p.add(TensorRepoSink(name="h_sink", slot_index=20))
+        c_sink = p.add(TensorRepoSink(name="c_sink", slot_index=21))
+        out = p.add(TensorSink(collect=True))
+        p.link(h_src, f"{mux.name}.sink_0")
+        p.link(c_src, f"{mux.name}.sink_1")
+        p.link(x_src, f"{mux.name}.sink_2")
+        p.link(mux, filt)
+        p.link(filt, demux)
+        p.link(f"{demux.name}.src_0", tee)
+        p.link(tee, h_sink)
+        p.link(tee, out)
+        p.link(f"{demux.name}.src_1", c_sink)
+        p.start()
+        assert out.wait_eos(timeout=60)
+        p.stop()
+        assert out.num_frames == n
+        # golden: step the cell directly
+        h = np.zeros((H,), np.float32)
+        c = np.zeros((H,), np.float32)
+        for i, f in enumerate(out.frames):
+            h, c = (np.asarray(a) for a in model.apply(model.params, h, c, xs[i]))
+            np.testing.assert_allclose(np.asarray(f.tensor(0)), h, rtol=1e-4, atol=1e-5)

@@ -1,0 +1,78 @@
+"""tensorflow / tensorflow-lite backend tests (lazy: skipped if TF absent)."""
+
+import numpy as np
+import pytest
+
+tf = pytest.importorskip("tensorflow")
+
+from nnstreamer_tpu import Pipeline  # noqa: E402
+from nnstreamer_tpu.elements.filter import TensorFilter  # noqa: E402
+from nnstreamer_tpu.elements.sink import TensorSink  # noqa: E402
+from nnstreamer_tpu.elements.testsrc import DataSrc  # noqa: E402
+
+
+def _keras_model():
+    inp = tf.keras.Input(shape=(4,), dtype=tf.float32)
+    out = tf.keras.layers.Dense(
+        2, kernel_initializer="ones", bias_initializer="zeros"
+    )(inp)
+    return tf.keras.Model(inp, out)
+
+
+def run_filter(data, **kwargs):
+    p = Pipeline()
+    src = p.add(DataSrc(data=data))
+    filt = p.add(TensorFilter(**kwargs))
+    sink = p.add(TensorSink(collect=True))
+    p.link_chain(src, filt, sink)
+    p.run(timeout=120)
+    return sink
+
+
+def test_tflite_backend_keras_conversion():
+    x = np.ones((1, 4), np.float32)
+    sink = run_filter([x], framework="tensorflow-lite", model=_keras_model())
+    out = sink.frames[0].tensor(0)
+    np.testing.assert_allclose(out, [[4.0, 4.0]], rtol=1e-6)
+
+
+def test_tflite_spec_discovery():
+    from nnstreamer_tpu.backends.base import get_backend
+
+    b = get_backend("tensorflow-lite")
+    b.open(_keras_model())
+    assert b.input_spec().tensors[0].shape == (1, 4)
+    assert b.output_spec().tensors[0].shape == (1, 2)
+    b.close()
+
+
+def test_tensorflow_backend_callable():
+    x = np.ones((2, 4), np.float32)
+    sink = run_filter([x], framework="tensorflow", model=_keras_model())
+    out = sink.frames[0].tensor(0)
+    np.testing.assert_allclose(out, np.full((2, 2), 4.0), rtol=1e-6)
+
+
+def test_savedmodel_path(tmp_path):
+    model = _keras_model()
+    path = str(tmp_path / "saved")
+    tf.saved_model.save(model, path)
+    x = np.ones((1, 4), np.float32)
+    sink = run_filter([x], framework="tensorflow", model=path)
+    np.testing.assert_allclose(sink.frames[0].tensor(0), [[4.0, 4.0]], rtol=1e-6)
+
+
+def test_tflite_dtype_mismatch_fails_at_negotiation():
+    from nnstreamer_tpu import NegotiationError, Pipeline
+    from nnstreamer_tpu.elements.testsrc import DataSrc
+    from nnstreamer_tpu.elements.filter import TensorFilter
+    from nnstreamer_tpu.elements.sink import TensorSink
+
+    p = Pipeline()
+    src = p.add(DataSrc(data=[np.ones((1, 4), np.int32)]))
+    filt = p.add(TensorFilter(framework="tensorflow-lite", model=_keras_model()))
+    sink = p.add(TensorSink())
+    p.link_chain(src, filt, sink)
+    with pytest.raises((NegotiationError, Exception)):
+        p.start()
+    p.stop()
