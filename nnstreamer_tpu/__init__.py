@@ -9,6 +9,7 @@ fan-in/out, time sync, windowing and recurrence, pluggable model backends
 """
 
 from .buffer import EOS, Event, Frame, NONE_TS, SECOND  # noqa: F401
+from .conf import Conf, conf  # noqa: F401
 from .graph import (  # noqa: F401
     NegotiationError,
     Node,

@@ -101,6 +101,10 @@ def get_backend(name: str) -> FilterBackend:
         importlib.import_module(_BUILTIN_MODULES[name])
         cls = _BACKENDS.get(name)
     if cls is None:
+        from ..conf import lookup_with_plugin_fallback
+
+        cls = lookup_with_plugin_fallback(lambda: _BACKENDS.get(name))
+    if cls is None:
         raise ValueError(
             f"unknown filter framework {name!r}; known: {sorted(known_backends())}"
         )

@@ -32,7 +32,12 @@ class TorchBackend(FilterBackend):
 
         del custom
         if isinstance(model, (str, os.PathLike)):
-            self.module = torch.jit.load(os.fspath(model), map_location="cpu")
+            # map location from conf (the `torch use gpu` ini knob analog,
+            # `nnstreamer.ini.in:19-20`); default cpu.
+            from ..conf import conf
+
+            device = conf.get("filter", "torch_device", "cpu")
+            self.module = torch.jit.load(os.fspath(model), map_location=device)
         else:
             self.module = model  # nn.Module / scripted module
         self.module.eval()

@@ -1,0 +1,82 @@
+"""``tensor_batch`` / ``tensor_unbatch``: the mux→device-mesh batching bridge.
+
+The reference's concurrency story for multi-stream inference is "one
+interpreter per element" — N camera streams mean N independent
+``tensor_filter`` invokes.  The TPU-native replacement (survey §2.6, §3.3:
+``tensor_mux`` is "the batching front-door for the TPU pmap path") turns the
+muxed N-tensor frame into ONE batched tensor so a single XLA invoke runs all
+streams at once, with the batch dim sharded over the device mesh by the
+``jax-sharded`` backend (data parallelism over ICI):
+
+    src×N → tensor_mux → tensor_batch → tensor_filter framework=jax-sharded
+          → tensor_unbatch → tensor_demux → sink×N
+
+- ``tensor_batch``   — frame with N same-spec tensors → one ``(N, *shape)``
+  tensor (``jnp.stack``: stays on device when inputs are device-resident).
+- ``tensor_unbatch`` — inverse: ``(N, *shape)`` → N tensors, so the demuxed
+  per-stream outputs line up with the original pads.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from ..buffer import Frame
+from ..graph.node import NegotiationError, Node, Pad
+from ..graph.registry import register_element
+from ..spec import TensorSpec, TensorsSpec
+
+
+@register_element("tensor_batch")
+class TensorBatch(Node):
+    def __init__(self, name: Optional[str] = None):
+        super().__init__(name)
+        self.add_sink_pad("sink")
+        self.add_src_pad("src")
+        self._n = 0
+
+    def configure(self, in_specs: Dict[str, TensorsSpec]) -> Dict[str, TensorsSpec]:
+        spec = in_specs["sink"]
+        if spec.num_tensors < 1:
+            raise NegotiationError(f"{self.name}: needs at least one tensor")
+        first = spec.tensors[0]
+        for t in spec.tensors[1:]:
+            if t.shape != first.shape or t.dtype != first.dtype:
+                raise NegotiationError(
+                    f"{self.name}: all tensors must share one spec to batch; "
+                    f"got {t} vs {first}"
+                )
+        self._n = spec.num_tensors
+        out = TensorSpec(dtype=first.dtype, shape=(self._n,) + tuple(first.shape))
+        return {"src": TensorsSpec(tensors=(out,), rate=spec.rate)}
+
+    def process(self, pad: Pad, frame: Frame):
+        del pad
+        import jax.numpy as jnp
+
+        return frame.with_tensors((jnp.stack(frame.tensors, axis=0),))
+
+
+@register_element("tensor_unbatch")
+class TensorUnbatch(Node):
+    def __init__(self, name: Optional[str] = None):
+        super().__init__(name)
+        self.add_sink_pad("sink")
+        self.add_src_pad("src")
+
+    def configure(self, in_specs: Dict[str, TensorsSpec]) -> Dict[str, TensorsSpec]:
+        spec = in_specs["sink"]
+        if spec.num_tensors != 1:
+            raise NegotiationError(f"{self.name}: expects one batched tensor")
+        t = spec.tensors[0]
+        if t.rank < 1 or t.shape[0] is None:
+            raise NegotiationError(f"{self.name}: batch dim must be fixed, got {t}")
+        n = t.shape[0]
+        per = TensorSpec(dtype=t.dtype, shape=tuple(t.shape[1:]))
+        return {"src": TensorsSpec(tensors=(per,) * n, rate=spec.rate)}
+
+    def process(self, pad: Pad, frame: Frame):
+        del pad
+        batched = frame.tensors[0]
+        # device-resident: row views share the parent buffer, no copies.
+        return frame.with_tensors(tuple(batched[i] for i in range(batched.shape[0])))

@@ -169,6 +169,14 @@ class TensorFilter(Node):
                 f"{self.name}: fused pre-transform output {spec_cur} is "
                 f"incompatible with model spec {model_spec}"
             )
+        # input= property describes the MODEL input, which with fusion is the
+        # pre-transform chain's output — enforce it here (the unfused path
+        # enforces it in sink_spec).
+        if self._prop_in is not None and self._prop_in.intersect(spec_cur) is None:
+            raise NegotiationError(
+                f"{self.name}: fused pre-transform output {spec_cur} "
+                f"conflicts with input property {self._prop_in}"
+            )
         post_stages = []
         if self._fused_post:
             spec_o = self.backend.trace_output_spec(spec_cur)

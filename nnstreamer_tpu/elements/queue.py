@@ -6,17 +6,27 @@ own streaming thread — the core of its single-node pipeline parallelism
 node reproduces that: ``_dispatch`` enqueues into a bounded buffer (returning
 immediately to the upstream thread, or blocking when full = backpressure),
 and a dedicated worker thread drains the buffer into the downstream chain.
+
+The buffer itself is the native C++ frame queue
+(:mod:`nnstreamer_tpu.native.queue`) when the runtime library is available —
+blocking waits then happen outside the GIL — with a pure-Python twin as
+fallback.  Leak modes mirror GStreamer's: ``no`` (backpressure),
+``downstream`` (drop oldest queued frame), ``upstream`` (drop newest
+incoming frame); in-band events are never dropped.
 """
 
 from __future__ import annotations
 
-import collections
 import threading
 from typing import List, Optional
 
-from ..buffer import Event, Frame
+from ..buffer import Event
 from ..graph.node import Node, Pad
 from ..graph.registry import register_element
+from ..native import OK, SHUTDOWN
+from ..native.queue import make_frame_queue
+
+_POLL_MS = 100  # wake periodically so shutdown is never missed
 
 
 @register_element("queue")
@@ -31,46 +41,41 @@ class Queue(Node):
         self.add_sink_pad("sink")
         self.add_src_pad("src")
         self.max_size = int(max_size_buffers)
-        self.leaky = str(leaky)  # "no" | "downstream" (drop newest when full)
-        self._buf = collections.deque()
-        self._cv = threading.Condition()
-        self._shutdown = False
+        if leaky not in ("no", "downstream", "upstream"):
+            raise ValueError(f"unknown leaky mode {leaky!r}")
+        self.leaky = str(leaky)
+        self._q = None
+
+    @property
+    def backend_kind(self) -> str:
+        """'native' or 'python' — which queue implementation is active."""
+        from ..native.queue import NativeFrameQueue
+
+        if self._q is None:
+            self._ensure_queue()
+        return "native" if isinstance(self._q, NativeFrameQueue) else "python"
+
+    def _ensure_queue(self) -> None:
+        if self._q is None:
+            self._q = make_frame_queue(self.max_size)
 
     def _dispatch(self, pad: Pad, item) -> None:
         del pad
-        with self._cv:
-            if self.leaky == "downstream":
-                # GStreamer leaky=downstream: leak the *oldest* queued frame
-                # so live pipelines stay current; events are never dropped.
-                if len(self._buf) >= self.max_size and isinstance(item, Frame):
-                    for i, queued in enumerate(self._buf):
-                        if isinstance(queued, Frame):
-                            del self._buf[i]
-                            break
-            elif self.leaky == "upstream":
-                if len(self._buf) >= self.max_size and isinstance(item, Frame):
-                    return  # drop the newest incoming frame
-            else:
-                while len(self._buf) >= self.max_size and not self._shutdown:
-                    self._cv.wait(0.1)
-            if self._shutdown:
-                return
-            self._buf.append(item)
-            self._cv.notify_all()
+        self._ensure_queue()
+        self._q.push(item, leaky=self.leaky)
 
     def spawn_threads(self) -> List[threading.Thread]:
-        self._shutdown = False
+        self._ensure_queue()
         return [threading.Thread(target=self._worker, name=f"queue:{self.name}")]
 
     def _worker(self) -> None:
+        q = self._q  # stop() may null the attribute while we drain
         while True:
-            with self._cv:
-                while not self._buf and not self._shutdown:
-                    self._cv.wait(0.1)
-                if self._shutdown and not self._buf:
-                    return
-                item = self._buf.popleft()
-                self._cv.notify_all()
+            status, item = q.pop(_POLL_MS)
+            if status == SHUTDOWN:
+                return
+            if status != OK:
+                continue  # timeout poll: retry
             if isinstance(item, Event):
                 if item.kind == "eos":
                     self.sink_pads["sink"].eos = True
@@ -86,6 +91,11 @@ class Queue(Node):
                     return
 
     def interrupt(self) -> None:
-        with self._cv:
-            self._shutdown = True
-            self._cv.notify_all()
+        if self._q is not None:
+            self._q.shutdown()
+
+    def stop(self) -> None:
+        if self._q is not None:
+            self._q.shutdown()
+            self._q = None
+        super().stop()

@@ -58,6 +58,10 @@ class Pipeline:
     def __getitem__(self, name: str) -> Node:
         return self.nodes[name]
 
+    def get_by_name(self, name: str) -> Node:
+        """Named-element lookup (``gst_bin_get_by_name`` analog)."""
+        return self.nodes[name]
+
     def _resolve(self, ref: Union[Node, str]) -> (Node, Optional[str]):
         """Resolve 'node' or 'node.pad' references."""
         if isinstance(ref, Node):
@@ -147,6 +151,13 @@ class Pipeline:
                 node.start()
                 started.append(node)
             self.negotiate()
+            self._leaves = {
+                n.name
+                for n in self.nodes.values()
+                if not any(p.peer is not None for p in n.src_pads.values())
+            }
+            if not self._leaves:
+                raise PipelineError("pipeline has no leaf (sink) nodes")
         except BaseException:
             for node in started:
                 try:
@@ -156,14 +167,8 @@ class Pipeline:
             for undo in reversed(fuse_undos):
                 undo()
             raise
-        self._leaves = {
-            n.name
-            for n in self.nodes.values()
-            if not any(p.peer is not None for p in n.src_pads.values())
-        }
-        if not self._leaves:
-            raise PipelineError("pipeline has no leaf (sink) nodes")
         self.state = "PLAYING"
+        self._post_negotiate_hooks()
         # Spawn worker threads requested by nodes (queues), then sources.
         for node in self.nodes.values():
             spawn = getattr(node, "spawn_threads", None)
@@ -248,6 +253,38 @@ class Pipeline:
             self.stop()
 
     # -- introspection ------------------------------------------------------
+
+    def _post_negotiate_hooks(self) -> None:
+        """Conf-driven observability at PLAYING: profiling enable + dot dump
+        (the GST_DEBUG_DUMP_DOT_DIR analog, ``tools/debugging/``)."""
+        import os
+        import warnings
+
+        from ..conf import conf
+
+        # observability must never take the pipeline down: any failure here
+        # (bad conf values included) is a warning, not an error.
+        try:
+            if conf.get_bool("common", "enable_profiling", False):
+                from ..utils import profiling
+
+                profiling.enable(True)
+            dot_dir = conf.get_path("common", "dump_dot_dir", "")
+            if dot_dir:
+                os.makedirs(dot_dir, exist_ok=True)
+                path = os.path.join(dot_dir, f"{self.name}.PLAYING.dot")
+                with open(path, "w") as f:
+                    f.write(self.to_dot())
+        except Exception as exc:  # noqa: BLE001
+            warnings.warn(f"observability hooks failed: {exc!r}", stacklevel=2)
+
+    def stats(self) -> dict:
+        """Per-node invoke-latency summary (ms) for this pipeline's nodes;
+        populated when profiling is enabled."""
+        from ..utils import profiling
+
+        all_stats = profiling.stats()
+        return {k: v for k, v in all_stats.items() if k in self.nodes}
 
     def to_dot(self) -> str:
         """Graphviz dump of the graph with negotiated specs — the analog of

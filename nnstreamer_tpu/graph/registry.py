@@ -51,6 +51,12 @@ def make(factory_name: str, /, element_name: Optional[str] = None, **props) -> N
         importlib.import_module(_BUILTIN_MODULES[factory_name])
         factory = _FACTORIES.get(factory_name)
     if factory is None:
+        # external-plugin fallback (conf-scanned nnstpu_*.py, the dlopen
+        # analog): load once, retry.
+        from ..conf import lookup_with_plugin_fallback
+
+        factory = lookup_with_plugin_fallback(lambda: _FACTORIES.get(factory_name))
+    if factory is None:
         raise ValueError(
             f"unknown element {factory_name!r}; known: {sorted(known_elements())}"
         )
@@ -79,6 +85,8 @@ for _el, _mod in {
     "tensor_reposink": "nnstreamer_tpu.elements.repo",
     "tensor_reposrc": "nnstreamer_tpu.elements.repo",
     "tensor_src_iio": "nnstreamer_tpu.elements.iio_src",
+    "tensor_batch": "nnstreamer_tpu.elements.batch",
+    "tensor_unbatch": "nnstreamer_tpu.elements.batch",
     # runtime/plumbing elements (GStreamer-provided in the reference)
     "queue": "nnstreamer_tpu.elements.queue",
     "tee": "nnstreamer_tpu.elements.tee",

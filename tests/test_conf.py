@@ -1,0 +1,172 @@
+"""Config system tests: env > ini > defaults layering + external plugin
+scanning (the ``nnstreamer_conf`` / subplugin-dlopen analog,
+``nnstreamer_conf.c:37-52,137-166``)."""
+
+import os
+import textwrap
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.conf import Conf
+
+
+class TestLayering:
+    def test_defaults(self):
+        c = Conf(ini_path="/nonexistent/nothing.ini", environ={})
+        assert c.get("filter", "jax_dtype") == "bfloat16"
+        assert c.get_bool("common", "enable_profiling") is False
+        assert c.get("common", "missing_key") is None
+        assert c.get("common", "missing_key", "fallback") == "fallback"
+
+    def test_ini_overrides_defaults(self, tmp_path):
+        ini = tmp_path / "nnstreamer_tpu.ini"
+        ini.write_text(
+            textwrap.dedent(
+                """
+                [filter]
+                jax_dtype = float32
+                [common]
+                enable_profiling = yes
+                """
+            )
+        )
+        c = Conf(ini_path=str(ini), environ={})
+        assert c.get("filter", "jax_dtype") == "float32"
+        assert c.get_bool("common", "enable_profiling") is True
+
+    def test_env_overrides_ini(self, tmp_path):
+        ini = tmp_path / "n.ini"
+        ini.write_text("[filter]\njax_dtype = float32\n")
+        c = Conf(ini_path=str(ini), environ={"NNSTPU_FILTER_JAX_DTYPE": "float16"})
+        assert c.get("filter", "jax_dtype") == "float16"
+
+    def test_nnstpu_conf_env_points_at_ini(self, tmp_path):
+        ini = tmp_path / "alt.ini"
+        ini.write_text("[common]\nenable_profiling = on\n")
+        c = Conf(environ={"NNSTPU_CONF": str(ini)})
+        assert c.ini_path == str(ini)
+        assert c.get_bool("common", "enable_profiling") is True
+
+    def test_typed_getters(self):
+        env = {
+            "NNSTPU_X_I": "42",
+            "NNSTPU_X_F": "2.5",
+            "NNSTPU_X_B": "off",
+            "NNSTPU_X_P": "~/somewhere",
+        }
+        c = Conf(ini_path="/nonexistent.ini", environ=env)
+        assert c.get_int("x", "i") == 42
+        assert c.get_float("x", "f") == 2.5
+        assert c.get_bool("x", "b", True) is False
+        assert c.get_path("x", "p") == os.path.expanduser("~/somewhere")
+
+    def test_bad_bool_raises(self):
+        c = Conf(ini_path="/nonexistent.ini", environ={"NNSTPU_X_B": "maybe"})
+        with pytest.raises(ValueError):
+            c.get_bool("x", "b")
+
+    def test_refresh_rereads_ini(self, tmp_path):
+        ini = tmp_path / "n.ini"
+        ini.write_text("[filter]\njax_dtype = float32\n")
+        c = Conf(ini_path=str(ini), environ={})
+        assert c.get("filter", "jax_dtype") == "float32"
+        ini.write_text("[filter]\njax_dtype = bfloat16\n")
+        c.refresh()
+        assert c.get("filter", "jax_dtype") == "bfloat16"
+
+
+PLUGIN_SRC = """
+import numpy as np
+from nnstreamer_tpu.backends.base import FilterBackend, register_backend
+from nnstreamer_tpu.graph.node import Node
+from nnstreamer_tpu.graph.registry import register_element
+from nnstreamer_tpu.elements.decoder import DecoderPlugin, register_decoder
+from nnstreamer_tpu.spec import TensorSpec, TensorsSpec
+
+
+@register_backend("test-negate")
+class NegateBackend(FilterBackend):
+    def open(self, model, custom=""):
+        pass
+
+    def reconfigure(self, in_spec):
+        return in_spec
+
+    def invoke(self, tensors):
+        return tuple(-t for t in tensors)
+
+
+@register_element("test_identity")
+class IdentityElement(Node):
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.add_sink_pad("sink")
+        self.add_src_pad("src")
+
+
+@register_decoder("test_sum")
+class SumDecoder(DecoderPlugin):
+    def out_spec(self, in_spec):
+        return TensorsSpec(tensors=(TensorSpec(dtype=np.float32, shape=(1,)),))
+
+    def decode(self, frame, in_spec):
+        total = np.asarray([sum(float(np.sum(t)) for t in frame.tensors)],
+                           dtype=np.float32)
+        return frame.replace(tensors=(total,))
+"""
+
+
+class TestExternalPlugins:
+    @pytest.fixture()
+    def plugin_dir(self, tmp_path, monkeypatch):
+        pdir = tmp_path / "plugins"
+        pdir.mkdir()
+        (pdir / "nnstpu_testplug.py").write_text(PLUGIN_SRC)
+        monkeypatch.setenv("NNSTPU_PLUGIN_PATH", str(pdir))
+        return pdir
+
+    def test_scan_finds_plugin_files(self, plugin_dir):
+        c = Conf(ini_path="/nonexistent.ini")
+        files = c.scan_plugin_files()
+        assert any(f.endswith("nnstpu_testplug.py") for f in files)
+
+    def test_non_plugin_files_ignored(self, plugin_dir):
+        (plugin_dir / "other.py").write_text("raise RuntimeError('must not load')")
+        c = Conf(ini_path="/nonexistent.ini")
+        assert not any(f.endswith("other.py") for f in c.scan_plugin_files())
+
+    def test_registry_miss_loads_plugin(self, plugin_dir):
+        # conf is the process-global; its env is read live, so the
+        # monkeypatched NNSTPU_PLUGIN_PATH is visible.
+        from nnstreamer_tpu.backends.base import get_backend
+        from nnstreamer_tpu.elements.decoder import get_decoder
+        from nnstreamer_tpu.graph.registry import make
+
+        backend = get_backend("test-negate")
+        backend.open(None)
+        (out,) = backend.invoke((np.ones(3, np.float32),))
+        assert (out == -1).all()
+
+        node = make("test_identity")
+        assert node.sink_pads and node.src_pads
+
+        dec = get_decoder("test_sum")
+        assert dec is not None
+
+    def test_plugin_loaded_once(self, plugin_dir):
+        c = Conf(ini_path="/nonexistent.ini")
+        first = c.load_external_plugins()
+        assert first >= 1
+        assert c.load_external_plugins() == 0
+
+    def test_ini_plugin_path(self, tmp_path, monkeypatch):
+        monkeypatch.delenv("NNSTPU_PLUGIN_PATH", raising=False)
+        pdir = tmp_path / "ini_plugins"
+        pdir.mkdir()
+        (pdir / "nnstpu_from_ini.py").write_text("LOADED = True\n")
+        ini = tmp_path / "n.ini"
+        ini.write_text(f"[common]\nplugin_path = {pdir}\n")
+        c = Conf(ini_path=str(ini), environ={})
+        assert c.plugin_dirs() == [str(pdir)]
+        assert c.load_external_plugins() == 1

@@ -156,3 +156,23 @@ def test_namedtuple_output_with_post_transform(rng):
     np.testing.assert_allclose(
         np.asarray(f.tensor(1)), np.clip(x + 1.0, 0, 100), rtol=1e-5
     )
+
+
+def test_fused_input_property_still_enforced(rng):
+    """input= describes the MODEL input; fusion must not skip the check
+    (regression: _install_fusion used to ignore _prop_in)."""
+    from nnstreamer_tpu import NegotiationError, PipelineError
+
+    x = rng.integers(0, 255, (4,), dtype=np.uint8)
+    p = Pipeline()
+    src = p.add(DataSrc(data=[x]))
+    tr = p.add(TensorTransform(mode="typecast", option="float32"))
+    filt = p.add(TensorFilter(
+        framework="jax", model=_model(), input="8", inputtype="float32"
+    ))
+    sink = p.add(TensorSink(collect=True))
+    p.link_chain(src, tr, filt, sink)
+    with pytest.raises((NegotiationError, PipelineError)):
+        p.start()
+    # failed start restored the spliced-out transform
+    assert tr.name in p.nodes

@@ -1,0 +1,243 @@
+"""Native runtime core tests: the C++ frame queue and its Python twin.
+
+Both implementations are driven through the same contract (the GStreamer
+queue leak-mode semantics the ``queue`` element needs); the native one also
+checks build/load plumbing and handle-table hygiene."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu import native
+from nnstreamer_tpu.buffer import Event, Frame
+from nnstreamer_tpu.native import (
+    DROPPED_INCOMING,
+    OK,
+    OK_DROPPED_OLDEST,
+    SHUTDOWN,
+    TIMEOUT,
+)
+from nnstreamer_tpu.native.queue import NativeFrameQueue, PyFrameQueue
+
+IMPLS = [PyFrameQueue]
+if native.load() is not None:
+    IMPLS.append(NativeFrameQueue)
+
+
+def test_native_library_builds():
+    """The toolchain is present in this image; the native path must be real."""
+    assert native.load() is not None
+
+
+@pytest.fixture(params=IMPLS, ids=lambda c: c.__name__)
+def q4(request):
+    q = request.param(4)
+    yield q
+    q.close()
+
+
+class TestContract:
+    def test_fifo_order(self, q4):
+        for i in range(4):
+            assert q4.push(i) == OK
+        assert len(q4) == 4
+        assert [q4.pop(0)[1] for _ in range(4)] == [0, 1, 2, 3]
+
+    def test_pop_timeout(self, q4):
+        status, item = q4.pop(timeout_ms=30)
+        assert status == TIMEOUT and item is None
+
+    def test_blocking_push_backpressure(self, q4):
+        for i in range(4):
+            q4.push(i)
+        done = []
+
+        def pusher():
+            done.append(q4.push(99, leaky="no"))
+
+        t = threading.Thread(target=pusher)
+        t.start()
+        time.sleep(0.05)
+        assert not done  # blocked: queue full
+        assert q4.pop(0) == (OK, 0)
+        t.join(timeout=2)
+        assert done == [OK]
+        assert len(q4) == 4
+
+    def test_leaky_downstream_drops_oldest(self, q4):
+        for i in range(4):
+            q4.push(i)
+        assert q4.push(4, leaky="downstream") == OK_DROPPED_OLDEST
+        assert [q4.pop(0)[1] for _ in range(4)] == [1, 2, 3, 4]
+
+    def test_leaky_upstream_rejects_incoming(self, q4):
+        for i in range(4):
+            q4.push(i)
+        assert q4.push(4, leaky="upstream") == DROPPED_INCOMING
+        assert [q4.pop(0)[1] for _ in range(4)] == [0, 1, 2, 3]
+
+    def test_events_never_dropped(self, q4):
+        eos = Event.eos()
+        q4.push(0)
+        q4.push(eos)
+        q4.push(2)
+        q4.push(3)
+        # leak downstream must evict the oldest NON-event (0), keeping eos
+        assert q4.push(4, leaky="downstream") == OK_DROPPED_OLDEST
+        popped = [q4.pop(0)[1] for _ in range(4)]
+        assert popped[0] is eos
+        assert popped[1:] == [2, 3, 4]
+
+    def test_shutdown_wakes_blocked_pop(self, q4):
+        results = []
+
+        def popper():
+            results.append(q4.pop(-1))
+
+        t = threading.Thread(target=popper)
+        t.start()
+        time.sleep(0.05)
+        q4.shutdown()
+        t.join(timeout=2)
+        assert results == [(SHUTDOWN, None)]
+
+    def test_shutdown_wakes_blocked_push(self, q4):
+        for i in range(4):
+            q4.push(i)
+        results = []
+
+        def pusher():
+            results.append(q4.push(99))
+
+        t = threading.Thread(target=pusher)
+        t.start()
+        time.sleep(0.05)
+        q4.shutdown()
+        t.join(timeout=2)
+        assert results == [SHUTDOWN]
+
+    def test_pop_drains_before_shutdown_reports(self, q4):
+        q4.push("x")
+        q4.shutdown()
+        assert q4.pop(0) == (OK, "x")
+        assert q4.pop(0) == (SHUTDOWN, None)
+
+    def test_arbitrary_python_objects(self, q4):
+        frame = Frame.of(np.arange(3))
+        q4.push(frame)
+        status, out = q4.pop(0)
+        assert status == OK and out is frame
+
+
+class TestNativeSpecifics:
+    @pytest.mark.skipif(native.load() is None, reason="native lib unavailable")
+    def test_handle_table_empties(self):
+        q = NativeFrameQueue(8)
+        try:
+            for i in range(8):
+                q.push(i)
+            for _ in range(8):
+                q.pop(0)
+            assert not q._objs
+            # rejected pushes must not leak table entries either
+            for i in range(8):
+                q.push(i)
+            q.push(99, leaky="upstream")
+            assert len(q._objs) == 8
+        finally:
+            q.close()
+
+    @pytest.mark.skipif(native.load() is None, reason="native lib unavailable")
+    def test_mpsc_stress(self):
+        """4 producers × 1 consumer, 400 items, nothing lost or duplicated."""
+        q = NativeFrameQueue(16)
+        seen = []
+        n_per = 100
+
+        def produce(base):
+            for i in range(n_per):
+                q.push(base + i)
+
+        def consume():
+            while len(seen) < 4 * n_per:
+                status, item = q.pop(200)
+                if status == OK:
+                    seen.append(item)
+                elif status == SHUTDOWN:
+                    return
+
+        threads = [threading.Thread(target=produce, args=(k * 1000,)) for k in range(4)]
+        consumer = threading.Thread(target=consume)
+        consumer.start()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=10)
+        consumer.join(timeout=10)
+        q.close()
+        assert sorted(seen) == sorted(
+            k * 1000 + i for k in range(4) for i in range(n_per)
+        )
+
+
+class TestQueueElementIntegration:
+    def test_element_uses_native_when_available(self):
+        from nnstreamer_tpu.elements.queue import Queue
+
+        q = Queue(max_size_buffers=2)
+        expected = "native" if native.load() is not None else "python"
+        assert q.backend_kind == expected
+        q.stop()
+
+    def test_element_python_fallback_via_conf(self, monkeypatch):
+        from nnstreamer_tpu.elements.queue import Queue
+
+        monkeypatch.setenv("NNSTPU_COMMON_NATIVE_RUNTIME", "off")
+        q = Queue(max_size_buffers=2)
+        assert q.backend_kind == "python"
+        q.stop()
+
+    @pytest.mark.parametrize("native_on", ["on", "off"])
+    def test_pipeline_through_queue(self, monkeypatch, native_on):
+        monkeypatch.setenv("NNSTPU_COMMON_NATIVE_RUNTIME", native_on)
+        from nnstreamer_tpu import Pipeline
+        from nnstreamer_tpu.elements.queue import Queue
+        from nnstreamer_tpu.elements.sink import TensorSink
+        from nnstreamer_tpu.elements.testsrc import DataSrc
+
+        data = [np.full(3, i, np.float32) for i in range(20)]
+        got = []
+        p = Pipeline()
+        src = p.add(DataSrc(data=data))
+        q = p.add(Queue(max_size_buffers=4))
+        sink = p.add(TensorSink(callback=lambda f: got.append(f)))
+        p.link_chain(src, q, sink)
+        p.run(timeout=60)
+        assert len(got) == 20
+        np.testing.assert_array_equal(np.asarray(got[7].tensors[0]), data[7])
+
+    def test_leaky_downstream_pipeline_stays_live(self):
+        """A slow consumer behind a leaky queue drops frames, not deadlocks."""
+        from nnstreamer_tpu import Pipeline
+        from nnstreamer_tpu.elements.queue import Queue
+        from nnstreamer_tpu.elements.sink import TensorSink
+        from nnstreamer_tpu.elements.testsrc import DataSrc
+
+        got = []
+
+        def slow_sink(frame):
+            time.sleep(0.005)
+            got.append(frame)
+
+        data = [np.full(2, i, np.float32) for i in range(50)]
+        p = Pipeline()
+        src = p.add(DataSrc(data=data))
+        q = p.add(Queue(max_size_buffers=2, leaky="downstream"))
+        sink = p.add(TensorSink(callback=slow_sink))
+        p.link_chain(src, q, sink)
+        p.run(timeout=60)
+        assert 0 < len(got) <= 50
+        # the LAST frame always survives leak-downstream (newest kept)
+        np.testing.assert_array_equal(np.asarray(got[-1].tensors[0]), data[-1])

@@ -1,0 +1,54 @@
+"""Observability: conf-driven dot dumps + per-pipeline latency stats."""
+
+import os
+
+import numpy as np
+
+from nnstreamer_tpu import Pipeline
+from nnstreamer_tpu.elements.filter import TensorFilter
+from nnstreamer_tpu.elements.sink import TensorSink
+from nnstreamer_tpu.elements.testsrc import DataSrc
+
+
+def simple_pipeline(got):
+    p = Pipeline(name="obs_test")
+    src = p.add(DataSrc(data=[np.full(4, i, np.float32) for i in range(5)]))
+    filt = p.add(
+        TensorFilter(framework="custom", model=lambda x: x * 2, name="double")
+    )
+    sink = p.add(TensorSink(callback=got.append))
+    p.link_chain(src, filt, sink)
+    return p
+
+
+def test_dump_dot_dir(tmp_path, monkeypatch):
+    monkeypatch.setenv("NNSTPU_COMMON_DUMP_DOT_DIR", str(tmp_path / "dots"))
+    got = []
+    simple_pipeline(got).run(timeout=30)
+    path = tmp_path / "dots" / "obs_test.PLAYING.dot"
+    assert path.exists()
+    dot = path.read_text()
+    assert "digraph" in dot and "double" in dot
+
+
+def test_conf_enables_profiling_and_stats(monkeypatch):
+    monkeypatch.setenv("NNSTPU_COMMON_ENABLE_PROFILING", "true")
+    got = []
+    p = simple_pipeline(got)
+    p.run(timeout=30)
+    assert len(got) == 5
+    stats = p.stats()
+    assert "double" in stats
+    assert stats["double"]["count"] == 5
+    assert stats["double"]["p50_ms"] >= 0
+
+
+def test_stats_scoped_to_pipeline(monkeypatch):
+    monkeypatch.setenv("NNSTPU_COMMON_ENABLE_PROFILING", "true")
+    from nnstreamer_tpu.utils import profiling
+
+    profiling.record("not_in_this_pipeline", 123)
+    got = []
+    p = simple_pipeline(got)
+    p.run(timeout=30)
+    assert "not_in_this_pipeline" not in p.stats()

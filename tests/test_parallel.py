@@ -1,0 +1,166 @@
+"""Multi-stream batching over the device mesh (north-star topology #5):
+
+    src×N → tensor_mux → tensor_batch → tensor_filter(jax-sharded)
+          → tensor_unbatch → tensor_demux → sink×N
+
+Runs on the virtual 8-device CPU mesh (conftest) — the CI analog of v5e-8
+(survey §4: "multi-node without a cluster" = CPU-backed JAX)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from nnstreamer_tpu import Frame, NegotiationError, Pipeline
+from nnstreamer_tpu.backends.jax_backend import JaxModel
+from nnstreamer_tpu.elements.batch import TensorBatch, TensorUnbatch
+from nnstreamer_tpu.elements.demux import TensorDemux
+from nnstreamer_tpu.elements.filter import TensorFilter
+from nnstreamer_tpu.elements.mux import TensorMux
+from nnstreamer_tpu.elements.sink import TensorSink
+from nnstreamer_tpu.elements.testsrc import DataSrc
+from nnstreamer_tpu.spec import TensorSpec, TensorsSpec
+
+
+def linear_model(rng, d_in=16, d_out=4):
+    w = rng.standard_normal((d_in, d_out)).astype(np.float32)
+    b = rng.standard_normal(d_out).astype(np.float32)
+
+    def apply(params, x):  # x: (batch, d_in)
+        return x @ params["w"] + params["b"]
+
+    return JaxModel(apply=apply, params={"w": w, "b": b}), (w, b)
+
+
+class TestBatchElements:
+    def test_batch_stacks(self):
+        batch = TensorBatch()
+        spec = TensorsSpec(
+            tensors=(TensorSpec(dtype=np.float32, shape=(4,)),) * 3
+        )
+        out = batch.configure({"sink": spec})["src"]
+        assert out.tensors[0].shape == (3, 4)
+        frame = Frame.of(*[np.full(4, i, np.float32) for i in range(3)])
+        res = batch.process(None, frame)
+        stacked = res.tensors[0]
+        assert stacked.shape == (3, 4)
+        np.testing.assert_array_equal(np.asarray(stacked)[:, 0], [0, 1, 2])
+
+    def test_unbatch_inverts(self):
+        unbatch = TensorUnbatch()
+        spec = TensorsSpec(tensors=(TensorSpec(dtype=np.int32, shape=(3, 2)),))
+        out = unbatch.configure({"sink": spec})["src"]
+        assert out.num_tensors == 3
+        assert out.tensors[0].shape == (2,)
+        frame = Frame.of(np.arange(6, dtype=np.int32).reshape(3, 2))
+        res = unbatch.process(None, frame)
+        assert res.num_tensors == 3
+        np.testing.assert_array_equal(np.asarray(res.tensors[2]), [4, 5])
+
+    def test_batch_rejects_mismatched_specs(self):
+        batch = TensorBatch()
+        spec = TensorsSpec(
+            tensors=(
+                TensorSpec(dtype=np.float32, shape=(4,)),
+                TensorSpec(dtype=np.float32, shape=(5,)),
+            )
+        )
+        with pytest.raises(NegotiationError):
+            batch.configure({"sink": spec})
+
+
+class TestMultiStreamSharded:
+    @pytest.mark.parametrize("n_streams,frames_per_stream", [(8, 3)])
+    def test_north_star_topology(self, rng, n_streams, frames_per_stream):
+        assert len(jax.devices()) >= 8, "conftest must provide 8 CPU devices"
+        model, (w, b) = linear_model(rng)
+        data = [
+            [rng.standard_normal(16).astype(np.float32) for _ in range(frames_per_stream)]
+            for _ in range(n_streams)
+        ]
+
+        received = {i: [] for i in range(n_streams)}
+        p = Pipeline()
+        mux = p.add(TensorMux(sync_mode="nosync"))
+        srcs = [p.add(DataSrc(data=data[i], name=f"cam{i}")) for i in range(n_streams)]
+        batch = p.add(TensorBatch())
+        filt = p.add(
+            TensorFilter(
+                framework="jax-sharded", model=model, custom="devices=8,axis=dp"
+            )
+        )
+        unbatch = p.add(TensorUnbatch())
+        demux = p.add(TensorDemux())
+        for i, src in enumerate(srcs):
+            p.link(src, f"{mux.name}.sink_{i}")
+        p.link_chain(mux, batch, filt, unbatch, demux)
+        for i in range(n_streams):
+            sink = p.add(TensorSink(name=f"out{i}"))
+            sink.connect("new-data", lambda f, i=i: received[i].append(f))
+            p.link(f"{demux.name}.src_{i}", sink)
+        p.run(timeout=120)
+
+        for i in range(n_streams):
+            assert len(received[i]) == frames_per_stream
+            for j, frame in enumerate(received[i]):
+                golden = data[i][j] @ w + b
+                np.testing.assert_allclose(
+                    np.asarray(frame.tensors[0]), golden, rtol=2e-5, atol=2e-5
+                )
+
+    def test_batched_invoke_is_sharded(self, rng):
+        """The filter's batched output must actually live across the mesh."""
+        model, _ = linear_model(rng)
+        seen = []
+        p = Pipeline()
+        srcs = [
+            p.add(DataSrc(data=[rng.standard_normal(16).astype(np.float32)]))
+            for _ in range(8)
+        ]
+        mux = p.add(TensorMux(sync_mode="nosync"))
+        batch = p.add(TensorBatch())
+        filt = p.add(
+            TensorFilter(framework="jax-sharded", model=model, custom="devices=8")
+        )
+        sink = p.add(TensorSink())
+        sink.connect("new-data", seen.append)
+        for i, src in enumerate(srcs):
+            p.link(src, f"{mux.name}.sink_{i}")
+        p.link_chain(mux, batch, filt, sink)
+        p.run(timeout=120)
+        assert len(seen) == 1
+        out = seen[0].tensors[0]
+        assert hasattr(out, "sharding")
+        assert len(out.sharding.device_set) == 8
+
+    def test_parse_launch_batched(self, rng):
+        """String pipelines can express the batched topology."""
+        from nnstreamer_tpu import parse_launch
+        from nnstreamer_tpu.backends.custom import register_custom_easy
+
+        in_spec = TensorsSpec(tensors=(TensorSpec(dtype=np.float32, shape=(4, 2)),))
+        out_spec = in_spec
+        register_custom_easy("double4x2", lambda x: x * 2, in_spec, out_spec)
+        try:
+            frames = []
+            p = parse_launch(
+                "tensor_mux name=m sync_mode=nosync ! tensor_batch ! "
+                "tensor_filter framework=custom-easy model=double4x2 ! "
+                "tensor_unbatch ! tensor_sink name=out"
+            )
+            for i in range(4):
+                src = DataSrc(data=[np.full(2, i, np.float32)], name=f"s{i}")
+                p.add(src)
+                p.link(src, f"m.sink_{i}")
+            p.get_by_name("out").connect("new-data", frames.append)
+            p.run(timeout=60)
+            assert len(frames) == 1
+            assert frames[0].num_tensors == 4
+            np.testing.assert_array_equal(
+                np.asarray(frames[0].tensors[3]), [6.0, 6.0]
+            )
+        finally:
+            from nnstreamer_tpu.backends.custom import unregister_custom_easy
+
+            unregister_custom_easy("double4x2")
