@@ -1,0 +1,22 @@
+"""Average custom filter — the `custom_example_average` analog.
+
+Reduces an (H, W, C) video tensor to its per-channel spatial mean (1, 1, C),
+keeping the input dtype like the reference example does."""
+
+import numpy as np
+
+from nnstreamer_tpu.backends.custom import CustomFilterBase
+from nnstreamer_tpu.spec import TensorSpec, TensorsSpec
+
+
+class CustomFilter(CustomFilterBase):
+    def set_input_spec(self, in_spec):
+        t = in_spec.tensors[0]
+        if len(t.shape) != 3:
+            raise ValueError(f"average expects (H, W, C) video tensors, got {t}")
+        out = TensorSpec(dtype=t.dtype, shape=(1, 1, t.shape[2]))
+        return TensorsSpec(tensors=(out,), rate=in_spec.rate)
+
+    def invoke(self, frame):
+        mean = np.asarray(frame).mean(axis=(0, 1), keepdims=True)
+        return mean.astype(frame.dtype)

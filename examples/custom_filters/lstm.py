@@ -1,0 +1,27 @@
+"""LSTM-step custom filter — the `dummy_LSTM.c` fixture analog.
+
+One step of a parameter-free LSTM-ish update (matching the reference
+fixture's golden math, ``tests/nnstreamer_repo_lstm/generateTestCase.py``):
+inputs ``(h, c, x)`` → outputs ``(h', c')``, meant to run inside a repo-slot
+cycle (`tensor_reposrc` slot feeds h/c back in)."""
+
+import numpy as np
+
+from nnstreamer_tpu.backends.custom import CustomFilterBase
+from nnstreamer_tpu.spec import TensorsSpec
+
+
+class CustomFilter(CustomFilterBase):
+    def set_input_spec(self, in_spec):
+        if in_spec.num_tensors != 3:
+            raise ValueError("lstm filter expects (h, c, x)")
+        h, c, x = in_spec.tensors
+        if not (h.shape == c.shape == x.shape):
+            raise ValueError(f"h/c/x specs must match, got {in_spec}")
+        return TensorsSpec(tensors=(h, c), rate=in_spec.rate)
+
+    def invoke(self, h, c, x):
+        h, c, x = (np.asarray(t, np.float32) for t in (h, c, x))
+        c_new = np.tanh(c + x)
+        h_new = np.tanh(h + c_new)
+        return h_new, c_new

@@ -1,0 +1,13 @@
+"""Passthrough custom filter — the `custom_example_passthrough` analog.
+
+Shape-polymorphic: accepts whatever the upstream spec is and echoes it."""
+
+from nnstreamer_tpu.backends.custom import CustomFilterBase
+
+
+class CustomFilter(CustomFilterBase):
+    def set_input_spec(self, in_spec):
+        return in_spec
+
+    def invoke(self, *tensors):
+        return tensors

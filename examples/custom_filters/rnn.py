@@ -1,0 +1,23 @@
+"""RNN-step custom filter — the `dummy_RNN.c` fixture analog.
+
+One step of a parameter-free tanh RNN: inputs ``(h, x)`` → output ``h'``,
+for repo-slot recurrence (`tests/nnstreamer_repo_rnn` topology)."""
+
+import numpy as np
+
+from nnstreamer_tpu.backends.custom import CustomFilterBase
+from nnstreamer_tpu.spec import TensorsSpec
+
+
+class CustomFilter(CustomFilterBase):
+    def set_input_spec(self, in_spec):
+        if in_spec.num_tensors != 2:
+            raise ValueError("rnn filter expects (h, x)")
+        h, x = in_spec.tensors
+        if h.shape != x.shape:
+            raise ValueError(f"h/x specs must match, got {in_spec}")
+        return TensorsSpec(tensors=(h,), rate=in_spec.rate)
+
+    def invoke(self, h, x):
+        h, x = (np.asarray(t, np.float32) for t in (h, x))
+        return np.tanh(h + x)

@@ -1,0 +1,37 @@
+"""Scaler custom filter — the `custom_example_scaler` analog.
+
+Nearest-neighbor resize of an (H, W, C) video tensor.  The target size comes
+from the filter's ``custom`` property as ``"WxH"`` (matching the reference
+scaler's property syntax); with no property it passes through unchanged."""
+
+import numpy as np
+
+from nnstreamer_tpu.backends.custom import CustomFilterBase
+from nnstreamer_tpu.spec import TensorSpec, TensorsSpec
+
+
+class CustomFilter(CustomFilterBase):
+    def __init__(self, custom: str = ""):
+        self.target = None
+        if custom:
+            w, _, h = custom.partition("x")
+            self.target = (int(h), int(w))
+
+    def set_input_spec(self, in_spec):
+        t = in_spec.tensors[0]
+        if len(t.shape) != 3:
+            raise ValueError(f"scaler expects (H, W, C) video tensors, got {t}")
+        if self.target is None:
+            return in_spec
+        h, w = self.target
+        out = TensorSpec(dtype=t.dtype, shape=(h, w, t.shape[2]))
+        return TensorsSpec(tensors=(out,), rate=in_spec.rate)
+
+    def invoke(self, frame):
+        if self.target is None:
+            return frame
+        h_in, w_in, _ = frame.shape
+        h, w = self.target
+        rows = (np.arange(h) * h_in // h).astype(np.int64)
+        cols = (np.arange(w) * w_in // w).astype(np.int64)
+        return np.ascontiguousarray(np.asarray(frame)[rows][:, cols])

@@ -1,0 +1,53 @@
+"""Image-labeling demo: the reference's
+`tests/nnstreamer_decoder_image_labeling` topology, TPU-native.
+
+videotestsrc → tensor_converter → tensor_transform (normalize; fused into
+the model's XLA program) → tensor_filter (jax MobileNet-v2) →
+tensor_decoder (image_labeling) → tensor_sink.
+
+Runs anywhere (tiny model, random weights); on a TPU host the filter runs on
+the chip."""
+
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+import nnstreamer_tpu as nns
+from nnstreamer_tpu.elements.filter import TensorFilter
+from nnstreamer_tpu.elements.sink import TensorSink
+from nnstreamer_tpu.models import mobilenet_v2
+
+
+def main():
+    size, classes = 64, 10
+    model = mobilenet_v2.build(
+        num_classes=classes, width_mult=0.35, image_size=size
+    )
+    with tempfile.NamedTemporaryFile("w", suffix=".txt", delete=False) as f:
+        f.write("\n".join(f"class_{i}" for i in range(classes)))
+        labels = f.name
+
+    p = nns.Pipeline(name="image_labeling")
+    src = p.add(nns.make("videotestsrc", num_buffers=8, width=size, height=size))
+    conv = p.add(nns.make("tensor_converter"))
+    norm = p.add(nns.make(
+        "tensor_transform", mode="arithmetic",
+        option="typecast:float32,add:-127.5,div:127.5",
+    ))
+    filt = p.add(TensorFilter(framework="jax", model=model))
+    dec = p.add(nns.make("tensor_decoder", mode="image_labeling", option1=labels))
+    sink = p.add(TensorSink(collect=True))
+    p.link_chain(src, conv, norm, filt, dec, sink)
+    p.run(timeout=120)
+
+    for i, frame in enumerate(sink.frames):
+        print(f"frame {i}: {bytes(np.asarray(frame.tensor(0))).decode()}")
+    os.unlink(labels)
+
+
+if __name__ == "__main__":
+    main()

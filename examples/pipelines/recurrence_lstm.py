@@ -1,0 +1,73 @@
+"""Recurrent LSTM pipeline through repo slots (north-star #4).
+
+The reference's LSTM topology (`tests/nnstreamer_repo_lstm/runTest.sh:10-22`):
+
+    reposrc:h ─┐
+    reposrc:c ─┼→ tensor_mux → tensor_filter(custom-python LSTM) → tensor_demux
+    data ──────┘        ↑                                             │
+                        └──── reposink:h / reposink:c  ←──────────────┘
+
+The cycle (forbidden in a DAG) closes through process-global repo slots."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+import nnstreamer_tpu as nns
+from nnstreamer_tpu.elements.filter import TensorFilter
+from nnstreamer_tpu.elements.repo import TensorRepoSink, TensorRepoSrc
+from nnstreamer_tpu.elements.sink import TensorSink
+from nnstreamer_tpu.elements.tee import Tee
+from nnstreamer_tpu.elements.testsrc import DataSrc
+from nnstreamer_tpu.buffer import Frame, SECOND
+from nnstreamer_tpu.spec import TensorSpec, TensorsSpec
+
+STEPS, DIM = 6, 4
+FILTER = os.path.join(os.path.dirname(__file__), "..", "custom_filters", "lstm.py")
+
+
+def main():
+    caps = TensorsSpec(tensors=(TensorSpec(dtype=np.float32, shape=(DIM,)),))
+    dur = SECOND // 30
+    xs = [np.full((DIM,), 0.1 * (i + 1), np.float32) for i in range(STEPS)]
+    data = [Frame.of(x, pts=i * dur, duration=dur) for i, x in enumerate(xs)]
+
+    p = nns.Pipeline(name="lstm_recurrence")
+    h_src = p.add(TensorRepoSrc(name="h_src", slot_index=0, caps=caps))
+    c_src = p.add(TensorRepoSrc(name="c_src", slot_index=1, caps=caps))
+    x_src = p.add(DataSrc(name="x_src", data=data))
+    mux = p.add(nns.make("tensor_mux", sync_mode="nosync"))
+    filt = p.add(TensorFilter(framework="custom-python", model=FILTER))
+    demux = p.add(nns.make("tensor_demux"))
+    tee = p.add(Tee())
+    h_sink = p.add(TensorRepoSink(name="h_sink", slot_index=0))
+    c_sink = p.add(TensorRepoSink(name="c_sink", slot_index=1))
+    out = p.add(TensorSink(collect=True))
+
+    p.link(h_src, f"{mux.name}.sink_0")
+    p.link(c_src, f"{mux.name}.sink_1")
+    p.link(x_src, f"{mux.name}.sink_2")
+    p.link_chain(mux, filt, demux)
+    p.link(f"{demux.name}.src_0", tee)
+    p.link(tee, h_sink)
+    p.link(tee, out)
+    p.link(f"{demux.name}.src_1", c_sink)
+
+    p.start()
+    out.wait_eos(timeout=30)
+    p.stop()
+
+    # independent golden (the reference computes it with np.tanh the same way)
+    h = c = np.zeros(DIM, np.float32)
+    for i, frame in enumerate(out.frames):
+        c = np.tanh(c + xs[i])
+        h = np.tanh(h + c)
+        ok = np.allclose(np.asarray(frame.tensor(0)), h, rtol=1e-5)
+        print(f"step {i}: h={np.asarray(frame.tensor(0))[:2]}... golden={'OK' if ok else 'MISMATCH'}")
+
+
+if __name__ == "__main__":
+    main()

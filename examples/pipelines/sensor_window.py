@@ -1,0 +1,49 @@
+"""Sensor-stream demo: fake IIO device → sliding window → stats.
+
+The reference's `tensor_src_iio` reads Linux industrial-IO sensors from
+sysfs; here we build the same fake device tree its tests use
+(`unittest_src_iio.cpp:52-120`) and window the samples with
+`tensor_aggregator`."""
+
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+import nnstreamer_tpu as nns
+
+
+def make_fake_device(base):
+    dev = os.path.join(base, "iio:device0")
+    os.makedirs(dev)
+    with open(os.path.join(dev, "name"), "w") as f:
+        f.write("demo_accel\n")
+    for chan, raw, scale in (("accel_x", 120, 0.01), ("accel_y", -40, 0.01),
+                             ("accel_z", 981, 0.01)):
+        with open(os.path.join(dev, f"in_{chan}_raw"), "w") as f:
+            f.write(f"{raw}\n")
+        with open(os.path.join(dev, f"in_{chan}_scale"), "w") as f:
+            f.write(f"{scale}\n")
+
+
+def main():
+    with tempfile.TemporaryDirectory() as base:
+        make_fake_device(base)
+        windows = []
+        p = nns.parse_launch(
+            f"tensor_src_iio device=demo_accel num_buffers=12 base_dir={base} ! "
+            "tensor_aggregator frames_in=1 frames_out=4 frames_flush=4 "
+            "frames_dim=0 ! tensor_sink name=out"
+        )
+        p.get_by_name("out").connect("new-data", windows.append)
+        p.run(timeout=30)
+        for i, w in enumerate(windows):
+            arr = np.asarray(w.tensors[0]).reshape(4, 3)
+            print(f"window {i}: mean={arr.mean(axis=0)}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,146 @@
+"""Example custom filters + codegen tool + runnable pipeline demos.
+
+The reference treats its `nnstreamer_example/` filters as test fixtures too
+(survey §4); same here."""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.api.single import SingleShot
+from nnstreamer_tpu.spec import TensorSpec, TensorsSpec
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FILTERS = os.path.join(REPO, "examples", "custom_filters")
+PIPELINES = os.path.join(REPO, "examples", "pipelines")
+
+
+class TestExampleFilters:
+    def test_passthrough(self, rng):
+        x = rng.standard_normal((3, 4)).astype(np.float32)
+        with SingleShot(
+            framework="custom-python", model=os.path.join(FILTERS, "passthrough.py")
+        ) as s:
+            (out,) = s.invoke(x)
+        np.testing.assert_array_equal(np.asarray(out), x)
+
+    def test_scaler_downscales(self, rng):
+        x = rng.integers(0, 255, (8, 8, 3)).astype(np.uint8)
+        with SingleShot(
+            framework="custom-python",
+            model=os.path.join(FILTERS, "scaler.py"),
+            custom="4x4",
+        ) as s:
+            spec_out = s.set_input_spec(
+                TensorsSpec(tensors=(TensorSpec(dtype=np.uint8, shape=(8, 8, 3)),))
+            )
+            assert spec_out.tensors[0].shape == (4, 4, 3)
+            (out,) = s.invoke(x)
+        assert out.shape == (4, 4, 3)
+        np.testing.assert_array_equal(out, np.asarray(x)[::2][:, ::2])
+
+    def test_scaler_passthrough_without_custom(self, rng):
+        x = rng.integers(0, 255, (4, 4, 3)).astype(np.uint8)
+        with SingleShot(
+            framework="custom-python", model=os.path.join(FILTERS, "scaler.py")
+        ) as s:
+            (out,) = s.invoke(x)
+        np.testing.assert_array_equal(np.asarray(out), x)
+
+    def test_average(self, rng):
+        x = rng.standard_normal((6, 5, 3)).astype(np.float32)
+        with SingleShot(
+            framework="custom-python", model=os.path.join(FILTERS, "average.py")
+        ) as s:
+            (out,) = s.invoke(x)
+        assert out.shape == (1, 1, 3)
+        np.testing.assert_allclose(out, x.mean(axis=(0, 1), keepdims=True), rtol=1e-5)
+
+    def test_lstm_step_matches_reference_golden(self):
+        """Reference golden math: c'=tanh(c+x), h'=tanh(h+c')
+        (tests/nnstreamer_repo_lstm/generateTestCase.py:40-60)."""
+        h = np.full(4, 0.25, np.float32)
+        c = np.full(4, -0.5, np.float32)
+        x = np.full(4, 0.1, np.float32)
+        with SingleShot(
+            framework="custom-python", model=os.path.join(FILTERS, "lstm.py")
+        ) as s:
+            h2, c2 = s.invoke(h, c, x)
+        c_ref = np.tanh(c + x)
+        np.testing.assert_allclose(c2, c_ref, rtol=1e-6)
+        np.testing.assert_allclose(h2, np.tanh(h + c_ref), rtol=1e-6)
+
+    def test_rnn_step(self):
+        h = np.full(3, 0.5, np.float32)
+        x = np.full(3, 0.25, np.float32)
+        with SingleShot(
+            framework="custom-python", model=os.path.join(FILTERS, "rnn.py")
+        ) as s:
+            (h2,) = s.invoke(h, x)
+        np.testing.assert_allclose(h2, np.tanh(h + x), rtol=1e-6)
+
+
+class TestCodegen:
+    def test_generated_filter_loads_and_runs(self, tmp_path, rng):
+        sys.path.insert(0, os.path.join(REPO, "tools"))
+        try:
+            import codegen_custom_filter
+
+            path = codegen_custom_filter.main([
+                "gen_demo",
+                "--input", "2:3", "--input-type", "uint8",
+                "--output", "6", "--output-type", "float32",
+                "-o", str(tmp_path),
+            ])
+        finally:
+            sys.path.pop(0)
+        assert os.path.exists(path)
+        x = rng.integers(0, 255, (2, 3)).astype(np.uint8)
+        with SingleShot(framework="custom-python", model=path) as s:
+            assert s.input_spec().tensors[0].shape == (2, 3)
+            (out,) = s.invoke(x)
+        assert out.shape == (6,)
+        assert out.dtype == np.float32
+        np.testing.assert_allclose(out, x.ravel().astype(np.float32))
+
+    def test_generated_multi_io(self, tmp_path):
+        sys.path.insert(0, os.path.join(REPO, "tools"))
+        try:
+            import codegen_custom_filter
+
+            path = codegen_custom_filter.main([
+                "gen_multi",
+                "--input", "4", "--input", "4",
+                "--input-type", "float32", "--input-type", "float32",
+                "--output", "2:2",
+                "-o", str(tmp_path),
+            ])
+        finally:
+            sys.path.pop(0)
+        with SingleShot(framework="custom-python", model=path) as s:
+            a = np.ones(4, np.float32)
+            (out,) = s.invoke(a, a * 2)
+        assert out.shape == (2, 2)
+
+
+@pytest.mark.parametrize(
+    "script,expect",
+    [
+        ("recurrence_lstm.py", "golden=OK"),
+        ("sensor_window.py", "window 2"),
+        ("multi_stream_batched.py", "stream 7"),
+        ("image_labeling.py", "frame 7"),
+    ],
+)
+def test_pipeline_demo_runs(script, expect):
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(PIPELINES, script)],
+        capture_output=True, text=True, timeout=300, env=env,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert expect in proc.stdout
