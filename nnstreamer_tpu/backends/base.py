@@ -75,6 +75,7 @@ _BUILTIN_MODULES = {
     "custom-python": "nnstreamer_tpu.backends.custom",
     "custom-easy": "nnstreamer_tpu.backends.custom",
     "custom": "nnstreamer_tpu.backends.custom",
+    "custom-so": "nnstreamer_tpu.backends.custom_so",
     "torch": "nnstreamer_tpu.backends.torch_backend",
     "torch-cpu": "nnstreamer_tpu.backends.torch_backend",
     "tensorflow-lite": "nnstreamer_tpu.backends.tf_backend",

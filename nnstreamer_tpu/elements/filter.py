@@ -226,4 +226,6 @@ class TensorFilter(Node):
             profiling.record(self.name, dt)
         else:
             outs = self.backend.invoke(frame.tensors)
+        if not outs:
+            return None  # backend dropped the frame (FLOW_DROPPED analog)
         return frame.with_tensors(outs)
