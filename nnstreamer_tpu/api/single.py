@@ -49,6 +49,8 @@ class SingleShot:
         self.timeout = timeout
         self._opened = False
         self._configured = False
+        self._in_spec: Optional[TensorsSpec] = None
+        self._out_spec: Optional[TensorsSpec] = None
         self._pool: Optional[concurrent.futures.ThreadPoolExecutor] = None
         self._lock = threading.Lock()
         self.backend.open(model, custom)
@@ -61,9 +63,15 @@ class SingleShot:
     # -- spec management (ml_single_get/set_input_info) ---------------------
 
     def input_spec(self) -> Optional[TensorsSpec]:
+        # Once configured, report the negotiated spec: a backend whose own
+        # spec is partial (wildcard dims) must not shadow the concrete one.
+        if self._in_spec is not None:
+            return self._in_spec
         return self.backend.input_spec()
 
     def output_spec(self) -> Optional[TensorsSpec]:
+        if self._out_spec is not None:
+            return self._out_spec
         return self.backend.output_spec()
 
     def set_input_spec(self, spec: TensorsSpec) -> TensorsSpec:
@@ -71,6 +79,11 @@ class SingleShot:
         (``ml_single_set_input_info``)."""
         out = self.backend.reconfigure(spec)
         self._configured = True
+        # remember the negotiated specs: shape-polymorphic backends (custom
+        # setInputDimension-style) have no intrinsic spec of their own, yet
+        # ml_single_get_input/output_info must reflect the configured one
+        self._in_spec = spec
+        self._out_spec = out
         return out
 
     def set_timeout(self, seconds: Optional[float]) -> None:

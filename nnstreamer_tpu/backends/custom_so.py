@@ -46,11 +46,19 @@ class _CTensorsSpec(ctypes.Structure):
 
 
 def _from_c_spec(cspec: _CTensorsSpec) -> TensorsSpec:
+    if cspec.num_tensors > NNS_MAX_TENSORS:
+        raise ValueError(
+            f"custom-so: num_tensors {cspec.num_tensors} > {NNS_MAX_TENSORS}"
+        )
     tensors = []
     for i in range(cspec.num_tensors):
         t = cspec.tensors[i]
         if not 0 <= t.dtype < len(_DTYPES):
             raise ValueError(f"custom-so: bad dtype code {t.dtype}")
+        if t.rank > NNS_MAX_RANK:
+            raise ValueError(
+                f"custom-so: tensor {i} rank {t.rank} > {NNS_MAX_RANK}"
+            )
         shape = tuple(int(t.dims[k]) for k in range(t.rank))
         tensors.append(TensorSpec(dtype=np.dtype(_DTYPES[t.dtype]), shape=shape))
     return TensorsSpec(tensors=tuple(tensors))
@@ -109,10 +117,25 @@ class CustomSoBackend(FilterBackend):
         return self._out_spec
 
     def invoke(self, tensors: Tuple) -> Tuple:
-        n_in = len(tensors)
         ins = [
             np.ascontiguousarray(np.asarray(t)) for t in tensors
         ]
+        # The ABI contract (nns_custom_filter.h) is that in_bufs has exactly
+        # num_tensors entries in spec order with the negotiated dtypes; a
+        # conforming .so indexes that far, so cross-check before the call.
+        expect = self._in_spec.tensors
+        if len(ins) != len(expect):
+            raise ValueError(
+                f"custom-so: got {len(ins)} input tensors, spec has "
+                f"{len(expect)}"
+            )
+        for i, (a, t) in enumerate(zip(ins, expect)):
+            if _DTYPE_CODE.get(a.dtype) != _DTYPE_CODE.get(np.dtype(t.dtype)):
+                raise ValueError(
+                    f"custom-so: input {i} dtype {a.dtype} != negotiated "
+                    f"{np.dtype(t.dtype)}"
+                )
+        n_in = len(ins)
         outs = [
             np.empty(t.shape, dtype=t.dtype) for t in self._out_spec.tensors
         ]

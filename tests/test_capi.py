@@ -1,0 +1,162 @@
+"""Native C application API (`ml_*`) tests.
+
+Two modes, mirroring how the reference tests its C API
+(tests/tizen_capi/unittest_tizen_capi.cpp):
+
+1. ctypes: load libnnstreamer_tpu_capi.so into THIS process — exercises the
+   "interpreter already running" branch of the embedding layer.
+2. standalone C binary: compile tests/native/capi_smoke.c with g++, link
+   the library, run it in a subprocess — exercises full CPython embedding
+   from a plain C program.
+"""
+
+import ctypes
+import os
+import shutil
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.skipif(
+    shutil.which("g++") is None, reason="needs a C++ toolchain"
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PASSTHROUGH = os.path.join(REPO, "examples", "custom_filters", "passthrough.py")
+
+ML_ERROR_NONE = 0
+ML_TENSOR_TYPE_FLOAT32 = 7
+
+
+@pytest.fixture(scope="module")
+def capi_lib():
+    from nnstreamer_tpu.native.capi import build_capi
+
+    path = build_capi()
+    lib = ctypes.CDLL(path)
+    lib.ml_tensors_info_create.argtypes = [ctypes.POINTER(ctypes.c_void_p)]
+    lib.ml_tensors_data_get_tensor_data.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_uint,
+        ctypes.POINTER(ctypes.c_void_p),
+        ctypes.POINTER(ctypes.c_size_t),
+    ]
+    return lib
+
+
+def test_info_crud_via_ctypes(capi_lib):
+    lib = capi_lib
+    info = ctypes.c_void_p()
+    assert lib.ml_tensors_info_create(ctypes.byref(info)) == ML_ERROR_NONE
+    assert lib.ml_tensors_info_set_count(info, 2) == ML_ERROR_NONE
+    count = ctypes.c_uint()
+    assert lib.ml_tensors_info_get_count(info, ctypes.byref(count)) == ML_ERROR_NONE
+    assert count.value == 2
+    assert (
+        lib.ml_tensors_info_set_tensor_type(info, 0, ML_TENSOR_TYPE_FLOAT32)
+        == ML_ERROR_NONE
+    )
+    dims = (ctypes.c_uint32 * 8)(2, 3)
+    assert (
+        lib.ml_tensors_info_set_tensor_dimension(info, 0, 2, dims) == ML_ERROR_NONE
+    )
+    size = ctypes.c_size_t()
+    assert (
+        lib.ml_tensors_info_get_tensor_size(info, 0, ctypes.byref(size))
+        == ML_ERROR_NONE
+    )
+    assert size.value == 2 * 3 * 4
+    # negative: bad index
+    assert lib.ml_tensors_info_set_tensor_type(info, 9, 0) != ML_ERROR_NONE
+    assert lib.ml_tensors_info_destroy(info) == ML_ERROR_NONE
+
+
+def test_single_invoke_via_ctypes(capi_lib):
+    """ml_single_* against the custom-python passthrough, called from an
+    already-running interpreter (GILState branch)."""
+    lib = capi_lib
+    info = ctypes.c_void_p()
+    lib.ml_tensors_info_create(ctypes.byref(info))
+    lib.ml_tensors_info_set_count(info, 1)
+    lib.ml_tensors_info_set_tensor_type(info, 0, ML_TENSOR_TYPE_FLOAT32)
+    dims = (ctypes.c_uint32 * 8)(4)
+    lib.ml_tensors_info_set_tensor_dimension(info, 0, 1, dims)
+
+    single = ctypes.c_void_p()
+    rc = lib.ml_single_open(
+        ctypes.byref(single),
+        PASSTHROUGH.encode(),
+        b"custom-python",
+        b"",
+        info,
+    )
+    assert rc == ML_ERROR_NONE
+
+    data = ctypes.c_void_p()
+    assert lib.ml_tensors_data_create(info, ctypes.byref(data)) == ML_ERROR_NONE
+    payload = (ctypes.c_float * 4)(1.0, 2.5, -3.0, 4.0)
+    assert (
+        lib.ml_tensors_data_set_tensor_data(
+            data, 0, payload, ctypes.sizeof(payload)
+        )
+        == ML_ERROR_NONE
+    )
+    out = ctypes.c_void_p()
+    assert lib.ml_single_invoke(single, data, ctypes.byref(out)) == ML_ERROR_NONE
+    raw = ctypes.c_void_p()
+    size = ctypes.c_size_t()
+    assert (
+        lib.ml_tensors_data_get_tensor_data(
+            out, 0, ctypes.byref(raw), ctypes.byref(size)
+        )
+        == ML_ERROR_NONE
+    )
+    assert size.value == 16
+    result = ctypes.cast(raw, ctypes.POINTER(ctypes.c_float * 4)).contents
+    assert list(result) == [1.0, 2.5, -3.0, 4.0]
+
+    lib.ml_tensors_data_destroy(data)
+    lib.ml_tensors_data_destroy(out)
+    lib.ml_tensors_info_destroy(info)
+    assert lib.ml_single_close(single) == ML_ERROR_NONE
+
+
+def test_capi_smoke_binary(tmp_path):
+    """Compile + run the standalone C program (embeds CPython itself)."""
+    from nnstreamer_tpu.native.capi import HEADER, build_capi, python_link_flags
+
+    lib = build_capi()
+    src = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "native", "capi_smoke.c")
+    binary = str(tmp_path / "capi_smoke")
+    subprocess.run(
+        [
+            "g++",
+            "-O1",
+            src,
+            "-o",
+            binary,
+            f"-I{os.path.dirname(HEADER)}",
+            lib,
+            f"-Wl,-rpath,{os.path.dirname(lib)}",
+        ]
+        + python_link_flags(),
+        check=True,
+        capture_output=True,
+        text=True,
+    )
+    env = dict(os.environ)
+    site = [p for p in sys.path if p.endswith("site-packages")]
+    env["PYTHONPATH"] = os.pathsep.join([REPO] + site)
+    # keep the subprocess off the real TPU: this is a dataflow test
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    proc = subprocess.run(
+        [binary, PASSTHROUGH],
+        capture_output=True,
+        text=True,
+        timeout=300,
+        env=env,
+    )
+    assert proc.returncode == 0, f"stdout={proc.stdout}\nstderr={proc.stderr}"
+    assert "pipeline ok" in proc.stdout

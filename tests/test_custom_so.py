@@ -4,6 +4,7 @@ Compiles real fixtures with g++ at test time (the analog of the reference
 building its custom-filter examples in-tree as test fixtures, survey §4)."""
 
 import os
+import shutil
 import subprocess
 import textwrap
 
@@ -11,6 +12,10 @@ import numpy as np
 import pytest
 
 from nnstreamer_tpu.api.single import SingleShot
+
+pytestmark = pytest.mark.skipif(
+    shutil.which("g++") is None, reason="needs a C++ toolchain"
+)
 
 HEADER_DIR = os.path.join(
     os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
