@@ -17,10 +17,14 @@ The transform compiles to a **pure function on jnp arrays** at negotiation
 time.  ``acceleration=True`` (the analog of the reference's Orc SIMD path,
 ``tensor_transform.c:330-405``) wraps it in ``jax.jit`` so XLA fuses the
 elementwise chain into one kernel; with device-resident inputs it runs on
-TPU and stays on device.  ``acceleration=False`` runs numpy on host —
-bit-exact with the reference's C loops and cheaper for tiny host frames.
-When an adjacent ``tensor_filter`` runs, its fusion pass can absorb this
-node's function into the model's XLA graph (survey §7 step 4).
+TPU and stays on device.  ``acceleration="pallas"`` lowers the elementwise
+modes (typecast/arithmetic/clamp) through the hand-written Pallas VPU
+kernel (:func:`nnstreamer_tpu.ops.pallas_kernels.fused_arith`) — the
+closest analog of the reference's *generated* Orc kernels.
+``acceleration=False`` runs numpy on host — bit-exact with the reference's
+C loops and cheaper for tiny host frames.  When an adjacent
+``tensor_filter`` runs, its fusion pass can absorb this node's function
+into the model's XLA graph (survey §7 step 4).
 """
 
 from __future__ import annotations
@@ -54,12 +58,31 @@ def _parse_arith_ops(option: str) -> List[Tuple[str, object]]:
         if op == "typecast":
             ops.append(("typecast", dtype_from_name(val)))
         elif op in ("add", "sub", "mul", "div"):
-            ops.append((op, float(val)))
+            # integer literals stay integral so int streams keep their
+            # dtype (the reference computes in the tensor's own type);
+            # float literals / div promote per jnp rules.
+            try:
+                num: object = int(val)
+            except ValueError:
+                num = float(val)
+            ops.append((op, num))
         else:
             raise ValueError(f"unknown arithmetic op {op!r} in {option!r}")
     if not ops:
         raise ValueError(f"empty arithmetic option: {option!r}")
     return ops
+
+
+def _parse_clamp(option: str) -> Tuple[object, object]:
+    lo_s, _, hi_s = option.partition(":")
+
+    def num(s: str) -> object:
+        try:
+            return int(s)
+        except ValueError:
+            return float(s)
+
+    return num(lo_s), num(hi_s)
 
 
 @register_element("tensor_transform")
@@ -78,7 +101,10 @@ class TensorTransform(Node):
             raise ValueError(f"unknown transform mode {mode!r}; known: {MODES}")
         self.mode = mode
         self.option = str(option)
-        self.acceleration = acceleration in (True, "true", "1")
+        if acceleration in ("pallas", "orc"):  # "orc" = reference prop name
+            self.acceleration = "pallas"
+        else:
+            self.acceleration = acceleration in (True, "true", "1")
         self._fns: Optional[List[Callable]] = None  # per-tensor ops
         self._jitted = None
 
@@ -89,11 +115,13 @@ class TensorTransform(Node):
         if self.mode == "typecast":
             return TensorSpec(dtype=dtype_from_name(self.option), shape=t.shape)
         if self.mode == "arithmetic":
-            dtype = t.dtype
-            for op, val in _parse_arith_ops(self.option):
-                if op == "typecast":
-                    dtype = val
-            return TensorSpec(dtype=dtype, shape=t.shape)
+            # Negotiate the true result dtype, including implicit promotion
+            # (e.g. div / float operands on int streams → float32); all
+            # three execution paths are cast to this.
+            from ..ops.pallas_kernels import chain_out_dtype
+
+            dtype = chain_out_dtype(t.dtype, _parse_arith_ops(self.option))
+            return TensorSpec(dtype=np.dtype(dtype), shape=t.shape)
         if self.mode == "transpose":
             perm = [int(x) for x in self.option.split(":")]
             if sorted(perm) != list(range(len(perm))):
@@ -115,7 +143,10 @@ class TensorTransform(Node):
         if self.mode == "stand":
             return TensorSpec(dtype=np.float32, shape=t.shape)
         if self.mode == "clamp":
-            return TensorSpec(dtype=t.dtype, shape=t.shape)
+            from ..ops.pallas_kernels import chain_out_dtype
+
+            dtype = chain_out_dtype(t.dtype, [("clamp", _parse_clamp(self.option))])
+            return TensorSpec(dtype=np.dtype(dtype), shape=t.shape)
         raise AssertionError(self.mode)
 
     def build_fn(self, t: TensorSpec) -> Callable:
@@ -184,8 +215,7 @@ class TensorTransform(Node):
                 return (x - mean) / (std + 1e-10)
 
         elif mode == "clamp":
-            lo_s, _, hi_s = option.partition(":")
-            lo, hi = float(lo_s), float(hi_s)
+            lo, hi = _parse_clamp(option)
 
             def fn(x, xp):
                 return xp.clip(x, lo, hi)
@@ -200,18 +230,40 @@ class TensorTransform(Node):
     def configure(self, in_specs: Dict[str, TensorsSpec]) -> Dict[str, TensorsSpec]:
         spec = in_specs["sink"]
         outs = tuple(self.out_spec_for(t) for t in spec.tensors)
+        self._out_dtypes = [t.dtype for t in outs]
         # Shape-dependent modes (transpose/dimchg) bake per-tensor geometry,
         # so each tensor in the frame gets its own compiled fn (the reference
         # likewise transforms each tensor independently).
         self._fns = [self.build_fn(t) for t in spec.tensors]
         self._jitted = None
-        if self.acceleration:
+        if self.acceleration == "pallas" and (
+            chain := self._chain_ops()
+        ) is not None:
+            import jax
+
+            from ..ops.pallas_kernels import fused_arith
+
+            self._jitted = [
+                jax.jit(lambda x, c=tuple(chain): fused_arith(x, c))
+            ] * len(self._fns)
+        elif self.acceleration:
             import jax
 
             self._jitted = [
                 jax.jit(lambda x, fn=fn: fn(x, _jnp())) for fn in self._fns
             ]
         return {"src": TensorsSpec(tensors=outs, rate=spec.rate)}
+
+    def _chain_ops(self):
+        """Elementwise op chain for the Pallas kernel, or None when the
+        mode is shape-changing (those stay on the XLA path)."""
+        if self.mode == "typecast":
+            return [("typecast", dtype_from_name(self.option))]
+        if self.mode == "arithmetic":
+            return _parse_arith_ops(self.option)
+        if self.mode == "clamp":
+            return [("clamp", _parse_clamp(self.option))]
+        return None
 
     # -- dataflow -----------------------------------------------------------
 
@@ -222,7 +274,10 @@ class TensorTransform(Node):
             if self.acceleration:
                 out.append(self._jitted[i](x))
             else:
-                out.append(self._fns[i](np.asarray(x), np))
+                # numpy promotes to float64 where jnp picks float32; the
+                # negotiated spec (jnp rules) is the contract, so cast.
+                y = self._fns[i](np.asarray(x), np)
+                out.append(y.astype(self._out_dtypes[i], copy=False))
         return frame.with_tensors(tuple(out))
 
     # -- fusion hook (survey §7 step 4) -------------------------------------

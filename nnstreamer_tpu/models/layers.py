@@ -15,6 +15,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..ops.quant import maybe_dequantize
+
 Params = Dict[str, Any]
 
 
@@ -47,9 +49,8 @@ def conv2d(
     groups: int = 1,
     dtype=None,
 ) -> jnp.ndarray:
-    w = params["w"]
-    if dtype is not None:
-        w = w.astype(dtype)
+    # int8 QuantizedWeight leaves dequantize here, fusing into the conv
+    w = maybe_dequantize(params["w"], dtype)
     return jax.lax.conv_general_dilated(
         x,
         w,
@@ -73,9 +74,9 @@ def relu6(x: jnp.ndarray) -> jnp.ndarray:
 
 
 def dense(params: Params, x: jnp.ndarray, dtype=None) -> jnp.ndarray:
-    w, b = params["w"], params["b"]
+    w, b = maybe_dequantize(params["w"], dtype), params["b"]
     if dtype is not None:
-        w, b = w.astype(dtype), b.astype(dtype)
+        b = b.astype(dtype)
     return x @ w + b
 
 

@@ -110,6 +110,80 @@ def apply(params: Params, x, dtype=jnp.bfloat16):
     return logits[0] if squeezed else logits
 
 
+def quantize_params(params: Params) -> Params:
+    """Weight-only int8 quantization of every conv/dense kernel (per output
+    channel).  The TPU-native analog of the reference's uint8-quantized
+    tflite flagship (survey §7f): weights live in HBM at 1 byte/element and
+    dequantize inside the fused XLA program; BN/bias stay float."""
+    from ..ops.quant import quantize_weight
+
+    def walk(node):
+        if isinstance(node, dict):
+            out = {}
+            for k, v in node.items():
+                if k == "w" and hasattr(v, "ndim") and v.ndim >= 2:
+                    out[k] = quantize_weight(v, axis=-1)
+                else:
+                    out[k] = walk(v)
+            return out
+        if isinstance(node, list):
+            return [walk(v) for v in node]
+        return node
+
+    return walk(params)
+
+
+def apply_quantized_int8_head(params: Params, x, dtype=jnp.bfloat16):
+    """Forward pass with the classifier matmul on the int8 MXU path:
+    dynamic activation quantization feeding the Pallas
+    :func:`~nnstreamer_tpu.ops.pallas_kernels.int8_matmul` kernel (int8×int8
+    → int32 accumulate → fused dequant+bias)."""
+    from ..ops.pallas_kernels import int8_matmul
+    from ..ops.quant import QuantizedWeight, quantize_activations
+
+    head = params["classifier"]
+    assert isinstance(head["w"], QuantizedWeight), "quantize_params first"
+    x, squeezed = ensure_batched(x, 4)
+    y = x.astype(dtype)
+    y = conv_bn_relu6(params["stem"], y, stride=2, dtype=dtype)
+    for block in params["blocks"]:
+        y = _block_apply(block, y, dtype)
+    y = conv_bn_relu6(params["head"], y, dtype=dtype)
+    y = y.mean(axis=(1, 2)).astype(jnp.float32)
+    feats_q, feats_scale = quantize_activations(y)
+    logits = int8_matmul(
+        feats_q,
+        head["w"].q,
+        feats_scale,
+        head["w"].scale.reshape(1, -1),
+        head["b"],
+    )
+    return logits[0] if squeezed else logits
+
+
+def build_quantized(
+    num_classes: int = 1001,
+    width_mult: float = 1.0,
+    image_size: int = 224,
+    batch: Optional[int] = None,
+    dtype=jnp.bfloat16,
+    seed: int = 0,
+    params: Optional[Params] = None,
+    int8_head: bool = False,
+) -> JaxModel:
+    """Quantized stream-ready model (int8 weights, on-device dequant);
+    ``int8_head=True`` additionally runs the classifier on the int8 MXU
+    kernel."""
+    m = build(num_classes, width_mult, image_size, batch, dtype, seed, params)
+    fwd = apply_quantized_int8_head if int8_head else apply
+    return JaxModel(
+        apply=lambda p, x: fwd(p, x, dtype=dtype),
+        params=quantize_params(m.params),
+        input_spec=m.input_spec,
+        name=f"mobilenet_v2_q8_{width_mult}_{image_size}",
+    )
+
+
 def build(
     num_classes: int = 1001,
     width_mult: float = 1.0,

@@ -26,6 +26,25 @@ import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
 
+def cpu_subprocess_env():
+    """Env for spawning python subprocesses pinned to CPU jax.
+
+    Stripping any sitecustomize dirs that register accelerator PJRT
+    plugins (they override JAX_PLATFORMS and may block on an external
+    device service) keeps subprocess tests hermetic.
+    """
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    parts = [
+        p
+        for p in env.get("PYTHONPATH", "").split(os.pathsep)
+        if p and "axon_site" not in p
+    ]
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = os.pathsep.join([repo] + parts)
+    return env
+
+
 @pytest.fixture
 def rng():
     return np.random.default_rng(42)

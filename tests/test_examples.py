@@ -136,8 +136,9 @@ class TestCodegen:
     ],
 )
 def test_pipeline_demo_runs(script, expect):
-    env = dict(os.environ)
-    env.setdefault("JAX_PLATFORMS", "cpu")
+    from conftest import cpu_subprocess_env
+
+    env = cpu_subprocess_env()
     proc = subprocess.run(
         [sys.executable, os.path.join(PIPELINES, script)],
         capture_output=True, text=True, timeout=300, env=env,

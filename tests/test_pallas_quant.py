@@ -1,0 +1,251 @@
+"""Pallas kernels + int8 quantization path.
+
+Runs on the CPU test platform via Pallas interpret mode (conftest pins
+jax_platforms=cpu); on TPU the same code lowers through Mosaic.  Golden
+references are independent numpy computations, per the reference's test
+strategy (survey §4: golden outputs from an independent NumPy path).
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from nnstreamer_tpu.ops.pallas_kernels import chain_out_dtype, fused_arith, int8_matmul
+from nnstreamer_tpu.ops.quant import (
+    QuantizedWeight,
+    maybe_dequantize,
+    quantize_activations,
+    quantize_weight,
+)
+
+
+class TestFusedArith:
+    @pytest.mark.parametrize(
+        "shape", [(4,), (7, 223, 3), (256, 128), (1, 1), (33000,)]
+    )
+    def test_normalize_chain(self, shape):
+        """The MobileNet preprocessing chain, odd shapes incl. non-tile-aligned."""
+        x = np.random.default_rng(0).integers(0, 256, shape).astype(np.uint8)
+        ops = [("typecast", np.float32), ("add", -127.5), ("div", 127.5)]
+        got = np.asarray(fused_arith(jnp.asarray(x), ops))
+        want = (x.astype(np.float32) - 127.5) / 127.5
+        np.testing.assert_allclose(got, want, rtol=1e-6)
+
+    def test_integer_chain_exact(self):
+        x = np.random.default_rng(1).integers(-50, 50, (300,)).astype(np.int32)
+        ops = [("mul", 3), ("sub", 7), ("clamp", (-100, 100))]
+        got = np.asarray(fused_arith(jnp.asarray(x), ops))
+        want = np.clip(x * 3 - 7, -100, 100)
+        np.testing.assert_array_equal(got, want)
+        assert got.dtype == np.int32
+
+    def test_out_dtype_matches_jit_path(self):
+        """Pallas and the XLA jit path must agree on promotion."""
+        ops = [("typecast", np.float32), ("div", 2.0)]
+        assert chain_out_dtype(np.uint8, ops) == np.float32
+        ops2 = [("add", 1)]
+        x = np.ones((5,), np.int16)
+        got = fused_arith(jnp.asarray(x), ops2)
+        want = jnp.asarray(x) + 1
+        assert got.dtype == want.dtype
+
+    def test_empty(self):
+        got = fused_arith(jnp.zeros((0, 3), np.float32), [("add", 1.0)])
+        assert got.shape == (0, 3)
+
+
+class TestInt8Matmul:
+    def test_against_int_reference(self):
+        rng = np.random.default_rng(2)
+        xq = rng.integers(-127, 128, (5, 96)).astype(np.int8)
+        wq = rng.integers(-127, 128, (96, 200)).astype(np.int8)
+        ws = (rng.random((1, 200)) * 0.01).astype(np.float32)
+        b = rng.random((200,)).astype(np.float32)
+        got = np.asarray(
+            int8_matmul(jnp.asarray(xq), jnp.asarray(wq), 0.05, jnp.asarray(ws), jnp.asarray(b))
+        )
+        want = (xq.astype(np.int64) @ wq.astype(np.int64)).astype(np.float32) * (
+            0.05 * ws
+        ) + b
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-4)
+
+    def test_no_bias_and_aligned(self):
+        rng = np.random.default_rng(3)
+        xq = rng.integers(-10, 10, (32, 128)).astype(np.int8)
+        wq = rng.integers(-10, 10, (128, 128)).astype(np.int8)
+        ws = np.ones((1, 128), np.float32)
+        got = np.asarray(int8_matmul(jnp.asarray(xq), jnp.asarray(wq), 1.0, jnp.asarray(ws)))
+        want = (xq.astype(np.int64) @ wq.astype(np.int64)).astype(np.float32)
+        np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+class TestQuantize:
+    def test_weight_roundtrip_error_bound(self):
+        rng = np.random.default_rng(4)
+        w = rng.normal(size=(3, 3, 16, 32)).astype(np.float32)
+        qw = quantize_weight(w)
+        assert qw.q.dtype == np.int8
+        back = np.asarray(qw.dequantize())
+        # max error per channel ≤ scale/2
+        scale = np.asarray(qw.scale)
+        assert np.all(np.abs(back - w) <= scale / 2 + 1e-8)
+
+    def test_maybe_dequantize_passthrough(self):
+        w = jnp.ones((4, 4), jnp.float32)
+        assert maybe_dequantize(w) is w
+        qw = quantize_weight(np.eye(4, dtype=np.float32))
+        assert isinstance(qw, QuantizedWeight)
+        np.testing.assert_allclose(np.asarray(maybe_dequantize(qw)), np.eye(4), atol=1e-6)
+
+    def test_activation_quant(self):
+        x = jnp.asarray(np.linspace(-5, 5, 64, dtype=np.float32))
+        q, scale = quantize_activations(x)
+        np.testing.assert_allclose(
+            np.asarray(q, np.float32) * np.asarray(scale), np.asarray(x), atol=float(scale) / 2 + 1e-7
+        )
+
+
+class TestQuantizedMobileNet:
+    @pytest.fixture(scope="class")
+    def models(self):
+        from nnstreamer_tpu.models import mobilenet_v2
+
+        kw = dict(
+            num_classes=16, width_mult=0.35, image_size=32, dtype=jnp.float32
+        )
+        f = mobilenet_v2.build(**kw)
+        q = mobilenet_v2.build_quantized(**kw)
+        qh = mobilenet_v2.build_quantized(**kw, int8_head=True)
+        return f, q, qh
+
+    def test_quantized_close_to_float(self, models):
+        f, q, _ = models
+        x = np.random.default_rng(5).random((32, 32, 3)).astype(np.float32)
+        lf = np.asarray(f.apply(f.params, x))
+        lq = np.asarray(q.apply(q.params, x))
+        # weight-only int8: logits track the float model closely
+        err = np.abs(lf - lq).max() / (np.abs(lf).max() + 1e-9)
+        assert err < 0.1, err
+        assert np.argmax(lf) == np.argmax(lq)
+
+    def test_int8_head_close(self, models):
+        f, _, qh = models
+        x = np.random.default_rng(6).random((32, 32, 3)).astype(np.float32)
+        lf = np.asarray(f.apply(f.params, x))
+        lq = np.asarray(qh.apply(qh.params, x))
+        err = np.abs(lf - lq).max() / (np.abs(lf).max() + 1e-9)
+        assert err < 0.15, err
+
+    def test_quantized_in_pipeline(self, models):
+        """build_quantized runs through the streaming filter element."""
+        _, q, _ = models
+        import nnstreamer_tpu as nns
+        from nnstreamer_tpu.elements.filter import TensorFilter
+        from nnstreamer_tpu.elements.sink import TensorSink
+        from nnstreamer_tpu.elements.testsrc import DataSrc
+
+        frames = [
+            np.random.default_rng(i).random((32, 32, 3)).astype(np.float32)
+            for i in range(3)
+        ]
+        p = nns.Pipeline()
+        src = p.add(DataSrc(data=frames))
+        filt = p.add(TensorFilter(framework="jax", model=q))
+        sink = p.add(TensorSink(collect=True))
+        p.link_chain(src, filt, sink)
+        p.run(timeout=120)
+        assert sink.num_frames == 3
+        assert sink.frames[0].tensor(0).shape == (16,)
+
+
+class TestTransformPallas:
+    def test_element_pallas_acceleration(self):
+        import nnstreamer_tpu as nns
+        from nnstreamer_tpu.elements.sink import TensorSink
+        from nnstreamer_tpu.elements.testsrc import DataSrc
+        from nnstreamer_tpu.elements.transform import TensorTransform
+
+        x = np.random.default_rng(7).integers(0, 256, (8, 8, 3)).astype(np.uint8)
+        p = nns.Pipeline()
+        src = p.add(DataSrc(data=[x]))
+        tr = p.add(
+            TensorTransform(
+                mode="arithmetic",
+                option="typecast:float32,add:-127.5,div:127.5",
+                acceleration="pallas",
+            )
+        )
+        sink = p.add(TensorSink(collect=True))
+        p.link_chain(src, tr, sink)
+        p.run(timeout=60)
+        got = np.asarray(sink.frames[0].tensor(0))
+        want = (x.astype(np.float32) - 127.5) / 127.5
+        np.testing.assert_allclose(got, want, rtol=1e-6)
+
+    def test_pallas_falls_back_for_transpose(self):
+        """Shape-changing modes silently use the XLA path."""
+        import nnstreamer_tpu as nns
+        from nnstreamer_tpu.elements.sink import TensorSink
+        from nnstreamer_tpu.elements.testsrc import DataSrc
+        from nnstreamer_tpu.elements.transform import TensorTransform
+
+        x = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+        p = nns.Pipeline()
+        src = p.add(DataSrc(data=[x]))
+        # NNS innermost-first perm 1:0:2:3 swaps the last two numpy axes
+        tr = p.add(
+            TensorTransform(mode="transpose", option="1:0:2:3",
+                            acceleration="pallas")
+        )
+        sink = p.add(TensorSink(collect=True))
+        p.link_chain(src, tr, sink)
+        p.run(timeout=60)
+        got = np.asarray(sink.frames[0].tensor(0))
+        np.testing.assert_array_equal(got, x.transpose(0, 2, 1))
+
+    def test_pallas_integer_chain_keeps_dtype(self):
+        """Integer literals stay integral: int stream + add:3 stays int32,
+        matching the negotiated spec, on the pallas path."""
+        import nnstreamer_tpu as nns
+        from nnstreamer_tpu.elements.sink import TensorSink
+        from nnstreamer_tpu.elements.testsrc import DataSrc
+        from nnstreamer_tpu.elements.transform import TensorTransform
+
+        x = np.arange(12, dtype=np.int32)
+        for accel in ("pallas", True, False):
+            p = nns.Pipeline()
+            src = p.add(DataSrc(data=[x]))
+            tr = p.add(
+                TensorTransform(mode="arithmetic", option="mul:3,add:1",
+                                acceleration=accel)
+            )
+            sink = p.add(TensorSink(collect=True))
+            p.link_chain(src, tr, sink)
+            p.run(timeout=60)
+            got = np.asarray(sink.frames[0].tensor(0))
+            assert got.dtype == np.int32, accel
+            np.testing.assert_array_equal(got, x * 3 + 1)
+
+    def test_implicit_promotion_negotiated(self):
+        """div on an int stream promotes to float32 in the spec and the
+        data, on every acceleration path."""
+        import nnstreamer_tpu as nns
+        from nnstreamer_tpu.elements.sink import TensorSink
+        from nnstreamer_tpu.elements.testsrc import DataSrc
+        from nnstreamer_tpu.elements.transform import TensorTransform
+
+        x = np.arange(8, dtype=np.uint8)
+        for accel in ("pallas", True, False):
+            p = nns.Pipeline()
+            src = p.add(DataSrc(data=[x]))
+            tr = p.add(
+                TensorTransform(mode="arithmetic", option="div:2.0",
+                                acceleration=accel)
+            )
+            sink = p.add(TensorSink(collect=True))
+            p.link_chain(src, tr, sink)
+            p.run(timeout=60)
+            got = np.asarray(sink.frames[0].tensor(0))
+            assert got.dtype == np.float32, accel
+            np.testing.assert_allclose(got, x / 2.0)
