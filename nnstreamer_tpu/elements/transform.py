@@ -85,6 +85,37 @@ def _parse_clamp(option: str) -> Tuple[object, object]:
     return num(lo_s), num(hi_s)
 
 
+def _bind_num(v: object, dtype: np.dtype) -> object:
+    """Keep an integer literal integral only when it is representable in
+    the current stream dtype; otherwise demote to float so the op promotes
+    (a negative literal on an unsigned stream must not wrap/overflow)."""
+    if isinstance(v, int) and np.issubdtype(dtype, np.integer):
+        info = np.iinfo(dtype)
+        if info.min <= v <= info.max:
+            return v
+        return float(v)
+    return v
+
+
+def _bind_chain(ops: List[Tuple[str, object]], in_dtype) -> List[Tuple[str, object]]:
+    """Bind op literals to the dtype flowing through the chain, tracking
+    dtype changes from typecasts and promotion as we go."""
+    from ..ops.pallas_kernels import chain_out_dtype
+
+    cur = np.dtype(in_dtype)
+    bound: List[Tuple[str, object]] = []
+    for op, val in ops:
+        if op == "typecast":
+            bound.append((op, val))
+        elif op == "clamp":
+            lo, hi = val
+            bound.append((op, (_bind_num(lo, cur), _bind_num(hi, cur))))
+        else:
+            bound.append((op, _bind_num(val, cur)))
+        cur = np.dtype(chain_out_dtype(cur, [bound[-1]]))
+    return bound
+
+
 @register_element("tensor_transform")
 class TensorTransform(Node):
     def __init__(
@@ -120,8 +151,9 @@ class TensorTransform(Node):
             # three execution paths are cast to this.
             from ..ops.pallas_kernels import chain_out_dtype
 
-            dtype = chain_out_dtype(t.dtype, _parse_arith_ops(self.option))
-            return TensorSpec(dtype=np.dtype(dtype), shape=t.shape)
+            ops = _bind_chain(_parse_arith_ops(self.option), t.dtype)
+            return TensorSpec(dtype=np.dtype(chain_out_dtype(t.dtype, ops)),
+                              shape=t.shape)
         if self.mode == "transpose":
             perm = [int(x) for x in self.option.split(":")]
             if sorted(perm) != list(range(len(perm))):
@@ -145,8 +177,9 @@ class TensorTransform(Node):
         if self.mode == "clamp":
             from ..ops.pallas_kernels import chain_out_dtype
 
-            dtype = chain_out_dtype(t.dtype, [("clamp", _parse_clamp(self.option))])
-            return TensorSpec(dtype=np.dtype(dtype), shape=t.shape)
+            ops = _bind_chain([("clamp", _parse_clamp(self.option))], t.dtype)
+            return TensorSpec(dtype=np.dtype(chain_out_dtype(t.dtype, ops)),
+                              shape=t.shape)
         raise AssertionError(self.mode)
 
     def build_fn(self, t: TensorSpec) -> Callable:
@@ -161,7 +194,7 @@ class TensorTransform(Node):
                 return x.astype(dtype)
 
         elif mode == "arithmetic":
-            ops = _parse_arith_ops(option)
+            ops = _bind_chain(_parse_arith_ops(option), t.dtype)
 
             def fn(x, xp):
                 for op, val in ops:
@@ -215,7 +248,9 @@ class TensorTransform(Node):
                 return (x - mean) / (std + 1e-10)
 
         elif mode == "clamp":
-            lo, hi = _parse_clamp(option)
+            lo, hi = _bind_chain(
+                [("clamp", _parse_clamp(option))], t.dtype
+            )[0][1]
 
             def fn(x, xp):
                 return xp.clip(x, lo, hi)
@@ -236,16 +271,18 @@ class TensorTransform(Node):
         # likewise transforms each tensor independently).
         self._fns = [self.build_fn(t) for t in spec.tensors]
         self._jitted = None
-        if self.acceleration == "pallas" and (
-            chain := self._chain_ops()
-        ) is not None:
+        chains = [self._chain_ops(t) for t in spec.tensors]
+        if self.acceleration == "pallas" and all(
+            c is not None for c in chains
+        ):
             import jax
 
             from ..ops.pallas_kernels import fused_arith
 
             self._jitted = [
                 jax.jit(lambda x, c=tuple(chain): fused_arith(x, c))
-            ] * len(self._fns)
+                for chain in chains
+            ]
         elif self.acceleration:
             import jax
 
@@ -254,15 +291,16 @@ class TensorTransform(Node):
             ]
         return {"src": TensorsSpec(tensors=outs, rate=spec.rate)}
 
-    def _chain_ops(self):
-        """Elementwise op chain for the Pallas kernel, or None when the
-        mode is shape-changing (those stay on the XLA path)."""
+    def _chain_ops(self, t: TensorSpec):
+        """Elementwise op chain for the Pallas kernel (literals bound to
+        the stream dtype), or None when the mode is shape-changing (those
+        stay on the XLA path)."""
         if self.mode == "typecast":
             return [("typecast", dtype_from_name(self.option))]
         if self.mode == "arithmetic":
-            return _parse_arith_ops(self.option)
+            return _bind_chain(_parse_arith_ops(self.option), t.dtype)
         if self.mode == "clamp":
-            return [("clamp", _parse_clamp(self.option))]
+            return _bind_chain([("clamp", _parse_clamp(self.option))], t.dtype)
         return None
 
     # -- dataflow -----------------------------------------------------------

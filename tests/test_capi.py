@@ -146,11 +146,13 @@ def test_capi_smoke_binary(tmp_path):
         capture_output=True,
         text=True,
     )
-    env = dict(os.environ)
+    from conftest import cpu_subprocess_env
+
+    env = cpu_subprocess_env()
+    # the embedded interpreter (plain prefix, no venv activation) also
+    # needs the venv's site-packages on its path
     site = [p for p in sys.path if p.endswith("site-packages")]
-    env["PYTHONPATH"] = os.pathsep.join([REPO] + site)
-    # keep the subprocess off the real TPU: this is a dataflow test
-    env.setdefault("JAX_PLATFORMS", "cpu")
+    env["PYTHONPATH"] = os.pathsep.join([env["PYTHONPATH"]] + site)
     proc = subprocess.run(
         [binary, PASSTHROUGH],
         capture_output=True,

@@ -227,6 +227,49 @@ class TestTransformPallas:
             assert got.dtype == np.int32, accel
             np.testing.assert_array_equal(got, x * 3 + 1)
 
+    def test_out_of_range_literal_promotes(self):
+        """add:-128 on uint8 must promote to float (not wrap / overflow),
+        on every acceleration path."""
+        import nnstreamer_tpu as nns
+        from nnstreamer_tpu.elements.sink import TensorSink
+        from nnstreamer_tpu.elements.testsrc import DataSrc
+        from nnstreamer_tpu.elements.transform import TensorTransform
+
+        x = np.array([0, 1, 200, 255], np.uint8)
+        for accel in ("pallas", True, False):
+            p = nns.Pipeline()
+            src = p.add(DataSrc(data=[x]))
+            tr = p.add(
+                TensorTransform(mode="arithmetic", option="add:-128",
+                                acceleration=accel)
+            )
+            sink = p.add(TensorSink(collect=True))
+            p.link_chain(src, tr, sink)
+            p.run(timeout=60)
+            got = np.asarray(sink.frames[0].tensor(0))
+            assert got.dtype == np.float32, accel
+            np.testing.assert_allclose(got, x.astype(np.float32) - 128, err_msg=str(accel))
+
+    def test_negative_clamp_on_unsigned(self):
+        """clamp=-1:1 on uint8: bound must not wrap to 255."""
+        import nnstreamer_tpu as nns
+        from nnstreamer_tpu.elements.sink import TensorSink
+        from nnstreamer_tpu.elements.testsrc import DataSrc
+        from nnstreamer_tpu.elements.transform import TensorTransform
+
+        x = np.array([0, 1, 2, 3], np.uint8)
+        for accel in ("pallas", True, False):
+            p = nns.Pipeline()
+            src = p.add(DataSrc(data=[x]))
+            tr = p.add(
+                TensorTransform(mode="clamp", option="-1:1", acceleration=accel)
+            )
+            sink = p.add(TensorSink(collect=True))
+            p.link_chain(src, tr, sink)
+            p.run(timeout=60)
+            got = np.asarray(sink.frames[0].tensor(0))
+            np.testing.assert_allclose(got, [0, 1, 1, 1], err_msg=str(accel))
+
     def test_implicit_promotion_negotiated(self):
         """div on an int stream promotes to float32 in the spec and the
         data, on every acceleration path."""
