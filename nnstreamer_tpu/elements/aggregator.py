@@ -54,6 +54,7 @@ class TensorAggregator(Node):
         self._axis = 0
         self._window: collections.deque = collections.deque()
         self._timing: collections.deque = collections.deque()
+        self._keep_state_on_start = False
 
     def configure(self, in_specs: Dict[str, TensorsSpec]) -> Dict[str, TensorsSpec]:
         spec = in_specs["sink"]
@@ -87,8 +88,13 @@ class TensorAggregator(Node):
         if rate is not None and rate != 0:
             rate = rate * self.frames_in / self.frames_flush
         out = TensorSpec(dtype=t.dtype, shape=out_shape)
-        self._window.clear()
-        self._timing.clear()
+        if self._keep_state_on_start:
+            # resuming from a checkpoint (negotiation is the last step
+            # before dataflow in this runtime, so consume the flag here)
+            self._keep_state_on_start = False
+        else:
+            self._window.clear()
+            self._timing.clear()
         return {"src": TensorsSpec(tensors=(out,), rate=rate)}
 
     def _split_units(self, arr) -> List:
@@ -136,5 +142,23 @@ class TensorAggregator(Node):
 
     def start(self) -> None:
         super().start()
+        if self._keep_state_on_start:
+            # resuming from a checkpoint: keep the restored window
+            return
         self._window.clear()
         self._timing.clear()
+
+    # -- checkpoint/resume (utils.checkpoint protocol) ----------------------
+
+    def state_dict(self):
+        return {
+            "window": [np.asarray(u) for u in self._window],
+            "timing": [list(t) for t in self._timing],
+        }
+
+    def load_state(self, state) -> None:
+        self._window = collections.deque(np.asarray(u) for u in state["window"])
+        self._timing = collections.deque(
+            (int(p), int(d)) for p, d in state["timing"]
+        )
+        self._keep_state_on_start = True

@@ -32,13 +32,16 @@ from ..spec import TensorsSpec
 
 
 class _Slot:
-    __slots__ = ("cond", "frame", "spec", "eos")
+    __slots__ = ("cond", "frame", "spec", "eos", "restored")
 
     def __init__(self):
         self.cond = threading.Condition()
         self.frame: Optional[Frame] = None
         self.spec: Optional[TensorsSpec] = None
         self.eos = False
+        # set by checkpoint restore: the next pipeline start must keep the
+        # slot contents and skip the zero-bootstrap frame
+        self.restored = False
 
 
 class TensorRepo:
@@ -115,6 +118,7 @@ class TensorRepo:
             s.frame = None
             s.spec = None
             s.eos = False
+            s.restored = False
             s.cond.notify_all()
 
     def reset(self, idx: Optional[int] = None) -> None:
@@ -153,7 +157,13 @@ class TensorRepoSink(SinkTerminal):
 
     def start(self) -> None:
         super().start()
-        self.repo.clear(self.slot_index)
+        s = self.repo.slot(self.slot_index)
+        with s.cond:
+            if not s.restored:  # keep checkpoint-restored contents
+                s.frame = None
+                s.spec = None
+            s.eos = False
+            s.cond.notify_all()
         self.dropped = 0
 
     def process(self, pad: Pad, frame: Frame):
@@ -230,8 +240,16 @@ class TensorRepoSrc(SourceNode):
         return Frame(tensors=arrays, pts=0, duration=0)
 
     def frames(self) -> Iterable[Frame]:
-        # Cycle bootstrap: first create emits zeros (tensor_reposrc.c:312-325).
-        yield self._dummy_frame()
+        # Cycle bootstrap: first create emits zeros (tensor_reposrc.c:312-325)
+        # — unless a checkpoint restored this slot, in which case the
+        # restored frame takes the bootstrap's place (resume must not inject
+        # a zero frame the uninterrupted run never saw).
+        s = self.repo.slot(self.slot_index)
+        with s.cond:
+            was_restored = s.restored
+            s.restored = False
+        if not was_restored:
+            yield self._dummy_frame()
         my_spec = self.output_spec()
         while not self.stopped:
             frame, spec, eos = self.repo.get_buffer(self.slot_index, timeout=0.1)

@@ -100,6 +100,8 @@ for _el, _mod in {
     "datasrc": "nnstreamer_tpu.elements.testsrc",
     "filesrc": "nnstreamer_tpu.elements.file_io",
     "filesink": "nnstreamer_tpu.elements.file_io",
+    "tensor_save": "nnstreamer_tpu.elements.save_load",
+    "tensor_load": "nnstreamer_tpu.elements.save_load",
     "fakesink": "nnstreamer_tpu.elements.sink",
 }.items():
     _lazy_builtin(_el, _mod)

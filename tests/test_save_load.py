@@ -1,0 +1,232 @@
+"""tensor_save / tensor_load elements + pipeline checkpoint/resume.
+
+The reference planned-but-never-built tensor_save/tensor_load
+(component-description.md:67-68) and has no checkpoint subsystem
+(survey §5); both are first-class here."""
+
+import numpy as np
+import pytest
+
+import nnstreamer_tpu as nns
+from nnstreamer_tpu.buffer import Frame
+from nnstreamer_tpu.elements.repo import GLOBAL_REPO
+from nnstreamer_tpu.elements.save_load import read_frames, write_frame, MAGIC
+from nnstreamer_tpu.utils import checkpoint as ckpt
+
+
+class TestContainer:
+    def test_roundtrip(self, tmp_path):
+        path = str(tmp_path / "s.nnstpu")
+        frames = [
+            Frame.of(np.arange(12, dtype=np.float32).reshape(3, 4),
+                     np.array([1, 2], np.int64), pts=100, duration=10),
+            Frame.of(np.ones((3, 4), np.float32) * 7,
+                     np.array([3, 4], np.int64), pts=110, duration=10),
+        ]
+        with open(path, "wb") as f:
+            f.write(MAGIC)
+            for fr in frames:
+                write_frame(f, fr)
+        got = list(read_frames(path))
+        assert len(got) == 2
+        for a, b in zip(got, frames):
+            assert a.pts == b.pts and a.duration == b.duration
+            for ta, tb in zip(a.tensors, b.tensors):
+                np.testing.assert_array_equal(ta, np.asarray(tb))
+                assert ta.dtype == np.asarray(tb).dtype
+
+    def test_truncated_tail_drops_partial(self, tmp_path):
+        path = str(tmp_path / "s.nnstpu")
+        fr = Frame.of(np.arange(100, dtype=np.float64))
+        with open(path, "wb") as f:
+            f.write(MAGIC)
+            write_frame(f, fr)
+            write_frame(f, fr)
+        raw = open(path, "rb").read()
+        open(path, "wb").write(raw[:-17])  # corrupt the last frame
+        assert len(list(read_frames(path))) == 1
+
+    def test_bad_magic(self, tmp_path):
+        path = str(tmp_path / "bad")
+        open(path, "wb").write(b"nope")
+        with pytest.raises(ValueError, match="not an NNSTPU1"):
+            list(read_frames(path))
+
+    def test_truncated_header_drops_partial(self, tmp_path):
+        path = str(tmp_path / "s.nnstpu")
+        fr = Frame.of(np.arange(10, dtype=np.float32))
+        with open(path, "wb") as f:
+            f.write(MAGIC)
+            write_frame(f, fr)
+            f.write(b'{"pts": 5, "tens')  # killed mid-header
+        assert len(list(read_frames(path))) == 1
+
+    def test_meta_roundtrip(self, tmp_path):
+        path = str(tmp_path / "s.nnstpu")
+        fr = Frame.of(
+            np.zeros((2, 2), np.uint8),
+            media="video", width=2, boxes=np.arange(8).reshape(2, 4),
+        )
+        with open(path, "wb") as f:
+            f.write(MAGIC)
+            write_frame(f, fr)
+        (got,) = read_frames(path)
+        assert got.meta["media"] == "video" and got.meta["width"] == 2
+        np.testing.assert_array_equal(
+            got.meta["boxes"], np.arange(8).reshape(2, 4)
+        )
+
+    def test_unserializable_meta_raises(self, tmp_path):
+        fr = Frame.of(np.zeros(2), bad=object())
+        with open(str(tmp_path / "x"), "wb") as f:
+            with pytest.raises(TypeError, match="meta"):
+                write_frame(f, fr)
+
+
+class TestElements:
+    def test_save_then_load_pipeline(self, tmp_path):
+        path = str(tmp_path / "stream.nnstpu")
+        data = [np.full((4,), i, np.float32) for i in range(5)]
+
+        from nnstreamer_tpu.elements.save_load import TensorSave
+        from nnstreamer_tpu.elements.testsrc import DataSrc
+
+        p = nns.Pipeline()
+        src = p.add(DataSrc(data=data))
+        save = p.add(TensorSave(location=path))
+        p.link_chain(src, save)
+        p.run(timeout=60)
+        assert save.num_frames == 5
+
+        # replay via parse_launch (string-pipeline parity)
+        h = nns.parse_launch(
+            f"tensor_load location={path} ! tensor_sink name=out collect=true"
+        )
+        h.start()
+        assert h.wait(30)
+        sink = h.nodes["out"]
+        assert sink.num_frames == 5
+        for i, fr in enumerate(sink.frames):
+            np.testing.assert_array_equal(
+                np.asarray(fr.tensor(0)), data[i]
+            )
+
+    def test_load_num_buffers(self, tmp_path):
+        path = str(tmp_path / "stream.nnstpu")
+        from nnstreamer_tpu.elements.save_load import TensorSave
+        from nnstreamer_tpu.elements.testsrc import DataSrc
+
+        p = nns.Pipeline()
+        src = p.add(DataSrc(data=[np.zeros((2,), np.uint8)] * 6))
+        p.add(TensorSave(name="sv", location=path))
+        p.link_chain(src, "sv")
+        p.run(timeout=60)
+
+        h = nns.parse_launch(
+            f"tensor_load location={path} num_buffers=2 ! "
+            "tensor_sink name=out collect=true"
+        )
+        h.start()
+        assert h.wait(30)
+        assert h.nodes["out"].num_frames == 2
+
+
+class TestCheckpoint:
+    def test_state_roundtrip_nested(self, tmp_path):
+        path = str(tmp_path / "st.npz")
+        state = {
+            "a": np.arange(6).reshape(2, 3),
+            "b": {"c": [1, 2.5, "x", None, True], "d": (np.ones(3),)},
+        }
+        ckpt.save_state(state, path)
+        got = ckpt.load_state(path)
+        np.testing.assert_array_equal(got["a"], state["a"])
+        assert got["b"]["c"] == [1, 2.5, "x", None, True]
+        assert isinstance(got["b"]["d"], tuple)
+        np.testing.assert_array_equal(got["b"]["d"][0], np.ones(3))
+
+    def test_repo_snapshot_restore(self):
+        GLOBAL_REPO.reset()
+        GLOBAL_REPO.set_buffer(3, Frame.of(np.arange(4), pts=7), None)
+        snap = ckpt.snapshot_repo()
+        GLOBAL_REPO.reset()
+        ckpt.restore_repo(snap)
+        frame, _, eos = GLOBAL_REPO.get_buffer(3, timeout=1)
+        assert not eos and frame.pts == 7
+        np.testing.assert_array_equal(frame.tensor(0), np.arange(4))
+        GLOBAL_REPO.reset()
+
+    def test_repo_cycle_resume_skips_bootstrap(self, tmp_path):
+        """After restore, reposrc must emit the restored frame — not its
+        zero bootstrap — and reposink must not wipe the slot on start."""
+        import threading
+        import time
+
+        from nnstreamer_tpu.utils import checkpoint as ckpt2
+
+        GLOBAL_REPO.reset()
+        GLOBAL_REPO.set_buffer(
+            5, Frame.of(np.full((4,), 7.0, np.float32), pts=42), None
+        )
+        path = str(tmp_path / "repo.npz")
+        ckpt2.save_state({"repo": ckpt2.snapshot_repo()}, path)
+        GLOBAL_REPO.reset()
+
+        h = nns.parse_launch(
+            "tensor_reposrc slot_index=5 caps='other/tensor, "
+            "dimension=(string)4:1:1:1, type=(string)float32, "
+            "framerate=(fraction)0/1' ! tensor_sink name=out collect=true"
+        )
+        ckpt2.restore_repo(ckpt2.load_state(path)["repo"])
+        sink = h.nodes["out"]
+        h.start()
+        deadline = time.monotonic() + 10
+        while sink.num_frames < 1 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        GLOBAL_REPO.set_eos(5)
+        assert h.wait(10)
+        assert sink.num_frames == 1  # no zero bootstrap injected
+        got = np.asarray(sink.frames[0].tensor(0))
+        np.testing.assert_array_equal(got, np.full((4,), 7.0, np.float32))
+        GLOBAL_REPO.reset()
+
+    def test_aggregator_resume_matches_uninterrupted(self, tmp_path):
+        """Stop mid-window, checkpoint, resume in a fresh pipeline: the
+        emitted window equals the uninterrupted run's."""
+        from nnstreamer_tpu.elements.aggregator import TensorAggregator
+        from nnstreamer_tpu.elements.sink import TensorSink
+        from nnstreamer_tpu.elements.testsrc import DataSrc
+
+        data = [np.full((1, 3), i, np.float32) for i in range(4)]
+
+        def build(frames):
+            p = nns.Pipeline()
+            src = p.add(DataSrc(data=frames))
+            agg = p.add(
+                TensorAggregator(name="agg", frames_in=1, frames_out=4,
+                                 frames_dim=1)
+            )
+            sink = p.add(TensorSink(name="out", collect=True))
+            p.link_chain(src, agg, sink)
+            return p, sink
+
+        # uninterrupted golden
+        p, sink = build(data)
+        p.run(timeout=60)
+        want = np.asarray(sink.frames[0].tensor(0))
+
+        # first half, checkpoint
+        path = str(tmp_path / "agg.npz")
+        p1, sink1 = build(data[:2])
+        p1.run(timeout=60)
+        assert sink1.num_frames == 0  # window not full yet
+        ckpt.checkpoint_pipeline(p1, path)
+
+        # fresh pipeline, restore, second half
+        p2, sink2 = build(data[2:])
+        ckpt.restore_pipeline(p2, path)
+        p2.run(timeout=60)
+        assert sink2.num_frames == 1
+        np.testing.assert_array_equal(
+            np.asarray(sink2.frames[0].tensor(0)), want
+        )
