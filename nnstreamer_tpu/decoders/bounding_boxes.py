@@ -29,7 +29,7 @@ import numpy as np
 from ..buffer import Frame
 from ..elements.decoder import DecoderPlugin, register_decoder
 from ..spec import TensorSpec, TensorsSpec
-from . import draw
+from . import draw, font
 
 DETECTION_THRESHOLD = 0.5
 Y_SCALE, X_SCALE, H_SCALE, W_SCALE = 10.0, 10.0, 5.0, 5.0
@@ -196,13 +196,23 @@ class BoundingBoxes(DecoderPlugin):
         sx = self.width / self.i_width
         sy = self.height / self.i_height
         for o in objs:
+            color = draw.color_for_class(o.class_id)
+            x, y = int(o.x * sx), int(o.y * sy)
             draw.draw_rect(
+                canvas, x, y, int(o.width * sx), int(o.height * sy), color
+            )
+            # class label above the box (inside when clipped at the top),
+            # like the reference's sprite text (tensordec-boundingbox.c:78)
+            text = o.label if o.label else str(o.class_id)
+            _, th = font.text_extent(text)
+            ly = y - th - 2
+            font.draw_label(
                 canvas,
-                int(o.x * sx),
-                int(o.y * sy),
-                int(o.width * sx),
-                int(o.height * sy),
-                draw.color_for_class(o.class_id),
+                x,
+                ly if ly >= 0 else y + 2,
+                text,
+                draw.WHITE,
+                bg=color,
             )
         out = frame.with_tensors((canvas,))
         out.meta["objects"] = objs

@@ -5,7 +5,10 @@ heatmap tensor shaped (grid_h, grid_w, 14) (NNS ``14:w:h``, asserted at
 ``:218``); per keypoint, decode takes the argmax cell (``:473-493``), then
 draws the 13-edge skeleton (``:401-437``) scaled into an RGBA canvas.
 
-option1 = output ``W:H``; option2 = input grid ``W:H``.
+option1 = output ``W:H``; option2 = input grid ``W:H``; option3 = keypoint
+label file (one name per line) — when given, each joint is annotated with
+its name using the built-in raster font (the reference's sprite text,
+``tensordec-font.c``).
 Keypoints ride in ``meta["pose"]`` as (x, y, prob) triples in grid coords.
 """
 
@@ -18,7 +21,7 @@ import numpy as np
 from ..buffer import Frame
 from ..elements.decoder import DecoderPlugin, register_decoder
 from ..spec import TensorSpec, TensorsSpec
-from . import draw
+from . import draw, font
 from .bounding_boxes import _parse_wh
 
 POSE_SIZE = 14
@@ -36,9 +39,13 @@ EDGES = [
 @register_decoder("pose_estimation")
 class PoseEstimation(DecoderPlugin):
     def init(self, options: List[str]) -> None:
-        opts = list(options) + [""] * (2 - len(options))
+        opts = list(options) + [""] * (3 - len(options))
         self.width, self.height = _parse_wh(opts[0], 640, 480)
         self.i_width, self.i_height = _parse_wh(opts[1], 0, 0)
+        self.labels: List[str] = []
+        if opts[2]:
+            with open(opts[2], "r", encoding="utf-8") as f:
+                self.labels = [ln.strip() for ln in f if ln.strip()]
 
     def out_spec(self, in_spec: TensorsSpec) -> TensorsSpec:
         t = in_spec.tensors[0]
@@ -71,8 +78,14 @@ class PoseEstimation(DecoderPlugin):
         pts = [(int(x * sx), int(y * sy)) for x, y, _ in keypoints]
         for a, b in EDGES:
             draw.draw_line(canvas, pts[a][0], pts[a][1], pts[b][0], pts[b][1], draw.WHITE)
-        for x, y in pts:
+        for i, (x, y) in enumerate(pts):
             draw.draw_dot(canvas, x, y, draw.WHITE)
+            if self.labels:
+                name = self.labels[i] if i < len(self.labels) else str(i)
+                font.draw_label(
+                    canvas, x + 4, y - 4, name, draw.WHITE,
+                    bg=np.array([0, 0, 0, 255], np.uint8),
+                )
         out = frame.with_tensors((canvas,))
         out.meta["pose"] = keypoints
         return out

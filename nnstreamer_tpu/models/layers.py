@@ -20,25 +20,45 @@ from ..ops.quant import maybe_dequantize
 Params = Dict[str, Any]
 
 
+def np_rng(key) -> np.random.Generator:
+    """A numpy Generator seeded from a jax PRNG key.
+
+    Param init runs on the host with numpy: ``jax.random.normal`` /
+    ``jnp.zeros`` would trigger one small XLA compile per distinct shape
+    (~60 for MobileNet), turning model *construction* into tens of seconds
+    of compile time on a cold cache.  Weights are random anyway (zero-egress
+    env); determinism per key is preserved.
+    """
+    raw = np.asarray(jax.random.key_data(key)).ravel()
+    return np.random.default_rng([int(x) for x in raw])
+
+
+def _normal(key, shape, stddev: float) -> jnp.ndarray:
+    w = np_rng(key).standard_normal(shape, dtype=np.float32) * stddev
+    return jnp.asarray(w)
+
+
 def conv_init(key, kh, kw, cin, cout, groups: int = 1) -> Params:
     fan_in = kh * kw * cin // groups
-    w = jax.random.normal(key, (kh, kw, cin // groups, cout), jnp.float32)
-    w = w * np.sqrt(2.0 / fan_in)
-    return {"w": w}
+    return {
+        "w": _normal(key, (kh, kw, cin // groups, cout), np.sqrt(2.0 / fan_in))
+    }
 
 
 def bn_init(c) -> Params:
     return {
-        "scale": jnp.ones((c,), jnp.float32),
-        "bias": jnp.zeros((c,), jnp.float32),
-        "mean": jnp.zeros((c,), jnp.float32),
-        "var": jnp.ones((c,), jnp.float32),
+        "scale": jnp.asarray(np.ones((c,), np.float32)),
+        "bias": jnp.asarray(np.zeros((c,), np.float32)),
+        "mean": jnp.asarray(np.zeros((c,), np.float32)),
+        "var": jnp.asarray(np.ones((c,), np.float32)),
     }
 
 
 def dense_init(key, cin, cout) -> Params:
-    w = jax.random.normal(key, (cin, cout), jnp.float32) * np.sqrt(1.0 / cin)
-    return {"w": w, "b": jnp.zeros((cout,), jnp.float32)}
+    return {
+        "w": _normal(key, (cin, cout), np.sqrt(1.0 / cin)),
+        "b": jnp.asarray(np.zeros((cout,), np.float32)),
+    }
 
 
 def conv2d(

@@ -65,10 +65,16 @@ static const char *type_names[] = {
 static const size_t type_sizes[] = {4, 4, 2, 2, 1, 1, 8, 4, 8, 8, 2, 2};
 
 static ml_tensor_type_e type_from_name (const char *name) {
-  for (unsigned i = 0; i < ML_TENSOR_TYPE_UNKNOWN; ++i)
-    if (!strcmp (name, type_names[i]))
-      return (ml_tensor_type_e) i;
+  if (name != nullptr)
+    for (unsigned i = 0; i < ML_TENSOR_TYPE_UNKNOWN; ++i)
+      if (!strcmp (name, type_names[i]))
+        return (ml_tensor_type_e) i;
   return ML_TENSOR_TYPE_UNKNOWN;
+}
+
+/* Name for a (possibly out-of-range) type value; never indexes OOB. */
+static const char *type_name_safe (ml_tensor_type_e t) {
+  return (t < ML_TENSOR_TYPE_UNKNOWN) ? type_names[t] : "unknown";
 }
 
 /* ------------------------------------------------------- interpreter init */
@@ -123,7 +129,23 @@ struct Gil {
   }
 };
 
-/* Call glue.<name>(args); returns new ref or nullptr (prints the error). */
+/* Classification of the last failed glue_call on this thread, so callers
+ * can map distinct Python exception types to distinct ml_error codes (the
+ * reference's C API distinguishes timeout vs invalid-arg vs pipe errors). */
+static thread_local int g_last_err = ML_ERROR_NONE;
+
+static int classify_pending_exception (void) {
+  if (PyErr_ExceptionMatches (PyExc_TimeoutError))
+    return ML_ERROR_TIMED_OUT; /* covers InvokeTimeout */
+  if (PyErr_ExceptionMatches (PyExc_ValueError)
+      || PyErr_ExceptionMatches (PyExc_TypeError)
+      || PyErr_ExceptionMatches (PyExc_KeyError))
+    return ML_ERROR_INVALID_PARAMETER;
+  return ML_ERROR_STREAMS_PIPE;
+}
+
+/* Call glue.<name>(args); returns new ref or nullptr (prints the error and
+ * records its classification in g_last_err). */
 static PyObject *glue_call (const char *name, PyObject *args) {
   PyObject *fn = PyObject_GetAttrString (g_glue, name);
   PyObject *res = nullptr;
@@ -132,8 +154,10 @@ static PyObject *glue_call (const char *name, PyObject *args) {
     Py_DECREF (fn);
   }
   Py_XDECREF (args);
-  if (res == nullptr)
+  if (res == nullptr) {
+    g_last_err = classify_pending_exception ();
     PyErr_Print ();
+  }
   return res;
 }
 
@@ -148,7 +172,7 @@ static PyObject *data_to_wire (const ml_tensors_data_s *d) {
     PyObject *shape = PyTuple_New (d->info.ranks[i]);
     for (unsigned r = 0; r < d->info.ranks[i]; ++r)
       PyTuple_SET_ITEM (shape, r, PyLong_FromUnsignedLong (d->info.dims[i][r]));
-    PyObject *dtype = PyUnicode_FromString (type_names[d->info.types[i]]);
+    PyObject *dtype = PyUnicode_FromString (type_name_safe (d->info.types[i]));
     PyObject *triple = PyTuple_Pack (3, buf, dtype, shape);
     Py_DECREF (buf);
     Py_DECREF (dtype);
@@ -166,6 +190,8 @@ static ml_tensors_data_s *wire_to_data (PyObject *list) {
   if (n > ML_TENSOR_SIZE_LIMIT)
     return nullptr;
   auto *d = (ml_tensors_data_s *) calloc (1, sizeof (ml_tensors_data_s));
+  if (d == nullptr)
+    return nullptr;
   d->info.count = (unsigned) n;
   for (Py_ssize_t i = 0; i < n; ++i) {
     PyObject *triple = PyList_GET_ITEM (list, i);
@@ -186,11 +212,14 @@ static ml_tensors_data_s *wire_to_data (PyObject *list) {
       d->info.dims[i][r] =
           (unsigned) PyLong_AsUnsignedLong (PyTuple_GET_ITEM (shape, r));
     d->buffers[i] = malloc ((size_t) size);
+    if (d->buffers[i] == nullptr)
+      goto fail;
     d->sizes[i] = (size_t) size;
     memcpy (d->buffers[i], raw, (size_t) size);
   }
   return d;
 fail:
+  PyErr_Clear (); /* e.g. non-string dtype from PyUnicode_AsUTF8 */
   for (unsigned i = 0; i < d->info.count; ++i)
     free (d->buffers[i]);
   free (d);
@@ -204,7 +233,7 @@ static PyObject *info_to_wire (const ml_tensors_info_s *info) {
     PyObject *shape = PyTuple_New (info->ranks[i]);
     for (unsigned r = 0; r < info->ranks[i]; ++r)
       PyTuple_SET_ITEM (shape, r, PyLong_FromUnsignedLong (info->dims[i][r]));
-    PyObject *dtype = PyUnicode_FromString (type_names[info->types[i]]);
+    PyObject *dtype = PyUnicode_FromString (type_name_safe (info->types[i]));
     PyObject *pair = PyTuple_Pack (2, dtype, shape);
     Py_DECREF (dtype);
     Py_DECREF (shape);
@@ -223,7 +252,15 @@ static int wire_to_info (PyObject *list, ml_tensors_info_s *info) {
     PyObject *pair = PyList_GET_ITEM (list, i);
     PyObject *dtype = PyTuple_GetItem (pair, 0);
     PyObject *shape = PyTuple_GetItem (pair, 1);
+    if (dtype == nullptr || shape == nullptr) {
+      PyErr_Clear (); /* PyTuple_GetItem set IndexError */
+      return -1;
+    }
     info->types[i] = type_from_name (PyUnicode_AsUTF8 (dtype));
+    if (info->types[i] == ML_TENSOR_TYPE_UNKNOWN) {
+      PyErr_Clear (); /* non-string dtype: AsUTF8 may have raised */
+      return -1;      /* partial spec (e.g. dtype "") — not representable */
+    }
     info->ranks[i] = (unsigned) PyTuple_GET_SIZE (shape);
     if (info->ranks[i] > ML_TENSOR_RANK_LIMIT)
       return -1;
@@ -305,7 +342,8 @@ int ml_tensors_info_get_tensor_dimension (ml_tensors_info_h info,
 int ml_tensors_info_get_tensor_size (ml_tensors_info_h info,
     unsigned int index, size_t *size) {
   auto *s = (ml_tensors_info_s *) info;
-  if (!s || !size || index >= s->count)
+  if (!s || !size || index >= s->count
+      || s->types[index] >= ML_TENSOR_TYPE_UNKNOWN)
     return ML_ERROR_INVALID_PARAMETER;
   size_t n = type_sizes[s->types[index]];
   for (unsigned r = 0; r < s->ranks[index]; ++r)
@@ -385,6 +423,10 @@ int ml_single_open (ml_single_h *single, const char *model,
   if (res == nullptr)
     return ML_ERROR_STREAMS_PIPE;
   auto *s = (ml_single_s *) malloc (sizeof (ml_single_s));
+  if (s == nullptr) {
+    Py_DECREF (res);
+    return ML_ERROR_OUT_OF_MEMORY;
+  }
   s->obj = res;
   *single = s;
   return ML_ERROR_NONE;
@@ -416,7 +458,7 @@ int ml_single_invoke (ml_single_h single, const ml_tensors_data_h in,
   PyObject *res = glue_call ("single_invoke",
       Py_BuildValue ("(ON)", s->obj, data_to_wire (d)));
   if (res == nullptr)
-    return ML_ERROR_TIMED_OUT; /* InvokeTimeout or backend failure */
+    return g_last_err; /* TIMED_OUT / INVALID_PARAMETER / STREAMS_PIPE */
   ml_tensors_data_s *od = wire_to_data (res);
   Py_DECREF (res);
   if (od == nullptr)
@@ -497,6 +539,10 @@ int ml_pipeline_construct (const char *description, ml_pipeline_h *pipe) {
   if (res == nullptr)
     return ML_ERROR_STREAMS_PIPE;
   auto *p = (ml_pipeline_s *) malloc (sizeof (ml_pipeline_s));
+  if (p == nullptr) {
+    Py_DECREF (res);
+    return ML_ERROR_OUT_OF_MEMORY;
+  }
   p->obj = res;
   *pipe = p;
   return ML_ERROR_NONE;
@@ -547,6 +593,11 @@ int ml_pipeline_get_state (ml_pipeline_h pipe, ml_pipeline_state_e *state) {
   if (res == nullptr)
     return ML_ERROR_STREAMS_PIPE;
   const char *st = PyUnicode_AsUTF8 (res);
+  if (st == nullptr) {
+    PyErr_Clear ();
+    Py_DECREF (res);
+    return ML_ERROR_UNKNOWN;
+  }
   if (!strcmp (st, "PLAYING"))
     *state = ML_PIPELINE_STATE_PLAYING;
   else if (!strcmp (st, "NULL"))
@@ -617,6 +668,8 @@ int ml_pipeline_sink_register (ml_pipeline_h pipe, const char *sink_name,
   if (!gil.ok)
     return ML_ERROR_NOT_SUPPORTED;
   auto *ctx = (sink_ctx *) malloc (sizeof (sink_ctx));
+  if (ctx == nullptr)
+    return ML_ERROR_OUT_OF_MEMORY;
   ctx->cb = cb;
   ctx->user_data = user_data;
   PyObject *capsule = PyCapsule_New (ctx, "nns.sink_ctx", sink_ctx_free);

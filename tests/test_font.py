@@ -1,0 +1,142 @@
+"""Built-in raster font + label rendering on overlay decoders.
+
+The reference analog: ``tensordec-font.c`` (baked 8×13 sprite) consumed by
+``tensordec-boundingbox.c:78`` — golden-pixel assertions here mirror the
+SSAT decoder goldens (independent expectations, not framework output).
+"""
+
+import string
+
+import numpy as np
+
+from nnstreamer_tpu.buffer import Frame
+from nnstreamer_tpu.decoders import draw, font
+
+
+class TestAtlas:
+    def test_covers_printable_ascii(self):
+        for ch in string.printable:
+            if ch in "\t\n\r\x0b\x0c":
+                continue
+            assert ch in font.ATLAS, f"missing glyph {ch!r}"
+
+    def test_glyph_shapes(self):
+        for ch, bitmap in font.ATLAS.items():
+            assert bitmap.shape == (font.GLYPH_H, font.GLYPH_W), ch
+            assert bitmap.dtype == bool
+
+    def test_only_space_is_empty(self):
+        for ch, bitmap in font.ATLAS.items():
+            if ch == " ":
+                assert not bitmap.any()
+            else:
+                assert bitmap.any(), f"glyph {ch!r} renders nothing"
+
+    def test_glyphs_distinct(self):
+        seen = {}
+        for ch, bitmap in font.ATLAS.items():
+            key = bitmap.tobytes()
+            assert key not in seen, f"{ch!r} identical to {seen[key]!r}"
+            seen[key] = ch
+
+
+class TestRenderText:
+    def test_extent_matches_render(self):
+        for text in ("A", "cat", "person 0.98", ""):
+            mask = font.render_text(text)
+            w, h = font.text_extent(text)
+            assert mask.shape == (h, w)
+
+    def test_scale_doubles_pixels(self):
+        m1 = font.render_text("X")
+        m2 = font.render_text("X", scale=2)
+        assert m2.shape == (m1.shape[0] * 2, m1.shape[1] * 2)
+        assert m2.sum() == m1.sum() * 4
+
+    def test_unknown_char_falls_back(self):
+        m = font.render_text("é")  # not in atlas
+        np.testing.assert_array_equal(m, font.ATLAS["?"])
+
+
+class TestDrawLabel:
+    def test_stamps_glyph_pixels(self):
+        canvas = draw.new_canvas(40, 20)
+        color = np.array([255, 0, 0, 255], np.uint8)
+        font.draw_label(canvas, 2, 2, "I", color)
+        mask = font.ATLAS["I"]
+        region = canvas[2 : 2 + font.GLYPH_H, 2 : 2 + font.GLYPH_W]
+        # golden: exactly the lit glyph pixels carry the color
+        np.testing.assert_array_equal(region[mask], np.tile(color, (mask.sum(), 1)))
+        assert (region[~mask] == 0).all()
+
+    def test_background_bar(self):
+        canvas = draw.new_canvas(40, 20)
+        bg = np.array([0, 0, 255, 255], np.uint8)
+        font.draw_label(canvas, 5, 5, "A", draw.WHITE, bg=bg, pad=1)
+        # padded bar corners filled with bg
+        np.testing.assert_array_equal(canvas[4, 4], bg)
+        w, h = font.text_extent("A")
+        np.testing.assert_array_equal(canvas[5 + h, 5 + w], bg)
+
+    def test_clips_at_edges(self):
+        canvas = draw.new_canvas(10, 10)
+        font.draw_label(canvas, -3, -3, "W", draw.WHITE)  # partially off-canvas
+        font.draw_label(canvas, 8, 8, "W", draw.WHITE)
+        assert canvas.shape == (10, 10, 4)  # no exception, no wraparound
+
+    def test_off_canvas_noop(self):
+        canvas = draw.new_canvas(10, 10)
+        font.draw_label(canvas, 50, 50, "W", draw.WHITE)
+        assert not canvas.any()
+
+
+class TestDecoderLabels:
+    def test_bounding_box_overlay_renders_label_text(self, tmp_path):
+        from nnstreamer_tpu.decoders.bounding_boxes import BoundingBoxes
+
+        labels = tmp_path / "labels.txt"
+        labels.write_text("background\ncat\n")
+        priors = tmp_path / "priors.txt"
+        priors.write_text(
+            "0.5 0.5\n0.5 0.5\n0.5 0.5\n0.5 0.5\n"
+        )
+        dec = BoundingBoxes()
+        dec.init(["tflite-ssd", str(labels), str(priors), "100:100", "100:100"])
+        locations = np.zeros((2, 4), np.float32)
+        scores = np.full((2, 2), -10.0, np.float32)
+        scores[0, 1] = 4.0
+        from nnstreamer_tpu.spec import TensorsSpec
+
+        out = dec.decode(Frame.of(locations, scores), TensorsSpec())
+        canvas = np.asarray(out.tensor(0))
+        o = out.meta["objects"][0]
+        assert o.label == "cat"
+        # label bar sits just above the box top edge; glyph pixels are white
+        x, y = o.x, o.y
+        _, th = font.text_extent("cat")
+        bar = canvas[y - th - 2 : y - 2, x : x + 20]
+        assert (bar[..., 3] == 255).any(), "label bar not rendered"
+        white = (bar[..., :3] == 255).all(axis=-1) & (bar[..., 3] == 255)
+        assert white.any(), "no white glyph pixels in the label area"
+        # golden cross-check: the white pixel pattern equals the rendered text
+        mask = font.render_text("cat")
+        sub = white[:, : mask.shape[1]]
+        np.testing.assert_array_equal(sub[: mask.shape[0]], mask)
+
+    def test_pose_overlay_renders_keypoint_names(self, tmp_path):
+        from nnstreamer_tpu.decoders.pose import POSE_SIZE, PoseEstimation
+
+        names = tmp_path / "joints.txt"
+        names.write_text("\n".join(f"j{i}" for i in range(POSE_SIZE)))
+        dec = PoseEstimation()
+        dec.init(["64:64", "8:8", str(names)])
+        hm = np.zeros((8, 8, POSE_SIZE), np.float32)
+        for k in range(POSE_SIZE):
+            hm[k % 8, (k * 3) % 8, k] = 1.0
+        from nnstreamer_tpu.spec import TensorsSpec
+
+        out = dec.decode(Frame.of(hm), TensorsSpec())
+        canvas = np.asarray(out.tensor(0))
+        # black label-bar pixels exist (bg) beyond the white skeleton
+        black_bars = (canvas[..., 3] == 255) & (canvas[..., :3] == 0).all(axis=-1)
+        assert black_bars.any(), "keypoint label bars not rendered"
