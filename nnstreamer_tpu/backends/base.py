@@ -45,6 +45,13 @@ class FilterBackend:
         """Model input signature; None if unknown until reconfigure()."""
         return None
 
+    def model_spec(self) -> Optional[TensorsSpec]:
+        """The model's DECLARED (possibly partial) input spec — the
+        negotiation template.  Unlike :meth:`input_spec` this never narrows
+        to the last negotiated shape, so mid-stream renegotiation judges a
+        new spec against what the model actually requires."""
+        return self.input_spec()
+
     def output_spec(self) -> Optional[TensorsSpec]:
         return None
 

@@ -33,6 +33,7 @@ from __future__ import annotations
 import dataclasses
 import importlib.util
 import os
+from collections import OrderedDict
 from typing import Any, Callable, Optional, Sequence, Tuple
 
 import numpy as np
@@ -108,6 +109,9 @@ def parse_custom(custom: str) -> dict:
     return out
 
 
+DEFAULT_COMPILE_CACHE = 8
+
+
 @register_backend("jax")
 class JaxBackend(FilterBackend):
     device_resident = True
@@ -117,9 +121,17 @@ class JaxBackend(FilterBackend):
         self._fn: Optional[Callable] = None
         self._wrapper: Optional[Callable] = None  # fn → fused fn (optimize.py)
         self._compiled = None
+        self._model_spec: Optional[TensorsSpec] = None
         self._in_spec: Optional[TensorsSpec] = None
         self._out_spec: Optional[TensorsSpec] = None
         self._single_output = False
+        # Bounded executable cache for mid-stream renegotiation: spec key →
+        # (jitted, out_spec, single_output).  A renegotiated shape either
+        # hits here (instant swap) or compiles exactly once — never a silent
+        # retrace inside the hot loop; eviction keeps alternating-shape
+        # streams from growing memory without bound.
+        self._cache: "OrderedDict[tuple, tuple]" = OrderedDict()
+        self._cache_size = DEFAULT_COMPILE_CACHE
 
     # -- open/close ---------------------------------------------------------
 
@@ -140,18 +152,36 @@ class JaxBackend(FilterBackend):
         else:
             raise TypeError(f"unsupported model object: {type(model)}")
         self._fn = self.model.fn()
+        # the model's DECLARED spec (possibly partial, never mutated) vs the
+        # currently negotiated spec: renegotiation re-reconciles against the
+        # former, so a mid-stream change isn't judged against the last shape
+        self._model_spec = self.model.input_spec
         self._in_spec = self.model.input_spec
         self._out_spec = self.model.output_spec
+        self._cache.clear()
+        try:
+            self._cache_size = max(
+                1,
+                int(parse_custom(custom).get(
+                    "compile_cache", DEFAULT_COMPILE_CACHE
+                )),
+            )
+        except ValueError:
+            self._cache_size = DEFAULT_COMPILE_CACHE
 
     def close(self) -> None:
         self.model = None
         self._fn = None
         self._compiled = None
+        self._cache.clear()
 
     # -- spec discovery -----------------------------------------------------
 
     def input_spec(self) -> Optional[TensorsSpec]:
         return self._in_spec
+
+    def model_spec(self) -> Optional[TensorsSpec]:
+        return self._model_spec
 
     def output_spec(self) -> Optional[TensorsSpec]:
         if self._out_spec is not None:
@@ -165,11 +195,21 @@ class JaxBackend(FilterBackend):
 
     # -- compilation (the "interpreter build") ------------------------------
 
-    def set_wrapper(self, wrapper: Optional[Callable]) -> None:
+    def set_wrapper(
+        self, wrapper: Optional[Callable], invalidate: bool = True
+    ) -> None:
         """Install a fn→fn wrapper (transform fusion): the wrapped function
-        compiles as one XLA program (``graph/optimize.py``)."""
+        compiles as one XLA program (``graph/optimize.py``).
+
+        ``invalidate=False`` keeps cached executables: valid when the new
+        wrapper is a spec-derived rebuild of the same fused chain (mid-stream
+        renegotiation re-installs per spec; an executable cached under a
+        spec key was compiled with that spec's functionally-identical
+        wrapper).  Pass True whenever the fused transform *list* changed."""
         self._wrapper = wrapper
         self._compiled = None
+        if invalidate:
+            self._cache.clear()  # cached executables compiled the old fn
 
     def trace_output_spec(self, in_spec: TensorsSpec) -> TensorsSpec:
         """Model-only output spec via tracing (no compile, no wrapper)."""
@@ -180,8 +220,18 @@ class JaxBackend(FilterBackend):
     def _effective_fn(self) -> Callable:
         return self._wrapper(self._fn) if self._wrapper is not None else self._fn
 
+    @staticmethod
+    def _spec_key(spec: TensorsSpec) -> tuple:
+        return tuple((np.dtype(t.dtype).str, tuple(t.shape)) for t in spec.tensors)
+
     def _compile(self, in_spec: TensorsSpec) -> TensorsSpec:
         self._in_spec = in_spec
+        key = self._spec_key(in_spec)
+        hit = self._cache.get(key)
+        if hit is not None:
+            self._cache.move_to_end(key)
+            self._compiled, self._out_spec, self._single_output = hit
+            return self._out_spec
         structs = _as_shape_structs(in_spec)
         jitted = self._jit(self._effective_fn)
         # AOT-lower for early error surfacing + warm cache, but keep the
@@ -194,6 +244,9 @@ class JaxBackend(FilterBackend):
         self._single_output = not isinstance(outs, (tuple, list))
         out_spec = _spec_from_outputs(outs if not self._single_output else (outs,))
         self._out_spec = out_spec
+        self._cache[key] = (jitted, out_spec, self._single_output)
+        while len(self._cache) > self._cache_size:
+            self._cache.popitem(last=False)  # evict LRU executable
         return out_spec
 
     def _jit(self, fn):
@@ -208,7 +261,7 @@ class JaxBackend(FilterBackend):
         return self._compile(raw_spec)
 
     def reconfigure(self, in_spec: TensorsSpec) -> TensorsSpec:
-        mine = self._in_spec
+        mine = self._model_spec
         if mine is not None:
             merged = mine.intersect(in_spec)
             if merged is None:

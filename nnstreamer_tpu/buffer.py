@@ -85,12 +85,20 @@ class Event:
     """In-band stream events (the analog of GstEvent): EOS, stream-start,
     flush, and segment/spec changes propagate through pads like frames do."""
 
-    kind: str  # "eos" | "stream-start" | "flush" | "spec"
+    kind: str  # "eos" | "stream-start" | "flush" | "caps"
     payload: Any = None
 
     @classmethod
     def eos(cls) -> "Event":
         return cls("eos")
+
+    @classmethod
+    def caps(cls, spec) -> "Event":
+        """Mid-stream spec change (the GST_EVENT_CAPS analog): ``payload`` is
+        the new fixed :class:`~nnstreamer_tpu.spec.TensorsSpec`.  Travels in
+        order with frames; each node re-runs its local negotiation
+        (``tensor_filter.c:666-763`` re-enters transform_caps at any time)."""
+        return cls("caps", spec)
 
     @classmethod
     def stream_start(cls) -> "Event":

@@ -61,6 +61,7 @@ class TensorFilter(Node):
         self._opened = False
         self._fused_pre: list = []  # TensorTransforms folded in (optimize.py)
         self._fused_post: list = []
+        self._fusion_dirty = False
         self.invoke_ns: list = []  # per-invoke latency when profiling
 
     def set_fused_transforms(self, pre: list, post: list) -> None:
@@ -68,6 +69,7 @@ class TensorFilter(Node):
         by the graph optimizer, ``graph/optimize.py``)."""
         self._fused_pre = list(pre)
         self._fused_post = list(post)
+        self._fusion_dirty = True  # next wrapper install must drop the cache
 
     @staticmethod
     def _parse_spec_props(dims: str, types: str) -> Optional[TensorsSpec]:
@@ -114,7 +116,7 @@ class TensorFilter(Node):
             # input= property, which describes the MODEL input) only applies
             # after the fused pre-ops run — checked in _install_fusion
             return TensorsSpec()
-        spec = self.backend.input_spec() if self._opened else None
+        spec = self.backend.model_spec() if self._opened else None
         if spec is not None and self._prop_in is not None:
             merged = spec.intersect(self._prop_in)
             if merged is None:
@@ -163,7 +165,7 @@ class TensorFilter(Node):
                 tensors=tuple(tr.out_spec_for(t) for t in spec_cur.tensors),
                 rate=spec_cur.rate,
             )
-        model_spec = self.backend.input_spec()
+        model_spec = self.backend.model_spec()
         if model_spec is not None and model_spec.intersect(spec_cur) is None:
             raise NegotiationError(
                 f"{self.name}: fused pre-transform output {spec_cur} is "
@@ -208,7 +210,11 @@ class TensorFilter(Node):
                 return type(out)(outs)
             return fn
 
-        self.backend.set_wrapper(wrapper)
+        # a spec-derived rebuild of the SAME fused chain keeps the backend's
+        # executable cache (mid-stream renegotiation alternating A/B shapes
+        # hits the cache); only a changed transform list invalidates
+        self.backend.set_wrapper(wrapper, invalidate=self._fusion_dirty)
+        self._fusion_dirty = False
         return spec_cur
 
     # -- hot loop -----------------------------------------------------------

@@ -76,19 +76,25 @@ class Queue(Node):
                 return
             if status != OK:
                 continue  # timeout poll: retry
-            if isinstance(item, Event):
-                if item.kind == "eos":
-                    self.sink_pads["sink"].eos = True
-                    self._on_eos()
-                    return
-                self.on_event(self.sink_pads["sink"], item)
-            else:
-                try:
+            try:
+                if isinstance(item, Event):
+                    if item.kind == "eos":
+                        self.sink_pads["sink"].eos = True
+                        self._on_eos()
+                        return
+                    if item.kind == "caps":
+                        # renegotiate our pads + forward (a NegotiationError
+                        # downstream must reach post_error, not kill the
+                        # worker silently)
+                        self._handle_caps(self.sink_pads["sink"], item.payload)
+                    else:
+                        self.on_event(self.sink_pads["sink"], item)
+                else:
                     self.push(item)
-                except BaseException as exc:  # noqa: BLE001
-                    if self.pipeline is not None:
-                        self.pipeline.post_error(self, exc)
-                    return
+            except BaseException as exc:  # noqa: BLE001
+                if self.pipeline is not None:
+                    self.pipeline.post_error(self, exc)
+                return
 
     def interrupt(self) -> None:
         if self._q is not None:

@@ -36,10 +36,21 @@ class StreamError(Exception):
     """Raised for unrecoverable dataflow errors (``GST_FLOW_ERROR``)."""
 
 
+def _frame_sig(tensors) -> tuple:
+    """Cheap (dtype, shape) signature of a frame's payloads."""
+    return tuple((t.dtype, tuple(t.shape)) for t in tensors)
+
+
+# Sentinel for pads whose negotiated spec is not fully fixed (polymorphic
+# sinks): per-frame signature checking is skipped there — a downstream pad
+# with a fixed spec still catches any change.
+_UNCHECKED = object()
+
+
 class Pad:
     """One endpoint of a link.  Direction is "sink" (input) or "src" (output)."""
 
-    __slots__ = ("node", "name", "direction", "peer", "spec", "eos")
+    __slots__ = ("node", "name", "direction", "peer", "spec", "eos", "sig")
 
     def __init__(self, node: "Node", name: str, direction: str):
         self.node = node
@@ -48,6 +59,8 @@ class Pad:
         self.peer: Optional[Pad] = None
         self.spec: Optional[TensorsSpec] = None
         self.eos = False
+        # last-seen frame signature; None = derive from spec on first frame
+        self.sig = None
 
     @property
     def full_name(self) -> str:
@@ -63,12 +76,44 @@ class Pad:
 
     def push(self, item: Union[Frame, Event]) -> None:
         """Push a frame/event to the linked downstream node (synchronous,
-        runs the downstream chain in the calling thread)."""
+        runs the downstream chain in the calling thread).
+
+        Frames are signature-checked against the negotiated spec: a
+        mid-stream (dtype, shape) change emits a caps event downstream
+        *before* the frame — triggering explicit renegotiation (and backend
+        recompiles) instead of a silent jit retrace.  The reference
+        re-enters ``transform_caps`` the same way (``tensor_filter.c:666``).
+        """
         if self.direction != "src":
             raise ValueError("push() is only valid on src pads")
         if self.peer is None:
             return  # unlinked src pad: drop (like an unlinked tee branch)
+        if isinstance(item, Frame):
+            sig = _frame_sig(item.tensors)
+            if sig != self.sig and self.sig is not _UNCHECKED:
+                self._spec_changed(sig, item)
         self.peer.node._dispatch(self.peer, item)
+
+    def _spec_changed(self, sig: tuple, frame: Frame) -> None:
+        if self.sig is None:
+            # first frame: bind the signature from the negotiated spec
+            if self.spec is not None and self.spec.tensors_fixed:
+                expected = tuple(
+                    (t.dtype, tuple(t.shape)) for t in self.spec.tensors
+                )
+                if sig == expected:
+                    self.sig = sig
+                    return
+            else:
+                self.sig = _UNCHECKED  # polymorphic pad: stop checking
+                return
+        # genuine mid-stream change: renegotiate downstream from here
+        new_spec = TensorsSpec.from_arrays(
+            frame.tensors, rate=self.spec.rate if self.spec else None
+        )
+        self.spec = new_spec
+        self.sig = sig
+        self.peer.node._dispatch(self.peer, Event.caps(new_spec))
 
     def __repr__(self) -> str:
         return f"Pad({self.full_name}, {self.direction})"
@@ -208,8 +253,48 @@ class Node:
             pad.eos = True
             if all(p.eos for p in self.sink_pads.values()):
                 self._on_eos()
+        elif event.kind == "caps":
+            self._handle_caps(pad, event.payload)
         else:
             self.on_event(pad, event)
+
+    def _handle_caps(self, pad: Pad, new_spec: TensorsSpec) -> None:
+        """Mid-stream renegotiation from this node downstream: re-check the
+        new spec against the pad template, re-run the commit phase, and
+        propagate a caps event on any src pad whose spec changed.  An
+        incompatible change raises (loud pipeline error, never a silent
+        retrace) — ``tensor_filter.c:799-839`` fails negotiation the same
+        way."""
+        template = self.sink_spec(pad.name)
+        merged = template.intersect(new_spec)
+        if merged is None:
+            raise NegotiationError(
+                f"{pad.full_name}: mid-stream spec change to {new_spec} "
+                f"rejected (template {template})"
+            )
+        pad.spec = merged
+        pad.sig = None
+        in_specs = {
+            p.name: p.spec
+            for p in self.sink_pads.values()
+            if p.peer is not None and p.spec is not None
+        }
+        out_specs = self.reconfigure(in_specs)
+        for name, spad in self.src_pads.items():
+            if spad.peer is None:
+                continue
+            spec = out_specs.get(name)
+            if spec is None or spec == spad.spec:
+                continue
+            spad.spec = spec
+            spad.sig = None
+            spad.peer.node._dispatch(spad.peer, Event.caps(spec))
+
+    def reconfigure(self, in_specs: Dict[str, TensorsSpec]) -> Dict[str, TensorsSpec]:
+        """Mid-stream re-negotiation hook; defaults to the same commit phase
+        as startup.  Stateful nodes (windowing aggregators) may override to
+        flush or reject."""
+        return self.configure(in_specs)
 
     def _on_eos(self) -> None:
         """All sink pads reached EOS: drain and forward."""

@@ -145,6 +145,7 @@ class Pipeline:
         for node in self.nodes.values():
             for pad in list(node.sink_pads.values()) + list(node.src_pads.values()):
                 pad.eos = False
+                pad.sig = None
         started = []
         try:
             for node in self.nodes.values():

@@ -5,6 +5,7 @@ build a complete fake IIO tree under ``$TMPDIR`` (device dirs, channel raw
 value files, scale/offset) and point the element at it via ``base_dir``."""
 
 import os
+import time
 
 import numpy as np
 import pytest
@@ -144,6 +145,363 @@ class TestSamples:
 
         collect(_Probe(device_number=1, num_buffers=3, base_dir=str(fake_tree)))
         assert seen == [7.0, 20.0, 30.0]
+
+
+def make_buffered_device(base, num, name, scan_channels, triggers=(),
+                         freqs=""):
+    """scan_channels: {chan: (enabled, index, type_str, scale, offset)}.
+    Builds the scan_elements/trigger/buffer tree the reference's fake-sysfs
+    tests build (unittest_src_iio.cpp build_dev_dir_*)."""
+    dev = base / f"iio:device{num}"
+    scan = dev / "scan_elements"
+    scan.mkdir(parents=True)
+    (dev / "name").write_text(name + "\n")
+    (dev / "buffer").mkdir()
+    (dev / "buffer" / "length").write_text("0\n")
+    (dev / "buffer" / "enable").write_text("0\n")
+    (dev / "trigger").mkdir()
+    (dev / "trigger" / "current_trigger").write_text("\n")
+    (dev / "sampling_frequency").write_text("0\n")
+    if freqs:
+        (dev / "sampling_frequency_available").write_text(freqs + "\n")
+    for chan, (en, idx, type_str, scale, offset) in scan_channels.items():
+        (scan / f"in_{chan}_en").write_text(f"{int(en)}\n")
+        (scan / f"in_{chan}_index").write_text(f"{idx}\n")
+        (scan / f"in_{chan}_type").write_text(type_str + "\n")
+        if scale is not None:
+            (dev / f"in_{chan}_scale").write_text(f"{scale}\n")
+        if offset is not None:
+            (dev / f"in_{chan}_offset").write_text(f"{offset}\n")
+    for i, tname in enumerate(triggers):
+        trig = base / f"trigger{i}"
+        trig.mkdir(parents=True, exist_ok=True)
+        (trig / "name").write_text(tname + "\n")
+    return dev
+
+
+class TestTypeStringParsing:
+    """Reference format [be|le]:[s|u]bits/storagebits>>shift
+    (tensor_src_iio.c:717-790)."""
+
+    def test_basic_le_signed(self):
+        from nnstreamer_tpu.elements.iio_src import parse_type_string
+
+        ch = parse_type_string("x", "le:s12/16>>4")
+        assert (ch.big_endian, ch.is_signed) == (False, True)
+        assert (ch.used_bits, ch.storage_bits, ch.shift) == (12, 16, 4)
+        assert ch.storage_bytes == 2
+
+    def test_no_shift_suffix(self):
+        from nnstreamer_tpu.elements.iio_src import parse_type_string
+
+        ch = parse_type_string("x", "be:u32/32")
+        assert ch.shift == 0 and ch.big_endian and not ch.is_signed
+
+    @pytest.mark.parametrize(
+        "bad",
+        ["xe:s12/16>>4", "le:q12/16>>4", "le:s0/16", "le:s20/16",
+         "le:s12/16>>16", "garbage", ""],
+    )
+    def test_malformed_rejected(self, bad):
+        from nnstreamer_tpu.elements.iio_src import parse_type_string
+
+        assert parse_type_string("x", bad) is None
+
+    def test_decode_sign_extend_and_shift(self):
+        from nnstreamer_tpu.elements.iio_src import parse_type_string
+
+        ch = parse_type_string("x", "le:s12/16>>4")
+        ch.scale, ch.offset, ch.location = 2.0, 1.0, 0
+        # stored LE 0x8050 -> >>4 = 0x805 -> 12-bit signed = -2043
+        raw = (0x8050).to_bytes(2, "little")
+        assert ch.decode(raw) == (-2043 + 1.0) * 2.0
+
+    def test_decode_big_endian_unsigned(self):
+        from nnstreamer_tpu.elements.iio_src import parse_type_string
+
+        ch = parse_type_string("x", "be:u8/16>>0")
+        ch.location = 0
+        raw = (0x0042).to_bytes(2, "big")
+        assert ch.decode(raw) == 0x42
+
+    def test_location_alignment(self):
+        from nnstreamer_tpu.elements.iio_src import (
+            assign_locations, parse_type_string,
+        )
+
+        a = parse_type_string("a", "le:s16/16")
+        b = parse_type_string("b", "le:s32/32")
+        a.index, b.index = 0, 1
+        # 2-byte channel then 4-byte channel: kernel pads to 4 (ref :1458)
+        size = assign_locations([a, b])
+        assert (a.location, b.location, size) == (0, 4, 8)
+
+
+@pytest.fixture()
+def buffered_tree(tmp_path):
+    base = tmp_path / "iio_devices"
+    make_buffered_device(
+        base, 0, "buf_accel",
+        {
+            "accel_x": (0, 0, "le:s12/16>>4", 0.5, None),
+            "accel_y": (0, 1, "le:s12/16>>4", 0.5, 8.0),
+            "timestamp": (0, 2, "le:s64/64", None, None),
+        },
+        triggers=("sysfstrig0", "hrtimer1"),
+        freqs="10 100 1000",
+    )
+    return base
+
+
+def _pack_scan_frame(x_raw, y_raw, ts):
+    """Independent golden packing: two s12/16>>4 then s64/64 at offset 8."""
+    import struct
+
+    buf = struct.pack("<hh", x_raw << 4, y_raw << 4)
+    buf += b"\x00" * 4  # alignment padding to 8 for the s64
+    buf += struct.pack("<q", ts)
+    return buf
+
+
+class TestContinuousMode:
+    def test_buffered_capture_end_to_end(self, buffered_tree, tmp_path):
+        devs = tmp_path / "devnodes"
+        devs.mkdir()
+        frames_bin = _pack_scan_frame(100, -200, 7) + _pack_scan_frame(
+            -300, 50, 8
+        )
+        (devs / "iio:device0").write_bytes(frames_bin)
+        src = TensorSrcIIO(
+            mode="continuous", device="buf_accel", channels="auto",
+            buffer_capacity=4, frequency=100.0, num_buffers=2,
+            base_dir=str(buffered_tree), dev_dir=str(devs),
+        )
+        frames = collect(src)
+        assert len(frames) == 2
+        s0 = np.asarray(frames[0].tensors[0])
+        # golden: (raw + offset) * scale; timestamp scale 1 offset 0
+        np.testing.assert_allclose(s0, [100 * 0.5, (-200 + 8) * 0.5, 7.0])
+        s1 = np.asarray(frames[1].tensors[0])
+        np.testing.assert_allclose(s1, [-300 * 0.5, (50 + 8) * 0.5, 8.0])
+
+    def test_auto_mode_enables_channels_and_buffer(self, buffered_tree, tmp_path):
+        devs = tmp_path / "devnodes"
+        devs.mkdir()
+        (devs / "iio:device0").write_bytes(_pack_scan_frame(1, 1, 1))
+        src = TensorSrcIIO(
+            mode="continuous", device="buf_accel", buffer_capacity=16,
+            num_buffers=1, base_dir=str(buffered_tree), dev_dir=str(devs),
+        )
+        collect(src)
+        dev = buffered_tree / "iio:device0"
+        scan = dev / "scan_elements"
+        assert (scan / "in_accel_x_en").read_text().strip() == "1"
+        assert (scan / "in_timestamp_en").read_text().strip() == "1"
+        assert (dev / "buffer" / "length").read_text().strip() == "16"
+        # enable toggled 1 during run, 0 on stop
+        assert (dev / "buffer" / "enable").read_text().strip() == "0"
+
+    def test_custom_mode_uses_only_enabled(self, buffered_tree, tmp_path):
+        dev = buffered_tree / "iio:device0"
+        (dev / "scan_elements" / "in_accel_x_en").write_text("1\n")
+        devs = tmp_path / "devnodes"
+        devs.mkdir()
+        import struct
+
+        (devs / "iio:device0").write_bytes(struct.pack("<h", 25 << 4))
+        src = TensorSrcIIO(
+            mode="continuous", device="buf_accel", channels="custom",
+            num_buffers=1, base_dir=str(buffered_tree), dev_dir=str(devs),
+        )
+        frames = collect(src)
+        sample = np.asarray(frames[0].tensors[0])
+        np.testing.assert_allclose(sample, [12.5])  # only accel_x, 25*.5
+
+    def test_trigger_selected_by_name(self, buffered_tree, tmp_path):
+        devs = tmp_path / "devnodes"
+        devs.mkdir()
+        (devs / "iio:device0").write_bytes(_pack_scan_frame(0, 0, 0))
+        src = TensorSrcIIO(
+            mode="continuous", device="buf_accel", trigger="hrtimer1",
+            num_buffers=1, base_dir=str(buffered_tree), dev_dir=str(devs),
+        )
+        collect(src)
+        cur = buffered_tree / "iio:device0" / "trigger" / "current_trigger"
+        assert cur.read_text().strip() == "hrtimer1"
+
+    def test_trigger_selected_by_number(self, buffered_tree, tmp_path):
+        devs = tmp_path / "devnodes"
+        devs.mkdir()
+        (devs / "iio:device0").write_bytes(_pack_scan_frame(0, 0, 0))
+        src = TensorSrcIIO(
+            mode="continuous", device="buf_accel", trigger_number=0,
+            num_buffers=1, base_dir=str(buffered_tree), dev_dir=str(devs),
+        )
+        collect(src)
+        cur = buffered_tree / "iio:device0" / "trigger" / "current_trigger"
+        assert cur.read_text().strip() == "sysfstrig0"
+
+    def test_unknown_trigger_fails(self, buffered_tree, tmp_path):
+        src = TensorSrcIIO(
+            mode="continuous", device="buf_accel", trigger="nope",
+            base_dir=str(buffered_tree), dev_dir=str(tmp_path),
+        )
+        with pytest.raises(FileNotFoundError):
+            src.start()
+
+    def test_frequency_validated_against_available(self, buffered_tree, tmp_path):
+        src = TensorSrcIIO(
+            mode="continuous", device="buf_accel", frequency=7.0,
+            base_dir=str(buffered_tree), dev_dir=str(tmp_path),
+        )
+        with pytest.raises(ValueError):
+            src.start()
+
+    def test_frequency_written_to_device(self, buffered_tree, tmp_path):
+        devs = tmp_path / "devnodes"
+        devs.mkdir()
+        (devs / "iio:device0").write_bytes(_pack_scan_frame(0, 0, 0))
+        src = TensorSrcIIO(
+            mode="continuous", device="buf_accel", frequency=100.0,
+            num_buffers=1, base_dir=str(buffered_tree), dev_dir=str(devs),
+        )
+        collect(src)
+        freq = buffered_tree / "iio:device0" / "sampling_frequency"
+        assert freq.read_text().strip() == "100"
+
+    def test_merge_channels_false_splits_tensors(self, buffered_tree, tmp_path):
+        devs = tmp_path / "devnodes"
+        devs.mkdir()
+        (devs / "iio:device0").write_bytes(_pack_scan_frame(10, 20, 3))
+        src = TensorSrcIIO(
+            mode="continuous", device="buf_accel", merge_channels=False,
+            num_buffers=1, base_dir=str(buffered_tree), dev_dir=str(devs),
+        )
+        frames = collect(src)
+        f = frames[0]
+        assert f.num_tensors == 3
+        np.testing.assert_allclose(np.asarray(f.tensors[0]), [5.0])
+        np.testing.assert_allclose(np.asarray(f.tensors[1]), [14.0])
+
+    def test_fifo_streaming_with_writer_thread(self, buffered_tree, tmp_path):
+        """The reference's mkfifo strategy (unittest_src_iio.cpp:348): a
+        writer thread feeds the char-device FIFO while the element reads."""
+        import threading
+
+        devs = tmp_path / "devnodes"
+        devs.mkdir()
+        fifo = devs / "iio:device0"
+        os.mkfifo(fifo)
+
+        def writer():
+            with open(fifo, "wb") as f:
+                for i in range(3):
+                    f.write(_pack_scan_frame(i * 10, i, i))
+                    f.flush()
+
+        t = threading.Thread(target=writer, daemon=True)
+        t.start()
+        src = TensorSrcIIO(
+            mode="continuous", device="buf_accel", num_buffers=3,
+            poll_timeout=5000, base_dir=str(buffered_tree),
+            dev_dir=str(devs),
+        )
+        frames = collect(src)
+        t.join(timeout=5)
+        assert len(frames) == 3
+        np.testing.assert_allclose(
+            np.asarray(frames[2].tensors[0]), [20 * 0.5, (2 + 8) * 0.5, 2.0]
+        )
+
+    def test_poll_timeout_ends_stream(self, buffered_tree, tmp_path):
+        devs = tmp_path / "devnodes"
+        devs.mkdir()
+        fifo = devs / "iio:device0"
+        os.mkfifo(fifo)
+        # hold the write end open but never write: reader must give up
+        # after poll_timeout instead of blocking forever
+        keep = os.open(fifo, os.O_RDWR)
+        try:
+            src = TensorSrcIIO(
+                mode="continuous", device="buf_accel", num_buffers=2,
+                poll_timeout=200, base_dir=str(buffered_tree),
+                dev_dir=str(devs),
+            )
+            t0 = time.monotonic()
+            frames = collect(src)
+            assert len(frames) == 0
+            assert time.monotonic() - t0 < 10
+        finally:
+            os.close(keep)
+
+    def test_auto_mode_disables_malformed_channel(self, buffered_tree, tmp_path):
+        """A channel whose type string can't be parsed must be DISABLED in
+        the kernel (else its bytes desynchronize every scan frame)."""
+        dev = buffered_tree / "iio:device0"
+        scan = dev / "scan_elements"
+        (scan / "in_broken_en").write_text("0\n")
+        (scan / "in_broken_index").write_text("9\n")
+        (scan / "in_broken_type").write_text("garbage\n")
+        devs = tmp_path / "devnodes"
+        devs.mkdir()
+        (devs / "iio:device0").write_bytes(_pack_scan_frame(4, 2, 1))
+        src = TensorSrcIIO(
+            mode="continuous", device="buf_accel", num_buffers=1,
+            base_dir=str(buffered_tree), dev_dir=str(devs),
+        )
+        frames = collect(src)
+        assert (scan / "in_broken_en").read_text().strip() == "0"
+        # remaining channels decode at the right offsets
+        np.testing.assert_allclose(
+            np.asarray(frames[0].tensors[0]), [2.0, 5.0, 1.0]
+        )
+
+    def test_custom_mode_malformed_enabled_channel_fails(self, buffered_tree, tmp_path):
+        dev = buffered_tree / "iio:device0"
+        scan = dev / "scan_elements"
+        (scan / "in_broken_en").write_text("1\n")
+        (scan / "in_broken_index").write_text("9\n")
+        (scan / "in_broken_type").write_text("garbage\n")
+        src = TensorSrcIIO(
+            mode="continuous", device="buf_accel", channels="custom",
+            base_dir=str(buffered_tree), dev_dir=str(tmp_path),
+        )
+        with pytest.raises(ValueError):
+            src.start()
+
+    def test_buffer_disabled_when_open_fails(self, buffered_tree, tmp_path):
+        """start() enabling the ring buffer then failing to open the char
+        device must still disable the buffer on stop (EBUSY prevention)."""
+        src = TensorSrcIIO(
+            mode="continuous", device="buf_accel", num_buffers=1,
+            base_dir=str(buffered_tree), dev_dir=str(tmp_path / "missing"),
+        )
+        with pytest.raises(OSError):
+            src.start()
+        src.stop()
+        enable = buffered_tree / "iio:device0" / "buffer" / "enable"
+        assert enable.read_text().strip() == "0"
+
+    def test_poll_mode_frequency_is_local_only(self, tmp_path):
+        """Poll-mode frequency is a local poll rate: no sysfs validation or
+        writes (regression: buffered-mode frequency logic leaked into poll)."""
+        base = tmp_path / "iio_devices"
+        dev = make_device(base, 0, "dev0", {"x": (5, None, None)})
+        (dev / "sampling_frequency_available").write_text("10 100\n")
+        (dev / "sampling_frequency").write_text("0\n")
+        src = TensorSrcIIO(
+            device="dev0", frequency=30.0, num_buffers=2, base_dir=str(base)
+        )
+        frames = collect(src)  # 30 not in the available set: must NOT raise
+        assert len(frames) == 2
+        assert (dev / "sampling_frequency").read_text().strip() == "0"
+
+    def test_one_shot_mode_single_poll_sample(self, fake_tree):
+        src = TensorSrcIIO(
+            mode="one-shot", device="fake_accel", base_dir=str(fake_tree)
+        )
+        frames = collect(src)
+        assert len(frames) == 1
 
 
 class TestPipelineIntegration:
