@@ -1,0 +1,84 @@
+"""Pose-estimation demo: north-star config #3, the reference's
+`tests/nnstreamer_decoder_pose` topology, TPU-native.
+
+videotestsrc → tensor_converter → tensor_transform (normalize, fused) →
+tensor_filter (jax PoseNet, 14-keypoint heatmaps) → tensor_decoder
+(pose_estimation: skeleton + keypoint-name labels) → tensor_sink.
+
+Golden check, SSAT-style: the same frame runs through SingleShot for the
+raw heatmaps; an independent numpy argmax per keypoint channel recomputes
+the expected (x, y, prob) triples, which must match the decoder's
+``meta["pose"]``.
+"""
+
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+import nnstreamer_tpu as nns
+from nnstreamer_tpu.api.single import SingleShot
+from nnstreamer_tpu.elements.filter import TensorFilter
+from nnstreamer_tpu.elements.sink import TensorSink
+from nnstreamer_tpu.models import posenet
+
+SIZE = 224
+NORMALIZE = "typecast:float32,add:-127.5,div:127.5"
+JOINTS = [
+    "top", "neck", "r_shoulder", "r_elbow", "r_wrist", "l_shoulder",
+    "l_elbow", "l_wrist", "r_hip", "r_knee", "r_ankle", "l_hip",
+    "l_knee", "l_ankle",
+]
+
+
+def main():
+    model = posenet.build(image_size=SIZE)
+    grid = posenet.grid_size(SIZE)
+    with tempfile.NamedTemporaryFile("w", suffix=".txt", delete=False) as f:
+        f.write("\n".join(JOINTS))
+        joints_path = f.name
+
+    p = nns.Pipeline(name="pose_estimation")
+    src = p.add(nns.make("videotestsrc", num_buffers=2, width=SIZE, height=SIZE))
+    conv = p.add(nns.make("tensor_converter"))
+    norm = p.add(nns.make("tensor_transform", mode="arithmetic", option=NORMALIZE))
+    filt = p.add(TensorFilter(framework="jax", model=model))
+    dec = p.add(nns.make(
+        "tensor_decoder", mode="pose_estimation",
+        option1=f"{SIZE}:{SIZE}", option2=f"{grid}:{grid}",
+        option3=joints_path,
+    ))
+    sink = p.add(TensorSink(collect=True))
+    p.link_chain(src, conv, norm, filt, dec, sink)
+    p.run(timeout=240)
+
+    for i, frame in enumerate(sink.frames):
+        pose = frame.meta["pose"]
+        overlay = np.asarray(frame.tensor(0))
+        print(f"frame {i}: {len(pose)} keypoints, overlay {overlay.shape}, "
+              f"painted px {int((overlay[..., 3] > 0).sum())}")
+
+    # -- golden: independent numpy keypoint extraction ----------------------
+    frame0 = nns.make("videotestsrc", width=SIZE, height=SIZE)._make_frame(0)
+    x = (frame0.astype(np.float32) - 127.5) / 127.5
+    with SingleShot(framework="jax", model=model) as s:
+        (heatmaps,) = (np.asarray(t) for t in s.invoke(x))
+    golden = []
+    for k in range(posenet.POSE_KEYPOINTS):
+        hm = heatmaps[..., k]
+        yy, xx = np.unravel_index(np.argmax(hm), hm.shape)
+        golden.append((int(xx), int(yy), float(hm[yy, xx])))
+    got = [(x_, y_, p_) for x_, y_, p_ in sink.frames[0].meta["pose"]]
+    assert len(got) == posenet.POSE_KEYPOINTS
+    for (gx, gy, gp), (wx, wy, wp) in zip(got, golden):
+        assert (gx, gy) == (wx, wy), f"keypoint mismatch: {(gx, gy)} != {(wx, wy)}"
+        assert abs(gp - wp) < 1e-5
+    print(f"golden=OK ({len(golden)} keypoints matched)")
+    os.unlink(joints_path)
+
+
+if __name__ == "__main__":
+    main()

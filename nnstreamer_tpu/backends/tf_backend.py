@@ -81,6 +81,18 @@ class TFLiteBackend(FilterBackend):
     def input_spec(self) -> Optional[TensorsSpec]:
         return self._in_spec
 
+    def model_spec(self) -> Optional[TensorsSpec]:
+        # dtype/arity are the model's real constraints; shapes are
+        # resizable (resize_tensor_input), so the template leaves them open
+        if self._in_spec is None:
+            return None
+        return TensorsSpec(
+            tensors=tuple(
+                TensorSpec(dtype=t.dtype, shape=None)
+                for t in self._in_spec.tensors
+            )
+        )
+
     def output_spec(self) -> Optional[TensorsSpec]:
         return self._out_spec
 
@@ -160,6 +172,11 @@ class TFBackend(FilterBackend):
     def input_spec(self) -> Optional[TensorsSpec]:
         return self._in_spec
 
+    def model_spec(self) -> Optional[TensorsSpec]:
+        # tf.functions/keras models retrace per shape: polymorphic, so the
+        # last fixated spec must not veto a mid-stream renegotiation
+        return None
+
     def output_spec(self) -> Optional[TensorsSpec]:
         return self._out_spec
 
@@ -191,5 +208,8 @@ class TFBackend(FilterBackend):
         return tuple(outs)
 
     def invoke(self, tensors: Tuple) -> Tuple:
-        outs = self._normalize(self.fn(*[np.asarray(t) for t in tensors]))
+        from .interop import to_tf
+
+        # dlpack bridge for device-resident jax inputs (interop.py)
+        outs = self._normalize(self.fn(*[to_tf(t) for t in tensors]))
         return tuple(np.asarray(o) for o in outs)

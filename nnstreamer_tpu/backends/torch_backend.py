@@ -48,6 +48,12 @@ class TorchBackend(FilterBackend):
     def input_spec(self) -> Optional[TensorsSpec]:
         return self._in_spec
 
+    def model_spec(self) -> Optional[TensorsSpec]:
+        # an nn.Module is shape-polymorphic: no declared constraint, so a
+        # mid-stream renegotiation must not be judged against the previous
+        # fixated shape (which is all _in_spec holds)
+        return None
+
     def output_spec(self) -> Optional[TensorsSpec]:
         return self._out_spec
 
@@ -79,8 +85,12 @@ class TorchBackend(FilterBackend):
     def invoke(self, tensors: Tuple) -> Tuple:
         import torch
 
+        from .interop import to_torch
+
         with torch.no_grad():
-            ins = [torch.from_numpy(np.ascontiguousarray(np.asarray(t))) for t in tensors]
+            # dlpack bridge: device-resident jax outputs from an upstream
+            # filter enter torch zero-copy on CPU (interop.py)
+            ins = [to_torch(t) for t in tensors]
             outs = self.module(*ins)
         if not isinstance(outs, (tuple, list)):
             outs = (outs,)

@@ -50,6 +50,10 @@ class CollectNode(Node):
             if len(parts) > 1:
                 self._base_tolerance = int(parts[1])
         self._queues: Dict[str, collections.deque] = {}
+        # per-pad most-recent contributed/popped frame (the reference's
+        # pad->buffer, tensor_common.c:1270+): basepad re-contributes it
+        # when a pad's head is outside tolerance, keeping pad-count stable
+        self._last: Dict[str, Frame] = {}
         self._finished = False
 
     # -- collection ---------------------------------------------------------
@@ -130,6 +134,13 @@ class CollectNode(Node):
                 base_ts = self._sync_point(active)
                 if base_ts == NONE_TS:
                     chosen = [(name, q.popleft()) for name, q in active]
+                elif self.sync_mode == "basepad":
+                    result = self._collect_basepad(active, base_ts)
+                    if result is None:
+                        return  # need newer data on some pad
+                    if result == "retry":
+                        continue  # stale head dropped: re-evaluate
+                    chosen = result
                 else:
                     chosen = []
                     need_buffer = False
@@ -152,19 +163,64 @@ class CollectNode(Node):
                         return
                     for name, _ in chosen:
                         self._queues[name].popleft()
-                    if self._base_tolerance != NONE_TS:
-                        chosen = [
-                            (n, f)
-                            for (n, f) in chosen
-                            if not is_valid_ts(f.pts)
-                            or abs(f.pts - base_ts) <= self._base_tolerance
-                        ]
             if not chosen:
                 return
             frames = dict(chosen)
             out = self.combine(frames)
             if out is not None:
                 self._emit(out)
+
+    def _collect_basepad(self, active, base_ts: int):
+        """One basepad collection round (tensor_common.c:1281-1390 semantics):
+
+        - a head strictly BEFORE the sync point is stale — pop it into the
+          pad's ``last`` slot and retry/wait (the reference's need_buffer);
+        - a head outside the tolerance window contributes the pad's LAST
+          frame instead (head stays queued) — the pad still participates, so
+          a combine round never has fewer pads than linked;
+        - tolerance = min(option duration, the base pad's own inter-frame
+          gap - 1) like the reference's dynamic ``base``.
+
+        Returns the chosen list, "retry" (state changed, re-evaluate), or
+        None (wait for newer data).
+        """
+        order = self._pad_order()
+        base_name = (
+            order[self._base_pad_idx] if self._base_pad_idx < len(order) else None
+        )
+        tol: Optional[int] = (
+            self._base_tolerance if self._base_tolerance != NONE_TS else None
+        )
+        last_base = self._last.get(base_name) if base_name else None
+        if last_base is not None:
+            bq = self._queues.get(base_name)
+            if bq and is_valid_ts(bq[0].pts) and is_valid_ts(last_base.pts):
+                gap = abs(bq[0].pts - last_base.pts) - 1
+                tol = gap if tol is None else min(tol, gap)
+        chosen = []
+        for name, q in active:
+            pad = self.sink_pads[name]
+            head = q[0]
+            if (
+                name != base_name
+                and is_valid_ts(head.pts)
+                and head.pts < base_ts
+            ):
+                self._last[name] = q.popleft()
+                if q or pad.eos:
+                    return "retry"  # newer head available / stream ending
+                return None  # laggard: wait for newer data
+            outside = (
+                tol is not None
+                and is_valid_ts(head.pts)
+                and abs(head.pts - base_ts) > tol
+            )
+            if outside and name in self._last:
+                chosen.append((name, self._last[name]))  # head stays queued
+            else:
+                self._last[name] = q.popleft()
+                chosen.append((name, self._last[name]))
+        return chosen
 
     @staticmethod
     def _closer(candidate_ts: int, current_ts: int, base_ts: int) -> bool:
@@ -189,6 +245,10 @@ class CollectNode(Node):
                 self._try_collect()
             if all(p.eos for p in self._linked_sinks()):
                 self._finish_stream()
+        elif event.kind == "caps":
+            # re-run the commit phase with ALL pad specs so downstream sees
+            # the new COMBINED spec — never the single pad's spec verbatim
+            self._handle_caps(pad, event.payload)
         else:
             self.on_event(pad, event)
 
@@ -196,6 +256,7 @@ class CollectNode(Node):
         super().start()
         self._finished = False
         self._queues.clear()
+        self._last.clear()
 
     # -- to be provided by subclasses ---------------------------------------
 

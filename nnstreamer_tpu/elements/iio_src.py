@@ -200,6 +200,7 @@ class TensorSrcIIO(SourceNode):
         self._dev_dir: Optional[str] = None
         self._dev_num = -1
         self._data_fd: Optional[int] = None
+        self._data_is_fifo = False
         self._buffer_enabled = False
 
     # -- device discovery ---------------------------------------------------
@@ -347,6 +348,9 @@ class TensorSrcIIO(SourceNode):
                 self._buffer_enabled = True
             data_path = os.path.join(self.dev_dir, f"iio:device{self._dev_num}")
             self._data_fd = os.open(data_path, os.O_RDONLY | os.O_NONBLOCK)
+            import stat as _stat
+
+            self._data_is_fifo = _stat.S_ISFIFO(os.fstat(self._data_fd).st_mode)
         else:
             self._channels = self._scan_poll_channels(self._dev_dir)
 
@@ -389,7 +393,7 @@ class TensorSrcIIO(SourceNode):
             )
         return TensorsSpec(tensors=tensors, rate=rate)
 
-    def _emit(self, values: np.ndarray, idx: int, dur: int) -> Frame:
+    def _emit_frame(self, values: np.ndarray, idx: int, dur: int) -> Frame:
         pts = idx * dur if dur else 0
         if self.merge_channels:
             return Frame.of(values, pts=pts, duration=dur)
@@ -412,9 +416,13 @@ class TensorSrcIIO(SourceNode):
                 continue
             chunk = os.read(self._data_fd, self._frame_size - len(buf))
             if not chunk:
-                # EOF: a regular test file is exhausted (FIFO writers keep
-                # it open); treat as end of stream
-                return None
+                if self._data_is_fifo:
+                    # a FIFO reads 0 both at real EOF and BEFORE any writer
+                    # has opened it (O_NONBLOCK open) — keep waiting until
+                    # data arrives or poll_timeout expires
+                    time.sleep(0.005)
+                    continue
+                return None  # regular file exhausted: end of stream
             buf += chunk
         return buf
 
@@ -432,7 +440,7 @@ class TensorSrcIIO(SourceNode):
                 values = np.array(
                     [c.decode(raw) for c in self._scan], dtype=np.float32
                 )
-                yield self._emit(values, idx, dur)
+                yield self._emit_frame(values, idx, dur)
                 idx += 1
             return
         while self.num_buffers < 0 or idx < self.num_buffers:
@@ -442,7 +450,7 @@ class TensorSrcIIO(SourceNode):
             values = np.array(
                 [c.read() for c in self._channels], dtype=np.float32
             )
-            yield self._emit(values, idx, dur)
+            yield self._emit_frame(values, idx, dur)
             idx += 1
             if period:
                 left = period - (time.monotonic() - t0)

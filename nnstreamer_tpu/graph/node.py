@@ -88,9 +88,9 @@ class Pad:
             raise ValueError("push() is only valid on src pads")
         if self.peer is None:
             return  # unlinked src pad: drop (like an unlinked tee branch)
-        if isinstance(item, Frame):
+        if isinstance(item, Frame) and self.sig is not _UNCHECKED:
             sig = _frame_sig(item.tensors)
-            if sig != self.sig and self.sig is not _UNCHECKED:
+            if sig != self.sig:
                 self._spec_changed(sig, item)
         self.peer.node._dispatch(self.peer, item)
 

@@ -238,8 +238,21 @@ class Pipeline:
             interrupt = getattr(node, "interrupt", None)
             if interrupt is not None:
                 interrupt()
+        leaked = []
         for t in self.threads:
             t.join(timeout=5.0)
+            if t.is_alive():
+                leaked.append(t.name)
+        if leaked:
+            import warnings
+
+            warnings.warn(
+                f"pipeline {self.name!r}: {len(leaked)} worker thread(s) did "
+                f"not exit within 5s and were abandoned (wedged backend "
+                f"invoke?): {', '.join(leaked)}",
+                RuntimeWarning,
+                stacklevel=2,
+            )
         self.threads.clear()
         for node in self.nodes.values():
             node.stop()

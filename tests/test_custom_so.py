@@ -84,6 +84,42 @@ extern "C" int nns_invoke(const void *const *in, const uint64_t *in_sz,
 """
 
 
+CPP_CLASS_SRC = r"""
+#include <cstring>
+#include "nns_filter.hh"
+
+/* C++ class-registration API (tensor_filter_cpp.h analog): subclass
+ * nns::Filter, NNS_REGISTER_FILTER, done — no free-function exports. */
+class OffsetScale : public nns::Filter {
+ public:
+  int init(const char *custom) override {
+    if (custom && custom[0]) offset_ = atof(custom);
+    return 0;
+  }
+  int get_input_spec(nns_tensors_spec *spec) override {
+    set_tensor(spec, 0, NNS_FLOAT32, {2, 5});
+    return 0;
+  }
+  int get_output_spec(nns_tensors_spec *spec) override {
+    return get_input_spec(spec);
+  }
+  int invoke(const void *const *in, const uint64_t *in_sz,
+             void *const *out, const uint64_t *out_sz) override {
+    if (in_sz[0] != out_sz[0]) return -1;
+    const float *src = (const float *)in[0];
+    float *dst = (float *)out[0];
+    for (uint64_t i = 0; i < in_sz[0] / sizeof(float); ++i)
+      dst[i] = src[i] * 3.0f + offset_;
+    return 0;
+  }
+
+ private:
+  float offset_ = 0.0f;
+};
+NNS_REGISTER_FILTER(OffsetScale)
+"""
+
+
 def build_so(tmp_path, name, src):
     cpp = tmp_path / f"{name}.cc"
     cpp.write_text(f'#include <cstdlib>\n{src}')
@@ -123,6 +159,35 @@ class TestCustomSo:
         )
         with pytest.raises(ValueError, match="missing required export"):
             SingleShot(framework="custom-so", model=str(so))
+
+    def test_cpp_class_api(self, tmp_path, rng):
+        """Subclass-based C++ filters (nns_filter.hh, the
+        tensor_filter_cpp.h:45-64 analog) load through the same loader."""
+        so = build_so(tmp_path, "offsetscale", CPP_CLASS_SRC)
+        x = rng.standard_normal((2, 5)).astype(np.float32)
+        with SingleShot(framework="custom-so", model=so, custom="1.5") as s:
+            assert s.input_spec().tensors[0].shape == (2, 5)
+            (out,) = s.invoke(x)
+        np.testing.assert_allclose(out, x * 3.0 + 1.5, rtol=1e-6)
+
+    def test_cpp_class_api_in_pipeline(self, tmp_path):
+        from nnstreamer_tpu import Pipeline
+        from nnstreamer_tpu.elements.filter import TensorFilter
+        from nnstreamer_tpu.elements.sink import TensorSink
+        from nnstreamer_tpu.elements.testsrc import DataSrc
+
+        so = build_so(tmp_path, "offsetscale2", CPP_CLASS_SRC)
+        data = [np.ones((2, 5), np.float32)]
+        got = []
+        p = Pipeline()
+        src = p.add(DataSrc(data=data))
+        filt = p.add(TensorFilter(framework="custom-so", model=so))
+        sink = p.add(TensorSink(callback=lambda f: got.append(f)))
+        p.link_chain(src, filt, sink)
+        p.run(timeout=30)
+        np.testing.assert_allclose(
+            np.asarray(got[0].tensors[0]), np.full((2, 5), 3.0)
+        )
 
     def test_pipeline_with_frame_dropping(self, tmp_path):
         """rc>0 from invoke drops the frame (the reference's

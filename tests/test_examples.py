@@ -133,6 +133,8 @@ class TestCodegen:
         ("sensor_window.py", "window 2"),
         ("multi_stream_batched.py", "stream 7"),
         ("image_labeling.py", "frame 7"),
+        ("object_detection.py", "golden=OK"),
+        ("pose_estimation.py", "golden=OK"),
     ],
 )
 def test_pipeline_demo_runs(script, expect):

@@ -1,0 +1,131 @@
+"""dlpack zero-copy interop + device-residency audit (VERDICT missing #6).
+
+Survey §2.6 maps the reference's zero-copy ``gst_memory_map`` hand-off
+(``tensor_filter.c:350-399``) to ``jax.dlpack`` bridging; these tests prove
+(a) jax→torch conversion shares memory on CPU (pointer equality), and
+(b) adjacent jax filters hand frames off device-resident with NO host
+round-trip (the exact array object flows through).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from nnstreamer_tpu import Pipeline
+from nnstreamer_tpu.backends.interop import to_jax, to_tf, to_torch
+from nnstreamer_tpu.backends.jax_backend import JaxModel
+from nnstreamer_tpu.elements.filter import TensorFilter
+from nnstreamer_tpu.elements.sink import TensorSink
+from nnstreamer_tpu.elements.testsrc import DataSrc
+
+
+class TestDlpackBridges:
+    def test_jax_to_torch_zero_copy(self):
+        """On CPU the torch tensor must alias the jax buffer — pointer
+        equality, not just value equality."""
+        import torch  # noqa: F401
+
+        arr = jnp.arange(16, dtype=jnp.float32)
+        tt = to_torch(arr)
+        assert tt.data_ptr() == arr.unsafe_buffer_pointer()
+        np.testing.assert_array_equal(tt.numpy(), np.arange(16, dtype=np.float32))
+
+    def test_numpy_to_torch_zero_copy(self):
+        arr = np.arange(8, dtype=np.float32)
+        tt = to_torch(arr)
+        assert tt.data_ptr() == arr.ctypes.data
+        tt[0] = 99.0
+        assert arr[0] == 99.0  # shared memory
+
+    def test_torch_to_jax_round_trip(self):
+        import torch
+
+        t = torch.arange(6, dtype=torch.float32)
+        ja = to_jax(t)
+        np.testing.assert_array_equal(np.asarray(ja), np.arange(6, dtype=np.float32))
+
+    def test_jax_to_tf_values(self):
+        pytest.importorskip("tensorflow")
+        arr = jnp.arange(12, dtype=jnp.float32).reshape(3, 4)
+        tf_t = to_tf(arr)
+        np.testing.assert_array_equal(
+            np.asarray(tf_t), np.arange(12, dtype=np.float32).reshape(3, 4)
+        )
+
+
+class TestPipelineInterop:
+    def test_jax_filter_feeds_torch_filter(self):
+        """jax filter output (device-resident Array) flows into a torch
+        filter through the dlpack bridge — correct end-to-end values."""
+        import torch
+
+        class Scale(torch.nn.Module):
+            def forward(self, x):
+                return x * 3.0
+
+        got = []
+        p = Pipeline()
+        src = p.add(DataSrc(data=[np.full((4,), 2.0, np.float32)]))
+        jf = p.add(
+            TensorFilter(
+                framework="jax", model=JaxModel(apply=lambda prm, x: x + 1.0)
+            )
+        )
+        tf_ = p.add(TensorFilter(framework="torch", model=Scale().eval()))
+        sink = p.add(TensorSink(callback=lambda f: got.append(f)))
+        p.link_chain(src, jf, tf_, sink)
+        p.run(timeout=60)
+        np.testing.assert_allclose(np.asarray(got[0].tensors[0]), np.full(4, 9.0))
+
+
+class TestDeviceResidency:
+    def test_adjacent_jax_filters_no_host_roundtrip(self):
+        """The audit: the EXACT jax Array produced by filter 1 must be the
+        argument filter 2's executable receives — no np.asarray, no
+        device_get, no copy in between."""
+        handoff = {}
+
+        p = Pipeline()
+        src = p.add(DataSrc(data=[np.ones((8,), np.float32)]))
+        f1 = p.add(
+            TensorFilter(framework="jax", model=JaxModel(apply=lambda prm, x: x * 2.0))
+        )
+        f2 = p.add(
+            TensorFilter(framework="jax", model=JaxModel(apply=lambda prm, x: x + 1.0))
+        )
+        sink = p.add(TensorSink(collect=True))
+        p.link_chain(src, f1, f2, sink)
+
+        orig1, orig2 = f1.backend.invoke, f2.backend.invoke
+
+        def probe1(tensors):
+            outs = orig1(tensors)
+            handoff["produced"] = outs[0]
+            return outs
+
+        def probe2(tensors):
+            handoff["received"] = tensors[0]
+            return orig2(tensors)
+
+        f1.backend.invoke = probe1
+        f2.backend.invoke = probe2
+        p.run(timeout=60)
+
+        assert isinstance(handoff["produced"], jax.Array)
+        assert handoff["received"] is handoff["produced"], (
+            "frame payload was copied/materialized between adjacent jax filters"
+        )
+        out = sink.frames[0].tensors[0]
+        assert isinstance(out, jax.Array)  # stays device-resident to the sink
+        np.testing.assert_allclose(np.asarray(out), np.full(8, 3.0))
+
+    def test_device_resident_flag_is_set(self):
+        from nnstreamer_tpu.backends.jax_backend import JaxBackend
+        from nnstreamer_tpu.backends.tf_backend import TFLiteBackend
+        from nnstreamer_tpu.backends.torch_backend import TorchBackend
+
+        assert JaxBackend.device_resident is True
+        assert TorchBackend.device_resident is False
+        assert TFLiteBackend.device_resident is False

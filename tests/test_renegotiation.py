@@ -148,6 +148,52 @@ class TestThroughQueueAndFusion:
         np.testing.assert_allclose(np.asarray(got[1].tensors[0]), np.full(6, 6.0))
 
 
+class TestThroughCollect:
+    def test_mux_recombines_caps_downstream(self):
+        """A caps change on ONE mux pad must re-run the mux's commit phase
+        so downstream sees the new COMBINED spec, not the single pad's."""
+        from nnstreamer_tpu.elements.mux import TensorMux
+
+        a = [np.ones((2,), np.float32), np.ones((3,), np.float32)]
+        b = [np.ones((4,), np.float32), np.ones((4,), np.float32)]
+        got = []
+        p = Pipeline()
+        mux = p.add(TensorMux(sync_mode="nosync"))
+        src_a = p.add(DataSrc(name="a", data=a))
+        src_b = p.add(DataSrc(name="b", data=b))
+        p.link(src_a, f"{mux.name}.sink_0")
+        p.link(src_b, f"{mux.name}.sink_1")
+        sink = p.add(TensorSink(callback=lambda f: got.append(f)))
+        p.link(mux, sink)
+        p.run(timeout=60)
+        assert len(got) == 2
+        assert [tuple(t.shape) for t in got[1].tensors] == [(3,), (4,)]
+        # sink pad saw the combined 2-tensor renegotiated spec
+        spec = sink.sink_pads["sink"].spec
+        assert spec.num_tensors == 2
+        assert spec.tensors[0].shape == (3,)
+
+    def test_torch_backend_allows_midstream_change(self):
+        """Polymorphic torch modules must not be pinned to the previously
+        negotiated shape (model_spec() returns None)."""
+        import torch
+
+        class Twice(torch.nn.Module):
+            def forward(self, x):
+                return x * 2.0
+
+        frames_in = [np.ones((4,), np.float32), np.ones((6,), np.float32)]
+        got = []
+        p = Pipeline()
+        src = p.add(DataSrc(data=frames_in))
+        filt = p.add(TensorFilter(framework="torch", model=Twice().eval()))
+        sink = p.add(TensorSink(callback=lambda f: got.append(f)))
+        p.link_chain(src, filt, sink)
+        p.run(timeout=60)
+        assert [tuple(f.tensors[0].shape) for f in got] == [(4,), (6,)]
+        np.testing.assert_allclose(np.asarray(got[1].tensors[0]), np.full(6, 2.0))
+
+
 class TestNegativeRenegotiation:
     def test_incompatible_change_fails_loudly(self):
         """A model with a FIXED input spec rejects a mid-stream change."""
