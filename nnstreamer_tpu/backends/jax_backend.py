@@ -14,6 +14,12 @@ implementation like tflite (``tensor_filter_tensorflow_lite_core.cc``):
   **outputs stay device-resident** (``device_resident=True``, generalizing
   ``allocate_in_invoke``): adjacent XLA-backed nodes hand arrays off with
   zero host round-trips.
+- host inputs with rank ≥ 2 cross the wire **flat** (1-D bytes) and are
+  reshaped inside the compiled program: a ``(224,224,3)`` uint8 frame
+  device_put directly pays a ~40× tiled-layout inflation on TPU (the minor
+  dim pads to the 128-lane tile), measured ~5 ms/frame over a tunneled
+  chip vs ~0.2 ms for the same bytes sent flat.  The reshape runs on
+  device where it fuses into the consumer.
 
 Model resolution accepts:
 
@@ -121,12 +127,22 @@ class JaxBackend(FilterBackend):
         self._fn: Optional[Callable] = None
         self._wrapper: Optional[Callable] = None  # fn → fused fn (optimize.py)
         self._compiled = None
+        self._flat_compiled = None  # wire-shaped (flattened-input) twin
+        self._wire_shapes: Optional[Tuple[Tuple[int, ...], ...]] = None
+        # installed by TensorFilter when transform fusion is active: rebuilds
+        # the fused wrapper + recompiles for a drifted input spec
+        self._drift_hook: Optional[Callable] = None
+        # set by TensorFilter from graph topology: a device_resident
+        # upstream means frames arrive as jax Arrays → prewarm the shaped
+        # entry, not the flat host-wire twin
+        self.expect_device_input = False
         self._model_spec: Optional[TensorsSpec] = None
         self._in_spec: Optional[TensorsSpec] = None
         self._out_spec: Optional[TensorsSpec] = None
         self._single_output = False
         # Bounded executable cache for mid-stream renegotiation: spec key →
-        # (jitted, out_spec, single_output).  A renegotiated shape either
+        # (jitted, flat_jitted, wire_shapes, out_spec, single_output).  A
+        # renegotiated shape either
         # hits here (instant swap) or compiles exactly once — never a silent
         # retrace inside the hot loop; eviction keeps alternating-shape
         # streams from growing memory without bound.
@@ -173,6 +189,7 @@ class JaxBackend(FilterBackend):
         self.model = None
         self._fn = None
         self._compiled = None
+        self._flat_compiled = None
         self._cache.clear()
 
     # -- spec discovery -----------------------------------------------------
@@ -208,8 +225,17 @@ class JaxBackend(FilterBackend):
         wrapper).  Pass True whenever the fused transform *list* changed."""
         self._wrapper = wrapper
         self._compiled = None
+        self._flat_compiled = None
+        if wrapper is None:
+            self._drift_hook = None
         if invalidate:
             self._cache.clear()  # cached executables compiled the old fn
+
+    def set_drift_hook(self, hook: Optional[Callable]) -> None:
+        """Install the fused-chain rebinder (``TensorFilter`` passes a
+        closure that re-runs ``_install_fusion`` + ``reconfigure_fused``
+        for a drifted spec)."""
+        self._drift_hook = hook
 
     def trace_output_spec(self, in_spec: TensorsSpec) -> TensorsSpec:
         """Model-only output spec via tracing (no compile, no wrapper)."""
@@ -224,32 +250,79 @@ class JaxBackend(FilterBackend):
     def _spec_key(spec: TensorsSpec) -> tuple:
         return tuple((np.dtype(t.dtype).str, tuple(t.shape)) for t in spec.tensors)
 
+    def _wire_shape(self, shape: Tuple[int, ...]) -> Tuple[int, ...]:
+        """Host-wire shape for an input: rank ≥ 2 tensors flatten to 1-D so
+        the transfer skips tiled-layout padding; reshaped back on device."""
+        if len(shape) < 2:
+            return tuple(shape)
+        n = 1
+        for d in shape:
+            n *= d
+        return (n,)
+
+    def _make_flat_entry(self, in_spec: TensorsSpec):
+        """(fn over wire-shaped inputs, wire shapes), or (None, None) when
+        no input benefits (all rank < 2)."""
+        shapes = [tuple(t.shape) for t in in_spec.tensors]
+        wire = tuple(self._wire_shape(s) for s in shapes)
+        if all(w == s for w, s in zip(wire, shapes)):
+            return None, None
+        eff = self._effective_fn
+
+        def flat_fn(*xs):
+            return eff(*(x.reshape(s) for x, s in zip(xs, shapes)))
+
+        return flat_fn, wire
+
     def _compile(self, in_spec: TensorsSpec) -> TensorsSpec:
         self._in_spec = in_spec
         key = self._spec_key(in_spec)
         hit = self._cache.get(key)
         if hit is not None:
             self._cache.move_to_end(key)
-            self._compiled, self._out_spec, self._single_output = hit
+            (self._compiled, self._flat_compiled, self._wire_shapes,
+             self._out_spec, self._single_output) = hit
             return self._out_spec
         structs = _as_shape_structs(in_spec)
+        flat_fn, wire_shapes = self._make_flat_entry(in_spec)
+        if flat_fn is not None:
+            self._wire_shapes = wire_shapes
+            flat_structs = tuple(
+                jax.ShapeDtypeStruct(w, t.dtype)
+                for w, t in zip(self._wire_shapes, in_spec.tensors)
+            )
+            self._flat_compiled = self._jit(flat_fn, wire=True)
+            if not self.expect_device_input:
+                # Pre-warm the flat entry (frames arrive from host); the
+                # shaped twin compiles lazily if a device-resident frame
+                # ever shows up.
+                self._flat_compiled.lower(*flat_structs).compile()
+        else:
+            self._flat_compiled = None
+            self._wire_shapes = None
         jitted = self._jit(self._effective_fn)
-        # AOT-lower for early error surfacing + warm cache, but keep the
-        # *jitted* callable for the hot loop: jit's C++ dispatch fast path
-        # overlaps host→device transfers with compute, which the AOT
-        # executable's __call__ does not (measured ~2× on a tunneled chip).
-        jitted.lower(*structs).compile()
+        if flat_fn is None or self.expect_device_input:
+            # AOT-lower for early error surfacing + warm cache, but keep the
+            # *jitted* callable for the hot loop: jit's C++ dispatch fast
+            # path overlaps host→device transfers with compute, which the
+            # AOT executable's __call__ does not (measured ~2× on a
+            # tunneled chip).
+            jitted.lower(*structs).compile()
         self._compiled = jitted
         outs = jax.eval_shape(self._effective_fn, *structs)
         self._single_output = not isinstance(outs, (tuple, list))
         out_spec = _spec_from_outputs(outs if not self._single_output else (outs,))
         self._out_spec = out_spec
-        self._cache[key] = (jitted, out_spec, self._single_output)
+        self._cache[key] = (
+            jitted, self._flat_compiled, self._wire_shapes, out_spec,
+            self._single_output,
+        )
         while len(self._cache) > self._cache_size:
             self._cache.popitem(last=False)  # evict LRU executable
         return out_spec
 
-    def _jit(self, fn):
+    def _jit(self, fn, wire: bool = False):
+        del wire
         return jax.jit(fn)
 
     def reconfigure_fused(self, raw_spec: TensorsSpec) -> TensorsSpec:
@@ -279,7 +352,48 @@ class JaxBackend(FilterBackend):
     def invoke(self, tensors: Tuple) -> Tuple:
         if self._compiled is None:
             self.reconfigure(TensorsSpec.from_arrays(tensors))
-        out = self._compiled(*tensors)
+        elif self._in_spec is not None and (
+            len(tensors) != len(self._in_spec.tensors)
+            or any(
+                tuple(t.shape) != tuple(s.shape)
+                or np.dtype(t.dtype) != np.dtype(s.dtype)
+                for t, s in zip(tensors, self._in_spec.tensors)
+            )
+        ):
+            # A frame whose (shape, dtype) drifted without renegotiation (a
+            # polymorphic upstream pad skips per-frame sig checks): the old
+            # shaped path silently retraced under jit; the flat path would
+            # reshape same-element-count data into the stale geometry —
+            # recompile explicitly instead (LRU cache makes repeats cheap).
+            drifted = TensorsSpec.from_arrays(tensors)
+            if self._wrapper is not None:
+                # Fused program: the wrapper bakes per-spec geometry
+                # (transpose/dimchg stages close over the old shapes), so
+                # the OWNER must rebuild the fused chain for the new spec —
+                # reconfiguring here would reshape into stale geometry.
+                if self._drift_hook is None:
+                    raise ValueError(
+                        f"jax backend: input drifted to {drifted} but the "
+                        "fused program cannot rebind without its filter "
+                        "(no drift hook installed)"
+                    )
+                self._drift_hook(drifted)
+            else:
+                self.reconfigure(drifted)
+        if self._flat_compiled is not None and not any(
+            isinstance(t, jax.Array) for t in tensors
+        ):
+            # host frames cross the wire flat (1-D view — no copy for
+            # C-contiguous arrays) and reshape on device; device-resident
+            # frames take the shaped entry untouched
+            out = self._flat_compiled(
+                *(
+                    np.ascontiguousarray(t).reshape(w)
+                    for t, w in zip(tensors, self._wire_shapes)
+                )
+            )
+        else:
+            out = self._compiled(*tensors)
         if self._single_output:
             return (out,)
         return tuple(out)
@@ -301,14 +415,28 @@ class JaxShardedBackend(JaxBackend):
         super().open(model, custom)
         self._custom = parse_custom(custom)
 
-    def _jit(self, fn):
+    def _wire_shape(self, shape: Tuple[int, ...]) -> Tuple[int, ...]:
+        """Keep the (sharded) batch dim; flatten the rest, so the wire
+        layout is still cheap and the batch still shards over the mesh."""
+        if len(shape) < 3:
+            return tuple(shape)
+        n = 1
+        for d in shape[1:]:
+            n *= d
+        return (shape[0], n)
+
+    def _jit(self, fn, wire: bool = False):
         from ..parallel.mesh import batch_sharding, make_mesh
 
         n = int(self._custom.get("devices", len(jax.devices())))
         axis = self._custom.get("axis", "dp")
         self._mesh = make_mesh((n,), (axis,))
         in_spec = self._in_spec
+        ranks = [
+            len(self._wire_shape(tuple(t.shape))) if wire else len(t.shape)
+            for t in in_spec.tensors
+        ]
         in_shardings = tuple(
-            batch_sharding(self._mesh, len(t.shape), axis) for t in in_spec.tensors
+            batch_sharding(self._mesh, r, axis) for r in ranks
         )
         return jax.jit(fn, in_shardings=in_shardings)

@@ -52,9 +52,19 @@ class TensorBatch(Node):
 
     def process(self, pad: Pad, frame: Frame):
         del pad
-        import jax.numpy as jnp
+        import jax
 
-        return frame.with_tensors((jnp.stack(frame.tensors, axis=0),))
+        if any(isinstance(t, jax.Array) for t in frame.tensors):
+            import jax.numpy as jnp
+
+            # device-resident inputs: stack on device, stays resident
+            return frame.with_tensors((jnp.stack(frame.tensors, axis=0),))
+        # host inputs: one host memcpy — the downstream jax filter's flat
+        # wire path then moves the whole batch in a single cheap transfer
+        # (per-tensor jnp.stack here would pay N tiled-layout device_puts)
+        import numpy as np
+
+        return frame.with_tensors((np.stack(frame.tensors, axis=0),))
 
 
 @register_element("tensor_unbatch")

@@ -127,13 +127,48 @@ class TensorFilter(Node):
             return merged
         return self._prop_in or spec or TensorsSpec()
 
+    def _upstream_device_resident(self, max_hops: int = 4) -> bool:
+        """Walk the upstream chain a few hops: a device_resident filter
+        with only residency-*preserving* elements between means our frames
+        arrive as jax Arrays — the backend then prewarms its shaped entry
+        instead of the flat host-wire twin.  Only elements that pass tensor
+        payloads through untouched qualify (queue/tee/batch/unbatch/demux/
+        mux); anything else (converter, host transforms, decoders) emits
+        host numpy and stops the walk."""
+        from ..elements.batch import TensorBatch, TensorUnbatch
+        from ..elements.demux import TensorDemux
+        from ..elements.mux import TensorMux
+        from ..elements.queue import Queue
+        from ..elements.tee import Tee
+
+        passthrough = (Queue, Tee, TensorBatch, TensorUnbatch, TensorDemux,
+                       TensorMux)
+        pad = self.sink_pads["sink"].peer
+        for _ in range(max_hops):
+            if pad is None:
+                return False
+            node = pad.node
+            backend = getattr(node, "backend", None)
+            if backend is not None:
+                return bool(getattr(backend, "device_resident", False))
+            if not isinstance(node, passthrough) or len(node.sink_pads) != 1:
+                return False
+            pad = next(iter(node.sink_pads.values())).peer
+        return False
+
     def configure(self, in_specs: Dict[str, TensorsSpec]) -> Dict[str, TensorsSpec]:
         in_spec = in_specs["sink"]
+        if hasattr(self.backend, "expect_device_input"):
+            self.backend.expect_device_input = self._upstream_device_resident()
         if self._fused_pre or self._fused_post:
             self._install_fusion(in_spec)  # validates model spec vs chain
             # compile against the RAW stream spec: the fused program's
             # entry point consumes pre-transform frames
             out_spec = self.backend.reconfigure_fused(in_spec)
+            if hasattr(self.backend, "set_drift_hook"):
+                # un-renegotiated shape/dtype drift (polymorphic upstream
+                # pad) must rebuild the fused chain, not just recompile
+                self.backend.set_drift_hook(self._drift_reinstall)
         else:
             out_spec = self.backend.reconfigure(in_spec)
         # output= property describes the MODEL output; with fused post-
@@ -150,6 +185,14 @@ class TensorFilter(Node):
         if in_spec.rate is not None and out_spec.rate is None:
             out_spec = TensorsSpec(tensors=out_spec.tensors, rate=in_spec.rate)
         return {"src": out_spec}
+
+    def _drift_reinstall(self, drifted_spec: TensorsSpec) -> None:
+        """Rebind the fused chain to a drifted input spec: stage functions
+        bake per-spec geometry (transpose/dimchg), so drift re-runs the
+        install before recompiling (the executable cache keys by spec, so
+        alternating shapes stay cheap)."""
+        self._install_fusion(drifted_spec)
+        self.backend.reconfigure_fused(drifted_spec)
 
     def _install_fusion(self, in_spec: TensorsSpec) -> TensorsSpec:
         """Compose fused pre/post transforms around the backend fn so the

@@ -265,6 +265,13 @@ class Node:
         incompatible change raises (loud pipeline error, never a silent
         retrace) — ``tensor_filter.c:799-839`` fails negotiation the same
         way."""
+        for spad, event in self._recompute_caps(pad, new_spec):
+            spad.peer.node._dispatch(spad.peer, event)
+
+    def _recompute_caps(self, pad: Pad, new_spec: TensorsSpec):
+        """Commit a mid-stream spec change locally; return the caps events
+        to propagate (pad, event) — pushed by the caller, which lets nodes
+        with their own emission discipline (CollectNode) defer them."""
         template = self.sink_spec(pad.name)
         merged = template.intersect(new_spec)
         if merged is None:
@@ -280,6 +287,7 @@ class Node:
             if p.peer is not None and p.spec is not None
         }
         out_specs = self.reconfigure(in_specs)
+        events = []
         for name, spad in self.src_pads.items():
             if spad.peer is None:
                 continue
@@ -288,7 +296,8 @@ class Node:
                 continue
             spad.spec = spec
             spad.sig = None
-            spad.peer.node._dispatch(spad.peer, Event.caps(spec))
+            events.append((spad, Event.caps(spec)))
+        return events
 
     def reconfigure(self, in_specs: Dict[str, TensorsSpec]) -> Dict[str, TensorsSpec]:
         """Mid-stream re-negotiation hook; defaults to the same commit phase

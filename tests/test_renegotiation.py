@@ -225,3 +225,62 @@ class TestNegativeRenegotiation:
         p.link_chain(src, filt, sink)
         with pytest.raises(PipelineError):
             p.run(timeout=60)
+
+
+class TestBackendDriftGuard:
+    """invoke()-level drift: frames whose signature changes WITHOUT a caps
+    event (the upstream pad is polymorphic → per-frame sig checks skipped).
+    The backend must recompile explicitly — never reshape same-element-count
+    data into stale geometry, and never silently retrace on a dtype flip."""
+
+    def test_shape_drift_direct_invoke(self):
+        b = JaxBackend()
+        b.open(JaxModel(apply=lambda p, x: x + 0.0))
+        b.reconfigure(TensorsSpec.of(TensorSpec(dtype=np.float32, shape=(4, 6, 3))))
+        x = np.arange(8 * 3 * 3, dtype=np.float32).reshape(8, 3, 3)
+        (out,) = b.invoke((x,))  # same element count, different geometry
+        assert out.shape == (8, 3, 3)
+        np.testing.assert_allclose(np.asarray(out), x)
+
+    def test_dtype_drift_direct_invoke(self):
+        b = JaxBackend()
+        b.open(JaxModel(apply=lambda p, x: x * 2))
+        b.reconfigure(TensorsSpec.of(TensorSpec(dtype=np.float32, shape=(2, 3))))
+        x = np.ones((2, 3), np.int32)
+        (out,) = b.invoke((x,))
+        assert np.dtype(out.dtype) == np.int32
+        # the drifted spec got its own cache entry + out_spec
+        assert np.dtype(b.output_spec().tensors[0].dtype) == np.int32
+
+    def test_fused_shape_drift_rebuilds_wrapper(self):
+        """Fused transpose bakes per-spec geometry: drift must re-install
+        the fused chain (via the drift hook), not recompile the stale one."""
+        from nnstreamer_tpu.buffer import Frame
+
+        filt = TensorFilter(framework="jax", model=poly_model())
+        tr = TensorTransform(mode="transpose", option="1:0:2:3")
+        filt.set_fused_transforms([tr], [])
+        filt.start()
+        spec_a = TensorsSpec.of(TensorSpec(dtype=np.float32, shape=(4, 6, 3)))
+        tr.configure({"sink": spec_a})
+        filt.configure({"sink": spec_a})
+        # NNS perm 1:0:2:3 swaps the two innermost dims = numpy axes -1,-2
+        a = np.arange(4 * 6 * 3, dtype=np.float32).reshape(4, 6, 3)
+        out_a = filt.process(None, Frame.of(a)).tensors[0]
+        np.testing.assert_allclose(
+            np.asarray(out_a), a.transpose(0, 2, 1) * 2.0
+        )
+        # drift to (8, 3, 2): same rank, new geometry, new element count
+        d = np.arange(8 * 3 * 2, dtype=np.float32).reshape(8, 3, 2)
+        out_d = filt.process(None, Frame.of(d)).tensors[0]
+        assert out_d.shape == (8, 2, 3)
+        np.testing.assert_allclose(
+            np.asarray(out_d), d.transpose(0, 2, 1) * 2.0
+        )
+        # and back to the original spec: cache hit must restore the
+        # matching wrapper, not the drifted one
+        out_a2 = filt.process(None, Frame.of(a + 1.0)).tensors[0]
+        np.testing.assert_allclose(
+            np.asarray(out_a2), (a + 1.0).transpose(0, 2, 1) * 2.0
+        )
+        filt.stop()
