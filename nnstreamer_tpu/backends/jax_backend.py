@@ -86,6 +86,60 @@ def _load_py_model(path: str, custom: str) -> JaxModel:
     raise ValueError(f"{path}: no get_model() found")
 
 
+def _load_checkpoint_model(path: str, custom: str) -> JaxModel:
+    """Resolve ``model=<checkpoint>.npz`` + ``custom="builder=..."``: load
+    the params pytree (``utils.checkpoint`` format — the same file
+    ``save_state`` writes after training) and hand it to a builder that
+    returns the :class:`JaxModel` around it.  Builder forms:
+
+    - ``builder=pkg/file.py:fn`` — user module, ``fn(params) -> JaxModel``;
+    - ``builder=mobilenet_v2`` (or ``name:fn``) — a module under
+      ``nnstreamer_tpu.models`` whose ``build``/``fn`` accepts
+      ``params=...``.
+
+    This is the analog of the reference's model-file ``open`` path
+    (``tensor_filter.c:873-888``) with trained weights instead of a
+    flatbuffer.
+    """
+    from ..utils.checkpoint import load_state
+
+    params = load_state(path)
+    props = parse_custom(custom)
+    builder = props.get("builder", "")
+    if not builder:
+        raise ValueError(
+            f"jax backend: checkpoint {path!r} needs custom=\"builder=...\""
+        )
+    spec_s, _, fn_name = builder.partition(":")
+    if spec_s.endswith(".py"):
+        mspec = importlib.util.spec_from_file_location("nns_tpu_builder", spec_s)
+        mod = importlib.util.module_from_spec(mspec)
+        mspec.loader.exec_module(mod)
+        fn = getattr(mod, fn_name or "build")
+        model = fn(params)
+    else:
+        # builtin-model builder: remaining custom props become builder
+        # kwargs (image_size=..., num_classes=... — the shape knobs the
+        # checkpoint itself doesn't carry)
+        kwargs = {}
+        for k, v in props.items():
+            if k in ("builder", "compile_cache"):
+                continue
+            try:
+                kwargs[k] = int(v)
+            except ValueError:
+                try:
+                    kwargs[k] = float(v)
+                except ValueError:
+                    kwargs[k] = v
+        mod = importlib.import_module(f"nnstreamer_tpu.models.{spec_s}")
+        fn = getattr(mod, fn_name or "build")
+        model = fn(params=params, **kwargs)
+    if not isinstance(model, JaxModel):
+        raise TypeError(f"builder {builder!r} must return JaxModel")
+    return model
+
+
 def _as_shape_structs(spec: TensorsSpec) -> Tuple[jax.ShapeDtypeStruct, ...]:
     return tuple(
         jax.ShapeDtypeStruct(tuple(t.shape), t.dtype) for t in spec.tensors
@@ -160,10 +214,13 @@ class JaxBackend(FilterBackend):
             path = os.fspath(model)
             if path.endswith(".py"):
                 self.model = _load_py_model(path, custom)
+            elif path.endswith(".npz"):
+                self.model = _load_checkpoint_model(path, custom)
             else:
                 raise ValueError(
                     f"jax backend cannot load {path!r}; use a .py model file "
-                    "defining get_model(), or pass a JaxModel object"
+                    "defining get_model(), a .npz params checkpoint with "
+                    "custom=\"builder=...\", or pass a JaxModel object"
                 )
         else:
             raise TypeError(f"unsupported model object: {type(model)}")

@@ -43,15 +43,22 @@ class TFLiteBackend(FilterBackend):
 
     def open(self, model, custom: str = "") -> None:
         tf = _tf()
-        del custom
+        kwargs = {}
+        for part in (custom or "").split(","):
+            k, _, v = part.partition("=")
+            if k.strip() == "num_threads" and v.strip():
+                # the reference pins interpreter threads the same way
+                # (tflite Interpreter option; see _core.cc interpreter build)
+                kwargs["num_threads"] = int(v)
         if isinstance(model, (str, os.PathLike)) and os.fspath(model).endswith(".tflite"):
-            self.interpreter = tf.lite.Interpreter(model_path=os.fspath(model))
+            self.interpreter = tf.lite.Interpreter(model_path=os.fspath(model), **kwargs)
         elif isinstance(model, (bytes, bytearray)):
-            self.interpreter = tf.lite.Interpreter(model_content=bytes(model))
+            self.interpreter = tf.lite.Interpreter(model_content=bytes(model), **kwargs)
         else:
             # keras model / concrete function → convert in-memory
             converter = tf.lite.TFLiteConverter.from_keras_model(model)
-            self.interpreter = tf.lite.Interpreter(model_content=converter.convert())
+            self.interpreter = tf.lite.Interpreter(
+                model_content=converter.convert(), **kwargs)
         self.interpreter.allocate_tensors()
         self._read_specs()
 

@@ -129,7 +129,7 @@ class BoundingBoxes(DecoderPlugin):
     def init(self, options: List[str]) -> None:
         opts = list(options) + [""] * (5 - len(options))
         self.submode = opts[0] or "tflite-ssd"
-        if self.submode not in ("tflite-ssd", "tf-ssd"):
+        if self.submode not in ("tflite-ssd", "tf-ssd", "fused-ssd"):
             raise ValueError(f"bounding_boxes: unknown sub-mode {self.submode!r}")
         self.labels: Optional[List[str]] = None
         if opts[1]:
@@ -147,6 +147,11 @@ class BoundingBoxes(DecoderPlugin):
                 raise ValueError("tflite-ssd needs 2 tensors (boxes, scores)")
             if self.priors is None:
                 raise ValueError("tflite-ssd needs a box-priors file (option3)")
+        elif self.submode == "fused-ssd":
+            # models/ssd_mobilenet.decode_topk already ran ON DEVICE: one
+            # (K, 6) tensor [x, y, w, h, class, score], geometry in [0,1]
+            if in_spec.num_tensors != 1:
+                raise ValueError("fused-ssd needs 1 tensor (topk detections)")
         elif in_spec.num_tensors != 4:
             raise ValueError("tf-ssd needs 4 tensors (num, classes, scores, boxes)")
         return TensorsSpec(
@@ -163,6 +168,23 @@ class BoundingBoxes(DecoderPlugin):
             objs = decode_tflite_ssd(
                 boxes, scores, self.priors, self.i_width, self.i_height
             )
+            objs = nms(objs)
+        elif self.submode == "fused-ssd":
+            det = np.asarray(frame.tensor(0), dtype=np.float32).reshape(-1, 6)
+            objs = []
+            for x, y, w, h, c, s in det:
+                if s < DETECTION_THRESHOLD:
+                    continue  # top-k is score-sorted, but keep it robust
+                objs.append(
+                    DetectedObject(
+                        class_id=int(c),
+                        x=max(0, int(x * self.i_width)),
+                        y=max(0, int(y * self.i_height)),
+                        width=int(w * self.i_width),
+                        height=int(h * self.i_height),
+                        prob=float(s),
+                    )
+                )
             objs = nms(objs)
         else:  # tf-ssd
             num = int(np.asarray(frame.tensor(0)).reshape(-1)[0])

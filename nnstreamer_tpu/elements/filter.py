@@ -59,6 +59,7 @@ class TensorFilter(Node):
         self._prop_in = self._parse_spec_props(input, inputtype)
         self._prop_out = self._parse_spec_props(output, outputtype)
         self._opened = False
+        self._downstream_host = False  # set at configure from topology
         self._fused_pre: list = []  # TensorTransforms folded in (optimize.py)
         self._fused_post: list = []
         self._fusion_dirty = False
@@ -127,14 +128,15 @@ class TensorFilter(Node):
             return merged
         return self._prop_in or spec or TensorsSpec()
 
-    def _upstream_device_resident(self, max_hops: int = 4) -> bool:
-        """Walk the upstream chain a few hops: a device_resident filter
-        with only residency-*preserving* elements between means our frames
-        arrive as jax Arrays — the backend then prewarms its shaped entry
-        instead of the flat host-wire twin.  Only elements that pass tensor
-        payloads through untouched qualify (queue/tee/batch/unbatch/demux/
-        mux); anything else (converter, host transforms, decoders) emits
-        host numpy and stops the walk."""
+    def _chain_device_resident(self, direction: str, max_hops: int = 4) -> bool:
+        """Walk the up- or downstream chain a few hops: a device_resident
+        filter with only residency-*preserving* elements between means
+        frames on that side are jax Arrays.  Upstream, the backend then
+        prewarms its shaped entry instead of the flat host-wire twin;
+        downstream, outputs must NOT be async-copied back to host.  Only
+        elements that pass tensor payloads through untouched qualify
+        (queue/tee/batch/unbatch/demux/mux); anything else (converter,
+        host transforms, decoders) emits host numpy and stops the walk."""
         from ..elements.batch import TensorBatch, TensorUnbatch
         from ..elements.demux import TensorDemux
         from ..elements.mux import TensorMux
@@ -143,7 +145,8 @@ class TensorFilter(Node):
 
         passthrough = (Queue, Tee, TensorBatch, TensorUnbatch, TensorDemux,
                        TensorMux)
-        pad = self.sink_pads["sink"].peer
+        up = direction == "up"
+        pad = (self.sink_pads["sink"] if up else self.src_pads["src"]).peer
         for _ in range(max_hops):
             if pad is None:
                 return False
@@ -151,15 +154,27 @@ class TensorFilter(Node):
             backend = getattr(node, "backend", None)
             if backend is not None:
                 return bool(getattr(backend, "device_resident", False))
-            if not isinstance(node, passthrough) or len(node.sink_pads) != 1:
+            pads = node.sink_pads if up else node.src_pads
+            if not isinstance(node, passthrough) or len(pads) != 1:
                 return False
-            pad = next(iter(node.sink_pads.values())).peer
+            pad = next(iter(pads.values())).peer
         return False
+
+    def _upstream_device_resident(self) -> bool:
+        return self._chain_device_resident("up")
+
+    def _downstream_device_resident(self) -> bool:
+        return self._chain_device_resident("down")
 
     def configure(self, in_specs: Dict[str, TensorsSpec]) -> Dict[str, TensorsSpec]:
         in_spec = in_specs["sink"]
         if hasattr(self.backend, "expect_device_input"):
             self.backend.expect_device_input = self._upstream_device_resident()
+        # downstream host consumers (decoders, numpy sinks) will call
+        # np.asarray on our outputs: start the device→host copy at emit
+        # time so their blocking read finds local data instead of paying a
+        # full round trip per frame (matters on tunneled chips)
+        self._downstream_host = not self._downstream_device_resident()
         if self._fused_pre or self._fused_post:
             self._install_fusion(in_spec)  # validates model spec vs chain
             # compile against the RAW stream spec: the fused program's
@@ -277,4 +292,9 @@ class TensorFilter(Node):
             outs = self.backend.invoke(frame.tensors)
         if not outs:
             return None  # backend dropped the frame (FLOW_DROPPED analog)
+        if self._downstream_host:
+            for o in outs:
+                start = getattr(o, "copy_to_host_async", None)
+                if start is not None:
+                    start()  # non-blocking; overlaps the d2h with dispatches
         return frame.with_tensors(outs)

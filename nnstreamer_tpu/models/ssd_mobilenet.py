@@ -105,6 +105,40 @@ def apply(params: Params, x, dtype=jnp.bfloat16):
     return boxes, scores
 
 
+def decode_topk(boxes, scores, priors, k: int = 100):
+    """On-device SSD decode head: the XLA replacement for the host-side
+    per-box loop in ``tensordec-boundingbox.c:631-678`` (mirrored by
+    ``decoders.bounding_boxes.decode_tflite_ssd``).
+
+    sigmoid scores → per-box best non-background class → ``lax.top_k`` →
+    prior decode, all fused into the detector's own program, so only a
+    ``(k, 6)`` tensor ever crosses device→host (instead of 1917×(4+L)
+    floats).  Rows: ``[x, y, w, h, class, score]``, box geometry normalized
+    to [0, 1] image space; host-side thresholding + NMS stay cheap on ≤k
+    candidates.
+    """
+    squeezed = boxes.ndim == 2
+    if squeezed:
+        boxes, scores = boxes[None], scores[None]
+    s = jax.nn.sigmoid(scores[..., 1:].astype(jnp.float32))
+    best = s.max(axis=-1)
+    cls = (s.argmax(axis=-1) + 1).astype(jnp.float32)  # class 0 = background
+    top_s, top_i = jax.lax.top_k(best, k)
+    loc = jnp.take_along_axis(
+        boxes.astype(jnp.float32), top_i[..., None], axis=1
+    )
+    pri = jnp.asarray(priors, jnp.float32).T[top_i]  # (..., k, 4) yc/xc/h/w
+    ycenter = loc[..., 0] / 10.0 * pri[..., 2] + pri[..., 0]
+    xcenter = loc[..., 1] / 10.0 * pri[..., 3] + pri[..., 1]
+    h = jnp.exp(loc[..., 2] / 5.0) * pri[..., 2]
+    w = jnp.exp(loc[..., 3] / 5.0) * pri[..., 3]
+    top_c = jnp.take_along_axis(cls, top_i, axis=1)
+    out = jnp.stack(
+        [xcenter - w / 2.0, ycenter - h / 2.0, w, h, top_c, top_s], axis=-1
+    )
+    return out[0] if squeezed else out
+
+
 def generate_priors() -> np.ndarray:
     """Anchor grid (4, 1917): ycenter/xcenter/h/w rows, matching the decoder's
     priors-file contract (``load_box_priors``)."""
@@ -143,14 +177,30 @@ def build(
     dtype=jnp.bfloat16,
     seed: int = 0,
     params: Optional[Params] = None,
+    fused_decode: Optional[int] = None,
 ) -> JaxModel:
+    """``fused_decode=K`` appends :func:`decode_topk` to the program: the
+    model then emits one small ``(K, 6)`` detection tensor (the
+    ``fused-ssd`` decoder sub-mode consumes it) instead of raw
+    boxes+scores."""
     if params is None:
         params = init_params(jax.random.PRNGKey(seed), num_labels)
     shape: Tuple[Optional[int], ...] = (image_size, image_size, 3)
     if batch is not None:
         shape = (batch,) + shape
+    if fused_decode:
+        priors = generate_priors()
+
+        def fwd(p, x):
+            boxes, scores = apply(p, x, dtype=dtype)
+            return decode_topk(boxes, scores, priors, k=fused_decode)
+
+    else:
+        def fwd(p, x):
+            return apply(p, x, dtype=dtype)
+
     return JaxModel(
-        apply=lambda p, x: apply(p, x, dtype=dtype),
+        apply=fwd,
         params=params,
         input_spec=TensorsSpec.of(TensorSpec(dtype=np.float32, shape=shape)),
         name="ssd_mobilenet_v2",

@@ -1,0 +1,102 @@
+"""Trainable tiny classifier fixture: the ``checkLabel.py`` analog's model.
+
+The reference's SSAT suites prove a real model labels a real image correctly
+(``tests/nnstreamer_filter_tensorflow_lite/runTest.sh:70-80`` +
+``checkLabel.py``); its model blob is stripped from this snapshot and the
+environment has zero egress, so the equivalent proof trains THIS model to
+convergence in-test, checkpoints it through ``utils.checkpoint``, and
+reloads it via the jax backend's ``model=<ckpt>.npz`` +
+``custom="builder=tests/fixtures/tiny_classifier.py:build"`` resolution.
+
+Architecture: 3×3 conv (3→8) + relu → global mean pool → dense 8→3.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from nnstreamer_tpu.backends.jax_backend import JaxModel
+from nnstreamer_tpu.spec import TensorSpec, TensorsSpec
+
+NUM_CLASSES = 3
+IMAGE_SIZE = 16
+
+
+def init_params(key):
+    k1, k2 = jax.random.split(key)
+    return {
+        "conv_w": jax.random.normal(k1, (3, 3, 3, 8), jnp.float32) * 0.1,
+        "conv_b": jnp.zeros((8,), jnp.float32),
+        "dense_w": jax.random.normal(k2, (8, NUM_CLASSES), jnp.float32) * 0.1,
+        "dense_b": jnp.zeros((NUM_CLASSES,), jnp.float32),
+    }
+
+
+def apply(params, x):
+    """x: (H, W, 3) or (B, H, W, 3) normalized float32 → logits."""
+    squeeze = x.ndim == 3
+    if squeeze:
+        x = x[None]
+    y = jax.lax.conv_general_dilated(
+        x, params["conv_w"], (1, 1), "SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    ) + params["conv_b"]
+    y = jax.nn.relu(y)
+    y = y.mean(axis=(1, 2))
+    logits = y @ params["dense_w"] + params["dense_b"]
+    return logits[0] if squeeze else logits
+
+
+def make_dataset(n: int, seed: int = 0):
+    """Synthetic separable data: class k's images have channel k brightest."""
+    rng = np.random.default_rng(seed)
+    xs = rng.integers(0, 96, (n, IMAGE_SIZE, IMAGE_SIZE, 3)).astype(np.uint8)
+    ys = rng.integers(0, NUM_CLASSES, (n,))
+    for i, y in enumerate(ys):
+        boost = rng.integers(96, 160, (IMAGE_SIZE, IMAGE_SIZE))
+        xs[i, :, :, y] = np.minimum(255, xs[i, :, :, y] + boost).astype(np.uint8)
+    return xs, ys
+
+
+def normalize(x_u8):
+    return (x_u8.astype(np.float32) - 127.5) / 127.5
+
+
+def train(steps: int = 300, lr: float = 0.05, seed: int = 0):
+    """SGD to convergence on the synthetic set; returns (params, accuracy)."""
+    xs_u8, ys = make_dataset(512, seed)
+    xs = normalize(xs_u8)
+    params = init_params(jax.random.PRNGKey(seed))
+
+    def loss_fn(p, xb, yb):
+        logits = apply(p, xb)
+        logz = jax.nn.log_softmax(logits)
+        return -jnp.mean(logz[jnp.arange(yb.shape[0]), yb])
+
+    @jax.jit
+    def step(p, xb, yb):
+        g = jax.grad(loss_fn)(p, xb, yb)
+        return jax.tree_util.tree_map(lambda a, b: a - lr * b, p, g)
+
+    rng = np.random.default_rng(seed)
+    for _ in range(steps):
+        idx = rng.integers(0, xs.shape[0], (64,))
+        params = step(params, xs[idx], ys[idx])
+    preds = np.asarray(jnp.argmax(apply(params, xs), axis=-1))
+    acc = float((preds == ys).mean())
+    return params, acc
+
+
+def build(params) -> JaxModel:
+    """Checkpoint builder entry point (jax backend ``builder=`` contract)."""
+    params = jax.tree_util.tree_map(jnp.asarray, params)
+    return JaxModel(
+        apply=lambda p, x: apply(p, x),
+        params=params,
+        input_spec=TensorsSpec.of(
+            TensorSpec(dtype=np.float32, shape=(IMAGE_SIZE, IMAGE_SIZE, 3))
+        ),
+        name="tiny_classifier",
+    )

@@ -85,6 +85,61 @@ class TestSSD:
         assert f.tensor(0).shape == (300, 300, 4)
         assert "objects" in f.meta  # detections list (may be empty: random net)
 
+    def test_fused_decode_matches_numpy_path(self):
+        """decode_topk (on-device XLA head) vs decode_tflite_ssd (the
+        reference-math numpy port).  The two differ only in class rule
+        (first-above-threshold vs best), so the strict comparison runs on
+        boxes with exactly one above-threshold class, where both coincide:
+        geometry, class, and score must match."""
+        from nnstreamer_tpu.decoders.bounding_boxes import (
+            DETECTION_THRESHOLD, decode_tflite_ssd,
+        )
+
+        rng = np.random.default_rng(3)
+        n, labels = 1917, 7
+        priors = ssd_mobilenet.generate_priors()
+        boxes = rng.normal(0, 2.0, (n, 4)).astype(np.float32)
+        scores = rng.normal(0, 2.0, (n, labels)).astype(np.float32)
+        sig = 1.0 / (1.0 + np.exp(-scores[:, 1:]))
+        single = (sig >= DETECTION_THRESHOLD).sum(axis=1) == 1
+        assert single.sum() > 100  # random logits: plenty of single-class boxes
+
+        ref = decode_tflite_ssd(
+            boxes[single], scores[single], priors[:, single], 300, 300)
+        det = np.asarray(ssd_mobilenet.decode_topk(
+            jnp.asarray(boxes[single]), jnp.asarray(scores[single]),
+            priors[:, single], k=int(single.sum())))
+        dev = {}
+        for x, y, w, h, c, sc in det:
+            if sc >= DETECTION_THRESHOLD:
+                key = (max(0, int(x * 300)), max(0, int(y * 300)),
+                       int(w * 300), int(h * 300))
+                dev[key] = (int(c), float(sc))
+        assert len(ref) == len(dev)  # same survivor set
+        for o in ref:
+            c, sc = dev[(o.x, o.y, o.width, o.height)]
+            assert c == o.class_id
+            assert abs(sc - o.prob) < 1e-3
+
+    def test_fused_decode_pipeline(self):
+        """Full fused pipeline: model(fused_decode) -> fused-ssd decoder."""
+        model = ssd_mobilenet.build(
+            num_labels=5, image_size=300, dtype=DT, fused_decode=64)
+        x = np.random.default_rng(0).random((300, 300, 3), np.float32)
+        p = Pipeline()
+        src = p.add(DataSrc(data=[x]))
+        filt = p.add(TensorFilter(framework="jax", model=model))
+        dec = p.add(TensorDecoder(
+            mode="bounding_boxes", option1="fused-ssd",
+            option4="300:300", option5="300:300"))
+        sink = p.add(TensorSink(collect=True))
+        p.link_chain(src, filt, dec, sink)
+        p.run(timeout=180)
+        f = sink.frames[0]
+        assert f.tensor(0).shape == (300, 300, 4)
+        assert "objects" in f.meta
+
+
 
 class TestPoseNet:
     def test_pose_pipeline(self):

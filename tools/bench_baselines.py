@@ -1,0 +1,294 @@
+#!/usr/bin/env python
+"""CPU baseline legs for bench.py — the reference stack on the same workloads.
+
+Each invocation measures ONE config in an isolated process (so the TPU
+runtime in the parent bench can never contend with the baseline's CPU
+threads — the round-2 advisor flagged an unexplained 132→13.7 fps baseline
+swing; isolation + pinned threads + recorded env is the fix) and prints
+exactly one JSON line.
+
+Usage: python tools/bench_baselines.py {config1|config1_quant|config2|config3|config4|config5}
+
+Models for configs 2/3/4 are the *exact same jax models* the TPU legs run,
+converted with ``tf.lite.TFLiteConverter.experimental_from_jax`` — matched
+architecture and weights, running on the reference's tflite-CPU runtime
+(``tensor_filter_tensorflow_lite_core.cc`` embeds the same interpreter).
+Config 1 uses keras MobileNetV2 (float and post-training-quantized uint8,
+the reference's actual flagship flavor).  All pipelines run through this
+framework's own graph runtime with ``framework="tensorflow-lite"`` — the
+identical topology the TPU legs use, only the backend differs.
+"""
+
+import json
+import multiprocessing
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "3")
+
+# Pin JAX to CPU before any backend init (the axon sitecustomize imports
+# jax early; config still works post-import, pre-init).
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+N_THREADS = int(os.environ.get("BENCH_BASELINE_THREADS",
+                               str(multiprocessing.cpu_count())))
+N_FRAMES = int(os.environ.get("BENCH_BASELINE_FRAMES", "200"))
+
+
+def log(*args):
+    print(*args, file=sys.stderr, flush=True)
+
+
+def _tf():
+    import tensorflow as tf
+
+    tf.config.threading.set_intra_op_parallelism_threads(N_THREADS)
+    tf.config.threading.set_inter_op_parallelism_threads(2)
+    return tf
+
+
+def tflite_from_jax(fn, example_args, quantize: bool = False,
+                    rep_data=None) -> bytes:
+    """Convert a jax fn to a tflite flatbuffer (same weights, same math)."""
+    tf = _tf()
+    converter = tf.lite.TFLiteConverter.experimental_from_jax(
+        [fn], [[(f"in{i}", a) for i, a in enumerate(example_args)]]
+    )
+    # some lax convs legalize only through flex (tf select) ops, e.g.
+    # explicit pads; the stock python tflite runtime ships the delegate
+    converter.target_spec.supported_ops = [
+        tf.lite.OpsSet.TFLITE_BUILTINS, tf.lite.OpsSet.SELECT_TF_OPS,
+    ]
+    if quantize:
+        converter.optimizations = [tf.lite.Optimize.DEFAULT]
+        if rep_data is not None:
+            converter.representative_dataset = rep_data
+    return converter.convert()
+
+
+def tflite_from_keras(model, quantize: bool = False, rep_data=None) -> bytes:
+    tf = _tf()
+    converter = tf.lite.TFLiteConverter.from_keras_model(model)
+    if quantize:
+        converter.optimizations = [tf.lite.Optimize.DEFAULT]
+        if rep_data is not None:
+            converter.representative_dataset = rep_data
+            converter.target_spec.supported_ops = [
+                tf.lite.OpsSet.TFLITE_BUILTINS_INT8
+            ]
+            converter.inference_input_type = tf.uint8
+            converter.inference_output_type = tf.uint8
+    return converter.convert()
+
+
+def stream_fps(model_bytes, frames, normalize=True, timeout=900,
+               decoder=None):
+    """datasrc → [normalize] → tensor_filter(tensorflow-lite)
+    [→ tensor_decoder] → sink fps.  Same topology as
+    bench.run_pipeline_fps; ``decoder`` = (mode, options-dict)."""
+    from nnstreamer_tpu import Pipeline
+    from nnstreamer_tpu.elements.decoder import TensorDecoder
+    from nnstreamer_tpu.elements.filter import TensorFilter
+    from nnstreamer_tpu.elements.sink import TensorSink
+    from nnstreamer_tpu.elements.testsrc import DataSrc
+    from nnstreamer_tpu.elements.transform import TensorTransform
+
+    state = {"first": None, "count": 0}
+
+    def cb(frame):
+        state["count"] += 1
+        if state["first"] is None:
+            state["first"] = time.perf_counter()
+
+    def run(n):
+        state.update(first=None, count=0)
+        p = Pipeline()
+        chain = [p.add(DataSrc(data=frames[:n]))]
+        if normalize:
+            chain.append(p.add(TensorTransform(
+                mode="arithmetic", option="typecast:float32,add:-127.5,div:127.5",
+                acceleration=False,
+            )))
+        chain.append(p.add(TensorFilter(
+            framework="tensorflow-lite", model=model_bytes,
+            custom=f"num_threads={N_THREADS}",
+        )))
+        if decoder is not None:
+            mode, options = decoder
+            chain.append(p.add(TensorDecoder(mode=mode, **options)))
+        chain.append(p.add(TensorSink(callback=cb)))
+        p.link_chain(*chain)
+        p.run(timeout=timeout)
+        if state["first"] is None or state["count"] < 2:
+            raise RuntimeError(f"baseline delivered {state['count']} frames")
+        return (state["count"] - 1) / (time.perf_counter() - state["first"])
+
+    run(min(5, len(frames)))  # warmup
+    return run(len(frames))
+
+
+def config1(quantize=False):
+    tf = _tf()
+    rng = np.random.default_rng(0)
+    keras_model = tf.keras.applications.MobileNetV2(
+        weights=None, input_shape=(224, 224, 3), classes=1000
+    )
+    img = rng.integers(0, 256, (224, 224, 3)).astype(np.uint8)
+    if quantize:
+        def rep():
+            for _ in range(8):
+                yield [rng.standard_normal((1, 224, 224, 3)).astype(np.float32)]
+
+        blob = tflite_from_keras(keras_model, quantize=True, rep_data=rep)
+        # uint8-in model: feed raw frames, no normalize (quant params absorb it)
+        frames = [img[None].copy() for _ in range(N_FRAMES)]
+        fps = stream_fps(blob, frames, normalize=False)
+    else:
+        blob = tflite_from_keras(keras_model)
+        frames = [img[None].copy() for _ in range(N_FRAMES)]
+        fps = stream_fps(blob, frames, normalize=True)
+    return {"fps": fps, "frames": N_FRAMES, "model": "keras MobileNetV2"}
+
+
+def config2():
+    import jax.numpy as jnp
+
+    from nnstreamer_tpu.models import ssd_mobilenet
+
+    # float32: tflite has no bfloat16 kernels (CPU wants f32 anyway)
+    ssd = ssd_mobilenet.build(num_labels=91, image_size=300, dtype=jnp.float32)
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((1, 300, 300, 3)).astype(np.float32)
+    fn = ssd.fn()
+    blob = tflite_from_jax(fn, [x])
+    img = rng.integers(0, 256, (1, 300, 300, 3)).astype(np.uint8)
+    n = max(30, N_FRAMES // 4)  # SSD CPU is slow; keep the leg bounded
+    import tempfile
+
+    priors_path = os.path.join(tempfile.mkdtemp(), "priors.txt")
+    ssd_mobilenet.write_priors_file(priors_path)
+    # full detection path on CPU too: host decode (tflite-ssd) + overlay —
+    # symmetric with the TPU leg's fused decode + overlay
+    fps = stream_fps(blob, [img.copy() for _ in range(n)], normalize=True,
+                     decoder=("bounding_boxes", {
+                         "option1": "tflite-ssd", "option3": priors_path,
+                         "option4": "300:300", "option5": "300:300"}))
+    return {"fps": fps, "frames": n, "model": "jax ssd_mobilenet → tflite"}
+
+
+def config3():
+    import jax.numpy as jnp
+
+    from nnstreamer_tpu.models import posenet
+
+    pose = posenet.build(image_size=224, dtype=jnp.float32)
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((1, 224, 224, 3)).astype(np.float32)
+    blob = tflite_from_jax(pose.fn(), [x])
+    img = rng.integers(0, 256, (1, 224, 224, 3)).astype(np.uint8)
+    n = max(30, N_FRAMES // 2)
+    fps = stream_fps(blob, [img.copy() for _ in range(n)], normalize=True)
+    return {"fps": fps, "frames": n, "model": "jax posenet → tflite"}
+
+
+def config4():
+    """The repo-slot LSTM recurrence with the cell on tflite-CPU — identical
+    topology to bench.run_lstm_recurrence_fps, backend swapped."""
+    import bench as bench_mod
+    from nnstreamer_tpu.models import lstm
+
+    hidden = 64
+    model = lstm.build_cell(input_size=hidden, hidden_size=hidden)
+    rng = np.random.default_rng(0)
+    h = rng.standard_normal((hidden,)).astype(np.float32)
+    blob = tflite_from_jax(model.fn(), [h, h.copy(), h.copy()])
+    steps = int(os.environ.get("BENCH_LSTM_STEPS", "200"))
+    fps = bench_mod.run_lstm_recurrence_fps(
+        steps, hidden=hidden, framework="tensorflow-lite", model=blob,
+        custom=f"num_threads=1",
+    )
+    return {"steps_per_sec": fps, "steps": steps, "model": "jax lstm cell → tflite"}
+
+
+def config4b():
+    """Windowed sequence LSTM (same lax.scan model → tflite while-loop)."""
+    from nnstreamer_tpu.models import lstm
+
+    seq_len, width = 128, 512
+    model = lstm.build_sequence(input_size=width, hidden_size=width,
+                                seq_len=seq_len)
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((seq_len, width)).astype(np.float32)
+    blob = tflite_from_jax(model.fn(), [x])
+    n = max(20, N_FRAMES // 10)
+    windows = [rng.standard_normal((seq_len, width)).astype(np.float32)
+               for _ in range(n)]
+    fps = stream_fps(blob, windows, normalize=False)
+    return {"windows_per_sec": fps, "steps_per_sec": fps * seq_len,
+            "frames": n, "model": "jax lstm sequence → tflite"}
+
+
+def config5():
+    """4-stream mux → batch → tflite(batch=4) → unbatch → demux."""
+    import bench as bench_mod
+    tf = _tf()
+    rng = np.random.default_rng(0)
+    keras_model = tf.keras.applications.MobileNetV2(
+        weights=None, input_shape=(224, 224, 3), classes=1000
+    )
+    blob = tflite_from_keras(keras_model)
+    n_streams = int(os.environ.get("BENCH_MUX_STREAMS", "4"))
+    per_stream = int(os.environ.get("BENCH_MUX_FRAMES", "30"))
+    img = rng.integers(0, 256, (224, 224, 3)).astype(np.uint8)
+    fps = bench_mod.run_mux_batched_fps(
+        blob, n_streams, per_stream, img, framework="tensorflow-lite",
+        custom=f"num_threads={N_THREADS}",
+    )
+    return {"fps": fps, "streams": n_streams, "frames_per_stream": per_stream,
+            "model": "keras MobileNetV2 (batch invoke)"}
+
+
+def main():
+    which = sys.argv[1] if len(sys.argv) > 1 else "config1"
+    t0 = time.perf_counter()
+    try:
+        if which == "config1":
+            out = config1()
+        elif which == "config1_quant":
+            out = config1(quantize=True)
+        elif which == "config2":
+            out = config2()
+        elif which == "config3":
+            out = config3()
+        elif which == "config4":
+            out = config4()
+        elif which == "config4b":
+            out = config4b()
+        elif which == "config5":
+            out = config5()
+        else:
+            raise ValueError(f"unknown config {which!r}")
+        out.update(
+            ok=True,
+            config=which,
+            threads=N_THREADS,
+            cpu_count=multiprocessing.cpu_count(),
+            wall_s=round(time.perf_counter() - t0, 1),
+        )
+    except Exception as exc:  # noqa: BLE001 — one leg must never kill the bench
+        import traceback
+
+        traceback.print_exc()
+        out = {"ok": False, "config": which, "error": repr(exc)[:400]}
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()
