@@ -85,11 +85,12 @@ def pin_cpu():
 
 
 def run_pipeline_fps(framework, model, frames, warmup=3, normalize=True,
-                     decoder=None):
+                     decoder=None, custom="", accel=True, timeout_s=600):
     """Stream frames through datasrc → transform(normalize) → tensor_filter
     [→ tensor_decoder] → sink; frames/sec.  On the jax path the transform
     fuses into the model's XLA program, so raw uint8 crosses host→device.
-    ``decoder`` is an optional (mode, options-dict) pair."""
+    ``decoder`` is an optional (mode, options-dict) pair; ``accel=False``
+    keeps the normalize on host numpy (the CPU-baseline configuration)."""
     from nnstreamer_tpu import Pipeline
     from nnstreamer_tpu.elements.decoder import TensorDecoder
     from nnstreamer_tpu.elements.filter import TensorFilter
@@ -111,14 +112,16 @@ def run_pipeline_fps(framework, model, frames, warmup=3, normalize=True,
         src = p.add(DataSrc(data=frames[:n]))
         chain = [src]
         if normalize:
-            chain.append(p.add(TensorTransform(mode="arithmetic", option=NORMALIZE)))
-        chain.append(p.add(TensorFilter(framework=framework, model=model)))
+            chain.append(p.add(TensorTransform(mode="arithmetic", option=NORMALIZE,
+                                               acceleration=accel)))
+        chain.append(p.add(TensorFilter(framework=framework, model=model,
+                                        custom=custom)))
         if decoder is not None:
             mode, options = decoder
             chain.append(p.add(TensorDecoder(mode=mode, **options)))
         chain.append(p.add(TensorSink(callback=sink_cb)))
         p.link_chain(*chain)
-        p.run(timeout=600)
+        p.run(timeout=timeout_s)
         out = state["out"]
         if out is not None and hasattr(out, "block_until_ready"):
             out.block_until_ready()  # drain async device work before timing
@@ -273,8 +276,7 @@ def measure_mfu(batches=None, image_size=224):
     peak_tflops = float(os.environ.get("BENCH_PEAK_TFLOPS", "197"))
     rng = np.random.default_rng(0)
     out = {"assumed_peak_tflops": peak_tflops, "compute_dtype": "bfloat16"}
-    sweep = []
-    for batch in batches:
+    def point(batch):
         model = mobilenet_v2.build(
             num_classes=1001, image_size=image_size, batch=batch
         )
@@ -305,14 +307,22 @@ def measure_mfu(batches=None, image_size=224):
         res.block_until_ready()
         step = (time.perf_counter() - t0) / n
         mfu = (flops / step / (peak_tflops * 1e12)) if flops else None
-        sweep.append({
+        return {
             "batch": batch,
             "step_ms": round(step * 1e3, 3),
             "fps": round(batch / step, 1),
             "achieved_tflops": round(flops / step / 1e12, 3) if flops else None,
             "mfu": round(mfu, 4) if mfu else None,
-        })
-        log(f"# mfu batch={batch}: {sweep[-1]}")
+        }
+
+    sweep = []
+    for batch in batches:
+        try:  # one failing batch point must not discard measured ones
+            sweep.append(point(batch))
+            log(f"# mfu batch={batch}: {sweep[-1]}")
+        except Exception as exc:
+            out[f"batch{batch}_error"] = repr(exc)[:200]
+            log(f"# mfu batch={batch} failed: {exc!r}")
     out["sweep"] = sweep
     best = max((s for s in sweep if s.get("mfu")), key=lambda s: s["mfu"],
                default=None)
@@ -595,13 +605,19 @@ def main():
         log(traceback.format_exc())
 
     # -- config #3: PoseNet pose-estimation pipeline -----------------------
+    # fused on-device keypoint decode (heatmap argmax in the model's XLA
+    # program) + skeleton overlay: the full pose path, both legs symmetric
     try:
         from nnstreamer_tpu.models import posenet
 
-        pose = posenet.build(image_size=224)
+        pose = posenet.build(image_size=224, fused_decode=True)
+        grid = posenet.grid_size(224)
         n_pose = int(os.environ.get("BENCH_POSE_FRAMES", "100"))
         pose_fps = run_pipeline_fps(
-            "jax", pose, [image_u8.copy() for _ in range(n_pose)]
+            "jax", pose, [image_u8.copy() for _ in range(n_pose)],
+            decoder=("pose_estimation", {
+                "option1": "224:224", "option2": f"{grid}:{grid}",
+            }),
         )
         results["config3_pose_fps"] = round(pose_fps, 2)
         log(f"# config3 pose fps: {pose_fps:.2f}")

@@ -1,0 +1,92 @@
+"""Fused-decode object detection: the TPU-first version of config #2.
+
+videotestsrc → tensor_converter → tensor_transform (normalize, fused) →
+tensor_filter (jax SSD-MobileNet with the on-device decode head:
+sigmoid → best-class → ``lax.top_k`` → prior decode inside ONE XLA
+program) → tensor_decoder (``fused-ssd``: threshold + NMS + overlay on a
+tiny (K,6) tensor) → tensor_sink.
+
+Versus `object_detection.py` (host decode of all 1917 anchors), only K
+rows ever cross device→host.  Golden check: the device-decoded top-k,
+re-thresholded in numpy, must agree with an independent numpy decode of
+the raw (boxes, scores) for every box where exactly one class clears the
+threshold (where the first-class and best-class rules coincide).
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+import nnstreamer_tpu as nns
+from nnstreamer_tpu.api.single import SingleShot
+from nnstreamer_tpu.elements.filter import TensorFilter
+from nnstreamer_tpu.elements.sink import TensorSink
+from nnstreamer_tpu.models import ssd_mobilenet
+
+SIZE, LABELS, TOPK = 300, 5, 64
+NORMALIZE = "typecast:float32,add:-127.5,div:127.5"
+
+
+def main():
+    model = ssd_mobilenet.build(
+        num_labels=LABELS, image_size=SIZE, fused_decode=TOPK
+    )
+
+    frames = []
+    p = nns.Pipeline()
+    src = p.add(nns.make("videotestsrc", num_buffers=4, width=SIZE,
+                         height=SIZE, pattern="random"))
+    conv = p.add(nns.make("tensor_converter"))
+    norm = p.add(nns.make("tensor_transform", mode="arithmetic",
+                          option=NORMALIZE))
+    filt = p.add(TensorFilter(framework="jax", model=model))
+    dec = p.add(nns.make("tensor_decoder", mode="bounding_boxes",
+                         option1="fused-ssd",
+                         option4=f"{SIZE}:{SIZE}", option5=f"{SIZE}:{SIZE}"))
+    sink = p.add(TensorSink(callback=lambda f: frames.append(f)))
+    p.link_chain(src, conv, norm, filt, dec, sink)
+    p.run(timeout=300)
+
+    print(f"decoded {len(frames)} frames; "
+          f"frame 0 objects: {len(frames[0].meta['objects'])}")
+
+    # golden: raw model (no fused head) on the same pixels, numpy decode
+    raw = ssd_mobilenet.build(num_labels=LABELS, image_size=SIZE)
+    from nnstreamer_tpu.decoders.bounding_boxes import (
+        DETECTION_THRESHOLD, decode_tflite_ssd,
+    )
+    from nnstreamer_tpu.elements.testsrc import VideoTestSrc
+
+    img = VideoTestSrc(width=SIZE, height=SIZE, pattern="random")._make_frame(0)
+    x = ((img.astype(np.float32) - 127.5) / 127.5)
+    with SingleShot(framework="jax", model=raw) as s:
+        boxes, scores = (np.asarray(t) for t in s.invoke(x))
+    priors = ssd_mobilenet.generate_priors()
+    sig = 1.0 / (1.0 + np.exp(-scores[:, 1:]))
+    single = (sig >= DETECTION_THRESHOLD).sum(axis=1) == 1
+    ref = decode_tflite_ssd(boxes[single], scores[single],
+                            priors[:, single], SIZE, SIZE)
+
+    det = np.asarray(ssd_mobilenet.decode_topk(
+        boxes[single], scores[single], priors[:, single],
+        k=int(single.sum())))
+    dev = {
+        (max(0, int(r[0] * SIZE)), max(0, int(r[1] * SIZE)),
+         int(r[2] * SIZE), int(r[3] * SIZE)): (int(r[4]), float(r[5]))
+        for r in det if r[5] >= DETECTION_THRESHOLD
+    }
+    ok = len(ref) == len(dev) and all(
+        (o.x, o.y, o.width, o.height) in dev
+        and dev[(o.x, o.y, o.width, o.height)][0] == o.class_id
+        for o in ref
+    )
+    print(f"golden={'OK' if ok else 'MISMATCH'} ({len(ref)} detections)")
+    if not ok:
+        raise SystemExit(1)
+
+
+if __name__ == "__main__":
+    main()

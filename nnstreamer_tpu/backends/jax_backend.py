@@ -86,7 +86,8 @@ def _load_py_model(path: str, custom: str) -> JaxModel:
     raise ValueError(f"{path}: no get_model() found")
 
 
-def _load_checkpoint_model(path: str, custom: str) -> JaxModel:
+def _load_checkpoint_model(path: str, custom: str,
+                           reserved: frozenset = frozenset()) -> JaxModel:
     """Resolve ``model=<checkpoint>.npz`` + ``custom="builder=..."``: load
     the params pytree (``utils.checkpoint`` format — the same file
     ``save_state`` writes after training) and hand it to a builder that
@@ -120,10 +121,10 @@ def _load_checkpoint_model(path: str, custom: str) -> JaxModel:
     else:
         # builtin-model builder: remaining custom props become builder
         # kwargs (image_size=..., num_classes=... — the shape knobs the
-        # checkpoint itself doesn't carry)
+        # checkpoint itself doesn't carry); backend-owned keys are excluded
         kwargs = {}
         for k, v in props.items():
-            if k in ("builder", "compile_cache"):
+            if k == "builder" or k in reserved:
                 continue
             try:
                 kwargs[k] = int(v)
@@ -205,6 +206,10 @@ class JaxBackend(FilterBackend):
 
     # -- open/close ---------------------------------------------------------
 
+    # custom= keys the backend itself consumes; never forwarded to
+    # checkpoint builders (subclasses extend)
+    RESERVED_CUSTOM_KEYS = frozenset({"compile_cache"})
+
     def open(self, model, custom: str = "") -> None:
         if isinstance(model, JaxModel):
             self.model = model
@@ -215,7 +220,8 @@ class JaxBackend(FilterBackend):
             if path.endswith(".py"):
                 self.model = _load_py_model(path, custom)
             elif path.endswith(".npz"):
-                self.model = _load_checkpoint_model(path, custom)
+                self.model = _load_checkpoint_model(
+                    path, custom, reserved=self.RESERVED_CUSTOM_KEYS)
             else:
                 raise ValueError(
                     f"jax backend cannot load {path!r}; use a .py model file "
@@ -462,6 +468,8 @@ class JaxShardedBackend(JaxBackend):
     leading dim of every input over a 1-D mesh; params are replicated by
     closure capture; XLA inserts the collectives (over ICI on real hardware).
     """
+
+    RESERVED_CUSTOM_KEYS = JaxBackend.RESERVED_CUSTOM_KEYS | {"devices", "axis"}
 
     def __init__(self):
         super().__init__()

@@ -47,11 +47,29 @@ class PoseEstimation(DecoderPlugin):
             with open(opts[2], "r", encoding="utf-8") as f:
                 self.labels = [ln.strip() for ln in f if ln.strip()]
 
+    @staticmethod
+    def _is_fused(shape) -> bool:
+        """(…,14,3) = keypoints already decoded on device
+        (``models/posenet.decode_keypoints``)."""
+        return (
+            shape is not None
+            and len(shape) >= 2
+            and shape[-1] == 3
+            and shape[-2] == POSE_SIZE
+        )
+
     def out_spec(self, in_spec: TensorsSpec) -> TensorsSpec:
         t = in_spec.tensors[0]
-        if t.shape is None or t.shape[-1] != POSE_SIZE:
+        if self._is_fused(t.shape):
+            if not (self.i_width and self.i_height):
+                raise ValueError(
+                    "pose_estimation with fused keypoints needs the grid "
+                    "size (option2=W:H) to scale coordinates"
+                )
+        elif t.shape is None or t.shape[-1] != POSE_SIZE:
             raise ValueError(
-                f"pose_estimation needs (h, w, {POSE_SIZE}) heatmaps, got {t}"
+                f"pose_estimation needs (h, w, {POSE_SIZE}) heatmaps or "
+                f"({POSE_SIZE}, 3) fused keypoints, got {t}"
             )
         return TensorsSpec(
             tensors=(TensorSpec(dtype=np.uint8, shape=(self.height, self.width, 4)),),
@@ -60,17 +78,24 @@ class PoseEstimation(DecoderPlugin):
 
     def decode(self, frame: Frame, in_spec: TensorsSpec) -> Frame:
         del in_spec
-        hm = np.asarray(frame.tensor(0), dtype=np.float32)
-        hm = hm.reshape(-1, hm.shape[-2], hm.shape[-1]) if hm.ndim > 3 else hm
-        grid_h, grid_w = hm.shape[0], hm.shape[1]
-        i_w = self.i_width or grid_w
-        i_h = self.i_height or grid_h
-        # argmax per keypoint channel (vectorized over all 14 at once)
-        flat = hm.reshape(-1, POSE_SIZE)
-        idx = flat.argmax(axis=0)
-        probs = flat[idx, np.arange(POSE_SIZE)]
-        ys, xs = np.unravel_index(idx, (grid_h, grid_w))
-        keypoints = [(int(x), int(y), float(p)) for x, y, p in zip(xs, ys, probs)]
+        raw = np.asarray(frame.tensor(0), dtype=np.float32)
+        if self._is_fused(raw.shape):
+            kps = raw.reshape(-1, POSE_SIZE, 3)[0]  # device-decoded (14,3)
+            i_w, i_h = self.i_width, self.i_height
+            keypoints = [(int(x), int(y), float(p)) for x, y, p in kps]
+        else:
+            hm = raw.reshape(-1, raw.shape[-2], raw.shape[-1]) if raw.ndim > 3 else raw
+            grid_h, grid_w = hm.shape[0], hm.shape[1]
+            i_w = self.i_width or grid_w
+            i_h = self.i_height or grid_h
+            # argmax per keypoint channel (vectorized over all 14 at once)
+            flat = hm.reshape(-1, POSE_SIZE)
+            idx = flat.argmax(axis=0)
+            probs = flat[idx, np.arange(POSE_SIZE)]
+            ys, xs = np.unravel_index(idx, (grid_h, grid_w))
+            keypoints = [
+                (int(x), int(y), float(p)) for x, y, p in zip(xs, ys, probs)
+            ]
 
         canvas = draw.new_canvas(self.width, self.height)
         sx = self.width / i_w

@@ -47,20 +47,49 @@ def apply(params: Params, x, dtype=jnp.bfloat16):
     return hm[0] if squeezed else hm
 
 
+def decode_keypoints(hm):
+    """On-device keypoint decode: (…,H,W,14) heatmaps → (…,14,3) rows of
+    ``[x, y, score]`` in grid coordinates — the argmax loop of
+    ``tensordec-pose.c:473-493`` fused into the model's XLA program, so a
+    tiny (14,3) tensor crosses device→host instead of the full heatmap
+    volume (whose small minor dims pay heavy tiled-layout padding)."""
+    squeezed = hm.ndim == 3
+    if squeezed:
+        hm = hm[None]
+    n, h, w, k = hm.shape
+    flat = hm.reshape(n, h * w, k)
+    idx = jnp.argmax(flat, axis=1)
+    score = jnp.take_along_axis(flat, idx[:, None, :], axis=1)[:, 0, :]
+    xs = (idx % w).astype(jnp.float32)
+    ys = (idx // w).astype(jnp.float32)
+    out = jnp.stack([xs, ys, score], axis=-1)
+    return out[0] if squeezed else out
+
+
 def build(
     image_size: int = 224,
     batch: Optional[int] = None,
     dtype=jnp.bfloat16,
     seed: int = 0,
     params: Optional[Params] = None,
+    fused_decode: bool = False,
 ) -> JaxModel:
+    """``fused_decode=True`` appends :func:`decode_keypoints`: the model
+    then emits ``(14, 3)`` keypoints (grid coords) that the
+    ``pose_estimation`` decoder consumes directly."""
     if params is None:
         params = init_params(jax.random.PRNGKey(seed))
     shape: Tuple[Optional[int], ...] = (image_size, image_size, 3)
     if batch is not None:
         shape = (batch,) + shape
+    if fused_decode:
+        def fwd(p, x):
+            return decode_keypoints(apply(p, x, dtype=dtype))
+    else:
+        def fwd(p, x):
+            return apply(p, x, dtype=dtype)
     return JaxModel(
-        apply=lambda p, x: apply(p, x, dtype=dtype),
+        apply=fwd,
         params=params,
         input_spec=TensorsSpec.of(TensorSpec(dtype=np.float32, shape=shape)),
         name="posenet_mobilenet_v2",

@@ -135,6 +135,7 @@ class TestCodegen:
         ("image_labeling.py", "frame 7"),
         ("object_detection.py", "golden=OK"),
         ("pose_estimation.py", "golden=OK"),
+        ("fused_detection.py", "golden=OK"),
     ],
 )
 def test_pipeline_demo_runs(script, expect):

@@ -142,6 +142,35 @@ class TestSSD:
 
 
 class TestPoseNet:
+    def test_fused_keypoints_match_numpy(self):
+        """decode_keypoints (device argmax) vs the decoder's numpy argmax."""
+        rng = np.random.default_rng(5)
+        hm = rng.random((14, 14, 14)).astype(np.float32)
+        kps = np.asarray(posenet.decode_keypoints(jnp.asarray(hm)))
+        flat = hm.reshape(-1, 14)
+        idx = flat.argmax(axis=0)
+        ys, xs = np.unravel_index(idx, (14, 14))
+        np.testing.assert_array_equal(kps[:, 0].astype(int), xs)
+        np.testing.assert_array_equal(kps[:, 1].astype(int), ys)
+        np.testing.assert_allclose(kps[:, 2], flat[idx, np.arange(14)], rtol=1e-6)
+
+    def test_fused_pose_pipeline(self):
+        model = posenet.build(image_size=96, dtype=DT, fused_decode=True)
+        grid = posenet.grid_size(96)
+        x = np.random.default_rng(0).random((96, 96, 3), np.float32)
+        p = Pipeline()
+        src = p.add(DataSrc(data=[x]))
+        filt = p.add(TensorFilter(framework="jax", model=model))
+        dec = p.add(TensorDecoder(mode="pose_estimation",
+                                  option1="96:96",
+                                  option2=f"{grid}:{grid}"))
+        sink = p.add(TensorSink(collect=True))
+        p.link_chain(src, filt, dec, sink)
+        p.run(timeout=180)
+        f = sink.frames[0]
+        assert f.tensor(0).shape == (96, 96, 4)
+        assert len(f.meta["pose"]) == 14
+
     def test_pose_pipeline(self):
         model = posenet.build(image_size=96, dtype=DT)
         grid = posenet.grid_size(96)

@@ -164,3 +164,38 @@ class TestMultiStreamSharded:
             from nnstreamer_tpu.backends.custom import unregister_custom_easy
 
             unregister_custom_easy("double4x2")
+
+
+class TestShardedFlatWire:
+    def test_flat_wire_batch_keeps_batch_sharding(self, rng):
+        """Host (8,H,W,C) frames take the flat wire path as (8, H*W*C):
+        the leading dim still shards over the dp mesh, and results match
+        an unsharded numpy computation."""
+        w = rng.standard_normal((12, 3)).astype(np.float32)
+
+        def apply(params, x):  # (8, 2, 2, 3) -> (8, 3)
+            return x.reshape(x.shape[0], -1) @ params
+
+        model = JaxModel(
+            apply=apply,
+            params=jnp.asarray(w),
+            input_spec=TensorsSpec.of(
+                TensorSpec(dtype=np.float32, shape=(8, 2, 2, 3))
+            ),
+        )
+        from nnstreamer_tpu.backends.base import get_backend
+
+        b = get_backend("jax-sharded")
+        b.open(model, custom="devices=8,axis=dp")
+        b.reconfigure(model.input_spec)
+        # wire shape: leading (sharded) dim preserved, rest flattened
+        assert b._wire_shapes == ((8, 12),)
+        x = rng.standard_normal((8, 2, 2, 3)).astype(np.float32)
+        (out,) = b.invoke((x,))
+        assert out.shape == (8, 3)
+        shardings = {d.id for d in out.sharding.device_set}
+        assert len(shardings) == 8  # batch stayed sharded over the mesh
+        np.testing.assert_allclose(
+            np.asarray(out), x.reshape(8, -1) @ w, rtol=1e-5, atol=1e-5
+        )
+        b.close()

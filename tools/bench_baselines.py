@@ -90,48 +90,16 @@ def tflite_from_keras(model, quantize: bool = False, rep_data=None) -> bytes:
 
 def stream_fps(model_bytes, frames, normalize=True, timeout=900,
                decoder=None):
-    """datasrc → [normalize] → tensor_filter(tensorflow-lite)
-    [→ tensor_decoder] → sink fps.  Same topology as
-    bench.run_pipeline_fps; ``decoder`` = (mode, options-dict)."""
-    from nnstreamer_tpu import Pipeline
-    from nnstreamer_tpu.elements.decoder import TensorDecoder
-    from nnstreamer_tpu.elements.filter import TensorFilter
-    from nnstreamer_tpu.elements.sink import TensorSink
-    from nnstreamer_tpu.elements.testsrc import DataSrc
-    from nnstreamer_tpu.elements.transform import TensorTransform
+    """datasrc → [normalize, host numpy] → tensor_filter(tensorflow-lite)
+    [→ tensor_decoder] → sink fps — bench.run_pipeline_fps with the
+    CPU-baseline knobs (one timing harness, no drift)."""
+    import bench as bench_mod
 
-    state = {"first": None, "count": 0}
-
-    def cb(frame):
-        state["count"] += 1
-        if state["first"] is None:
-            state["first"] = time.perf_counter()
-
-    def run(n):
-        state.update(first=None, count=0)
-        p = Pipeline()
-        chain = [p.add(DataSrc(data=frames[:n]))]
-        if normalize:
-            chain.append(p.add(TensorTransform(
-                mode="arithmetic", option="typecast:float32,add:-127.5,div:127.5",
-                acceleration=False,
-            )))
-        chain.append(p.add(TensorFilter(
-            framework="tensorflow-lite", model=model_bytes,
-            custom=f"num_threads={N_THREADS}",
-        )))
-        if decoder is not None:
-            mode, options = decoder
-            chain.append(p.add(TensorDecoder(mode=mode, **options)))
-        chain.append(p.add(TensorSink(callback=cb)))
-        p.link_chain(*chain)
-        p.run(timeout=timeout)
-        if state["first"] is None or state["count"] < 2:
-            raise RuntimeError(f"baseline delivered {state['count']} frames")
-        return (state["count"] - 1) / (time.perf_counter() - state["first"])
-
-    run(min(5, len(frames)))  # warmup
-    return run(len(frames))
+    return bench_mod.run_pipeline_fps(
+        "tensorflow-lite", model_bytes, frames, normalize=normalize,
+        decoder=decoder, custom=f"num_threads={N_THREADS}", accel=False,
+        timeout_s=timeout,
+    )
 
 
 def config1(quantize=False):
@@ -189,12 +157,18 @@ def config3():
     from nnstreamer_tpu.models import posenet
 
     pose = posenet.build(image_size=224, dtype=jnp.float32)
+    grid = posenet.grid_size(224)
     rng = np.random.default_rng(0)
     x = rng.standard_normal((1, 224, 224, 3)).astype(np.float32)
     blob = tflite_from_jax(pose.fn(), [x])
     img = rng.integers(0, 256, (1, 224, 224, 3)).astype(np.uint8)
     n = max(30, N_FRAMES // 2)
-    fps = stream_fps(blob, [img.copy() for _ in range(n)], normalize=True)
+    # full pose path on CPU too: host heatmap argmax + skeleton overlay —
+    # symmetric with the TPU leg's fused decode + overlay
+    fps = stream_fps(blob, [img.copy() for _ in range(n)], normalize=True,
+                     decoder=("pose_estimation", {
+                         "option1": "224:224",
+                         "option2": f"{grid}:{grid}"}))
     return {"fps": fps, "frames": n, "model": "jax posenet → tflite"}
 
 
@@ -249,7 +223,7 @@ def config5():
     img = rng.integers(0, 256, (224, 224, 3)).astype(np.uint8)
     fps = bench_mod.run_mux_batched_fps(
         blob, n_streams, per_stream, img, framework="tensorflow-lite",
-        custom=f"num_threads={N_THREADS}",
+        custom=f"num_threads={N_THREADS}", accel=False,
     )
     return {"fps": fps, "streams": n_streams, "frames_per_stream": per_stream,
             "model": "keras MobileNetV2 (batch invoke)"}
