@@ -354,7 +354,9 @@ def run_baseline_leg(which: str, timeout: float = 1800.0):
     )
 
 
-def measure_frame_breakdown(image_u8, n=100):
+def measure_frame_breakdown(image_u8, n=None):
+    if n is None:
+        n = int(os.environ.get("BENCH_BREAKDOWN_FRAMES", "100"))
     """Where the per-frame time goes for config #1 (round-2 verdict #2
     asked for this table): wire transfer, device compute, jit dispatch,
     and framework overhead measured separately."""
@@ -401,6 +403,19 @@ def measure_frame_breakdown(image_u8, n=100):
         out = fn(ds[0])
     res["dispatch_only_ms"] = round((time.perf_counter() - t0) / n * 1e3, 3)
     out.block_until_ready()
+
+    # 5) p50/p99 per-frame LATENCY (BASELINE.md's second metric): one frame
+    # submitted and synced at a time — the latency-floor view, vs the
+    # overlapped-throughput view above.  Includes the host→device transfer
+    # and the full device round trip.
+    lats = []
+    for f in frames[: min(50, n)]:
+        t0 = time.perf_counter()
+        fn(f).block_until_ready()
+        lats.append((time.perf_counter() - t0) * 1e3)
+    lats.sort()
+    res["latency_p50_ms"] = round(lats[len(lats) // 2], 3)
+    res["latency_p99_ms"] = round(lats[min(len(lats) - 1, int(len(lats) * 0.99))], 3)
     return res
 
 
@@ -672,7 +687,11 @@ def main():
         n_dev = max(1, len(_jax.devices()))
         n_streams = int(os.environ.get("BENCH_MUX_STREAMS", "4"))
         per_stream = int(os.environ.get("BENCH_MUX_FRAMES", "50"))
-        sweep = sorted({1, 2, 4, 8} | {n_streams})
+        sweep_set = {
+            int(v) for v in
+            os.environ.get("BENCH_MUX_SWEEP", "1,2,4,8").split(",") if v
+        }
+        sweep = sorted(sweep_set | {n_streams})
         scaling = {}
         results["config5_scaling"] = scaling
         results["config5_frames_per_stream"] = per_stream
