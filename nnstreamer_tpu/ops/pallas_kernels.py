@@ -129,8 +129,8 @@ def int8_matmul(
     w_scale,
     bias=None,
     interpret: Optional[bool] = None,
-    block_m: int = 32,
-    block_n: int = 128,
+    block_m: Optional[int] = None,
+    block_n: Optional[int] = None,
 ):
     """``(x_q · w_q) * (x_scale * w_scale) + bias`` on the MXU.
 
@@ -139,12 +139,25 @@ def int8_matmul(
     bias: (N,) f32 or None.  Returns (M, N) float32.  K rides whole into
     VMEM (fine for classifier-head sizes; block over K before reusing this
     for giant matmuls).
+
+    Default tiles are adaptive: the whole M dim in one block when it fits
+    a VMEM budget (classifier heads have small M — one pass over the
+    weight stream, no re-fetch per row block), N in 256-lane stripes.
     """
     if interpret is None:
         interpret = _interpret()
     m, k = x_q.shape
     k2, n = w_q.shape
     assert k == k2, (x_q.shape, w_q.shape)
+    if block_m is None:
+        if m <= 256:
+            # whole-M single block, rounded up to the int8 sublane tile
+            # (32): x block ≤ 256×K int8 (K=1280 → 320 KB of VMEM)
+            block_m = max(32, -(-m // 32) * 32)
+        else:
+            block_m = 128  # row stripes; ≤127 padded rows
+    if block_n is None:
+        block_n = 256 if n >= 256 else 128
 
     m_pad = -m % block_m
     n_pad = -n % block_n
