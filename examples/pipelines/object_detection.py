@@ -60,6 +60,7 @@ def golden_decode(boxes, scores, priors, threshold=0.5):
             "h": int(h * SIZE),
         })
     dets.sort(key=lambda o: -o["prob"])
+    dets = dets[:100]  # decoder contract: NMS over the top-100 candidates
     kept = []
     for o in dets:
         ok = True

@@ -34,6 +34,11 @@ from . import draw, font
 DETECTION_THRESHOLD = 0.5
 Y_SCALE, X_SCALE, H_SCALE, W_SCALE = 10.0, 10.0, 5.0, 5.0
 THRESHOLD_IOU = 0.5
+# NMS considers at most this many highest-prob candidates (standard SSD
+# practice; bounds the O(n²) suppression pass — a degenerate/random model
+# can push thousands of boxes over threshold, and the reference's per-box
+# C loop never faced Python loop costs).  Matches the fused head's top-k.
+PRE_NMS_TOP_K = 100
 
 
 @dataclasses.dataclass
@@ -112,8 +117,14 @@ def iou(a: DetectedObject, b: DetectedObject) -> float:
     return max(inter / union, 0.0) if union > 0 else 0.0
 
 
-def nms(objs: List[DetectedObject]) -> List[DetectedObject]:
+def nms(objs: List[DetectedObject],
+        pre_top_k: Optional[int] = PRE_NMS_TOP_K) -> List[DetectedObject]:
+    """Greedy IoU-0.5 suppression over the ``pre_top_k`` highest-prob
+    candidates (None = uncapped — used when the candidate set is already
+    bounded, e.g. the fused device-side top-k)."""
     objs = sorted(objs, key=lambda o: -o.prob)
+    if pre_top_k is not None:
+        objs = objs[:pre_top_k]
     keep = [True] * len(objs)
     for i in range(len(objs)):
         if not keep[i]:
@@ -185,7 +196,9 @@ class BoundingBoxes(DecoderPlugin):
                         prob=float(s),
                     )
                 )
-            objs = nms(objs)
+            # the device-side top-k already bounded the candidate set —
+            # honor whatever K the fused head was built with
+            objs = nms(objs, pre_top_k=None)
         else:  # tf-ssd
             num = int(np.asarray(frame.tensor(0)).reshape(-1)[0])
             classes = np.asarray(frame.tensor(1)).reshape(-1)[:num]

@@ -169,3 +169,24 @@ def test_known_decoders():
 def test_unknown_mode_rejected():
     with pytest.raises(ValueError):
         TensorDecoder(mode="nope")
+
+
+class TestPreNmsCap:
+    def test_nms_caps_candidates_at_top_k(self):
+        """>PRE_NMS_TOP_K above-threshold candidates: only the highest-prob
+        PRE_NMS_TOP_K enter suppression (the example golden mirrors this)."""
+        from nnstreamer_tpu.decoders.bounding_boxes import (
+            PRE_NMS_TOP_K, DetectedObject, nms,
+        )
+
+        # 300 non-overlapping boxes, prob descending with index
+        objs = [
+            DetectedObject(class_id=1, x=(i % 40) * 20, y=(i // 40) * 20,
+                           width=10, height=10, prob=1.0 - i * 1e-3)
+            for i in range(300)
+        ]
+        kept = nms(objs)
+        assert len(kept) == PRE_NMS_TOP_K
+        assert min(o.prob for o in kept) >= 1.0 - (PRE_NMS_TOP_K - 1) * 1e-3 - 1e-9
+        # uncapped: every non-overlapping box survives
+        assert len(nms(objs, pre_top_k=None)) == 300
