@@ -540,8 +540,9 @@ def write_notes(results, platform, errors):
     if errors:
         lines += ["", "## Errors", ""]
         lines += [f"- `{e}`" for e in errors]
-    with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                           "BENCH_NOTES.md"), "w") as f:
+    path = os.environ.get("BENCH_NOTES_PATH") or os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "BENCH_NOTES.md")
+    with open(path, "w") as f:
         f.write("\n".join(lines) + "\n")
 
 
@@ -599,6 +600,7 @@ def main():
             "jax", quant_model, [image_u8.copy() for _ in range(n_q)]
         )
         results["config1_quant_fps"] = round(q_fps, 2)
+        results["config1_quant_frames"] = n_q
         log(f"# config1 quantized fps: {q_fps:.2f}")
     except Exception as exc:
         errors.append(f"config1 quant leg: {exc!r}"[:400])
@@ -623,6 +625,7 @@ def main():
             }),
         )
         results["config2_ssd_fps"] = round(ssd_fps, 2)
+        results["config2_frames"] = n_ssd
         log(f"# config2 ssd fps: {ssd_fps:.2f}")
     except Exception as exc:
         errors.append(f"config2 ssd leg: {exc!r}"[:400])
@@ -644,6 +647,7 @@ def main():
             }),
         )
         results["config3_pose_fps"] = round(pose_fps, 2)
+        results["config3_frames"] = n_pose
         log(f"# config3 pose fps: {pose_fps:.2f}")
     except Exception as exc:
         errors.append(f"config3 pose leg: {exc!r}"[:400])
@@ -654,6 +658,7 @@ def main():
         n_steps = int(os.environ.get("BENCH_LSTM_STEPS", "200"))
         lstm_fps = run_lstm_recurrence_fps(n_steps)
         results["config4_lstm_steps_per_sec"] = round(lstm_fps, 2)
+        results["config4_steps"] = n_steps
         log(f"# config4 lstm recurrence steps/sec: {lstm_fps:.2f}")
     except Exception as exc:
         errors.append(f"config4 lstm leg: {exc!r}"[:400])
@@ -678,6 +683,7 @@ def main():
         ]
         win_fps = run_pipeline_fps("jax", seq_model, windows, normalize=False)
         results["config4b_seq_windows_per_sec"] = round(win_fps, 2)
+        results["config4b_windows"] = n_win
         results["config4b_seq_steps_per_sec"] = round(win_fps * seq_len, 1)
         log(f"# config4b sequence-lstm windows/sec: {win_fps:.2f} "
             f"({win_fps * seq_len:.0f} steps/s)")

@@ -37,6 +37,34 @@ def make_mesh(
     return Mesh(arr, axis_names)
 
 
+def init_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> int:
+    """Join a multi-host JAX job (the DCN side of the comm backend).
+
+    The reference's concurrency never leaves one process (no NCCL/MPI —
+    survey §2.6); scaling past one host here is the standard JAX recipe:
+    every host calls this (TPU pods auto-discover via the metadata server,
+    so all arguments may be None; explicit coordinator/process args cover
+    CPU/GPU clusters), after which ``jax.devices()`` spans the whole job.
+    A :func:`make_mesh` over that global device list lays dp/tp axes so
+    XLA routes collectives over ICI within a slice and DCN across hosts —
+    the ``jax.distributed`` analog of the NCCL/MPI backends the reference
+    never had.  Returns the process count.  Idempotent: a second call is a
+    no-op.
+    """
+    if jax.distributed.is_initialized():
+        return jax.process_count()  # already joined: no-op
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    return jax.process_count()
+
+
 def batch_sharding(mesh: Mesh, rank: int, axis: str = "dp") -> NamedSharding:
     """Shard the leading (batch) dim over ``axis``, replicate the rest."""
     return NamedSharding(mesh, P(axis, *([None] * (rank - 1))))

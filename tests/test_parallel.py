@@ -199,3 +199,33 @@ class TestShardedFlatWire:
             np.asarray(out), x.reshape(8, -1) @ w, rtol=1e-5, atol=1e-5
         )
         b.close()
+
+
+class TestDistributedInit:
+    def test_single_process_join(self):
+        """init_distributed joins a (1-process) multi-host job — must run
+        before backend init, so exercised in a fresh subprocess."""
+        import socket
+        import subprocess
+        import sys
+
+        from conftest import cpu_subprocess_env
+
+        with socket.socket() as s:  # free port: avoids parallel-run clashes
+            s.bind(("localhost", 0))
+            port = s.getsockname()[1]
+        code = (
+            "import jax; jax.config.update('jax_platforms', 'cpu')\n"
+            "from nnstreamer_tpu.parallel.mesh import init_distributed, make_mesh\n"
+            f"n = init_distributed('localhost:{port}', num_processes=1, process_id=0)\n"
+            "assert n == 1, n\n"
+            f"n2 = init_distributed('localhost:{port}', num_processes=1, process_id=0)\n"
+            "assert n2 == 1, n2  # idempotent\n"
+            "print('mesh', make_mesh().shape)\n"
+        )
+        proc = subprocess.run(
+            [sys.executable, "-c", code], capture_output=True, text=True,
+            timeout=120, env=cpu_subprocess_env(),
+        )
+        assert proc.returncode == 0, proc.stderr[-1500:]
+        assert "mesh" in proc.stdout
