@@ -1,4 +1,4 @@
-from .mesh import batch_sharding, make_mesh, replicated  # noqa: F401
+from .mesh import batch_sharding, init_distributed, make_mesh, replicated  # noqa: F401
 from .ring_attention import (  # noqa: F401
     full_attention,
     ring_attention,
