@@ -448,7 +448,12 @@ def measure_pallas():
     try:
         from nnstreamer_tpu.ops.pallas_kernels import fused_arith
 
-        x = jnp.asarray(rng.integers(0, 256, (8, 224, 224, 3)).astype(np.uint8))
+        # device-resident input: measure the KERNELS, not the host->device
+        # relayout both legs would otherwise pay per call
+        x = jax.device_put(
+            rng.integers(0, 256, (8, 224, 224, 3)).astype(np.uint8)
+        )
+        x.block_until_ready()
         ops = (("typecast", np.float32), ("add", -127.5), ("div", 127.5))
         pal = jax.jit(lambda a: fused_arith(a, ops))
 
