@@ -290,3 +290,76 @@ class TestAggregator:
         np.testing.assert_array_equal(
             np.asarray(sink.frames[1].tensor(0))[:, 0], [3, 4, 5]
         )
+
+
+class TestTestSources:
+    """videotestsrc/audiotestsrc pattern + timing contracts (the gtest
+    pipelines' workhorse sources, unittest_sink.cpp:972+)."""
+
+    def test_video_patterns_deterministic(self):
+        from nnstreamer_tpu.elements.testsrc import VideoTestSrc
+
+        for pattern, check in [
+            ("black", lambda a: (a == 0).all()),
+            ("white", lambda a: (a == 255).all()),
+            ("random", lambda a: a.std() > 10),
+            ("smpte", lambda a: a.std() > 10),
+        ]:
+            src = VideoTestSrc(pattern=pattern, width=16, height=12)
+            f0 = src._make_frame(0)
+            assert f0.shape == (12, 16, 3) and f0.dtype == np.uint8
+            assert check(f0), pattern
+            # deterministic per index
+            np.testing.assert_array_equal(f0, VideoTestSrc(
+                pattern=pattern, width=16, height=12)._make_frame(0))
+
+    def test_video_timestamps_follow_framerate(self):
+        p = parse_launch(
+            "videotestsrc num-buffers=3 width=8 height=8 framerate=50/1 ! "
+            "tensor_converter ! tensor_sink name=out collect=true"
+        )
+        p.run(timeout=30)
+        sink = p.get_by_name("out")
+        pts = [f.pts for f in sink.frames]
+        assert pts == [0, 20_000_000, 40_000_000]  # 50 fps → 20 ms
+
+    def test_audio_sine_properties(self):
+        from nnstreamer_tpu.buffer import SECOND
+        from nnstreamer_tpu.elements.testsrc import AudioTestSrc
+
+        src = AudioTestSrc(num_buffers=2, samplesperbuffer=160, channels=2,
+                           rate=16000, freq=1000.0)
+        frames = list(src.frames())
+        assert len(frames) == 2
+        a = frames[0].tensor(0)
+        assert a.shape == (160, 2) and a.dtype == np.int16
+        assert a.std() > 1000  # actually a sine, not silence
+        assert frames[1].pts == 160 * SECOND // 16000
+        silent = AudioTestSrc(num_buffers=1, wave="silence")
+        assert np.asarray(list(silent.frames())[0].tensor(0)).std() == 0
+
+
+class TestProfilingStats:
+    def test_stats_summarize_invokes(self):
+        from nnstreamer_tpu.backends.jax_backend import JaxModel
+        from nnstreamer_tpu.elements.filter import TensorFilter
+        from nnstreamer_tpu.elements.sink import TensorSink
+        from nnstreamer_tpu.elements.testsrc import DataSrc
+        from nnstreamer_tpu.utils import profiling
+
+        profiling.reset()
+        model = JaxModel(apply=lambda p, x: x + 1.0)
+        pipe = Pipeline()
+        src = pipe.add(DataSrc(data=[np.ones((4,), np.float32)] * 6))
+        filt = pipe.add(TensorFilter(framework="jax", model=model, name="f"))
+        sink = pipe.add(TensorSink())
+        pipe.link_chain(src, filt, sink)
+        with profiling.profiled():
+            pipe.run(timeout=60)
+        stats = pipe.stats()
+        assert "f" in stats
+        s = stats["f"]
+        assert s["count"] == 6
+        assert 0 < s["min_ms"] <= s["p50_ms"] <= s["max_ms"]
+        profiling.reset()
+        assert profiling.stats() == {}
