@@ -1,0 +1,116 @@
+"""Python-side contract of the C-API marshaling glue.
+
+The native library (``native/capi/capi.cpp``) calls ONLY these functions,
+with wire-simple types ((bytes, dtype, shape) triples).  The C smoke
+binary exercises the embed path; these tests pin the full glue surface —
+including the pipeline control entries — from Python, where assertion
+failures are readable."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.api import capi_glue as g
+
+
+class TestSingleGlue:
+    def test_open_invoke_roundtrip(self, tmp_path):
+        script = tmp_path / "double.py"
+        script.write_text(
+            "import numpy as np\n"
+            "from nnstreamer_tpu.backends.custom import CustomFilterBase\n"
+            "from nnstreamer_tpu.spec import TensorSpec, TensorsSpec\n"
+            "class CustomFilter(CustomFilterBase):\n"
+            "    def set_input_spec(self, spec):\n"
+            "        return spec\n"
+            "    def invoke(self, x):\n"
+            "        return x * 2\n"
+        )
+        s = g.single_open("custom-python", str(script))
+        x = np.arange(6, dtype=np.float32).reshape(2, 3)
+        g.single_set_input_info(s, [("float32", (2, 3))])
+        outs = g.single_invoke(s, [(x.tobytes(), "float32", (2, 3))])
+        buf, dtype, shape = outs[0]
+        got = np.frombuffer(buf, dtype=dtype).reshape(shape)
+        np.testing.assert_array_equal(got, x * 2)
+        assert g.single_input_info(s) == [("float32", (2, 3))]
+        assert g.single_output_info(s) == [("float32", (2, 3))]
+        g.single_set_timeout(s, 5000)
+        g.single_close(s)
+
+    def test_spec_wire_roundtrip(self):
+        from nnstreamer_tpu.spec import TensorSpec, TensorsSpec
+
+        spec = TensorsSpec.of(
+            TensorSpec(dtype=np.float32, shape=(2, 3)),
+            TensorSpec(dtype=np.uint8, shape=(4,)),
+        )
+        wire = g._spec_to_wire(spec)
+        assert wire == [("float32", (2, 3)), ("uint8", (4,))]
+        back = g._spec_from_wire(wire)
+        assert back.tensors[0].shape == (2, 3)
+        assert np.dtype(back.tensors[1].dtype) == np.uint8
+        assert g._spec_to_wire(None) is None
+
+
+class TestPipelineGlue:
+    def test_construct_control_sink_src(self):
+        caps = "'other/tensor, dimension=(string)4:1:1:1, type=(string)float32'"
+        h = g.pipeline_construct(
+            f"appsrc name=in caps={caps} ! tensor_transform mode=arithmetic "
+            "option=mul:3 acceleration=false ! tensor_sink name=out"
+        )
+        got = []
+        evt = threading.Event()
+
+        def cb(tensors):
+            got.append(tensors)
+            evt.set()
+
+        g.pipeline_sink_register(h, "out", cb)
+        g.pipeline_start(h)
+        assert g.pipeline_get_state(h) == "PLAYING"
+        x = np.ones((4,), np.float32)
+        g.pipeline_src_input(h, "in", [(x.tobytes(), "float32", (4,))])
+        assert evt.wait(30)
+        buf, dtype, shape = got[0][0]
+        np.testing.assert_array_equal(
+            np.frombuffer(buf, dtype=dtype).reshape(shape), x * 3
+        )
+        g.pipeline_src_eos(h, "in")
+        assert g.pipeline_wait(h, 30_000)
+        g.pipeline_sink_unregister(h, "out", cb)
+        g.pipeline_stop(h)
+        g.pipeline_destroy(h)
+
+    def test_valve_and_switch_control(self):
+        caps = "'other/tensor, dimension=(string)2:1:1:1, type=(string)float32'"
+        h = g.pipeline_construct(
+            f"appsrc name=in caps={caps} ! valve name=v ! "
+            "output-selector name=sel sel.src_0 ! tensor_sink name=a "
+            "sel.src_1 ! tensor_sink name=b"
+        )
+        seen = {"a": 0, "b": 0}
+        g.pipeline_sink_register(h, "a", lambda t: seen.__setitem__("a", seen["a"] + 1))
+        g.pipeline_sink_register(h, "b", lambda t: seen.__setitem__("b", seen["b"] + 1))
+        g.pipeline_start(h)
+        x = np.zeros((2,), np.float32)
+        wire = [(x.tobytes(), "float32", (2,))]
+
+        g.pipeline_valve_set_open(h, "v", False)  # drop
+        g.pipeline_src_input(h, "in", wire)
+        time.sleep(0.2)  # appsrc is async: let the frame hit the valve
+        g.pipeline_valve_set_open(h, "v", True)
+        g.pipeline_src_input(h, "in", wire)  # → sel's active pad (src_0)
+        time.sleep(0.2)
+        pads = g.pipeline_switch_pads(h, "sel")
+        assert set(pads) >= {"src_0", "src_1"}
+        g.pipeline_switch_select(h, "sel", "src_1")
+        g.pipeline_src_input(h, "in", wire)  # → b
+        g.pipeline_src_eos(h, "in")
+        assert g.pipeline_wait(h, 30_000)
+        g.pipeline_stop(h)
+        assert seen == {"a": 1, "b": 1}
+        g.pipeline_destroy(h)
