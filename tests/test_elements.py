@@ -363,3 +363,45 @@ class TestProfilingStats:
         assert 0 < s["min_ms"] <= s["p50_ms"] <= s["max_ms"]
         profiling.reset()
         assert profiling.stats() == {}
+
+
+class TestMediaSpecs:
+    """Media-type → tensor-caps derivation (the tensor_converter.c:930-1135
+    per-media config analog)."""
+
+    def test_video_formats_and_batching(self):
+        from nnstreamer_tpu.media import VideoSpec
+
+        v = VideoSpec(format="RGB", width=8, height=4, rate=Fraction(30))
+        assert v.channels == 3
+        s = v.tensor_spec()
+        assert s.tensors[0].shape == (4, 8, 3) and s.rate == Fraction(30)
+        s4 = v.tensor_spec(frames_per_tensor=4)
+        assert s4.tensors[0].shape == (4, 4, 8, 3)
+        assert s4.rate == Fraction(30, 4)  # batched stream rate drops
+        assert VideoSpec(format="GRAY8", width=2, height=2).channels == 1
+        assert VideoSpec(format="BGRx", width=2, height=2).channels == 4
+        with pytest.raises(ValueError, match="format"):
+            VideoSpec(format="YUY2")
+
+    def test_audio_formats(self):
+        from nnstreamer_tpu.media import AudioSpec
+
+        a = AudioSpec(format="F32LE", channels=2, sample_rate=16000)
+        assert a.dtype == np.float32
+        s = a.tensor_spec(frames_per_tensor=160)
+        assert s.tensors[0].shape == (160, 2)
+        assert s.rate == Fraction(16000, 160)
+        with pytest.raises(ValueError, match="format"):
+            AudioSpec(format="MP3")
+
+    def test_text_and_octet(self):
+        from nnstreamer_tpu.media import OctetSpec, TextSpec
+        from nnstreamer_tpu.spec import TensorSpec, TensorsSpec
+
+        t = TextSpec(size=16).tensor_spec()
+        assert t.tensors[0].shape == (16,) and t.tensors[0].dtype == np.uint8
+        custom = TensorsSpec.of(TensorSpec(dtype=np.int16, shape=(3, 2)))
+        assert OctetSpec(spec=custom).tensor_spec() is custom
+        with pytest.raises(ValueError, match="octet"):
+            OctetSpec().tensor_spec()
