@@ -73,6 +73,8 @@ class TensorUnbatch(Node):
         super().__init__(name)
         self.add_sink_pad("sink")
         self.add_src_pad("src")
+        self._to_host = True
+        self._split = None  # jitted row-splitter (jit caches per input shape)
 
     def configure(self, in_specs: Dict[str, TensorsSpec]) -> Dict[str, TensorsSpec]:
         spec = in_specs["sink"]
@@ -83,10 +85,35 @@ class TensorUnbatch(Node):
             raise NegotiationError(f"{self.name}: batch dim must be fixed, got {t}")
         n = t.shape[0]
         per = TensorSpec(dtype=t.dtype, shape=tuple(t.shape[1:]))
+        from ..graph.residency import chain_device_resident
+
+        # host consumers read every row anyway: one device→host copy of the
+        # whole batch (often already in flight — the upstream filter starts
+        # it async) beats N per-row d2h round trips; device consumers get a
+        # single compiled split instead of N eager slice dispatches.
+        self._to_host = not chain_device_resident(self, "down")
         return {"src": TensorsSpec(tensors=(per,) * n, rate=spec.rate)}
+
+    def _device_split(self, batched):
+        if self._split is None:
+            import jax
+
+            # x.shape is static under trace; jit's own cache handles any
+            # alternation of input shapes across renegotiations
+            self._split = jax.jit(
+                lambda x: tuple(x[i] for i in range(x.shape[0]))
+            )
+        return self._split(batched)
 
     def process(self, pad: Pad, frame: Frame):
         del pad
         batched = frame.tensors[0]
-        # device-resident: row views share the parent buffer, no copies.
+        if hasattr(batched, "copy_to_host_async"):  # jax Array
+            if self._to_host:
+                import numpy as np
+
+                batched = np.asarray(batched)
+            else:
+                return frame.with_tensors(self._device_split(batched))
+        # numpy: row views share the parent buffer, no copies
         return frame.with_tensors(tuple(batched[i] for i in range(batched.shape[0])))

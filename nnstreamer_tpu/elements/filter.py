@@ -128,43 +128,15 @@ class TensorFilter(Node):
             return merged
         return self._prop_in or spec or TensorsSpec()
 
-    def _chain_device_resident(self, direction: str, max_hops: int = 4) -> bool:
-        """Walk the up- or downstream chain a few hops: a device_resident
-        filter with only residency-*preserving* elements between means
-        frames on that side are jax Arrays.  Upstream, the backend then
-        prewarms its shaped entry instead of the flat host-wire twin;
-        downstream, outputs must NOT be async-copied back to host.  Only
-        elements that pass tensor payloads through untouched qualify
-        (queue/tee/batch/unbatch/demux/mux); anything else (converter,
-        host transforms, decoders) emits host numpy and stops the walk."""
-        from ..elements.batch import TensorBatch, TensorUnbatch
-        from ..elements.demux import TensorDemux
-        from ..elements.mux import TensorMux
-        from ..elements.queue import Queue
-        from ..elements.tee import Tee
-
-        passthrough = (Queue, Tee, TensorBatch, TensorUnbatch, TensorDemux,
-                       TensorMux)
-        up = direction == "up"
-        pad = (self.sink_pads["sink"] if up else self.src_pads["src"]).peer
-        for _ in range(max_hops):
-            if pad is None:
-                return False
-            node = pad.node
-            backend = getattr(node, "backend", None)
-            if backend is not None:
-                return bool(getattr(backend, "device_resident", False))
-            pads = node.sink_pads if up else node.src_pads
-            if not isinstance(node, passthrough) or len(pads) != 1:
-                return False
-            pad = next(iter(pads.values())).peer
-        return False
-
     def _upstream_device_resident(self) -> bool:
-        return self._chain_device_resident("up")
+        from ..graph.residency import chain_device_resident
+
+        return chain_device_resident(self, "up")
 
     def _downstream_device_resident(self) -> bool:
-        return self._chain_device_resident("down")
+        from ..graph.residency import chain_device_resident
+
+        return chain_device_resident(self, "down")
 
     def configure(self, in_specs: Dict[str, TensorsSpec]) -> Dict[str, TensorsSpec]:
         in_spec = in_specs["sink"]

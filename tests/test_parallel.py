@@ -229,3 +229,77 @@ class TestDistributedInit:
         )
         assert proc.returncode == 0, proc.stderr[-1500:]
         assert "mesh" in proc.stdout
+
+
+class TestUnbatchResidency:
+    """tensor_unbatch picks its split strategy from downstream topology:
+    host consumers get ONE device→host copy + numpy row views; a
+    device-resident consumer (another jax filter) gets a single jitted
+    split and payloads stay jax Arrays (no N eager slice dispatches)."""
+
+    def test_host_consumer_emits_numpy_rows(self, rng):
+        model, (w, b) = linear_model(rng)
+        batched = JaxModel(
+            apply=model.apply, params=model.params,
+            input_spec=TensorsSpec.of(TensorSpec(dtype=np.float32, shape=(4, 16))),
+        )
+        got = []
+        p = Pipeline()
+        srcs = [
+            p.add(DataSrc(data=[rng.standard_normal(16).astype(np.float32)]))
+            for _ in range(4)
+        ]
+        mux = p.add(TensorMux(sync_mode="nosync"))
+        bat = p.add(TensorBatch())
+        filt = p.add(TensorFilter(framework="jax", model=batched))
+        unb = p.add(TensorUnbatch())
+        sink = p.add(TensorSink())
+        sink.connect("new-data", got.append)
+        for i, src in enumerate(srcs):
+            p.link(src, f"{mux.name}.sink_{i}")
+        p.link_chain(mux, bat, filt, unb, sink)
+        p.run(timeout=120)
+        assert unb._to_host is True
+        assert len(got) == 1 and got[0].num_tensors == 4
+        assert all(isinstance(t, np.ndarray) for t in got[0].tensors)
+
+    def test_device_consumer_stays_resident(self, rng):
+        model, (w, b) = linear_model(rng)
+        batched = JaxModel(
+            apply=model.apply, params=model.params,
+            input_spec=TensorsSpec.of(TensorSpec(dtype=np.float32, shape=(4, 16))),
+        )
+        plus_one = JaxModel(
+            apply=lambda p_, x: x + 1.0,
+            input_spec=TensorsSpec.of(TensorSpec(dtype=np.float32, shape=(4,))),
+        )
+        got = []
+        xs = [rng.standard_normal(16).astype(np.float32) for _ in range(4)]
+        p = Pipeline()
+        mux = p.add(TensorMux(sync_mode="nosync"))
+        for i, x in enumerate(xs):
+            p.link(p.add(DataSrc(data=[x], name=f"s{i}")), f"{mux.name}.sink_{i}")
+        bat = p.add(TensorBatch())
+        filt = p.add(TensorFilter(framework="jax", model=batched))
+        unb = p.add(TensorUnbatch())
+        demux = p.add(TensorDemux(name="dm"))
+        f2 = p.add(TensorFilter(framework="jax", model=plus_one))
+        sink = p.add(TensorSink())
+        sink.connect("new-data", got.append)
+        p.link_chain(mux, bat, filt, unb, demux)
+        p.link("dm.src_0", f2)
+        p.link(f2, sink)
+        p.run(timeout=120)
+        assert unb._to_host is False
+        assert len(got) == 1
+        golden = xs[0] @ w + b + 1.0
+        np.testing.assert_allclose(
+            np.asarray(got[0].tensors[0]), golden, rtol=2e-5, atol=2e-5
+        )
+        # the split path itself must emit device arrays (payload probe:
+        # the pipeline assertions above would also pass if the numpy
+        # fallback ran, since the second filter re-uploads host input)
+        probe = unb.process(
+            None, Frame.of(jnp.ones((4, 16), jnp.float32))
+        )
+        assert all(isinstance(t, jax.Array) for t in probe.tensors)

@@ -1,0 +1,113 @@
+#!/usr/bin/env python
+"""Where does config1's per-frame time go on the real chip?
+
+Measures, in order of increasing framework involvement:
+  a) batch-1 device step time (device-resident input, sync each call)
+  b) jit dispatch rate from Python (async, same input, drain at end)
+  c) host->device invoke chain (numpy arg per call, flat wire, drain at end)
+  d) backend.invoke() loop (JaxBackend, no graph)
+  e) full streaming pipeline (DataSrc -> transform(fused) -> filter -> sink)
+  f) (e) under cProfile, top cumulative entries
+
+Run:  python tools/profile_hotloop.py [n_frames]
+"""
+import cProfile
+import io
+import os
+import pstats
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+def rate(fn, n, drain=None):
+    t0 = time.perf_counter()
+    for _ in range(n):
+        out = fn()
+    if drain is not None:
+        drain(out)
+    dt = time.perf_counter() - t0
+    return n / dt, dt / n * 1e3
+
+
+def main():
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 200
+    from nnstreamer_tpu.models import mobilenet_v2
+
+    model = mobilenet_v2.build(num_classes=1001, image_size=224)
+    rng = np.random.default_rng(0)
+    img = rng.integers(0, 256, (224, 224, 3)).astype(np.uint8)
+    flat = np.ascontiguousarray(img).reshape(-1)
+
+    fused = jax.jit(lambda x: model.apply(
+        model.params,
+        ((x.astype(jnp.float32) - 127.5) / 127.5).reshape(1, 224, 224, 3),
+    ))
+    d = jax.device_put(flat)
+    d.block_until_ready()
+    fused(d).block_until_ready()
+    fused(flat).block_until_ready()
+
+    # a) sync step time, device-resident
+    fps, ms = rate(lambda: fused(d).block_until_ready(), min(n, 100))
+    print(f"a) sync device step:        {ms:8.3f} ms  ({fps:7.1f}/s)")
+
+    # b) async dispatch, device-resident
+    fps, ms = rate(lambda: fused(d), n, drain=lambda o: o.block_until_ready())
+    print(f"b) async dispatch (device): {ms:8.3f} ms  ({fps:7.1f}/s)")
+
+    # c) async chain from host numpy (fresh array each call to defeat caching)
+    frames = [flat.copy() for _ in range(n)]
+    it = iter(frames)
+    fps, ms = rate(lambda: fused(next(it)), n, drain=lambda o: o.block_until_ready())
+    print(f"c) async chain (host np):   {ms:8.3f} ms  ({fps:7.1f}/s)")
+
+    # c2) explicit device_put then dispatch, K-deep window
+    it = iter(frames)
+    fps, ms = rate(lambda: fused(jax.device_put(next(it))), n,
+                   drain=lambda o: o.block_until_ready())
+    print(f"c2) device_put + dispatch:  {ms:8.3f} ms  ({fps:7.1f}/s)")
+
+    # d) backend.invoke loop (float32 frames — the model's declared spec;
+    # the streaming pipeline feeds uint8 only via the fused-transform entry)
+    from nnstreamer_tpu.backends.jax_backend import JaxBackend
+    from nnstreamer_tpu.spec import TensorsSpec
+
+    imgf = img.astype(np.float32)
+    be = JaxBackend()
+    be.open(model)
+    be.reconfigure(TensorsSpec.from_arrays((imgf,)))
+    be.invoke((imgf,))
+    frames2 = [imgf.copy() for _ in range(n)]
+    it2 = iter(frames2)
+    fps, ms = rate(lambda: be.invoke((next(it2),)), n,
+                   drain=lambda o: o[0].block_until_ready())
+    print(f"d) backend.invoke loop:     {ms:8.3f} ms  ({fps:7.1f}/s)")
+
+    # e) full pipeline
+    import bench
+
+    data = [img.copy() for _ in range(n)]
+    fps = bench.run_pipeline_fps("jax", model, data)
+    print(f"e) full pipeline:           {1e3 / fps:8.3f} ms  ({fps:7.1f}/s)")
+
+    # f) profile the pipeline run
+    pr = cProfile.Profile()
+    pr.enable()
+    fps = bench.run_pipeline_fps("jax", model, data)
+    pr.disable()
+    print(f"f) pipeline under profile:  {1e3 / fps:8.3f} ms  ({fps:7.1f}/s)")
+    s = io.StringIO()
+    st = pstats.Stats(pr, stream=s)
+    st.sort_stats("cumulative").print_stats(30)
+    print(s.getvalue())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,68 @@
+#!/usr/bin/env python
+"""Per-frame overhead of the mux->batch->filter->unbatch->demux path
+vs a single stream, identity model, CPU: isolates the collect/batch
+machinery cost that config5 adds."""
+import os, sys, time
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+
+from nnstreamer_tpu import Pipeline
+from nnstreamer_tpu.backends.jax_backend import JaxModel
+from nnstreamer_tpu.elements.batch import TensorBatch, TensorUnbatch
+from nnstreamer_tpu.elements.demux import TensorDemux
+from nnstreamer_tpu.elements.filter import TensorFilter
+from nnstreamer_tpu.elements.mux import TensorMux
+from nnstreamer_tpu.elements.sink import TensorSink
+from nnstreamer_tpu.elements.testsrc import DataSrc
+from nnstreamer_tpu.spec import TensorSpec, TensorsSpec
+
+N = int(sys.argv[1]) if len(sys.argv) > 1 else 2000
+STREAMS = 4
+arr = np.zeros((16,), np.float32)
+
+ident1 = JaxModel(apply=lambda p, x: x,
+    input_spec=TensorsSpec.of(TensorSpec(dtype=np.float32, shape=(16,))))
+identB = JaxModel(apply=lambda p, x: x,
+    input_spec=TensorsSpec.of(TensorSpec(dtype=np.float32, shape=(STREAMS, 16))))
+
+def run_single(n):
+    state = {"count": 0, "t0": None}
+    def cb(frame):
+        if state["t0"] is None: state["t0"] = time.perf_counter()
+        state["count"] += 1
+    p = Pipeline()
+    p.add(DataSrc(name="s", data=[arr.copy() for _ in range(n)]))
+    p.add(TensorFilter(name="f", framework="jax", model=ident1))
+    p.add(TensorSink(name="o", callback=cb))
+    p.link_chain("s", "f", "o")
+    p.run(timeout=300)
+    return (state["count"] - 1) / (time.perf_counter() - state["t0"])
+
+def run_mux(n_per_stream):
+    state = {"count": 0, "t0": None}
+    def cb(frame):
+        if state["t0"] is None: state["t0"] = time.perf_counter()
+        state["count"] += 1
+    p = Pipeline()
+    mux = p.add(TensorMux(sync_mode="nosync"))
+    for i in range(STREAMS):
+        src = p.add(DataSrc(name=f"s{i}", data=[arr.copy() for _ in range(n_per_stream)]))
+        p.link(src, f"{mux.name}.sink_{i}")
+    batch = p.add(TensorBatch())
+    filt = p.add(TensorFilter(name="f", framework="jax", model=identB))
+    unb = p.add(TensorUnbatch())
+    demux = p.add(TensorDemux())
+    p.link_chain(mux, batch, filt, unb, demux)
+    for i in range(STREAMS):
+        p.link(f"{demux.name}.src_{i}", p.add(TensorSink(name=f"o{i}", callback=cb)))
+    p.run(timeout=300)
+    return (state["count"] - STREAMS) / (time.perf_counter() - state["t0"])
+
+run_single(50); run_mux(20)  # warm
+fps1 = run_single(N)
+print(f"single stream:  {1e6/fps1:8.1f} us/frame ({fps1:9.0f}/s)")
+fpsM = run_mux(N // STREAMS)
+print(f"mux x{STREAMS} batched: {1e6/fpsM:8.1f} us/frame ({fpsM:9.0f}/s aggregate)")
+print(f"per-batched-invoke overhead: {STREAMS*1e6/fpsM:8.1f} us")

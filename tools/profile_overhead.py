@@ -1,0 +1,62 @@
+#!/usr/bin/env python
+"""Framework-overhead microbench: identity model, CPU, tiny tensors.
+
+Removes compute + transfer from the picture: what's left is the per-frame
+cost of the graph runtime (pads, locks, frames, invoke plumbing).
+Run under JAX_PLATFORMS=cpu.
+"""
+import os, sys, time
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+
+from nnstreamer_tpu import Pipeline
+from nnstreamer_tpu.backends.jax_backend import JaxModel
+from nnstreamer_tpu.elements.filter import TensorFilter
+from nnstreamer_tpu.elements.sink import TensorSink
+from nnstreamer_tpu.elements.testsrc import DataSrc
+from nnstreamer_tpu.elements.transform import TensorTransform
+from nnstreamer_tpu.spec import TensorSpec, TensorsSpec
+
+N = int(sys.argv[1]) if len(sys.argv) > 1 else 5000
+arr = np.zeros((16,), np.float32)
+frames = [arr.copy() for _ in range(N)]
+
+model = JaxModel(
+    apply=lambda p, x: x,
+    input_spec=TensorsSpec.of(TensorSpec(dtype=np.float32, shape=(16,))),
+)
+
+def run(with_transform=False, profile=False):
+    state = {"count": 0, "t0": None}
+    def cb(frame):
+        if state["t0"] is None: state["t0"] = time.perf_counter()
+        state["count"] += 1
+    p = Pipeline()
+    src = p.add(DataSrc(data=frames))
+    chain = [src]
+    if with_transform:
+        chain.append(p.add(TensorTransform(mode="arithmetic", option="add:0.0")))
+    chain.append(p.add(TensorFilter(framework="jax", model=model)))
+    chain.append(p.add(TensorSink(callback=cb)))
+    p.link_chain(*chain)
+    if profile:
+        import cProfile, pstats, io
+        pr = cProfile.Profile(); pr.enable()
+    p.run(timeout=300)
+    if profile:
+        pr.disable()
+        s = io.StringIO()
+        pstats.Stats(pr, stream=s).sort_stats("tottime").print_stats(25)
+        print(s.getvalue())
+    dt = time.perf_counter() - state["t0"]
+    return (state["count"] - 1) / dt
+
+run(False)  # warm compile
+fps = run(False)
+print(f"src->filter->sink:            {1e6/fps:8.1f} us/frame ({fps:9.0f}/s)")
+fps = run(True)
+print(f"src->transform->filter->sink: {1e6/fps:8.1f} us/frame ({fps:9.0f}/s)")
+if os.environ.get("PROFILE"):
+    run(False, profile=True)
