@@ -47,6 +47,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from ..buffer import WireTensor
 from ..spec import TensorSpec, TensorsSpec
 from .base import FilterBackend, register_backend
 
@@ -443,7 +444,27 @@ class JaxBackend(FilterBackend):
                 self._drift_hook(drifted)
             else:
                 self.reconfigure(drifted)
-        if self._flat_compiled is not None and not any(
+        if tensors and isinstance(tensors[0], WireTensor):
+            # tensor_upload already moved the bytes (wire layout, upstream
+            # thread): dispatch-only here — the transfer/dispatch overlap
+            # that SURVEY §7(b) asks for.  The upload stage derives its
+            # layout from OUR _wire_shape rule; if the payload nevertheless
+            # mismatches (re-linked graph, foreign producer), materialize
+            # the logical arrays and take the normal host path instead of
+            # dispatching garbage geometry.
+            expected = self._wire_shapes or tuple(
+                tuple(t.shape) for t in self._in_spec.tensors
+            )
+            xs = tuple(t.data if isinstance(t, WireTensor) else t for t in tensors)
+            if all(tuple(x.shape) == tuple(w) for x, w in zip(xs, expected)):
+                out = (
+                    self._flat_compiled(*xs)
+                    if self._flat_compiled is not None
+                    else self._compiled(*xs)
+                )
+            else:
+                return self.invoke(tuple(np.asarray(t) for t in tensors))
+        elif self._flat_compiled is not None and not any(
             isinstance(t, jax.Array) for t in tensors
         ):
             # host frames cross the wire flat (1-D view — no copy for

@@ -80,6 +80,43 @@ class Frame:
         return f"Frame[{shapes} pts={self.pts}]"
 
 
+class WireTensor:
+    """A device-resident payload in **wire layout** (flat 1-D) that still
+    presents its logical ``shape``/``dtype`` to the graph.
+
+    Produced by ``tensor_upload``: the host→device transfer of a rank ≥ 2
+    frame is cheapest flat (no tiled-layout padding — see
+    ``backends/jax_backend.py``), but the graph's spec/signature checks and
+    any host consumer need the logical geometry.  A jax filter recognizes
+    the wrapper and feeds ``data`` straight to its flat wire entry; any
+    other consumer's ``np.asarray`` materializes the logical array.
+    """
+
+    __slots__ = ("data", "shape", "dtype")
+
+    def __init__(self, data, shape: Tuple[int, ...], dtype):
+        self.data = data  # jax Array, flat wire layout
+        self.shape = tuple(shape)
+        self.dtype = np.dtype(dtype)
+
+    def __array__(self, dtype=None, copy=None):
+        arr = np.asarray(self.data).reshape(self.shape)
+        if dtype is not None and np.dtype(dtype) != arr.dtype:
+            return arr.astype(dtype)
+        return arr
+
+    def block_until_ready(self):
+        self.data.block_until_ready()
+        return self
+
+    @property
+    def nbytes(self) -> int:
+        return self.data.nbytes
+
+    def __repr__(self) -> str:
+        return f"WireTensor({self.dtype}{self.shape})"
+
+
 @dataclasses.dataclass
 class Event:
     """In-band stream events (the analog of GstEvent): EOS, stream-start,

@@ -107,8 +107,22 @@ class TensorUnbatch(Node):
 
     def process(self, pad: Pad, frame: Frame):
         del pad
+        from ..buffer import WireTensor
+
         batched = frame.tensors[0]
-        if hasattr(batched, "copy_to_host_async"):  # jax Array
+        if isinstance(batched, WireTensor):
+            if self._to_host:
+                # wire-layout payload, host consumers: one d2h materialize
+                import numpy as np
+
+                batched = np.asarray(batched)
+            else:
+                # device consumers: restore logical geometry ON DEVICE
+                # (cheap reshape) and split there — never a host round trip
+                return frame.with_tensors(
+                    self._device_split(batched.data.reshape(batched.shape))
+                )
+        elif hasattr(batched, "copy_to_host_async"):  # jax Array
             if self._to_host:
                 import numpy as np
 

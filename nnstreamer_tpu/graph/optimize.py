@@ -42,6 +42,20 @@ def _is_fusable_filter(node: Node) -> bool:
     return isinstance(node, TensorFilter) and isinstance(node.backend, JaxBackend)
 
 
+def _hop_transparent(pad, direction: str):
+    """Walk past spec-transparent 1-in/1-out plumbing (queue, tensor_upload)
+    so transforms separated from the filter only by thread/wire boundaries
+    still fuse: ``transform → upload → queue → filter`` compiles to one XLA
+    program fed raw wire bytes.  (Deliberately narrower than the residency
+    walk's passthrough set: hopping tee/mux/demux would move a transform
+    across a fan point and change other branches' streams.)"""
+    from ..elements.queue import Queue
+    from ..elements.upload import TensorUpload
+    from .residency import hop_plumbing
+
+    return hop_plumbing(pad, direction, (Queue, TensorUpload))
+
+
 def _splice_out(pipeline: Pipeline, node: Node):
     """Remove a 1-in/1-out node, reconnecting its neighbors.  Returns an
     undo closure restoring the original topology."""
@@ -82,7 +96,7 @@ def fuse_transforms(pipeline: Pipeline) -> List:
         # upstream chain (immediately preceding transforms, nearest last)
         pre: List[Node] = []
         while True:
-            peer = filt.sink_pads["sink"].peer
+            peer = _hop_transparent(filt.sink_pads["sink"].peer, "up")
             if peer is None or not _is_fusable_transform(peer.node):
                 break
             tr = peer.node
@@ -90,7 +104,7 @@ def fuse_transforms(pipeline: Pipeline) -> List:
             pre.insert(0, tr)
         post: List[Node] = []
         while True:
-            peer = filt.src_pads["src"].peer
+            peer = _hop_transparent(filt.src_pads["src"].peer, "down")
             if peer is None or not _is_fusable_transform(peer.node):
                 break
             tr = peer.node

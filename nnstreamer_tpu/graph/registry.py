@@ -87,6 +87,7 @@ for _el, _mod in {
     "tensor_src_iio": "nnstreamer_tpu.elements.iio_src",
     "tensor_batch": "nnstreamer_tpu.elements.batch",
     "tensor_unbatch": "nnstreamer_tpu.elements.batch",
+    "tensor_upload": "nnstreamer_tpu.elements.upload",
     # runtime/plumbing elements (GStreamer-provided in the reference)
     "queue": "nnstreamer_tpu.elements.queue",
     "tee": "nnstreamer_tpu.elements.tee",

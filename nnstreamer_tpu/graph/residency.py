@@ -25,32 +25,48 @@ def _passthrough_types():
     from ..elements.mux import TensorMux
     from ..elements.queue import Queue
     from ..elements.tee import Tee
+    from ..elements.upload import TensorUpload
 
-    return (Queue, Tee, TensorBatch, TensorUnbatch, TensorDemux, TensorMux)
+    return (Queue, Tee, TensorBatch, TensorUnbatch, TensorDemux, TensorMux,
+            TensorUpload)
+
+
+def hop_plumbing(pad, direction: str, transparent, max_hops: int = 4):
+    """Follow a chain of 1-in/1-out nodes of the given ``transparent`` types
+    starting at ``pad`` (a peer pad); returns the first pad whose node is
+    not transparent (or None when the chain ends/branches).  The single
+    graph-walk primitive behind residency detection, fusion hopping, and
+    the upload element's wire-rule discovery — one place to update when a
+    new spec-transparent element is added."""
+    up = direction == "up"
+    hops = 0
+    while pad is not None and isinstance(pad.node, transparent) and hops < max_hops:
+        node = pad.node
+        pads = node.sink_pads if up else node.src_pads
+        if len(pads) != 1:
+            break
+        pad = next(iter(pads.values())).peer
+        hops += 1
+    return pad
 
 
 def chain_device_resident(node: Node, direction: str, max_hops: int = 4) -> bool:
     """Walk the up- or downstream chain a few hops from ``node``: a
     device_resident filter with only residency-*preserving* elements between
     means frames on that side are jax Arrays.  Only elements that pass
-    tensor payloads through untouched qualify (queue/tee/batch/unbatch/
-    demux/mux); anything else (converter, host transforms, decoders, sinks)
-    emits or consumes host numpy and stops the walk."""
-    passthrough = _passthrough_types()
+    device payloads through untouched qualify (queue/tee/batch/unbatch/
+    demux/mux/upload); anything else (converter, host transforms, decoders,
+    sinks) emits or consumes host numpy and stops the walk."""
     up = direction == "up"
     pads = node.sink_pads if up else node.src_pads
     if len(pads) != 1:
         return False
-    pad = next(iter(pads.values())).peer
-    for _ in range(max_hops):
-        if pad is None:
-            return False
-        cur = pad.node
-        backend = getattr(cur, "backend", None)
-        if backend is not None:
-            return bool(getattr(backend, "device_resident", False))
-        nxt = cur.sink_pads if up else cur.src_pads
-        if not isinstance(cur, passthrough) or len(nxt) != 1:
-            return False
-        pad = next(iter(nxt.values())).peer
-    return False
+    pad = hop_plumbing(
+        next(iter(pads.values())).peer, direction, _passthrough_types(), max_hops
+    )
+    if pad is None:
+        return False
+    backend = getattr(pad.node, "backend", None)
+    if backend is None:
+        return False
+    return bool(getattr(backend, "device_resident", False))

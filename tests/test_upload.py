@@ -1,0 +1,208 @@
+"""tensor_upload: the transfer/dispatch overlap stage (SURVEY §7 hard part
+(b) "prefetch, donated buffers"; round-2 verdict weak #2).
+
+Checks: wire-layout WireTensor semantics, end-to-end equivalence with the
+plain path, transform fusion hopping over upload/queue plumbing, and host
+consumers downstream of an un-filtered upload.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from nnstreamer_tpu import Pipeline, parse_launch
+from nnstreamer_tpu.backends.jax_backend import JaxModel
+from nnstreamer_tpu.buffer import Frame, WireTensor
+from nnstreamer_tpu.elements.filter import TensorFilter
+from nnstreamer_tpu.elements.queue import Queue
+from nnstreamer_tpu.elements.sink import TensorSink
+from nnstreamer_tpu.elements.testsrc import DataSrc
+from nnstreamer_tpu.elements.transform import TensorTransform
+from nnstreamer_tpu.elements.upload import TensorUpload
+from nnstreamer_tpu.spec import TensorSpec, TensorsSpec
+
+
+class TestWireTensor:
+    def test_logical_shape_dtype_and_asarray(self):
+        arr = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+        wt = WireTensor(jax.device_put(arr.reshape(-1)), arr.shape, arr.dtype)
+        assert wt.shape == (2, 3, 4)
+        assert wt.dtype == np.float32
+        np.testing.assert_array_equal(np.asarray(wt), arr)
+
+    def test_spec_derivation_sees_logical_geometry(self):
+        arr = np.zeros((4, 5), np.int16)
+        wt = WireTensor(jax.device_put(arr.reshape(-1)), arr.shape, arr.dtype)
+        spec = TensorsSpec.from_arrays((wt,))
+        assert spec.tensors[0].shape == (4, 5)
+        assert spec.tensors[0].dtype == np.int16
+
+
+class TestUploadElement:
+    def _model(self, shape=(4, 6)):
+        w = np.arange(np.prod(shape), dtype=np.float32).reshape(-1, 1)
+
+        def apply(params, x):
+            return x.reshape(-1) @ params
+
+        return JaxModel(
+            apply=apply, params=jax.device_put(w),
+            input_spec=TensorsSpec.of(TensorSpec(dtype=np.float32, shape=shape)),
+        ), w
+
+    def test_upload_filter_matches_plain_path(self, rng):
+        model, w = self._model()
+        frames = [rng.standard_normal((4, 6)).astype(np.float32) for _ in range(6)]
+
+        def run(upload):
+            got = []
+            p = Pipeline()
+            src = p.add(DataSrc(data=[f.copy() for f in frames]))
+            chain = [src]
+            if upload:
+                chain.append(p.add(TensorUpload()))
+                chain.append(p.add(Queue(max_size_buffers=8)))
+            chain.append(p.add(TensorFilter(framework="jax", model=model)))
+            sink = p.add(TensorSink())
+            sink.connect("new-data", lambda f: got.append(np.asarray(f.tensor(0))))
+            chain.append(sink)
+            p.link_chain(*chain)
+            p.run(timeout=120)
+            return got
+
+        plain, uploaded = run(False), run(True)
+        assert len(plain) == len(uploaded) == 6
+        for a, b in zip(plain, uploaded):
+            np.testing.assert_allclose(a, b, rtol=1e-6)
+
+    def test_fusion_hops_over_upload_and_queue(self, rng):
+        """transform → upload → queue → filter still compiles fused: the
+        transform splices out and the filter consumes raw wire bytes."""
+        model, w = self._model()
+        frames = [rng.integers(0, 255, (4, 6)).astype(np.uint8) for _ in range(4)]
+        got = []
+        p = Pipeline()
+        src = p.add(DataSrc(data=[f.copy() for f in frames]))
+        tr = p.add(TensorTransform(mode="arithmetic",
+                                   option="typecast:float32,div:255.0"))
+        up = p.add(TensorUpload())
+        q = p.add(Queue(max_size_buffers=8))
+        filt = p.add(TensorFilter(framework="jax", model=model))
+        sink = p.add(TensorSink())
+        sink.connect("new-data", lambda f: got.append(np.asarray(f.tensor(0))))
+        p.link_chain(src, tr, up, q, filt, sink)
+        p.run(timeout=120)
+        assert filt._fused_pre, "transform did not fuse across upload/queue"
+        assert len(got) == 4
+        golden = (frames[0].astype(np.float32) / 255.0).reshape(-1) @ w
+        np.testing.assert_allclose(got[0], golden, rtol=1e-5, atol=1e-6)
+
+    def test_host_consumer_after_upload(self):
+        """A non-filter consumer (sink) still sees logical arrays."""
+        frames = [np.full((3, 2), i, np.float32) for i in range(3)]
+        got = []
+        p = Pipeline()
+        src = p.add(DataSrc(data=frames))
+        up = p.add(TensorUpload())
+        sink = p.add(TensorSink())
+        sink.connect("new-data", lambda f: got.append(np.asarray(f.tensor(0))))
+        p.link_chain(src, up, sink)
+        p.run(timeout=60)
+        assert len(got) == 3
+        assert got[1].shape == (3, 2)
+        np.testing.assert_array_equal(got[1], np.full((3, 2), 1, np.float32))
+
+    def test_parse_launch_spelling(self, rng):
+        model, w = self._model()
+        got = []
+        p = parse_launch(
+            "datasrc name=s ! tensor_upload ! queue ! "
+            "tensor_filter framework=jax name=f ! tensor_sink name=out"
+        )
+        p["s"].data = [rng.standard_normal((4, 6)).astype(np.float32)]
+        p["f"].model = model
+        p["out"].connect("new-data", lambda f: got.append(np.asarray(f.tensor(0))))
+        p.run(timeout=60)
+        assert len(got) == 1
+
+    def test_upload_feeds_sharded_backend_wire_rule(self, rng):
+        """upload -> queue -> jax-sharded: the upload stage must use the
+        SHARDED wire rule ((batch, rest), not fully-flat) so the batch dim
+        still shards over the mesh."""
+        w = rng.standard_normal((12, 3)).astype(np.float32)
+
+        def apply(params, x):  # (8, 2, 2, 3) -> (8, 3)
+            return x.reshape(x.shape[0], -1) @ params
+
+        model = JaxModel(
+            apply=apply, params=jax.device_put(w),
+            input_spec=TensorsSpec.of(
+                TensorSpec(dtype=np.float32, shape=(8, 2, 2, 3))
+            ),
+        )
+        frames = [rng.standard_normal((8, 2, 2, 3)).astype(np.float32)
+                  for _ in range(3)]
+        got = []
+        p = Pipeline()
+        src = p.add(DataSrc(data=[f.copy() for f in frames]))
+        up = p.add(TensorUpload())
+        q = p.add(Queue(max_size_buffers=4))
+        filt = p.add(TensorFilter(framework="jax-sharded", model=model,
+                                  custom="devices=8,axis=dp"))
+        sink = p.add(TensorSink())
+        sink.connect("new-data", lambda f: got.append(f.tensor(0)))
+        p.link_chain(src, up, q, filt, sink)
+        p.run(timeout=120)
+        assert len(got) == 3
+        assert len(got[-1].sharding.device_set) == 8  # batch stayed sharded
+        np.testing.assert_allclose(
+            np.asarray(got[0]), frames[0].reshape(8, -1) @ w, rtol=1e-5,
+            atol=1e-5,
+        )
+
+    def test_upload_into_unbatch_materializes(self, rng):
+        """upload -> unbatch (no filter): unbatch must materialize the
+        wire payload instead of crashing on WireTensor."""
+        from nnstreamer_tpu.elements.batch import TensorUnbatch
+
+        frames = [rng.standard_normal((3, 4)).astype(np.float32)]
+        got = []
+        p = Pipeline()
+        src = p.add(DataSrc(data=[f.copy() for f in frames]))
+        up = p.add(TensorUpload())
+        unb = p.add(TensorUnbatch())
+        sink = p.add(TensorSink())
+        sink.connect("new-data", lambda f: got.append(f))
+        p.link_chain(src, up, unb, sink)
+        p.run(timeout=60)
+        assert len(got) == 1 and got[0].num_tensors == 3
+        np.testing.assert_array_equal(np.asarray(got[0].tensor(2)), frames[0][2])
+
+    def test_upload_between_filters_keeps_residency(self, rng):
+        """filter1 -> upload -> queue -> filter2: upload passes device
+        arrays through untouched, so filter1 must NOT start host copies
+        (residency walk treats upload as passthrough)."""
+        m1 = JaxModel(
+            apply=lambda p, x: x * 2.0,
+            input_spec=TensorsSpec.of(TensorSpec(dtype=np.float32, shape=(4, 6))),
+        )
+        m2 = JaxModel(
+            apply=lambda p, x: x + 1.0,
+            input_spec=TensorsSpec.of(TensorSpec(dtype=np.float32, shape=(4, 6))),
+        )
+        got = []
+        x = rng.standard_normal((4, 6)).astype(np.float32)
+        p = Pipeline()
+        src = p.add(DataSrc(data=[x.copy()]))
+        f1 = p.add(TensorFilter(framework="jax", model=m1))
+        up = p.add(TensorUpload())
+        q = p.add(Queue(max_size_buffers=4))
+        f2 = p.add(TensorFilter(framework="jax", model=m2))
+        sink = p.add(TensorSink())
+        sink.connect("new-data", lambda f: got.append(f.tensor(0)))
+        p.link_chain(src, f1, up, q, f2, sink)
+        p.run(timeout=120)
+        assert f1._downstream_host is False
+        assert len(got) == 1 and isinstance(got[0], jax.Array)
+        np.testing.assert_allclose(np.asarray(got[0]), x * 2.0 + 1.0, rtol=1e-6)
