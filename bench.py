@@ -56,8 +56,12 @@ def probe_accelerator():
 
     A subprocess (not a thread) because a wedged PJRT client cannot be
     interrupted from Python — round 1 lost its whole bench to this.
+    BENCH_PROBE_RETRIES attempts with a pause between them ride out a
+    briefly-sick tunnel (seen round 3: wedges can last minutes to hours).
     """
-    for attempt in (1, 2):
+    retries = max(1, int(os.environ.get("BENCH_PROBE_RETRIES", "3")))
+    pause = float(os.environ.get("BENCH_PROBE_PAUSE_S", "30"))
+    for attempt in range(1, retries + 1):
         try:
             out = subprocess.run(
                 [sys.executable, "-c", _PROBE_SRC],
@@ -71,7 +75,34 @@ def probe_accelerator():
                 f"{out.stderr.strip()[-400:]}")
         except subprocess.TimeoutExpired:
             log(f"# probe attempt {attempt} timed out after {PROBE_TIMEOUT}s")
+        if attempt < retries:
+            time.sleep(pause)
     return None
+
+
+TPU_CACHE_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "BENCH_TPU_CACHE.json"
+)
+
+
+def save_tpu_cache(out: dict) -> None:
+    """Persist the last on-accelerator results: a later run that loses the
+    tunnel (wedges can outlast a whole round) still carries the most recent
+    real-chip evidence, clearly labeled as cached."""
+    try:
+        with open(TPU_CACHE_PATH, "w") as f:
+            json.dump({"cached_at": time.strftime("%Y-%m-%d %H:%M:%S"),
+                       "result": out}, f)
+    except Exception as exc:
+        log(f"# tpu-cache save failed: {exc!r}")
+
+
+def load_tpu_cache():
+    try:
+        with open(TPU_CACHE_PATH) as f:
+            return json.load(f)
+    except Exception:
+        return None
 
 
 def pin_cpu():
@@ -522,6 +553,30 @@ def measure_pallas():
         res["int8_matmul_ms"] = round(t_i8 * 1e3, 4)
         res["bf16_matmul_ms"] = round(t_bf * 1e3, 4)
         res["int8_matmul_speedup"] = round(t_bf / t_i8, 3)
+
+        # On-chip tile autotune (verdict weak: int8 under its ~2x headroom —
+        # the bound is weight HBM traffic, which halves vs bf16; the right
+        # tile split depends on the part, so search it on the hardware the
+        # bench runs on and report the best alongside the default).
+        best = None
+        for bm in (None, 128):
+            for bn in (128, 256, 512, 1024):
+                try:
+                    f = jax.jit(
+                        lambda q, s, bm=bm, bn=bn: int8_matmul(
+                            q, qw.q, s, qw.scale.reshape(1, -1), b,
+                            block_m=bm, block_n=bn,
+                        )
+                    )
+                    t = timeit(f, aq, ascale, n=30)
+                    if best is None or t < best[0]:
+                        best = (t, bm, bn)
+                except Exception:
+                    continue  # illegal tile for this part: skip
+        if best is not None:
+            res["int8_autotune_ms"] = round(best[0] * 1e3, 4)
+            res["int8_autotune_block"] = f"m={best[1]},n={best[2]}"
+            res["int8_autotune_speedup"] = round(t_bf / best[0], 3)
     except Exception as exc:
         res["int8_matmul_error"] = repr(exc)[:300]
     return res
@@ -873,6 +928,19 @@ def main():
         results["tflite_cpu_fps"] = round(cpu_fps, 2)
     vs_baseline = vs["config1"]
 
+    if platform in (None, "cpu"):
+        cached = load_tpu_cache()
+        if cached is not None:
+            # current run had no accelerator: carry the last real-chip
+            # numbers alongside (NOT replacing) this run's CPU measurements
+            # — added before write_notes so the evidence document shows it
+            results["last_accelerator_run"] = {
+                "cached_at": cached.get("cached_at"),
+                "value": (cached.get("result") or {}).get("value"),
+                "vs_baseline": (cached.get("result") or {}).get("vs_baseline"),
+                "platform": (cached.get("result") or {}).get("platform"),
+            }
+
     try:
         write_notes(results, platform, errors)
     except Exception as exc:
@@ -889,6 +957,8 @@ def main():
     }
     if errors:
         out["error"] = "; ".join(errors)
+    if platform not in (None, "cpu"):
+        save_tpu_cache(out)
     print(json.dumps(out))
 
 

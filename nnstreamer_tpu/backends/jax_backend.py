@@ -314,15 +314,25 @@ class JaxBackend(FilterBackend):
     def _spec_key(spec: TensorsSpec) -> tuple:
         return tuple((np.dtype(t.dtype).str, tuple(t.shape)) for t in spec.tensors)
 
-    def _wire_shape(self, shape: Tuple[int, ...]) -> Tuple[int, ...]:
+    @staticmethod
+    def _wire_shape(shape: Tuple[int, ...]) -> Tuple[int, ...]:
         """Host-wire shape for an input: rank ≥ 2 tensors flatten to 1-D so
-        the transfer skips tiled-layout padding; reshaped back on device."""
+        the transfer skips tiled-layout padding; reshaped back on device.
+        (Static: ``tensor_upload`` reuses this as its default wire rule.)"""
         if len(shape) < 2:
             return tuple(shape)
         n = 1
         for d in shape:
             n *= d
         return (n,)
+
+    def wire_input_sharding(self, idx: int = 0):
+        """Sharding a ``tensor_upload`` stage should device_put with (None
+        for the single-device backend; the sharded subclass returns the
+        mesh batch sharding so uploads land pre-distributed instead of
+        being re-scattered inside the jitted dispatch)."""
+        del idx
+        return None
 
     def _make_flat_entry(self, in_spec: TensorsSpec):
         """(fn over wire-shaped inputs, wire shapes), or (None, None) when
@@ -501,7 +511,8 @@ class JaxShardedBackend(JaxBackend):
         super().open(model, custom)
         self._custom = parse_custom(custom)
 
-    def _wire_shape(self, shape: Tuple[int, ...]) -> Tuple[int, ...]:
+    @staticmethod
+    def _wire_shape(shape: Tuple[int, ...]) -> Tuple[int, ...]:
         """Keep the (sharded) batch dim; flatten the rest, so the wire
         layout is still cheap and the batch still shards over the mesh."""
         if len(shape) < 3:
@@ -510,6 +521,18 @@ class JaxShardedBackend(JaxBackend):
         for d in shape[1:]:
             n *= d
         return (shape[0], n)
+
+    def wire_input_sharding(self, idx: int = 0):
+        if self._mesh is None or self._in_spec is None:
+            return None
+        from ..parallel.mesh import batch_sharding
+
+        axis = self._custom.get("axis", "dp")
+        if self._wire_shapes is not None and idx < len(self._wire_shapes):
+            rank = len(self._wire_shapes[idx])
+        else:
+            rank = len(self._in_spec.tensors[idx].shape)
+        return batch_sharding(self._mesh, rank, axis)
 
     def _jit(self, fn, wire: bool = False):
         from ..parallel.mesh import batch_sharding, make_mesh

@@ -109,9 +109,31 @@ class WireTensor:
         self.data.block_until_ready()
         return self
 
+    # minimal ndarray duck-typing so payload consumers that poke geometry
+    # or subscript directly (tensor_split, decoders) keep working; indexing
+    # materializes (host copy) — the jax filter fast path never calls these
+    @property
+    def ndim(self) -> int:
+        return len(self.shape)
+
+    @property
+    def size(self) -> int:
+        n = 1
+        for d in self.shape:
+            n *= d
+        return n
+
     @property
     def nbytes(self) -> int:
         return self.data.nbytes
+
+    def __len__(self) -> int:
+        if not self.shape:
+            raise TypeError("len() of unsized WireTensor")
+        return self.shape[0]
+
+    def __getitem__(self, key):
+        return self.__array__()[key]
 
     def __repr__(self) -> str:
         return f"WireTensor({self.dtype}{self.shape})"

@@ -47,27 +47,46 @@ class TensorUpload(Node):
         self.add_sink_pad("sink")
         self.add_src_pad("src")
         self._wire_shape = None  # downstream backend's wire rule
+        self._backend = None  # downstream backend (sharding queried lazily)
+        self._shardings = None  # per-tensor-index device_put shardings
 
-    def _downstream_wire_rule(self):
-        """The wire layout is the *consumer's* contract: the base jax
-        backend flattens rank ≥ 2 fully, the sharded backend keeps the
-        leading (batch) dim so the mesh sharding still applies.  Ask the
-        first filter downstream (hopping queue/upload plumbing) for its
-        rule; default to fully-flat."""
+    def _downstream_backend(self):
         from ..elements.queue import Queue
         from ..graph.residency import hop_plumbing
 
         pad = hop_plumbing(
             self.src_pads["src"].peer, "down", (Queue, TensorUpload)
         )
-        backend = getattr(pad.node, "backend", None) if pad is not None else None
-        rule = getattr(backend, "_wire_shape", None)
-        if callable(rule):
-            return rule
-        return lambda shape: (int(np.prod(shape)),) if len(shape) >= 2 else tuple(shape)
+        return getattr(pad.node, "backend", None) if pad is not None else None
+
+    def _downstream_wire_rule(self):
+        """The wire layout is the *consumer's* contract: the base jax
+        backend flattens rank ≥ 2 fully, the sharded backend keeps the
+        leading (batch) dim so the mesh sharding still applies.  Ask the
+        first filter downstream (hopping queue/upload plumbing) for its
+        rule; default to the base backend's."""
+        from ..backends.jax_backend import JaxBackend
+
+        self._backend = self._downstream_backend()
+        rule = getattr(self._backend, "_wire_shape", None)
+        return rule if callable(rule) else JaxBackend._wire_shape
+
+    def _sharding_for(self, idx: int):
+        """Mesh sharding for tensor ``idx`` (sharded consumers): resolved
+        lazily at first frame — the consumer compiles during negotiation
+        AFTER this node configures, so its mesh exists only by stream
+        time.  Uploading pre-sharded keeps the scatter off the dispatch
+        thread."""
+        if self._shardings is None:
+            self._shardings = {}
+        if idx not in self._shardings:
+            get = getattr(self._backend, "wire_input_sharding", None)
+            self._shardings[idx] = get(idx) if callable(get) else None
+        return self._shardings[idx]
 
     def configure(self, in_specs: Dict[str, TensorsSpec]) -> Dict[str, TensorsSpec]:
         self._wire_shape = self._downstream_wire_rule()
+        self._shardings = None
         return {"src": in_specs["sink"]}
 
     def process(self, pad: Pad, frame: Frame):
@@ -77,7 +96,7 @@ class TensorUpload(Node):
         if self._wire_shape is None:
             self._wire_shape = self._downstream_wire_rule()
         out = []
-        for t in frame.tensors:
+        for i, t in enumerate(frame.tensors):
             if isinstance(t, (jax.Array, WireTensor)):
                 out.append(t)  # already device-resident: nothing to move
                 continue
@@ -87,5 +106,11 @@ class TensorUpload(Node):
                 arr_w = np.ascontiguousarray(arr).reshape(wire)
             else:
                 arr_w = arr
-            out.append(WireTensor(jax.device_put(arr_w), arr.shape, arr.dtype))
+            sharding = self._sharding_for(i)
+            put = (
+                jax.device_put(arr_w, sharding)
+                if sharding is not None
+                else jax.device_put(arr_w)
+            )
+            out.append(WireTensor(put, arr.shape, arr.dtype))
         return frame.with_tensors(out)

@@ -156,6 +156,9 @@ class TestUploadElement:
         p.run(timeout=120)
         assert len(got) == 3
         assert len(got[-1].sharding.device_set) == 8  # batch stayed sharded
+        # the upload stage itself put frames PRE-sharded over the mesh (the
+        # scatter runs on the source thread, not inside the jitted dispatch)
+        assert up._shardings and len(up._shardings[0].mesh.devices.flat) == 8
         np.testing.assert_allclose(
             np.asarray(got[0]), frames[0].reshape(8, -1) @ w, rtol=1e-5,
             atol=1e-5,
@@ -206,3 +209,28 @@ class TestUploadElement:
         assert f1._downstream_host is False
         assert len(got) == 1 and isinstance(got[0], jax.Array)
         np.testing.assert_allclose(np.asarray(got[0]), x * 2.0 + 1.0, rtol=1e-6)
+
+
+    def test_split_after_upload_duck_typing(self, rng):
+        """Elements that poke geometry/subscript payloads directly
+        (tensor_split) must work on WireTensor (materializing views)."""
+        import nnstreamer_tpu as nns
+
+        frames = [rng.standard_normal((4, 6)).astype(np.float32)]
+        got = []
+        p = Pipeline()
+        src = p.add(DataSrc(data=[f.copy() for f in frames]))
+        up = p.add(TensorUpload())
+        split = p.add(nns.make("tensor_split", name="sp", tensorseg="6:2,6:2"))
+        sink0 = p.add(TensorSink(name="a"))
+        sink0.connect("new-data", lambda f: got.append(np.asarray(f.tensor(0))))
+        sink1 = p.add(TensorSink(name="b"))
+        sink1.connect("new-data", lambda f: got.append(np.asarray(f.tensor(0))))
+        p.link_chain(src, up, split)
+        p.link("sp.src_0", sink0)
+        p.link("sp.src_1", sink1)
+        p.run(timeout=60)
+        assert len(got) == 2
+        np.testing.assert_array_equal(
+            np.concatenate(got, axis=0).reshape(4, 6), frames[0]
+        )
