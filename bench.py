@@ -187,6 +187,72 @@ def run_pipeline_fps(framework, model, frames, warmup=3, normalize=True,
     return run(len(frames))
 
 
+def run_dynbatch_fps(frames, max_batch=8):
+    """Config #1d: adaptive micro-batching on ONE stream — datasrc →
+    tensor_dynbatch → jax filter (polymorphic batch, normalize fused in
+    the model fn) → tensor_dynunbatch → sink.  Frames that pile up behind
+    the device coalesce into bucketed batched invokes; transfer+dispatch
+    amortize over the pile-up automatically.
+
+    EVERY bucket executable is pre-compiled into the backend's LRU cache
+    and the warm backend is injected into the filter — which pile-ups
+    occur mid-run is timing-dependent, and an in-run XLA compile would
+    otherwise skew the measurement."""
+    import jax.numpy as jnp
+
+    from nnstreamer_tpu import Pipeline
+    from nnstreamer_tpu.backends.base import get_backend
+    from nnstreamer_tpu.backends.jax_backend import JaxModel
+    from nnstreamer_tpu.elements.dynbatch import DynBatch, DynUnbatch
+    from nnstreamer_tpu.elements.filter import TensorFilter
+    from nnstreamer_tpu.elements.sink import TensorSink
+    from nnstreamer_tpu.elements.testsrc import DataSrc
+    from nnstreamer_tpu.models import mobilenet_v2
+    from nnstreamer_tpu.spec import TensorSpec, TensorsSpec
+
+    base = mobilenet_v2.build(num_classes=1001, image_size=224)
+    poly = JaxModel(
+        apply=lambda p, x: base.apply(
+            base.params, (x.astype(jnp.float32) - 127.5) / 127.5
+        ),
+        input_spec=TensorsSpec.of(
+            TensorSpec(dtype=np.uint8, shape=(None, 224, 224, 3))
+        ),
+    )
+    backend = get_backend("jax")
+    backend.open(poly)
+    b = 1
+    while b <= max_batch:  # prime every bucket's executable (LRU-cached)
+        backend.reconfigure(TensorsSpec.of(
+            TensorSpec(dtype=np.uint8, shape=(b, 224, 224, 3))
+        ))
+        b <<= 1
+
+    state = {"first": None, "count": 0, "out": None, "batches": None}
+
+    def cb(frame):
+        state["count"] += 1
+        state["out"] = frame.tensors[0]
+        if state["first"] is None:
+            state["first"] = time.perf_counter()
+
+    p = Pipeline()
+    src = p.add(DataSrc(data=frames))
+    dyn = p.add(DynBatch(max_batch=max_batch))
+    filt = p.add(TensorFilter(framework="jax", backend=backend))
+    unb = p.add(DynUnbatch())
+    sink = p.add(TensorSink(callback=cb))
+    p.link_chain(src, dyn, filt, unb, sink)
+    p.run(timeout=600)
+    state["batches"] = dyn.batches_emitted
+    if state["first"] is None or state["count"] < 2:
+        raise RuntimeError(
+            f"dynbatch pipeline delivered {state['count']} frames"
+        )
+    fps = (state["count"] - 1) / (time.perf_counter() - state["first"])
+    return fps, state["batches"], len(frames)
+
+
 def run_mux_batched_fps(model, n_streams, frames_per_stream, image_u8,
                         framework="jax", custom="", accel=True):
     """Config #5: src×N → mux → batch → filter → unbatch → demux →
@@ -714,6 +780,22 @@ def main():
         log(f"# config1 upload-overlap fps: {u_fps:.2f}")
     except Exception as exc:
         errors.append(f"config1 upload leg: {exc!r}"[:400])
+        log(traceback.format_exc())
+
+    # -- config #1d: adaptive micro-batching (tensor_dynbatch) -------------
+    try:
+        n_d = int(os.environ.get("BENCH_DYNBATCH_FRAMES",
+                                 os.environ.get("BENCH_FRAMES", "400")))
+        d_fps, d_batches, d_frames = run_dynbatch_fps(
+            [image_u8.copy() for _ in range(n_d)]
+        )
+        results["config1_dynbatch_fps"] = round(d_fps, 2)
+        results["config1_dynbatch_invokes"] = d_batches
+        results["config1_dynbatch_frames"] = d_frames
+        log(f"# config1 dynbatch fps: {d_fps:.2f} "
+            f"({d_batches} invokes / {d_frames} frames)")
+    except Exception as exc:
+        errors.append(f"config1 dynbatch leg: {exc!r}"[:400])
         log(traceback.format_exc())
 
     # -- config #1q: uint8-quantized flagship (int8 weights, on-device

@@ -99,8 +99,14 @@ class TensorFilter(Node):
     def start(self) -> None:
         super().start()
         if not self._opened:
-            self.backend.open(self.model, self.custom)
-            self._opened = True
+            if self.model is None and getattr(self.backend, "model", None) is not None:
+                # injected pre-opened backend (model already loaded, possibly
+                # with pre-compiled executables in its cache): re-opening
+                # would discard that warm state
+                self._opened = True
+            else:
+                self.backend.open(self.model, self.custom)
+                self._opened = True
 
     def stop(self) -> None:
         if self._opened:

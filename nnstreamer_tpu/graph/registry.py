@@ -88,6 +88,8 @@ for _el, _mod in {
     "tensor_batch": "nnstreamer_tpu.elements.batch",
     "tensor_unbatch": "nnstreamer_tpu.elements.batch",
     "tensor_upload": "nnstreamer_tpu.elements.upload",
+    "tensor_dynbatch": "nnstreamer_tpu.elements.dynbatch",
+    "tensor_dynunbatch": "nnstreamer_tpu.elements.dynbatch",
     # runtime/plumbing elements (GStreamer-provided in the reference)
     "queue": "nnstreamer_tpu.elements.queue",
     "tee": "nnstreamer_tpu.elements.tee",

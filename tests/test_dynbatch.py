@@ -1,0 +1,197 @@
+"""tensor_dynbatch / tensor_dynunbatch: adaptive within-stream batching.
+
+The serving-framework dynamic-batching discipline: frames that queue up
+behind a slow consumer coalesce into one batched invoke (power-of-2
+buckets), while a fast consumer sees batch-1 latency.  Correctness is
+order + timing preservation and per-frame golden equality; coalescing is
+forced deterministically with a blockable backend.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu import Pipeline, parse_launch
+from nnstreamer_tpu.backends.base import FilterBackend
+from nnstreamer_tpu.backends.jax_backend import JaxModel
+from nnstreamer_tpu.buffer import Frame
+from nnstreamer_tpu.elements.dynbatch import DynBatch, DynUnbatch, _bucket
+from nnstreamer_tpu.elements.filter import TensorFilter
+from nnstreamer_tpu.elements.sink import TensorSink
+from nnstreamer_tpu.elements.testsrc import DataSrc
+from nnstreamer_tpu.spec import TensorSpec, TensorsSpec
+
+
+class BlockingDouble(FilterBackend):
+    """Doubles its (batch, d) input; the FIRST invoke blocks until
+    released — frames pile up behind it deterministically."""
+
+    def __init__(self, d=4):
+        self.d = d
+        self.release = threading.Event()
+        self.batch_sizes = []
+        self._first = True
+
+    def open(self, model, custom=""):
+        pass
+
+    def input_spec(self):
+        return TensorsSpec.of(TensorSpec(dtype=np.float32, shape=(None, self.d)))
+
+    def reconfigure(self, in_spec):
+        t = in_spec.tensors[0]
+        return TensorsSpec.of(TensorSpec(dtype=np.float32, shape=tuple(t.shape)))
+
+    def invoke(self, tensors):
+        if self._first:
+            self._first = False
+            assert self.release.wait(30), "test never released the backend"
+        x = np.asarray(tensors[0])
+        self.batch_sizes.append(x.shape[0])
+        return (x * 2.0,)
+
+
+def test_bucket_rounding():
+    assert [_bucket(n, 8) for n in (1, 2, 3, 4, 5, 8, 9)] == [1, 2, 4, 4, 8, 8, 8]
+    assert _bucket(7, 4) == 4
+
+
+class TestDynBatchPipeline:
+    def _run(self, n_frames, max_batch, release_after=0.5):
+        be = BlockingDouble()
+        frames = [
+            Frame.of(np.full((4,), i, np.float32), pts=i * 100, duration=100)
+            for i in range(n_frames)
+        ]
+        got = []
+        p = Pipeline()
+        src = p.add(DataSrc(data=frames))
+        dyn = p.add(DynBatch(max_batch=max_batch))
+        filt = p.add(TensorFilter(framework="custom-dyn", backend=be))
+        unb = p.add(DynUnbatch())
+        sink = p.add(TensorSink())
+        sink.connect("new-data", lambda f: got.append(f))
+        p.link_chain(src, dyn, filt, unb, sink)
+        p.start()
+        releaser = threading.Timer(release_after, be.release.set)
+        releaser.start()
+        try:
+            assert p.wait(60)
+        finally:
+            releaser.cancel()
+            be.release.set()
+            p.stop()
+        return be, dyn, got
+
+    def test_coalesces_under_backpressure(self):
+        be, dyn, got = self._run(n_frames=9, max_batch=8)
+        # every frame came out once, in order, doubled, timing preserved
+        assert len(got) == 9
+        for i, f in enumerate(got):
+            np.testing.assert_allclose(np.asarray(f.tensor(0)), 2.0 * i)
+            assert f.pts == i * 100 and f.duration == 100
+        # the pile-up coalesced: strictly fewer invokes than frames, and
+        # at least one invoke carried a real batch
+        assert dyn.batches_emitted < dyn.frames_in == 9
+        assert max(be.batch_sizes) > 1
+        # buckets are powers of two bounded by max_batch
+        assert all(b in (1, 2, 4, 8) for b in be.batch_sizes)
+
+    def test_no_reorder_no_loss_across_buckets(self):
+        be, dyn, got = self._run(n_frames=23, max_batch=4)
+        assert [int(np.asarray(f.tensor(0))[0]) // 2 for f in got] == list(range(23))
+        assert all(b in (1, 2, 4) for b in be.batch_sizes)
+
+    def test_unblocked_stream_is_batch1_and_exact(self):
+        """Fast consumer: results identical, each frame exact."""
+        be = BlockingDouble()
+        be.release.set()
+        be._first = False
+        frames = [Frame.of(np.full((4,), i, np.float32), pts=i) for i in range(6)]
+        got = []
+        p = Pipeline()
+        src = p.add(DataSrc(data=frames))
+        dyn = p.add(DynBatch(max_batch=8))
+        filt = p.add(TensorFilter(framework="custom-dyn2", backend=be))
+        unb = p.add(DynUnbatch())
+        sink = p.add(TensorSink())
+        sink.connect("new-data", lambda f: got.append(np.asarray(f.tensor(0))))
+        p.link_chain(src, dyn, filt, unb, sink)
+        p.run(timeout=60)
+        assert len(got) == 6
+        for i, a in enumerate(got):
+            np.testing.assert_allclose(a, 2.0 * i)
+
+    def test_jax_filter_polymorphic_batch(self):
+        """The jax backend handles bucket flips via its drift/LRU path."""
+        model = JaxModel(
+            apply=lambda p, x: x * 3.0,
+            input_spec=TensorsSpec.of(
+                TensorSpec(dtype=np.float32, shape=(None, 4))
+            ),
+        )
+        frames = [Frame.of(np.full((4,), i, np.float32), pts=i) for i in range(12)]
+        got = []
+        p = Pipeline()
+        src = p.add(DataSrc(data=frames))
+        dyn = p.add(DynBatch(max_batch=4))
+        filt = p.add(TensorFilter(framework="jax", model=model))
+        unb = p.add(DynUnbatch())
+        sink = p.add(TensorSink())
+        sink.connect("new-data", lambda f: got.append(np.asarray(f.tensor(0))))
+        p.link_chain(src, dyn, filt, unb, sink)
+        p.run(timeout=120)
+        assert len(got) == 12
+        for i, a in enumerate(got):
+            np.testing.assert_allclose(a, 3.0 * i, rtol=1e-6)
+
+    def test_parse_launch_spelling(self):
+        model = JaxModel(
+            apply=lambda p, x: x + 1.0,
+            input_spec=TensorsSpec.of(
+                TensorSpec(dtype=np.float32, shape=(None, 3))
+            ),
+        )
+        got = []
+        p = parse_launch(
+            "datasrc name=s ! tensor_dynbatch max_batch=4 ! "
+            "tensor_filter framework=jax name=f ! tensor_dynunbatch ! "
+            "tensor_sink name=out"
+        )
+        p["s"].data = [np.full((3,), i, np.float32) for i in range(5)]
+        p["f"].model = model
+        p["out"].connect("new-data", lambda f: got.append(np.asarray(f.tensor(0))))
+        p.run(timeout=60)
+        assert len(got) == 5
+        np.testing.assert_allclose(got[4], 5.0)
+
+    def test_midstream_renegotiation_through_dynbatch(self):
+        """A mid-stream per-frame shape change must renegotiate the BATCHED
+        spec downstream (caps handled on the worker, like queue)."""
+        model = JaxModel(
+            apply=lambda p, x: x.reshape(x.shape[0], -1).sum(axis=1),
+        )
+        a = [Frame.of(np.full((4,), i, np.float32), pts=i) for i in range(3)]
+        b = [Frame.of(np.full((2, 3), 10.0 + i, np.float32), pts=3 + i)
+             for i in range(3)]
+        got = []
+        p = Pipeline()
+        src = p.add(DataSrc(data=a + b))
+        dyn = p.add(DynBatch(max_batch=4))
+        filt = p.add(TensorFilter(framework="jax", model=model))
+        unb = p.add(DynUnbatch())
+        sink = p.add(TensorSink())
+        sink.connect("new-data", lambda f: got.append(np.asarray(f.tensor(0))))
+        p.link_chain(src, dyn, filt, unb, sink)
+        p.run(timeout=120)
+        assert len(got) == 6
+        for i in range(3):
+            np.testing.assert_allclose(got[i], 4.0 * i)          # sum of (4,)
+        for i in range(3):
+            np.testing.assert_allclose(got[3 + i], 6 * (10.0 + i))  # sum of (2,3)
+
+    def test_non_power_of_two_max_batch_rejected(self):
+        with pytest.raises(ValueError, match="power of two"):
+            DynBatch(max_batch=6)

@@ -136,6 +136,7 @@ class TestCodegen:
         ("object_detection.py", "golden=OK"),
         ("pose_estimation.py", "golden=OK"),
         ("fused_detection.py", "golden=OK"),
+        ("parallel_inference.py", "sp-ring: 2 frames"),
     ],
 )
 def test_pipeline_demo_runs(script, expect):
