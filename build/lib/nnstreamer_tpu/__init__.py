@@ -1,0 +1,37 @@
+"""nnstreamer_tpu: a TPU-native streaming inference framework.
+
+Re-designed from scratch with the capability set of NNStreamer (GStreamer
+neural-network plugins; see SURVEY.md): typed tensor streams with negotiated
+specs, a pipeline graph of converters / transforms / filters / decoders with
+fan-in/out, time sync, windowing and recurrence, pluggable model backends
+(XLA-compiled JAX models first-class), and a two-level application API
+(pipeline + single-shot).
+"""
+
+from .buffer import EOS, Event, Frame, NONE_TS, SECOND  # noqa: F401
+from .conf import Conf, conf  # noqa: F401
+from .graph import (  # noqa: F401
+    NegotiationError,
+    Node,
+    Pipeline,
+    PipelineError,
+    SourceNode,
+    StreamError,
+    known_elements,
+    make,
+    parse_launch,
+    register_element,
+)
+from .media import AudioSpec, OctetSpec, TextSpec, VideoSpec  # noqa: F401
+from .spec import (  # noqa: F401
+    ANY,
+    NNS_TENSOR_RANK_LIMIT,
+    NNS_TENSOR_SIZE_LIMIT,
+    TensorSpec,
+    TensorsSpec,
+    dtype_from_name,
+    dtype_name,
+    spec_of,
+)
+
+__version__ = "0.1.0"

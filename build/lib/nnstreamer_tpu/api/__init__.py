@@ -1,0 +1,2 @@
+from .pipeline_api import PipelineHandle  # noqa: F401
+from .single import InvokeTimeout, SingleShot  # noqa: F401

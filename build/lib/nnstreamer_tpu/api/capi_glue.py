@@ -1,0 +1,156 @@
+"""Marshaling glue for the native C API (``nnstreamer_tpu/native/capi``).
+
+The C library (the analog of the reference's ``api/capi`` layer —
+``nnstreamer-capi-single.c`` / ``nnstreamer-capi-pipeline.c``) embeds
+CPython and calls only the functions in this module, using nothing but
+simple types at the boundary: tensors travel as ``(bytes, dtype_name,
+shape_tuple)`` triples, exactly one copy each way (the reference's C API
+also copies at the app boundary, ``nnstreamer-capi-util.c``
+``ml_tensors_data_create``).
+
+Keeping all object manipulation on the Python side keeps the C side free
+of CPython object-protocol detail beyond calling these entry points.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..spec import TensorSpec, TensorsSpec, dtype_from_name
+from .pipeline_api import PipelineHandle
+from .single import SingleShot
+
+Wire = Tuple[bytes, str, Tuple[int, ...]]
+
+
+def _to_arrays(inputs: Sequence[Wire]) -> Tuple[np.ndarray, ...]:
+    return tuple(
+        np.frombuffer(buf, dtype=dtype_from_name(dtype)).reshape(shape).copy()
+        for buf, dtype, shape in inputs
+    )
+
+
+def _to_wire(tensors: Sequence) -> List[Wire]:
+    out = []
+    for t in tensors:
+        a = np.asarray(t)
+        out.append((a.tobytes(), a.dtype.name, tuple(int(d) for d in a.shape)))
+    return out
+
+
+def _spec_to_wire(spec: Optional[TensorsSpec]) -> Optional[List[Tuple[str, Tuple[int, ...]]]]:
+    if spec is None:
+        return None
+    out = []
+    for t in spec.tensors:
+        dtype = np.dtype(t.dtype).name if t.dtype is not None else ""
+        shape = tuple(int(d) if d is not None else 0 for d in (t.shape or ()))
+        out.append((dtype, shape))
+    return out
+
+
+def _spec_from_wire(info: Sequence[Tuple[str, Sequence[int]]]) -> TensorsSpec:
+    return TensorsSpec(
+        tensors=tuple(
+            TensorSpec(dtype=dtype_from_name(dtype), shape=tuple(int(d) for d in shape))
+            for dtype, shape in info
+        )
+    )
+
+
+# -- single-shot (ml_single_*) ----------------------------------------------
+
+def single_open(framework: str, model: str, custom: str = "",
+                input_info: Optional[Sequence] = None) -> SingleShot:
+    spec = _spec_from_wire(input_info) if input_info else None
+    return SingleShot(framework=framework, model=model, custom=custom,
+                      input_spec=spec)
+
+
+def single_invoke(s: SingleShot, inputs: Sequence[Wire]) -> List[Wire]:
+    return _to_wire(s.invoke(*_to_arrays(inputs)))
+
+
+def single_input_info(s: SingleShot):
+    return _spec_to_wire(s.input_spec())
+
+
+def single_output_info(s: SingleShot):
+    return _spec_to_wire(s.output_spec())
+
+
+def single_set_timeout(s: SingleShot, ms: int) -> None:
+    s.set_timeout(ms / 1000.0 if ms > 0 else None)
+
+
+def single_set_input_info(s: SingleShot, info: Sequence) -> None:
+    s.set_input_spec(_spec_from_wire(info))
+
+
+def single_close(s: SingleShot) -> None:
+    s.close()
+
+
+# -- pipeline (ml_pipeline_*) ------------------------------------------------
+
+def pipeline_construct(description: str) -> PipelineHandle:
+    return PipelineHandle.construct(description)
+
+
+def pipeline_start(h: PipelineHandle) -> None:
+    h.start()
+
+
+def pipeline_stop(h: PipelineHandle) -> None:
+    h.stop()
+
+
+def pipeline_destroy(h: PipelineHandle) -> None:
+    h.destroy()
+
+
+def pipeline_get_state(h: PipelineHandle) -> str:
+    return h.get_state()
+
+
+def pipeline_wait(h: PipelineHandle, timeout_ms: int) -> bool:
+    return h.wait(timeout_ms / 1000.0 if timeout_ms > 0 else None)
+
+
+def pipeline_sink_register(h: PipelineHandle, name: str,
+                           trampoline: Callable[[List[Wire]], None]) -> Callable:
+    """Register ``trampoline`` (a C-side callable taking the wire format)
+    on sink ``name``; returns the Python-side callback for unregister."""
+    def cb(frame):
+        trampoline(_to_wire(frame.tensors))
+    h.sink_register(name, cb)
+    return cb
+
+
+def pipeline_sink_unregister(h: PipelineHandle, name: str, cb: Callable) -> None:
+    sink = h.sinks.get(name)
+    if sink is not None and cb in getattr(sink, "callbacks", ()):
+        sink.callbacks.remove(cb)
+
+
+def pipeline_src_input(h: PipelineHandle, name: str,
+                       inputs: Sequence[Wire]) -> None:
+    h.src_input(name, *_to_arrays(inputs))
+
+
+def pipeline_src_eos(h: PipelineHandle, name: str) -> None:
+    h.src_eos(name)
+
+
+def pipeline_switch_select(h: PipelineHandle, name: str, pad: str) -> None:
+    h.switch_select(name, pad)
+
+
+def pipeline_switch_pads(h: PipelineHandle, name: str) -> List[str]:
+    return h.switch_pads(name)
+
+
+def pipeline_valve_set_open(h: PipelineHandle, name: str, open_: bool) -> None:
+    h.valve_set_open(name, open_)

@@ -1,0 +1,125 @@
+"""Pipeline application API: ``ml_pipeline_*`` parity.
+
+The reference C-API constructs a pipeline from a launch string, then indexes
+the named app-facing elements inside it — sinks, app sources, valves,
+selector switches (``ml_pipeline_construct`` walking the bin,
+``nnstreamer-capi-pipeline.c:426,465-503``).  ``PipelineHandle`` is that
+object model in Python:
+
+- :meth:`construct` / :meth:`start` / :meth:`stop` / :meth:`destroy`
+  (``ml_pipeline_construct/start/stop/destroy``)
+- :meth:`sink_register`   — per-sink frame callbacks (``ml_pipeline_sink_register``)
+- :meth:`src_input`       — push app data into a named appsrc
+  (``ml_pipeline_src_input_data``)
+- :meth:`switch_select`   — flip input/output selectors (``ml_pipeline_switch_select``)
+- :meth:`valve_set_open`  — open/close valves (``ml_pipeline_valve_set_open``)
+- :meth:`get_state`, :meth:`wait`
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Union
+
+import numpy as np
+
+from ..buffer import Frame
+from ..elements.app import AppSink, AppSrc
+from ..elements.selector import InputSelector, OutputSelector
+from ..elements.sink import TensorSink
+from ..elements.valve import Valve
+from ..graph.parse import parse_launch
+from ..graph.pipeline import Pipeline
+
+
+class PipelineHandle:
+    def __init__(self, description_or_pipeline: Union[str, Pipeline]):
+        if isinstance(description_or_pipeline, str):
+            self.pipeline = parse_launch(description_or_pipeline)
+        else:
+            self.pipeline = description_or_pipeline
+        # Index the app-facing elements by name (the bin walk).
+        self.sinks: Dict[str, Union[TensorSink, AppSink]] = {}
+        self.sources: Dict[str, AppSrc] = {}
+        self.valves: Dict[str, Valve] = {}
+        self.switches: Dict[str, Union[InputSelector, OutputSelector]] = {}
+        for name, node in self.pipeline.nodes.items():
+            if isinstance(node, (TensorSink, AppSink)):
+                self.sinks[name] = node
+            elif isinstance(node, AppSrc):
+                self.sources[name] = node
+            elif isinstance(node, Valve):
+                self.valves[name] = node
+            elif isinstance(node, (InputSelector, OutputSelector)):
+                self.switches[name] = node
+
+    @classmethod
+    def construct(cls, description: str) -> "PipelineHandle":
+        return cls(description)
+
+    # -- state (ml_pipeline_start/stop/get_state) ---------------------------
+
+    def start(self) -> "PipelineHandle":
+        self.pipeline.start()
+        return self
+
+    def stop(self) -> None:
+        self.pipeline.stop()
+
+    def get_state(self) -> str:
+        return self.pipeline.state
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        return self.pipeline.wait(timeout)
+
+    def destroy(self) -> None:
+        if self.pipeline.state == "PLAYING":
+            self.pipeline.stop()
+
+    def __enter__(self) -> "PipelineHandle":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.destroy()
+
+    # -- sinks (ml_pipeline_sink_register) ----------------------------------
+
+    def sink_register(self, name: str, callback: Callable[[Frame], None]) -> None:
+        sink = self.sinks.get(name)
+        if sink is None:
+            raise KeyError(f"no sink element named {name!r}")
+        sink.connect("new-data", callback)
+
+    # -- sources (ml_pipeline_src_input_data) -------------------------------
+
+    def src_input(self, name: str, *tensors, pts: int = -1) -> None:
+        src = self.sources.get(name)
+        if src is None:
+            raise KeyError(f"no appsrc element named {name!r}")
+        arrays = tuple(np.asarray(t) if not hasattr(t, "shape") else t for t in tensors)
+        src.push_frame(Frame(tensors=arrays, pts=pts))
+
+    def src_eos(self, name: str) -> None:
+        src = self.sources.get(name)
+        if src is None:
+            raise KeyError(f"no appsrc element named {name!r}")
+        src.end_of_stream()
+
+    # -- switches / valves (ml_pipeline_switch_select / valve_set_open) -----
+
+    def switch_select(self, name: str, pad: str) -> None:
+        sw = self.switches.get(name)
+        if sw is None:
+            raise KeyError(f"no selector element named {name!r}")
+        sw.select(pad)
+
+    def switch_pads(self, name: str) -> List[str]:
+        sw = self.switches.get(name)
+        if sw is None:
+            raise KeyError(f"no selector element named {name!r}")
+        return sw.pads()
+
+    def valve_set_open(self, name: str, open_: bool) -> None:
+        valve = self.valves.get(name)
+        if valve is None:
+            raise KeyError(f"no valve element named {name!r}")
+        valve.set_open(open_)

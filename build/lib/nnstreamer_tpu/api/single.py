@@ -1,0 +1,131 @@
+"""Single-shot inference API: ``ml_single_*`` parity.
+
+The reference's minimal-latency path (``nnstreamer-capi-single-new.c``,
+survey §3.5): drive a filter backend directly — no pipeline, no pads, no
+threads.  ``SingleShot`` is the analog of the ``ml_single_open /
+ml_single_invoke / ml_single_close`` triple (plus context-manager sugar),
+including the invoke timeout (``ml_single_set_timeout``,
+``-single-new.c:706``) and get/set of I/O specs.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import threading
+from typing import Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+from ..backends.base import FilterBackend, get_backend
+from ..spec import TensorsSpec
+
+
+class InvokeTimeout(TimeoutError):
+    pass
+
+
+class SingleShot:
+    """One-shot synchronous inference on a model.
+
+    >>> with SingleShot(framework="jax", model=my_model) as s:
+    ...     out, = s.invoke(image)
+    """
+
+    def __init__(
+        self,
+        framework: str = "",
+        model=None,
+        custom: str = "",
+        input_spec: Optional[TensorsSpec] = None,
+        timeout: Optional[float] = None,
+        backend: Optional[FilterBackend] = None,
+    ):
+        if backend is not None:
+            self.backend = backend
+        else:
+            if not framework:
+                raise ValueError("SingleShot requires framework= (or backend=)")
+            self.backend = get_backend(framework)
+        self.timeout = timeout
+        self._opened = False
+        self._configured = False
+        self._in_spec: Optional[TensorsSpec] = None
+        self._out_spec: Optional[TensorsSpec] = None
+        self._pool: Optional[concurrent.futures.ThreadPoolExecutor] = None
+        self._lock = threading.Lock()
+        self.backend.open(model, custom)
+        self._opened = True
+        if input_spec is not None:
+            self.set_input_spec(input_spec)
+        elif (spec := self.backend.input_spec()) is not None and spec.tensors_fixed:
+            self.set_input_spec(spec)
+
+    # -- spec management (ml_single_get/set_input_info) ---------------------
+
+    def input_spec(self) -> Optional[TensorsSpec]:
+        # Once configured, report the negotiated spec: a backend whose own
+        # spec is partial (wildcard dims) must not shadow the concrete one.
+        if self._in_spec is not None:
+            return self._in_spec
+        return self.backend.input_spec()
+
+    def output_spec(self) -> Optional[TensorsSpec]:
+        if self._out_spec is not None:
+            return self._out_spec
+        return self.backend.output_spec()
+
+    def set_input_spec(self, spec: TensorsSpec) -> TensorsSpec:
+        """Reconfigure for a new input spec; returns the output spec
+        (``ml_single_set_input_info``)."""
+        out = self.backend.reconfigure(spec)
+        self._configured = True
+        # remember the negotiated specs: shape-polymorphic backends (custom
+        # setInputDimension-style) have no intrinsic spec of their own, yet
+        # ml_single_get_input/output_info must reflect the configured one
+        self._in_spec = spec
+        self._out_spec = out
+        return out
+
+    def set_timeout(self, seconds: Optional[float]) -> None:
+        self.timeout = seconds
+
+    # -- invoke -------------------------------------------------------------
+
+    def invoke(self, *tensors) -> Tuple:
+        """Synchronous inference; raises :class:`InvokeTimeout` when a
+        timeout is set and exceeded."""
+        if not self._opened:
+            raise RuntimeError("SingleShot is closed")
+        arrays = tuple(
+            t if hasattr(t, "shape") else np.asarray(t) for t in tensors
+        )
+        if not self._configured:
+            self.set_input_spec(TensorsSpec.from_arrays(arrays))
+        if self.timeout is None:
+            return self.backend.invoke(arrays)
+        with self._lock:
+            if self._pool is None:
+                self._pool = concurrent.futures.ThreadPoolExecutor(max_workers=1)
+        future = self._pool.submit(self.backend.invoke, arrays)
+        try:
+            return future.result(timeout=self.timeout)
+        except concurrent.futures.TimeoutError:
+            raise InvokeTimeout(
+                f"invoke exceeded {self.timeout}s"
+            ) from None
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def close(self) -> None:
+        if self._opened:
+            self.backend.close()
+            self._opened = False
+        if self._pool is not None:
+            self._pool.shutdown(wait=False)
+            self._pool = None
+
+    def __enter__(self) -> "SingleShot":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

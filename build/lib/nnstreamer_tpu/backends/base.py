@@ -1,0 +1,123 @@
+"""Filter-backend protocol and registry.
+
+The analog of ``GstTensorFilterFramework``
+(``nnstreamer_plugin_api_filter.h:76-157``) and its probe-based registry
+(``nnstreamer_filter_probe``, ``nnstreamer_subplugin.c:56-165``): a backend
+("subplugin") owns a loaded model and exposes spec discovery + invoke.
+
+Key vtable mappings:
+
+- ``open``/``close``            → :meth:`FilterBackend.open` / ``close``
+- ``getInputDimension``/``getOutputDimension``
+                                → :meth:`input_spec` / :meth:`output_spec`
+- ``setInputDimension`` (shape-polymorphic backends)
+                                → :meth:`reconfigure`
+- ``invoke_NN``                 → :meth:`invoke`
+- ``allocate_in_invoke`` (output buffers owned by the backend, zero-copy
+  hand-off, ``tensor_filter.c:366-403``)
+                                → :attr:`device_resident` — outputs may stay
+  on TPU and flow downstream without host transfer.
+"""
+
+from __future__ import annotations
+
+import importlib
+import threading
+from typing import Dict, Optional, Tuple
+
+from ..spec import TensorsSpec
+
+
+class FilterBackend:
+    """Base class for model backends."""
+
+    name: str = "base"
+    device_resident: bool = False  # allocate_in_invoke analog
+
+    def open(self, model, custom: str = "") -> None:
+        """Load the model (called once, on element start / single open)."""
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+    def input_spec(self) -> Optional[TensorsSpec]:
+        """Model input signature; None if unknown until reconfigure()."""
+        return None
+
+    def model_spec(self) -> Optional[TensorsSpec]:
+        """The model's DECLARED (possibly partial) input spec — the
+        negotiation template.  Unlike :meth:`input_spec` this never narrows
+        to the last negotiated shape, so mid-stream renegotiation judges a
+        new spec against what the model actually requires."""
+        return self.input_spec()
+
+    def output_spec(self) -> Optional[TensorsSpec]:
+        return None
+
+    def reconfigure(self, in_spec: TensorsSpec) -> TensorsSpec:
+        """setInputDimension analog: adapt to a caller-imposed input spec,
+        return the resulting output spec.  Default: reject changes."""
+        mine = self.input_spec()
+        if mine is not None and mine.intersect(in_spec) is None:
+            raise ValueError(
+                f"backend {self.name}: input spec {in_spec} incompatible with "
+                f"model spec {mine}"
+            )
+        out = self.output_spec()
+        if out is None:
+            raise ValueError(f"backend {self.name}: output spec unknown")
+        return out
+
+    def invoke(self, tensors: Tuple) -> Tuple:
+        """Run inference on one frame's tensors; returns output tensors."""
+        raise NotImplementedError
+
+
+_BACKENDS: Dict[str, type] = {}
+_LOCK = threading.Lock()
+_BUILTIN_MODULES = {
+    "jax": "nnstreamer_tpu.backends.jax_backend",
+    "jax-sharded": "nnstreamer_tpu.backends.jax_backend",
+    "custom-python": "nnstreamer_tpu.backends.custom",
+    "custom-easy": "nnstreamer_tpu.backends.custom",
+    "custom": "nnstreamer_tpu.backends.custom",
+    "custom-so": "nnstreamer_tpu.backends.custom_so",
+    "torch": "nnstreamer_tpu.backends.torch_backend",
+    "torch-cpu": "nnstreamer_tpu.backends.torch_backend",
+    "tensorflow-lite": "nnstreamer_tpu.backends.tf_backend",
+    "tensorflow": "nnstreamer_tpu.backends.tf_backend",
+}
+
+
+def register_backend(name: str):
+    """Decorator: register a backend class (the nnstreamer_filter_probe
+    analog)."""
+
+    def deco(cls):
+        with _LOCK:
+            _BACKENDS[name] = cls
+        cls.name = name
+        return cls
+
+    return deco
+
+
+def get_backend(name: str) -> FilterBackend:
+    cls = _BACKENDS.get(name)
+    if cls is None and name in _BUILTIN_MODULES:
+        importlib.import_module(_BUILTIN_MODULES[name])
+        cls = _BACKENDS.get(name)
+    if cls is None:
+        from ..conf import lookup_with_plugin_fallback
+
+        cls = lookup_with_plugin_fallback(lambda: _BACKENDS.get(name))
+    if cls is None:
+        raise ValueError(
+            f"unknown filter framework {name!r}; known: {sorted(known_backends())}"
+        )
+    return cls()
+
+
+def known_backends():
+    return set(_BACKENDS) | set(_BUILTIN_MODULES)

@@ -1,0 +1,173 @@
+"""Custom filter backends: user code as a stream filter.
+
+Three variants, mirroring the reference's custom-filter family:
+
+- ``custom-python`` — load a user ``.py`` file defining ``class
+  CustomFilter`` with ``get_input_spec``/``get_output_spec`` (or
+  ``set_input_spec`` for shape-polymorphic filters) and ``invoke`` — the
+  analog of the python subplugin's script protocol
+  (``tensor_filter_python_core.cc:171-204``).
+- ``custom`` — a Python object/callable passed directly as the model (the
+  analog of the C ``.so`` custom vtable, ``tensor_filter_custom.h:36-160``;
+  in a Python-first framework "load a shared object" *is* "pass an object").
+- ``custom-easy`` — a registry of named (callable, in_spec, out_spec)
+  triples, registered programmatically; the analog of
+  ``NNS_custom_easy_register``.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+import threading
+from typing import Callable, Dict, Optional, Tuple
+
+from ..spec import TensorsSpec
+from .base import FilterBackend, register_backend
+
+
+class CustomFilterBase:
+    """Protocol for user filter objects (duck-typed; subclassing optional):
+
+    - ``get_input_spec() -> TensorsSpec``   (optional if set_input_spec)
+    - ``get_output_spec() -> TensorsSpec``  (optional if set_input_spec)
+    - ``set_input_spec(in_spec) -> TensorsSpec``  (shape-polymorphic)
+    - ``invoke(*tensors) -> tensor | tuple``
+    """
+
+    def get_input_spec(self) -> Optional[TensorsSpec]:
+        return None
+
+    def get_output_spec(self) -> Optional[TensorsSpec]:
+        return None
+
+    def invoke(self, *tensors):
+        raise NotImplementedError
+
+
+def _wrap_outputs(out) -> Tuple:
+    if isinstance(out, tuple):
+        return out
+    if isinstance(out, list):
+        return tuple(out)
+    return (out,)
+
+
+class _ObjectBackend(FilterBackend):
+    """Shared machinery: drive a CustomFilterBase-shaped object."""
+
+    device_resident = False
+
+    def __init__(self):
+        self.obj = None
+
+    def _bind(self, obj) -> None:
+        if callable(obj) and not hasattr(obj, "invoke"):
+            fn = obj
+
+            class _CallableFilter(CustomFilterBase):
+                def invoke(self, *tensors):
+                    return fn(*tensors)
+
+            obj = _CallableFilter()
+        if not hasattr(obj, "invoke"):
+            raise TypeError(f"custom filter object lacks invoke(): {obj!r}")
+        self.obj = obj
+
+    def close(self) -> None:
+        self.obj = None
+
+    def input_spec(self) -> Optional[TensorsSpec]:
+        get = getattr(self.obj, "get_input_spec", None)
+        return get() if get else None
+
+    def output_spec(self) -> Optional[TensorsSpec]:
+        get = getattr(self.obj, "get_output_spec", None)
+        return get() if get else None
+
+    def reconfigure(self, in_spec: TensorsSpec) -> TensorsSpec:
+        setter = getattr(self.obj, "set_input_spec", None)
+        if setter is not None:
+            return setter(in_spec)
+        if self.output_spec() is not None:
+            return super().reconfigure(in_spec)
+        # No spec info at all (bare callable): probe with a zero frame —
+        # the ergonomic equivalent of requiring setInputDim in the
+        # reference's custom vtable.
+        import numpy as np
+
+        if not in_spec.is_fixed:
+            in_spec = in_spec.fixate()
+        dummies = tuple(
+            np.zeros(t.shape, dtype=t.dtype) for t in in_spec.tensors
+        )
+        outs = self.invoke(dummies)
+        return TensorsSpec.from_arrays(outs)
+
+    def invoke(self, tensors: Tuple) -> Tuple:
+        return _wrap_outputs(self.obj.invoke(*tensors))
+
+
+@register_backend("custom")
+class CustomBackend(_ObjectBackend):
+    def open(self, model, custom: str = "") -> None:
+        del custom
+        self._bind(model)
+
+
+@register_backend("custom-python")
+class CustomPythonBackend(_ObjectBackend):
+    def open(self, model, custom: str = "") -> None:
+        path = os.fspath(model)
+        spec = importlib.util.spec_from_file_location("nns_tpu_custom_filter", path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        cls = getattr(mod, "CustomFilter", None)
+        if cls is None:
+            raise ValueError(f"{path}: no CustomFilter class found")
+        self._bind(cls(custom) if custom else cls())
+
+
+# -- custom-easy ------------------------------------------------------------
+
+_EASY: Dict[str, tuple] = {}
+_EASY_LOCK = threading.Lock()
+
+
+def register_custom_easy(
+    name: str,
+    fn: Callable,
+    in_spec: TensorsSpec,
+    out_spec: TensorsSpec,
+) -> None:
+    """Register a named easy filter (NNS_custom_easy_register analog)."""
+    with _EASY_LOCK:
+        _EASY[name] = (fn, in_spec, out_spec)
+
+
+def unregister_custom_easy(name: str) -> None:
+    with _EASY_LOCK:
+        _EASY.pop(name, None)
+
+
+@register_backend("custom-easy")
+class CustomEasyBackend(_ObjectBackend):
+    def open(self, model, custom: str = "") -> None:
+        del custom
+        key = os.fspath(model) if isinstance(model, os.PathLike) else str(model)
+        try:
+            fn, in_spec, out_spec = _EASY[key]
+        except KeyError:
+            raise ValueError(f"no custom-easy filter registered as {key!r}") from None
+
+        class _Easy(CustomFilterBase):
+            def get_input_spec(self):
+                return in_spec
+
+            def get_output_spec(self):
+                return out_spec
+
+            def invoke(self, *tensors):
+                return fn(*tensors)
+
+        self._bind(_Easy())

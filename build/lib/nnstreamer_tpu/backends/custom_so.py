@@ -1,0 +1,155 @@
+"""``custom-so``: user C/C++ shared objects as filter backends.
+
+The direct analog of the reference's ``tensor_filter_custom``
+(``tensor_filter_custom.{c,h}``: a user ``.so`` exposing the
+``NNStreamer_custom`` C vtable, loaded with ``dlopen``).  Here the contract
+is the C ABI in :file:`nnstreamer_tpu/native/nns_custom_filter.h`; loading
+is ``ctypes.CDLL`` and tensors cross the boundary as raw buffers (numpy
+arrays pinned for the call — the ``gst_memory_map`` analog,
+``tensor_filter.c:353-399``)."""
+
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import Optional, Tuple
+
+import numpy as np
+
+from ..spec import TensorSpec, TensorsSpec
+from .base import FilterBackend, register_backend
+
+NNS_MAX_TENSORS = 16
+NNS_MAX_RANK = 8
+
+# enum nns_dtype (matches the reference's _nns_tensor_type order)
+_DTYPES = [
+    np.int32, np.uint32, np.int16, np.uint16, np.int8, np.uint8,
+    np.float64, np.float32, np.int64, np.uint64,
+]
+_DTYPE_CODE = {np.dtype(d): i for i, d in enumerate(_DTYPES)}
+
+
+class _CTensorSpec(ctypes.Structure):
+    _fields_ = [
+        ("dtype", ctypes.c_int32),
+        ("rank", ctypes.c_uint32),
+        ("dims", ctypes.c_uint64 * NNS_MAX_RANK),
+    ]
+
+
+class _CTensorsSpec(ctypes.Structure):
+    _fields_ = [
+        ("num_tensors", ctypes.c_uint32),
+        ("tensors", _CTensorSpec * NNS_MAX_TENSORS),
+    ]
+
+
+def _from_c_spec(cspec: _CTensorsSpec) -> TensorsSpec:
+    if cspec.num_tensors > NNS_MAX_TENSORS:
+        raise ValueError(
+            f"custom-so: num_tensors {cspec.num_tensors} > {NNS_MAX_TENSORS}"
+        )
+    tensors = []
+    for i in range(cspec.num_tensors):
+        t = cspec.tensors[i]
+        if not 0 <= t.dtype < len(_DTYPES):
+            raise ValueError(f"custom-so: bad dtype code {t.dtype}")
+        if t.rank > NNS_MAX_RANK:
+            raise ValueError(
+                f"custom-so: tensor {i} rank {t.rank} > {NNS_MAX_RANK}"
+            )
+        shape = tuple(int(t.dims[k]) for k in range(t.rank))
+        tensors.append(TensorSpec(dtype=np.dtype(_DTYPES[t.dtype]), shape=shape))
+    return TensorsSpec(tensors=tuple(tensors))
+
+
+@register_backend("custom-so")
+class CustomSoBackend(FilterBackend):
+    device_resident = False
+
+    def __init__(self):
+        self._lib: Optional[ctypes.CDLL] = None
+        self._in_spec: Optional[TensorsSpec] = None
+        self._out_spec: Optional[TensorsSpec] = None
+
+    def open(self, model, custom: str = "") -> None:
+        path = os.fspath(model)
+        lib = ctypes.CDLL(path)
+        for sym in ("nns_get_input_spec", "nns_get_output_spec", "nns_invoke"):
+            if not hasattr(lib, sym):
+                raise ValueError(f"{path}: missing required export {sym}()")
+        lib.nns_get_input_spec.argtypes = [ctypes.POINTER(_CTensorsSpec)]
+        lib.nns_get_input_spec.restype = ctypes.c_int
+        lib.nns_get_output_spec.argtypes = [ctypes.POINTER(_CTensorsSpec)]
+        lib.nns_get_output_spec.restype = ctypes.c_int
+        lib.nns_invoke.argtypes = [
+            ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(ctypes.c_uint64),
+        ]
+        lib.nns_invoke.restype = ctypes.c_int
+        if hasattr(lib, "nns_init"):
+            lib.nns_init.argtypes = [ctypes.c_char_p]
+            lib.nns_init.restype = ctypes.c_int
+            rc = lib.nns_init(custom.encode())
+            if rc != 0:
+                raise RuntimeError(f"{path}: nns_init failed ({rc})")
+        self._lib = lib
+
+        cspec = _CTensorsSpec()
+        if lib.nns_get_input_spec(ctypes.byref(cspec)) != 0:
+            raise RuntimeError(f"{path}: nns_get_input_spec failed")
+        self._in_spec = _from_c_spec(cspec)
+        cspec = _CTensorsSpec()
+        if lib.nns_get_output_spec(ctypes.byref(cspec)) != 0:
+            raise RuntimeError(f"{path}: nns_get_output_spec failed")
+        self._out_spec = _from_c_spec(cspec)
+
+    def close(self) -> None:
+        if self._lib is not None and hasattr(self._lib, "nns_destroy"):
+            self._lib.nns_destroy()
+        self._lib = None
+
+    def input_spec(self) -> Optional[TensorsSpec]:
+        return self._in_spec
+
+    def output_spec(self) -> Optional[TensorsSpec]:
+        return self._out_spec
+
+    def invoke(self, tensors: Tuple) -> Tuple:
+        ins = [
+            np.ascontiguousarray(np.asarray(t)) for t in tensors
+        ]
+        # The ABI contract (nns_custom_filter.h) is that in_bufs has exactly
+        # num_tensors entries in spec order with the negotiated dtypes; a
+        # conforming .so indexes that far, so cross-check before the call.
+        expect = self._in_spec.tensors
+        if len(ins) != len(expect):
+            raise ValueError(
+                f"custom-so: got {len(ins)} input tensors, spec has "
+                f"{len(expect)}"
+            )
+        for i, (a, t) in enumerate(zip(ins, expect)):
+            if _DTYPE_CODE.get(a.dtype) != _DTYPE_CODE.get(np.dtype(t.dtype)):
+                raise ValueError(
+                    f"custom-so: input {i} dtype {a.dtype} != negotiated "
+                    f"{np.dtype(t.dtype)}"
+                )
+        n_in = len(ins)
+        outs = [
+            np.empty(t.shape, dtype=t.dtype) for t in self._out_spec.tensors
+        ]
+        in_bufs = (ctypes.c_void_p * n_in)(
+            *[a.ctypes.data_as(ctypes.c_void_p).value for a in ins]
+        )
+        in_sizes = (ctypes.c_uint64 * n_in)(*[a.nbytes for a in ins])
+        out_bufs = (ctypes.c_void_p * len(outs))(
+            *[a.ctypes.data_as(ctypes.c_void_p).value for a in outs]
+        )
+        out_sizes = (ctypes.c_uint64 * len(outs))(*[a.nbytes for a in outs])
+        rc = self._lib.nns_invoke(in_bufs, in_sizes, out_bufs, out_sizes)
+        if rc < 0:
+            raise RuntimeError(f"custom-so invoke failed ({rc})")
+        if rc > 0:
+            return ()  # drop the frame (GST_BASE_TRANSFORM_FLOW_DROPPED analog)
+        return tuple(outs)

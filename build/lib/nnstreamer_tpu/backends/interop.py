@@ -1,0 +1,68 @@
+"""Zero-copy tensor interop between backend frameworks (dlpack bridging).
+
+The reference's transfer layer is ``gst_memory_map`` + ``GstTensorMemory``
+pointer hand-off between elements (``tensor_filter.c:350-399``) — zero-copy
+because everything is host memory.  Here frames may carry **jax Arrays**
+(possibly device-resident); when a torch or tensorflow filter consumes them
+the bridge is ``__dlpack__``:
+
+- jax(CPU) → torch/tf: zero-copy (same buffer, refcounted via the capsule);
+- jax(TPU) → torch/tf: dlpack is impossible (foreign device) — falls back
+  to one explicit device→host transfer, same as the reference's single
+  ``memcpy`` worst case;
+- numpy → torch: ``torch.from_numpy`` (zero-copy for contiguous arrays).
+
+Survey §2.6 names this mapping explicitly (``jax.dlpack`` as the
+``gst_memory_map`` analog).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def _is_jax_array(t) -> bool:
+    # cheap structural check — avoids importing jax for torch-only pipelines
+    return type(t).__module__.startswith("jax") and hasattr(t, "__dlpack__")
+
+
+def to_torch(t):
+    """Tensor → torch.Tensor with zero-copy where the memory allows."""
+    import torch
+
+    if isinstance(t, torch.Tensor):
+        return t
+    if isinstance(t, np.ndarray):
+        return torch.from_numpy(np.ascontiguousarray(t))
+    if _is_jax_array(t):
+        try:
+            return torch.utils.dlpack.from_dlpack(t)
+        except Exception:
+            pass  # non-CPU jax buffer (TPU): transfer below
+    return torch.from_numpy(np.ascontiguousarray(np.asarray(t)))
+
+
+def to_tf(t):
+    """Tensor → tf-consumable tensor with zero-copy where possible."""
+    import tensorflow as tf
+
+    if _is_jax_array(t):
+        try:
+            return tf.experimental.dlpack.from_dlpack(t.__dlpack__())
+        except Exception:
+            pass  # non-CPU jax buffer or tf build without dlpack
+    return np.asarray(t)  # tf ops consume numpy zero-copy on CPU
+
+
+def to_jax(t):
+    """Tensor → jax Array via dlpack when it avoids a copy (torch CPU)."""
+    import jax
+
+    if _is_jax_array(t):
+        return t
+    if type(t).__module__.startswith("torch"):
+        try:
+            return jax.dlpack.from_dlpack(t)
+        except Exception:
+            return jax.numpy.asarray(np.asarray(t))
+    return t  # numpy flows into jit natively

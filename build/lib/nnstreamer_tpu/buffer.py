@@ -1,0 +1,171 @@
+"""Stream buffers: the frames that flow through pipeline graphs.
+
+Analog of ``GstBuffer`` carrying up to 16 ``GstMemory`` chunks
+(``tensor_typedef.h:35``, ``GstTensorMemory`` ``tensor_typedef.h:138-143``),
+re-designed for the TPU substrate: a frame's payloads may be **numpy arrays
+(host) or jax Arrays (device-resident)** interchangeably.  Keeping payloads
+device-resident between XLA-backed nodes is our generalization of the
+reference's ``allocate_in_invoke`` zero-copy hand-off
+(``tensor_filter.c:350-399``).
+
+Timestamps are integer nanoseconds, GStreamer-style; ``NONE_TS`` marks an
+invalid/absent timestamp (``GST_CLOCK_TIME_NONE``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+NONE_TS = -1  # GST_CLOCK_TIME_NONE analog
+SECOND = 1_000_000_000  # ns
+
+
+def is_valid_ts(ts: int) -> bool:
+    return ts is not None and ts >= 0
+
+
+@dataclasses.dataclass
+class Frame:
+    """One frame on a pad: a tuple of tensors + timing + metadata.
+
+    ``tensors`` entries are numpy ndarrays or jax Arrays.  ``meta`` carries
+    auxiliary per-frame data (the analog of GstMeta, e.g. the repo element's
+    ``GstMetaRepo`` caps meta, ``tensor_repo.h:37-54``).
+    """
+
+    tensors: Tuple[Any, ...]
+    pts: int = NONE_TS
+    duration: int = NONE_TS
+    meta: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    def __post_init__(self):
+        if not isinstance(self.tensors, tuple):
+            self.tensors = tuple(self.tensors)
+
+    @classmethod
+    def of(cls, *tensors, pts: int = NONE_TS, duration: int = NONE_TS, **meta) -> "Frame":
+        return cls(tensors=tensors, pts=pts, duration=duration, meta=dict(meta))
+
+    @property
+    def num_tensors(self) -> int:
+        return len(self.tensors)
+
+    def tensor(self, i: int = 0):
+        return self.tensors[i]
+
+    def with_tensors(self, tensors, **updates) -> "Frame":
+        """New frame with replaced payloads, timing/meta preserved."""
+        return Frame(
+            tensors=tuple(tensors),
+            pts=updates.get("pts", self.pts),
+            duration=updates.get("duration", self.duration),
+            meta=dict(updates.get("meta", self.meta)),
+        )
+
+    def to_host(self) -> "Frame":
+        """Materialize all payloads as numpy arrays (device→host)."""
+        return self.with_tensors(tuple(np.asarray(t) for t in self.tensors))
+
+    @property
+    def end_ts(self) -> int:
+        if is_valid_ts(self.pts) and is_valid_ts(self.duration):
+            return self.pts + self.duration
+        return NONE_TS
+
+    def __repr__(self) -> str:
+        shapes = ",".join(f"{np.asarray(t).dtype}{tuple(t.shape)}" for t in self.tensors)
+        return f"Frame[{shapes} pts={self.pts}]"
+
+
+class WireTensor:
+    """A device-resident payload in **wire layout** (flat 1-D) that still
+    presents its logical ``shape``/``dtype`` to the graph.
+
+    Produced by ``tensor_upload``: the host→device transfer of a rank ≥ 2
+    frame is cheapest flat (no tiled-layout padding — see
+    ``backends/jax_backend.py``), but the graph's spec/signature checks and
+    any host consumer need the logical geometry.  A jax filter recognizes
+    the wrapper and feeds ``data`` straight to its flat wire entry; any
+    other consumer's ``np.asarray`` materializes the logical array.
+    """
+
+    __slots__ = ("data", "shape", "dtype")
+
+    def __init__(self, data, shape: Tuple[int, ...], dtype):
+        self.data = data  # jax Array, flat wire layout
+        self.shape = tuple(shape)
+        self.dtype = np.dtype(dtype)
+
+    def __array__(self, dtype=None, copy=None):
+        arr = np.asarray(self.data).reshape(self.shape)
+        if dtype is not None and np.dtype(dtype) != arr.dtype:
+            return arr.astype(dtype)
+        return arr
+
+    def block_until_ready(self):
+        self.data.block_until_ready()
+        return self
+
+    # minimal ndarray duck-typing so payload consumers that poke geometry
+    # or subscript directly (tensor_split, decoders) keep working; indexing
+    # materializes (host copy) — the jax filter fast path never calls these
+    @property
+    def ndim(self) -> int:
+        return len(self.shape)
+
+    @property
+    def size(self) -> int:
+        n = 1
+        for d in self.shape:
+            n *= d
+        return n
+
+    @property
+    def nbytes(self) -> int:
+        return self.data.nbytes
+
+    def __len__(self) -> int:
+        if not self.shape:
+            raise TypeError("len() of unsized WireTensor")
+        return self.shape[0]
+
+    def __getitem__(self, key):
+        return self.__array__()[key]
+
+    def __repr__(self) -> str:
+        return f"WireTensor({self.dtype}{self.shape})"
+
+
+@dataclasses.dataclass
+class Event:
+    """In-band stream events (the analog of GstEvent): EOS, stream-start,
+    flush, and segment/spec changes propagate through pads like frames do."""
+
+    kind: str  # "eos" | "stream-start" | "flush" | "caps"
+    payload: Any = None
+
+    @classmethod
+    def eos(cls) -> "Event":
+        return cls("eos")
+
+    @classmethod
+    def caps(cls, spec) -> "Event":
+        """Mid-stream spec change (the GST_EVENT_CAPS analog): ``payload`` is
+        the new fixed :class:`~nnstreamer_tpu.spec.TensorsSpec`.  Travels in
+        order with frames; each node re-runs its local negotiation
+        (``tensor_filter.c:666-763`` re-enters transform_caps at any time)."""
+        return cls("caps", spec)
+
+    @classmethod
+    def stream_start(cls) -> "Event":
+        return cls("stream-start")
+
+    @classmethod
+    def flush(cls) -> "Event":
+        return cls("flush")
+
+
+EOS = Event.eos()

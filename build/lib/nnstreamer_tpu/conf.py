@@ -1,0 +1,204 @@
+"""Runtime configuration: the ``nnstreamer_conf`` analog.
+
+The reference merges **three config sources** with fixed precedence — env
+vars, an ini file, hardcoded defaults (``nnstreamer_conf.c:37-52``) — and
+scans configured directories for subplugin shared objects, lazily loaded on
+first lookup (``nnstreamer_conf.c:137-166``, ``nnstreamer_subplugin.c:56-113``).
+
+Here the same shape, Python-native:
+
+- env vars ``NNSTPU_<SECTION>_<KEY>`` (e.g. ``NNSTPU_COMMON_PLUGIN_PATH``)
+  take top precedence; ``NNSTPU_CONF`` points at the ini file (the analog of
+  ``NNSTREAMER_CONF``);
+- an ini file (``configparser`` flavor) searched at ``$NNSTPU_CONF``,
+  ``./nnstreamer_tpu.ini``, ``~/.config/nnstreamer_tpu/nnstreamer_tpu.ini``,
+  ``/etc/nnstreamer_tpu.ini`` — first hit wins (mirrors the ini template
+  ``nnstreamer.ini.in:1-21`` including per-backend knobs);
+- hardcoded defaults.
+
+External plugins (the ``libnnstreamer_{filter,decoder}_*.so`` analog) are
+plain ``.py`` files named ``nnstpu_*.py`` in the configured plugin dirs.
+They are imported on first registry miss (lazy, like the reference's
+``dlopen``-on-first-lookup) and self-register via
+:func:`~nnstreamer_tpu.graph.registry.register_element`,
+:func:`~nnstreamer_tpu.backends.base.register_backend`, or
+:func:`~nnstreamer_tpu.elements.decoder.register_decoder`.
+"""
+
+from __future__ import annotations
+
+import configparser
+import importlib.util
+import os
+import sys
+import threading
+from typing import Dict, List, Optional
+
+_TRUE = {"1", "true", "yes", "on"}
+_FALSE = {"0", "false", "no", "off"}
+
+DEFAULTS: Dict[str, Dict[str, str]] = {
+    "common": {
+        "plugin_path": "",          # colon-separated dirs of nnstpu_*.py
+        "enable_profiling": "false",
+        "native_runtime": "true",   # C++ frame queue (nnstreamer_tpu/native)
+        "dump_dot_dir": "",         # write <pipeline>.PLAYING.dot here
+    },
+    "filter": {
+        "jax_dtype": "bfloat16",    # compute dtype for the jax backend
+        "torch_device": "cpu",      # the `torch use gpu` knob analog
+    },
+    "decoder": {},
+}
+
+
+class Conf:
+    """Layered configuration with lazy external-plugin loading."""
+
+    def __init__(self, ini_path: Optional[str] = None, environ=None):
+        self._lock = threading.Lock()
+        self._environ = environ if environ is not None else os.environ
+        self._explicit_ini = ini_path
+        self._loaded_plugin_files: Dict[str, object] = {}
+        self.refresh()
+
+    # -- source loading -----------------------------------------------------
+
+    def _ini_candidates(self) -> List[str]:
+        cands = []
+        if self._explicit_ini:
+            cands.append(self._explicit_ini)
+        env = self._environ.get("NNSTPU_CONF")
+        if env:
+            cands.append(env)
+        cands.append(os.path.join(os.getcwd(), "nnstreamer_tpu.ini"))
+        cands.append(
+            os.path.expanduser("~/.config/nnstreamer_tpu/nnstreamer_tpu.ini")
+        )
+        cands.append("/etc/nnstreamer_tpu.ini")
+        return cands
+
+    def refresh(self) -> None:
+        """Re-read the ini file (env vars are always read live)."""
+        parser = configparser.ConfigParser()
+        path = None
+        for cand in self._ini_candidates():
+            if cand and os.path.isfile(cand):
+                path = cand
+                break
+        if path:
+            parser.read(path)
+        with self._lock:
+            self.ini_path = path
+            self._ini = parser
+
+    # -- typed getters (env > ini > defaults) --------------------------------
+
+    def get(self, section: str, key: str, default: Optional[str] = None) -> Optional[str]:
+        env_key = f"NNSTPU_{section.upper()}_{key.upper()}"
+        val = self._environ.get(env_key)
+        if val is not None:
+            return val
+        with self._lock:
+            if self._ini.has_option(section, key):
+                return self._ini.get(section, key)
+        val = DEFAULTS.get(section, {}).get(key)
+        return val if val is not None else default
+
+    def get_bool(self, section: str, key: str, default: bool = False) -> bool:
+        val = self.get(section, key)
+        if val is None or val == "":
+            return default
+        low = val.strip().lower()
+        if low in _TRUE:
+            return True
+        if low in _FALSE:
+            return False
+        raise ValueError(f"[{section}] {key}: not a boolean: {val!r}")
+
+    def get_int(self, section: str, key: str, default: int = 0) -> int:
+        val = self.get(section, key)
+        return int(val) if val not in (None, "") else default
+
+    def get_float(self, section: str, key: str, default: float = 0.0) -> float:
+        val = self.get(section, key)
+        return float(val) if val not in (None, "") else default
+
+    def get_path(self, section: str, key: str, default: str = "") -> str:
+        val = self.get(section, key, default)
+        return os.path.expanduser(val) if val else val
+
+    # -- external plugin scanning (the dlopen analog) ------------------------
+
+    def plugin_dirs(self) -> List[str]:
+        """Plugin search dirs: ``$NNSTPU_PLUGIN_PATH`` (colon-separated) then
+        ini ``[common] plugin_path`` (the reference's env-over-ini order,
+        ``nnstreamer_conf.c:99-109``)."""
+        dirs: List[str] = []
+        for source in (
+            self._environ.get("NNSTPU_PLUGIN_PATH", ""),
+            self.get("common", "plugin_path", "") or "",
+        ):
+            for d in source.split(os.pathsep):
+                d = os.path.expanduser(d.strip())
+                if d and d not in dirs:
+                    dirs.append(d)
+        return dirs
+
+    def scan_plugin_files(self) -> List[str]:
+        """All ``nnstpu_*.py`` files in the plugin dirs, sorted."""
+        files = []
+        for d in self.plugin_dirs():
+            if not os.path.isdir(d):
+                continue
+            for fname in sorted(os.listdir(d)):
+                if fname.startswith("nnstpu_") and fname.endswith(".py"):
+                    files.append(os.path.join(d, fname))
+        return files
+
+    def load_external_plugins(self) -> int:
+        """Import every not-yet-loaded plugin file; returns how many loaded.
+
+        Modules self-register their elements/backends/decoders at import
+        time, exactly like the reference's shared-object constructors calling
+        ``register_subplugin`` (``nnstreamer_subplugin.c:117-165``).
+        """
+        loaded = 0
+        for path in self.scan_plugin_files():
+            real = os.path.realpath(path)
+            with self._lock:
+                if real in self._loaded_plugin_files:
+                    continue
+                # reserve before exec so a recursive lookup can't double-load
+                self._loaded_plugin_files[real] = None
+            modname = "nnstpu_plugins." + os.path.splitext(os.path.basename(path))[0]
+            spec = importlib.util.spec_from_file_location(modname, real)
+            mod = importlib.util.module_from_spec(spec)
+            sys.modules[modname] = mod
+            try:
+                spec.loader.exec_module(mod)
+            except BaseException:
+                with self._lock:
+                    del self._loaded_plugin_files[real]
+                sys.modules.pop(modname, None)
+                raise
+            with self._lock:
+                self._loaded_plugin_files[real] = mod
+            loaded += 1
+        return loaded
+
+
+conf = Conf()
+
+
+def load_external_plugins() -> int:
+    """Module-level convenience used by the registries on lookup miss."""
+    return conf.load_external_plugins()
+
+
+def lookup_with_plugin_fallback(get):
+    """Shared registry-miss handler: scan+load external plugins once, then
+    retry ``get()`` if anything new was loaded (else None)."""
+    if conf.load_external_plugins():
+        return get()
+    return None

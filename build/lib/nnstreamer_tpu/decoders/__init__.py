@@ -1,0 +1,1 @@
+"""Decoder subplugins (tensor → media post-processing)."""

@@ -1,0 +1,261 @@
+"""``bounding_boxes`` decoder: SSD detector outputs → RGBA overlay video.
+
+Analog of ``ext/nnstreamer/tensor_decoder/tensordec-boundingbox.c`` with its
+two sub-modes:
+
+- ``tflite-ssd`` — 2 tensors: box encodings ``(#boxes, 4)`` + class scores
+  ``(#boxes, #labels)``, decoded against a **box-priors file** (4 lines of
+  #boxes floats: ycenter/xcenter/h/w, ``:288-350``) with the reference's
+  constants (threshold .5 after sigmoid, scales 10/10/5/5, first class ≥
+  threshold wins, ``:631-678``), then IoU-0.5 NMS (``:740-780``).
+- ``tf-ssd`` — 4 tensors: num_detections, classes, scores, normalized boxes
+  ``(ymin, xmin, ymax, xmax)``; no extra decode, threshold .5.
+
+Options (``:30-44``): option1 = sub-mode, option2 = label file,
+option3 = priors file (tflite-ssd), option4 = output ``W:H``,
+option5 = model input ``W:H``.
+
+The heavy decode is vectorized numpy on host (detection counts are tiny);
+detections also ride in ``meta["objects"]`` for app consumption.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional
+
+import numpy as np
+
+from ..buffer import Frame
+from ..elements.decoder import DecoderPlugin, register_decoder
+from ..spec import TensorSpec, TensorsSpec
+from . import draw, font
+
+DETECTION_THRESHOLD = 0.5
+Y_SCALE, X_SCALE, H_SCALE, W_SCALE = 10.0, 10.0, 5.0, 5.0
+THRESHOLD_IOU = 0.5
+# NMS considers at most this many highest-prob candidates (standard SSD
+# practice; bounds the O(n²) suppression pass — a degenerate/random model
+# can push thousands of boxes over threshold, and the reference's per-box
+# C loop never faced Python loop costs).  Matches the fused head's top-k.
+PRE_NMS_TOP_K = 100
+
+
+@dataclasses.dataclass
+class DetectedObject:
+    class_id: int
+    x: int
+    y: int
+    width: int
+    height: int
+    prob: float
+    label: Optional[str] = None
+
+
+def load_box_priors(path: str) -> np.ndarray:
+    """4×N priors (ycenter, xcenter, h, w rows), as the reference loads
+    (``:288-350``)."""
+    rows = []
+    with open(path, "r", encoding="utf-8") as f:
+        for line in f:
+            vals = [float(v) for v in line.split()]
+            if vals:
+                rows.append(vals)
+    if len(rows) < 4:
+        raise ValueError(f"box priors file {path!r} needs >= 4 rows, got {len(rows)}")
+    n = min(len(r) for r in rows[:4])
+    return np.array([r[:n] for r in rows[:4]], dtype=np.float32)
+
+
+def decode_tflite_ssd(
+    locations: np.ndarray,
+    raw_scores: np.ndarray,
+    priors: np.ndarray,
+    i_width: int,
+    i_height: int,
+) -> List[DetectedObject]:
+    """Vectorized port of the reference's per-box macro loop (``:652-678``):
+    first class (index ≥ 1) whose sigmoid score ≥ .5 claims the box."""
+    n = min(locations.shape[0], raw_scores.shape[0], priors.shape[1])
+    loc = locations[:n].astype(np.float32)
+    scores = 1.0 / (1.0 + np.exp(-raw_scores[:n].astype(np.float32)))
+    pri = priors[:, :n]
+
+    ycenter = loc[:, 0] / Y_SCALE * pri[2] + pri[0]
+    xcenter = loc[:, 1] / X_SCALE * pri[3] + pri[1]
+    h = np.exp(loc[:, 2] / H_SCALE) * pri[2]
+    w = np.exp(loc[:, 3] / W_SCALE) * pri[3]
+    ymin = ycenter - h / 2.0
+    xmin = xcenter - w / 2.0
+
+    above = scores[:, 1:] >= DETECTION_THRESHOLD  # class 0 is background
+    valid = above.any(axis=1)
+    first_cls = above.argmax(axis=1) + 1  # argmax → first True
+    out: List[DetectedObject] = []
+    for d in np.nonzero(valid)[0]:
+        c = int(first_cls[d])
+        out.append(
+            DetectedObject(
+                class_id=c,
+                x=max(0, int(xmin[d] * i_width)),
+                y=max(0, int(ymin[d] * i_height)),
+                width=int(w[d] * i_width),
+                height=int(h[d] * i_height),
+                prob=float(scores[d, c]),
+            )
+        )
+    return out
+
+
+def iou(a: DetectedObject, b: DetectedObject) -> float:
+    x1, y1 = max(a.x, b.x), max(a.y, b.y)
+    x2 = min(a.x + a.width, b.x + b.width)
+    y2 = min(a.y + a.height, b.y + b.height)
+    w, h = max(0, x2 - x1 + 1), max(0, y2 - y1 + 1)
+    inter = float(w * h)
+    union = a.width * a.height + b.width * b.height - inter
+    return max(inter / union, 0.0) if union > 0 else 0.0
+
+
+def nms(objs: List[DetectedObject],
+        pre_top_k: Optional[int] = PRE_NMS_TOP_K) -> List[DetectedObject]:
+    """Greedy IoU-0.5 suppression over the ``pre_top_k`` highest-prob
+    candidates (None = uncapped — used when the candidate set is already
+    bounded, e.g. the fused device-side top-k)."""
+    objs = sorted(objs, key=lambda o: -o.prob)
+    if pre_top_k is not None:
+        objs = objs[:pre_top_k]
+    keep = [True] * len(objs)
+    for i in range(len(objs)):
+        if not keep[i]:
+            continue
+        for j in range(i + 1, len(objs)):
+            if keep[j] and iou(objs[i], objs[j]) > THRESHOLD_IOU:
+                keep[j] = False
+    return [o for o, k in zip(objs, keep) if k]
+
+
+@register_decoder("bounding_boxes")
+class BoundingBoxes(DecoderPlugin):
+    def init(self, options: List[str]) -> None:
+        opts = list(options) + [""] * (5 - len(options))
+        self.submode = opts[0] or "tflite-ssd"
+        if self.submode not in ("tflite-ssd", "tf-ssd", "fused-ssd"):
+            raise ValueError(f"bounding_boxes: unknown sub-mode {self.submode!r}")
+        self.labels: Optional[List[str]] = None
+        if opts[1]:
+            with open(opts[1], "r", encoding="utf-8") as f:
+                self.labels = [ln.strip() for ln in f if ln.strip()]
+        self.priors: Optional[np.ndarray] = None
+        if opts[2]:
+            self.priors = load_box_priors(opts[2])
+        self.width, self.height = _parse_wh(opts[3], 640, 480)
+        self.i_width, self.i_height = _parse_wh(opts[4], 300, 300)
+
+    def out_spec(self, in_spec: TensorsSpec) -> TensorsSpec:
+        if self.submode == "tflite-ssd":
+            if in_spec.num_tensors != 2:
+                raise ValueError("tflite-ssd needs 2 tensors (boxes, scores)")
+            if self.priors is None:
+                raise ValueError("tflite-ssd needs a box-priors file (option3)")
+        elif self.submode == "fused-ssd":
+            # models/ssd_mobilenet.decode_topk already ran ON DEVICE: one
+            # (K, 6) tensor [x, y, w, h, class, score], geometry in [0,1]
+            if in_spec.num_tensors != 1:
+                raise ValueError("fused-ssd needs 1 tensor (topk detections)")
+        elif in_spec.num_tensors != 4:
+            raise ValueError("tf-ssd needs 4 tensors (num, classes, scores, boxes)")
+        return TensorsSpec(
+            tensors=(TensorSpec(dtype=np.uint8, shape=(self.height, self.width, 4)),),
+            rate=in_spec.rate,
+        )
+
+    def _detect(self, frame: Frame) -> List[DetectedObject]:
+        if self.submode == "tflite-ssd":
+            boxes = np.asarray(frame.tensor(0), dtype=np.float32)
+            scores = np.asarray(frame.tensor(1), dtype=np.float32)
+            boxes = boxes.reshape(-1, boxes.shape[-1])
+            scores = scores.reshape(-1, scores.shape[-1])
+            objs = decode_tflite_ssd(
+                boxes, scores, self.priors, self.i_width, self.i_height
+            )
+            objs = nms(objs)
+        elif self.submode == "fused-ssd":
+            det = np.asarray(frame.tensor(0), dtype=np.float32).reshape(-1, 6)
+            objs = []
+            for x, y, w, h, c, s in det:
+                if s < DETECTION_THRESHOLD:
+                    continue  # top-k is score-sorted, but keep it robust
+                objs.append(
+                    DetectedObject(
+                        class_id=int(c),
+                        x=max(0, int(x * self.i_width)),
+                        y=max(0, int(y * self.i_height)),
+                        width=int(w * self.i_width),
+                        height=int(h * self.i_height),
+                        prob=float(s),
+                    )
+                )
+            # the device-side top-k already bounded the candidate set —
+            # honor whatever K the fused head was built with
+            objs = nms(objs, pre_top_k=None)
+        else:  # tf-ssd
+            num = int(np.asarray(frame.tensor(0)).reshape(-1)[0])
+            classes = np.asarray(frame.tensor(1)).reshape(-1)[:num]
+            scores = np.asarray(frame.tensor(2)).reshape(-1)[:num]
+            boxes = np.asarray(frame.tensor(3)).reshape(-1, 4)[:num]
+            objs = []
+            for c, s, b in zip(classes, scores, boxes):
+                if s < DETECTION_THRESHOLD:
+                    continue
+                ymin, xmin, ymax, xmax = (float(v) for v in b)
+                objs.append(
+                    DetectedObject(
+                        class_id=int(c),
+                        x=int(xmin * self.i_width),
+                        y=int(ymin * self.i_height),
+                        width=int((xmax - xmin) * self.i_width),
+                        height=int((ymax - ymin) * self.i_height),
+                        prob=float(s),
+                    )
+                )
+        for o in objs:
+            if self.labels and 0 <= o.class_id < len(self.labels):
+                o.label = self.labels[o.class_id]
+        return objs
+
+    def decode(self, frame: Frame, in_spec: TensorsSpec) -> Frame:
+        del in_spec
+        objs = self._detect(frame)
+        canvas = draw.new_canvas(self.width, self.height)
+        sx = self.width / self.i_width
+        sy = self.height / self.i_height
+        for o in objs:
+            color = draw.color_for_class(o.class_id)
+            x, y = int(o.x * sx), int(o.y * sy)
+            draw.draw_rect(
+                canvas, x, y, int(o.width * sx), int(o.height * sy), color
+            )
+            # class label above the box (inside when clipped at the top),
+            # like the reference's sprite text (tensordec-boundingbox.c:78)
+            text = o.label if o.label else str(o.class_id)
+            _, th = font.text_extent(text)
+            ly = y - th - 2
+            font.draw_label(
+                canvas,
+                x,
+                ly if ly >= 0 else y + 2,
+                text,
+                draw.WHITE,
+                bg=color,
+            )
+        out = frame.with_tensors((canvas,))
+        out.meta["objects"] = objs
+        return out
+
+
+def _parse_wh(opt: str, dw: int, dh: int):
+    if not opt:
+        return dw, dh
+    w, _, h = opt.partition(":")
+    return int(w), int(h)

@@ -1,0 +1,54 @@
+"""``image_labeling`` decoder: classifier scores + label file → label text.
+
+Analog of ``ext/nnstreamer/tensor_decoder/tensordec-imagelabel.c``:
+``option1`` is the labels file (one label per line, ``:96+``); decode is an
+argmax over the scores tensor (``:43-49``) emitting the matching label as a
+text frame (utf-8 bytes; the decoded string also rides in
+``meta["label"]`` / ``meta["label_index"]``).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from ..buffer import Frame
+from ..elements.decoder import DecoderPlugin, register_decoder
+from ..spec import TensorSpec, TensorsSpec
+
+
+@register_decoder("image_labeling")
+class ImageLabeling(DecoderPlugin):
+    def init(self, options: List[str]) -> None:
+        self.labels: Optional[List[str]] = None
+        if options and options[0]:
+            with open(options[0], "r", encoding="utf-8") as f:
+                self.labels = [ln.strip() for ln in f if ln.strip()]
+
+    def set_labels(self, labels: List[str]) -> None:
+        self.labels = list(labels)
+
+    def out_spec(self, in_spec: TensorsSpec) -> TensorsSpec:
+        t = in_spec.tensors[0]
+        if t.rank is None:
+            raise ValueError("image_labeling needs a fixed score tensor")
+        # variable-length text: spec advertises dtype only
+        return TensorsSpec(
+            tensors=(TensorSpec(dtype=np.uint8, shape=None),), rate=in_spec.rate
+        )
+
+    def decode(self, frame: Frame, in_spec: TensorsSpec) -> Frame:
+        del in_spec
+        scores = np.asarray(frame.tensor(0)).reshape(-1)
+        idx = int(np.argmax(scores))
+        if self.labels is not None and idx < len(self.labels):
+            label = self.labels[idx]
+        else:
+            label = str(idx)
+        data = np.frombuffer(label.encode("utf-8"), dtype=np.uint8)
+        out = frame.with_tensors((data,))
+        out.meta["label"] = label
+        out.meta["label_index"] = idx
+        out.meta["score"] = float(scores[idx])
+        return out

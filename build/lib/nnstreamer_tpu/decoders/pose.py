@@ -1,0 +1,116 @@
+"""``pose_estimation`` decoder: 14-keypoint heatmaps → skeleton overlay.
+
+Analog of ``ext/nnstreamer/tensor_decoder/tensordec-pose.c``: input is one
+heatmap tensor shaped (grid_h, grid_w, 14) (NNS ``14:w:h``, asserted at
+``:218``); per keypoint, decode takes the argmax cell (``:473-493``), then
+draws the 13-edge skeleton (``:401-437``) scaled into an RGBA canvas.
+
+option1 = output ``W:H``; option2 = input grid ``W:H``; option3 = keypoint
+label file (one name per line) — when given, each joint is annotated with
+its name using the built-in raster font (the reference's sprite text,
+``tensordec-font.c``).
+Keypoints ride in ``meta["pose"]`` as (x, y, prob) triples in grid coords.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+
+from ..buffer import Frame
+from ..elements.decoder import DecoderPlugin, register_decoder
+from ..spec import TensorSpec, TensorsSpec
+from . import draw, font
+from .bounding_boxes import _parse_wh
+
+POSE_SIZE = 14
+# The reference's skeleton edges (tensordec-pose.c:401-437), 0-indexed:
+# top(0)-neck(1), neck-shoulders-elbows-wrists, neck-hips-knees-ankles.
+EDGES = [
+    (0, 1),
+    (1, 2), (2, 3), (3, 4),      # right arm
+    (1, 5), (5, 6), (6, 7),      # left arm
+    (1, 8), (8, 9), (9, 10),     # right leg
+    (1, 11), (11, 12), (12, 13), # left leg
+]
+
+
+@register_decoder("pose_estimation")
+class PoseEstimation(DecoderPlugin):
+    def init(self, options: List[str]) -> None:
+        opts = list(options) + [""] * (3 - len(options))
+        self.width, self.height = _parse_wh(opts[0], 640, 480)
+        self.i_width, self.i_height = _parse_wh(opts[1], 0, 0)
+        self.labels: List[str] = []
+        if opts[2]:
+            with open(opts[2], "r", encoding="utf-8") as f:
+                self.labels = [ln.strip() for ln in f if ln.strip()]
+
+    @staticmethod
+    def _is_fused(shape) -> bool:
+        """(…,14,3) = keypoints already decoded on device
+        (``models/posenet.decode_keypoints``)."""
+        return (
+            shape is not None
+            and len(shape) >= 2
+            and shape[-1] == 3
+            and shape[-2] == POSE_SIZE
+        )
+
+    def out_spec(self, in_spec: TensorsSpec) -> TensorsSpec:
+        t = in_spec.tensors[0]
+        if self._is_fused(t.shape):
+            if not (self.i_width and self.i_height):
+                raise ValueError(
+                    "pose_estimation with fused keypoints needs the grid "
+                    "size (option2=W:H) to scale coordinates"
+                )
+        elif t.shape is None or t.shape[-1] != POSE_SIZE:
+            raise ValueError(
+                f"pose_estimation needs (h, w, {POSE_SIZE}) heatmaps or "
+                f"({POSE_SIZE}, 3) fused keypoints, got {t}"
+            )
+        return TensorsSpec(
+            tensors=(TensorSpec(dtype=np.uint8, shape=(self.height, self.width, 4)),),
+            rate=in_spec.rate,
+        )
+
+    def decode(self, frame: Frame, in_spec: TensorsSpec) -> Frame:
+        del in_spec
+        raw = np.asarray(frame.tensor(0), dtype=np.float32)
+        if self._is_fused(raw.shape):
+            kps = raw.reshape(-1, POSE_SIZE, 3)[0]  # device-decoded (14,3)
+            i_w, i_h = self.i_width, self.i_height
+            keypoints = [(int(x), int(y), float(p)) for x, y, p in kps]
+        else:
+            hm = raw.reshape(-1, raw.shape[-2], raw.shape[-1]) if raw.ndim > 3 else raw
+            grid_h, grid_w = hm.shape[0], hm.shape[1]
+            i_w = self.i_width or grid_w
+            i_h = self.i_height or grid_h
+            # argmax per keypoint channel (vectorized over all 14 at once)
+            flat = hm.reshape(-1, POSE_SIZE)
+            idx = flat.argmax(axis=0)
+            probs = flat[idx, np.arange(POSE_SIZE)]
+            ys, xs = np.unravel_index(idx, (grid_h, grid_w))
+            keypoints = [
+                (int(x), int(y), float(p)) for x, y, p in zip(xs, ys, probs)
+            ]
+
+        canvas = draw.new_canvas(self.width, self.height)
+        sx = self.width / i_w
+        sy = self.height / i_h
+        pts = [(int(x * sx), int(y * sy)) for x, y, _ in keypoints]
+        for a, b in EDGES:
+            draw.draw_line(canvas, pts[a][0], pts[a][1], pts[b][0], pts[b][1], draw.WHITE)
+        for i, (x, y) in enumerate(pts):
+            draw.draw_dot(canvas, x, y, draw.WHITE)
+            if self.labels:
+                name = self.labels[i] if i < len(self.labels) else str(i)
+                font.draw_label(
+                    canvas, x + 4, y - 4, name, draw.WHITE,
+                    bg=np.array([0, 0, 0, 255], np.uint8),
+                )
+        out = frame.with_tensors((canvas,))
+        out.meta["pose"] = keypoints
+        return out

@@ -1,0 +1,5 @@
+"""Stream elements (the reference's 13 + runtime plumbing).
+
+Modules are imported lazily via the registry
+(:mod:`nnstreamer_tpu.graph.registry`); importing this package does not pull
+jax/torch."""

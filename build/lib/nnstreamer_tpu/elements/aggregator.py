@@ -1,0 +1,164 @@
+"""``tensor_aggregator``: sliding-window / batch aggregation over frames.
+
+Analog of ``gst/nnstreamer/tensor_aggregator/tensor_aggregator.c`` with the
+GstAdapter accumulate+flush semantics of its README diagram
+(``tensor_aggregator/README.md:14-35``; props ``tensor_aggregator.c:207-215``):
+
+- ``frames_in``    — frames contained in each incoming buffer (along
+  ``frames_dim``); the incoming axis length must divide by it.
+- ``frames_out``   — frames per outgoing buffer (concatenated along
+  ``frames_dim``).
+- ``frames_flush`` — frames dropped after each output; 0 ⇒ ``frames_out``
+  (tumbling window); < ``frames_out`` ⇒ sliding window with overlap.
+- ``frames_dim``   — NNS dimension index (innermost-first) to window along.
+
+This is the temporal-windowing backbone for sequence models (survey §5
+"long-context" analog): an aggregator in front of a filter turns a sample
+stream into overlapping model windows.
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..buffer import Frame, NONE_TS, is_valid_ts
+from ..graph.node import NegotiationError, Node, Pad
+from ..graph.registry import register_element
+from ..spec import TensorSpec, TensorsSpec
+
+
+@register_element("tensor_aggregator")
+class TensorAggregator(Node):
+    def __init__(
+        self,
+        name: Optional[str] = None,
+        frames_in: int = 1,
+        frames_out: int = 1,
+        frames_flush: int = 0,
+        frames_dim: int = 3,
+        concat: bool = True,
+    ):
+        super().__init__(name)
+        self.add_sink_pad("sink")
+        self.add_src_pad("src")
+        self.frames_in = int(frames_in)
+        self.frames_out = int(frames_out)
+        self.frames_flush = int(frames_flush) or self.frames_out
+        self.nns_dim = int(frames_dim)
+        self.concat = concat in (True, "true", "1")
+        if self.frames_in < 1 or self.frames_out < 1 or self.frames_flush < 1:
+            raise ValueError("frames-in/out/flush must be >= 1")
+        self._axis = 0
+        self._window: collections.deque = collections.deque()
+        self._timing: collections.deque = collections.deque()
+        self._keep_state_on_start = False
+
+    def configure(self, in_specs: Dict[str, TensorsSpec]) -> Dict[str, TensorsSpec]:
+        spec = in_specs["sink"]
+        if spec.num_tensors != 1:
+            raise NegotiationError(f"{self.name}: aggregator input must be single-tensor")
+        t = spec.tensors[0]
+        rank = t.rank
+        if self.nns_dim >= rank:
+            # NNS pads rank to 4 with trailing 1s; windowing along a padded
+            # dim prepends a new numpy axis (3:224:224:1 → window along dim 3).
+            self._axis = -1  # sentinel: stack on new leading axis
+            unit = t.shape
+            if self.frames_in != 1:
+                raise NegotiationError(
+                    f"{self.name}: frames-in>1 needs an explicit frames dim in input"
+                )
+            out_shape = (self.frames_out,) + unit
+        else:
+            self._axis = rank - 1 - self.nns_dim
+            if t.shape[self._axis] % self.frames_in:
+                raise NegotiationError(
+                    f"{self.name}: input dim {t.shape[self._axis]} not divisible "
+                    f"by frames-in={self.frames_in}"
+                )
+            unit_len = t.shape[self._axis] // self.frames_in
+            out_shape = tuple(
+                unit_len * self.frames_out if ax == self._axis else d
+                for ax, d in enumerate(t.shape)
+            )
+        rate = spec.rate
+        if rate is not None and rate != 0:
+            rate = rate * self.frames_in / self.frames_flush
+        out = TensorSpec(dtype=t.dtype, shape=out_shape)
+        if self._keep_state_on_start:
+            # resuming from a checkpoint (negotiation is the last step
+            # before dataflow in this runtime, so consume the flag here)
+            self._keep_state_on_start = False
+        else:
+            self._window.clear()
+            self._timing.clear()
+        return {"src": TensorsSpec(tensors=(out,), rate=rate)}
+
+    def _split_units(self, arr) -> List:
+        if self._axis == -1:
+            return [arr]
+        n = self.frames_in
+        if n == 1:
+            return [arr]
+        return [
+            u for u in np.split(np.asarray(arr), n, axis=self._axis)
+        ]
+
+    def _emit_window(self) -> Frame:
+        units = [self._window[i] for i in range(self.frames_out)]
+        if self._axis == -1:
+            out = np.stack([np.asarray(u) for u in units], axis=0)
+        elif len(units) == 1:
+            out = np.asarray(units[0])
+        else:
+            out = np.concatenate([np.asarray(u) for u in units], axis=self._axis)
+        pts = self._timing[0][0]
+        durs = [d for (_, d) in list(self._timing)[: self.frames_out] if is_valid_ts(d)]
+        dur = sum(durs) if durs else NONE_TS
+        for _ in range(min(self.frames_flush, len(self._window))):
+            self._window.popleft()
+            self._timing.popleft()
+        return Frame.of(out, pts=pts, duration=dur)
+
+    def process(self, pad: Pad, frame: Frame):
+        del pad
+        units = self._split_units(frame.tensor(0))
+        per_dur = frame.duration
+        if is_valid_ts(per_dur) and len(units) > 1:
+            per_dur //= len(units)
+        for i, u in enumerate(units):
+            pts = frame.pts
+            if is_valid_ts(pts) and is_valid_ts(per_dur):
+                pts += i * per_dur
+            self._window.append(u)
+            self._timing.append((pts, per_dur))
+        out = []
+        while len(self._window) >= self.frames_out:
+            out.append(self._emit_window())
+        return out or None
+
+    def start(self) -> None:
+        super().start()
+        if self._keep_state_on_start:
+            # resuming from a checkpoint: keep the restored window
+            return
+        self._window.clear()
+        self._timing.clear()
+
+    # -- checkpoint/resume (utils.checkpoint protocol) ----------------------
+
+    def state_dict(self):
+        return {
+            "window": [np.asarray(u) for u in self._window],
+            "timing": [list(t) for t in self._timing],
+        }
+
+    def load_state(self, state) -> None:
+        self._window = collections.deque(np.asarray(u) for u in state["window"])
+        self._timing = collections.deque(
+            (int(p), int(d)) for p, d in state["timing"]
+        )
+        self._keep_state_on_start = True

@@ -1,0 +1,133 @@
+"""``tensor_batch`` / ``tensor_unbatch``: the mux→device-mesh batching bridge.
+
+The reference's concurrency story for multi-stream inference is "one
+interpreter per element" — N camera streams mean N independent
+``tensor_filter`` invokes.  The TPU-native replacement (survey §2.6, §3.3:
+``tensor_mux`` is "the batching front-door for the TPU pmap path") turns the
+muxed N-tensor frame into ONE batched tensor so a single XLA invoke runs all
+streams at once, with the batch dim sharded over the device mesh by the
+``jax-sharded`` backend (data parallelism over ICI):
+
+    src×N → tensor_mux → tensor_batch → tensor_filter framework=jax-sharded
+          → tensor_unbatch → tensor_demux → sink×N
+
+- ``tensor_batch``   — frame with N same-spec tensors → one ``(N, *shape)``
+  tensor (``jnp.stack``: stays on device when inputs are device-resident).
+- ``tensor_unbatch`` — inverse: ``(N, *shape)`` → N tensors, so the demuxed
+  per-stream outputs line up with the original pads.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from ..buffer import Frame
+from ..graph.node import NegotiationError, Node, Pad
+from ..graph.registry import register_element
+from ..spec import TensorSpec, TensorsSpec
+
+
+@register_element("tensor_batch")
+class TensorBatch(Node):
+    def __init__(self, name: Optional[str] = None):
+        super().__init__(name)
+        self.add_sink_pad("sink")
+        self.add_src_pad("src")
+        self._n = 0
+
+    def configure(self, in_specs: Dict[str, TensorsSpec]) -> Dict[str, TensorsSpec]:
+        spec = in_specs["sink"]
+        if spec.num_tensors < 1:
+            raise NegotiationError(f"{self.name}: needs at least one tensor")
+        first = spec.tensors[0]
+        for t in spec.tensors[1:]:
+            if t.shape != first.shape or t.dtype != first.dtype:
+                raise NegotiationError(
+                    f"{self.name}: all tensors must share one spec to batch; "
+                    f"got {t} vs {first}"
+                )
+        self._n = spec.num_tensors
+        out = TensorSpec(dtype=first.dtype, shape=(self._n,) + tuple(first.shape))
+        return {"src": TensorsSpec(tensors=(out,), rate=spec.rate)}
+
+    def process(self, pad: Pad, frame: Frame):
+        del pad
+        import jax
+
+        if any(isinstance(t, jax.Array) for t in frame.tensors):
+            import jax.numpy as jnp
+
+            # device-resident inputs: stack on device, stays resident
+            return frame.with_tensors((jnp.stack(frame.tensors, axis=0),))
+        # host inputs: one host memcpy — the downstream jax filter's flat
+        # wire path then moves the whole batch in a single cheap transfer
+        # (per-tensor jnp.stack here would pay N tiled-layout device_puts)
+        import numpy as np
+
+        return frame.with_tensors((np.stack(frame.tensors, axis=0),))
+
+
+@register_element("tensor_unbatch")
+class TensorUnbatch(Node):
+    def __init__(self, name: Optional[str] = None):
+        super().__init__(name)
+        self.add_sink_pad("sink")
+        self.add_src_pad("src")
+        self._to_host = True
+        self._split = None  # jitted row-splitter (jit caches per input shape)
+
+    def configure(self, in_specs: Dict[str, TensorsSpec]) -> Dict[str, TensorsSpec]:
+        spec = in_specs["sink"]
+        if spec.num_tensors != 1:
+            raise NegotiationError(f"{self.name}: expects one batched tensor")
+        t = spec.tensors[0]
+        if t.rank < 1 or t.shape[0] is None:
+            raise NegotiationError(f"{self.name}: batch dim must be fixed, got {t}")
+        n = t.shape[0]
+        per = TensorSpec(dtype=t.dtype, shape=tuple(t.shape[1:]))
+        from ..graph.residency import chain_device_resident
+
+        # host consumers read every row anyway: one device→host copy of the
+        # whole batch (often already in flight — the upstream filter starts
+        # it async) beats N per-row d2h round trips; device consumers get a
+        # single compiled split instead of N eager slice dispatches.
+        self._to_host = not chain_device_resident(self, "down")
+        return {"src": TensorsSpec(tensors=(per,) * n, rate=spec.rate)}
+
+    def _device_split(self, batched):
+        if self._split is None:
+            import jax
+
+            # x.shape is static under trace; jit's own cache handles any
+            # alternation of input shapes across renegotiations
+            self._split = jax.jit(
+                lambda x: tuple(x[i] for i in range(x.shape[0]))
+            )
+        return self._split(batched)
+
+    def process(self, pad: Pad, frame: Frame):
+        del pad
+        from ..buffer import WireTensor
+
+        batched = frame.tensors[0]
+        if isinstance(batched, WireTensor):
+            if self._to_host:
+                # wire-layout payload, host consumers: one d2h materialize
+                import numpy as np
+
+                batched = np.asarray(batched)
+            else:
+                # device consumers: restore logical geometry ON DEVICE
+                # (cheap reshape) and split there — never a host round trip
+                return frame.with_tensors(
+                    self._device_split(batched.data.reshape(batched.shape))
+                )
+        elif hasattr(batched, "copy_to_host_async"):  # jax Array
+            if self._to_host:
+                import numpy as np
+
+                batched = np.asarray(batched)
+            else:
+                return frame.with_tensors(self._device_split(batched))
+        # numpy: row views share the parent buffer, no copies
+        return frame.with_tensors(tuple(batched[i] for i in range(batched.shape[0])))

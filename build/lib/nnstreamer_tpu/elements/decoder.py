@@ -1,0 +1,116 @@
+"""``tensor_decoder``: tensor streams → media, via decoder subplugins.
+
+Analog of ``gst/nnstreamer/tensor_decoder/tensordec.c``: the ``mode``
+property picks a decoder from the registry (``GstTensorDecoderDef`` vtable,
+``nnstreamer_plugin_api_decoder.h:38-63``), ``option1..N`` parametrize it,
+and output caps come from the subplugin (``tensordec.c:222-234``).
+"""
+
+from __future__ import annotations
+
+import importlib
+import threading
+from typing import Dict, List, Optional
+
+from ..buffer import Frame
+from ..graph.node import NegotiationError, Node, Pad
+from ..graph.registry import register_element
+from ..spec import TensorsSpec
+
+_DECODERS: Dict[str, type] = {}
+_LOCK = threading.Lock()
+_BUILTIN = {
+    "direct_video": "nnstreamer_tpu.decoders.direct_video",
+    "image_labeling": "nnstreamer_tpu.decoders.image_label",
+    "bounding_boxes": "nnstreamer_tpu.decoders.bounding_boxes",
+    "pose_estimation": "nnstreamer_tpu.decoders.pose",
+}
+
+
+def register_decoder(name: str):
+    def deco(cls):
+        with _LOCK:
+            _DECODERS[name] = cls
+        cls.name = name
+        return cls
+
+    return deco
+
+
+def get_decoder(name: str):
+    cls = _DECODERS.get(name)
+    if cls is None and name in _BUILTIN:
+        importlib.import_module(_BUILTIN[name])
+        cls = _DECODERS.get(name)
+    if cls is None:
+        from ..conf import lookup_with_plugin_fallback
+
+        cls = lookup_with_plugin_fallback(lambda: _DECODERS.get(name))
+    if cls is None:
+        raise ValueError(f"unknown decoder mode {name!r}; known: {sorted(known_decoders())}")
+    return cls()
+
+
+def known_decoders():
+    return set(_DECODERS) | set(_BUILTIN)
+
+
+class DecoderPlugin:
+    """Subplugin protocol (GstTensorDecoderDef analog):
+
+    - ``init(options)`` — option1..N strings;
+    - ``out_spec(in_spec) -> TensorsSpec`` — output caps (getOutCaps);
+    - ``decode(frame, in_spec) -> Frame`` — the transform (decode).
+    """
+
+    name = "base"
+
+    def init(self, options: List[str]) -> None:
+        del options
+
+    def out_spec(self, in_spec: TensorsSpec) -> TensorsSpec:
+        raise NotImplementedError
+
+    def decode(self, frame: Frame, in_spec: TensorsSpec) -> Frame:
+        raise NotImplementedError
+
+
+@register_element("tensor_decoder")
+class TensorDecoder(Node):
+    def __init__(self, name: Optional[str] = None, mode: str = "", **options):
+        super().__init__(name)
+        self.add_sink_pad("sink")
+        self.add_src_pad("src")
+        if not mode:
+            raise ValueError("tensor_decoder requires mode=")
+        self.mode = mode
+        self.plugin = get_decoder(mode)
+        # option1..optionN → ordered list
+        opts: List[str] = []
+        for i in range(1, 10):
+            key = f"option{i}"
+            if key in options:
+                opts.append(str(options.pop(key)))
+            else:
+                opts.append("")
+        while opts and opts[-1] == "":
+            opts.pop()
+        if options:
+            raise ValueError(f"unknown tensor_decoder properties: {sorted(options)}")
+        self.plugin.init(opts)
+        self._in_spec: Optional[TensorsSpec] = None
+
+    def configure(self, in_specs: Dict[str, TensorsSpec]) -> Dict[str, TensorsSpec]:
+        in_spec = in_specs["sink"]
+        self._in_spec = in_spec
+        try:
+            out = self.plugin.out_spec(in_spec)
+        except ValueError as exc:
+            raise NegotiationError(f"{self.name}: {exc}") from exc
+        if out.rate is None and in_spec.rate is not None:
+            out = TensorsSpec(tensors=out.tensors, rate=in_spec.rate)
+        return {"src": out}
+
+    def process(self, pad: Pad, frame: Frame):
+        del pad
+        return self.plugin.decode(frame, self._in_spec)

@@ -1,0 +1,225 @@
+"""``tensor_dynbatch`` / ``tensor_dynunbatch``: adaptive micro-batching.
+
+``tensor_mux → tensor_batch`` batches a *fixed* number of parallel streams
+(survey §2.6's north star).  This pair batches adaptively **within one
+stream**: whatever frames have queued up behind a slow consumer coalesce
+into a single batched invoke — the serving-framework "dynamic batching"
+discipline (and the TPU-native answer to a slow or erratic host↔device
+wire: transfer + dispatch costs amortize over the pile-up, automatically,
+while a lightly-loaded stream stays at batch 1 for latency).
+
+Mechanics:
+
+- ``tensor_dynbatch`` is queue-like (own worker thread, bounded buffer).
+  Each round it pops one frame then drains everything else pending, up to
+  ``max_batch``; the set is stacked into one ``(bucket, *shape)`` frame.
+- Batch sizes round up to power-of-2 **buckets** (padding repeats the
+  last frame) so the downstream XLA filter compiles one executable per
+  bucket — the backend's bounded LRU executable cache makes bucket flips
+  cheap after first sight, and per-frame signature checks are skipped via
+  the polymorphic (batch=None) negotiated spec, exactly the drift path
+  the jax backend already handles.
+- Frame timing/meta ride in ``meta["dynbatch"]``; ``tensor_dynunbatch``
+  splits the batched result back into the original frames (padding rows
+  dropped), preserving per-frame pts/duration.
+
+The model under the filter must accept a polymorphic leading batch dim
+(``input_spec`` shape ``(None, ...)``).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..buffer import Event, Frame
+from ..graph.node import NegotiationError, Node, Pad
+from ..graph.registry import register_element
+from ..native import OK, SHUTDOWN
+from ..native.queue import make_frame_queue
+from ..spec import TensorSpec, TensorsSpec
+
+_POLL_MS = 100
+
+
+def _bucket(n: int, max_batch: int) -> int:
+    b = 1
+    while b < n:
+        b <<= 1
+    return min(b, max_batch)
+
+
+@register_element("tensor_dynbatch")
+class DynBatch(Node):
+    def __init__(
+        self,
+        name: Optional[str] = None,
+        max_batch: int = 8,
+        max_size_buffers: int = 64,
+    ):
+        super().__init__(name)
+        self.add_sink_pad("sink")
+        self.add_src_pad("src")
+        self.max_batch = int(max_batch)
+        if self.max_batch < 1 or (self.max_batch & (self.max_batch - 1)):
+            # the bucket set {1, 2, 4, ..., max_batch} bounds the filter's
+            # per-bucket executable cache; a non-power-of-2 cap would emit
+            # an extra odd bucket and silently break that reasoning
+            raise ValueError(
+                f"max_batch must be a power of two, got {self.max_batch}"
+            )
+        self.max_size = int(max_size_buffers)
+        self._q = None
+        self.batches_emitted = 0  # observability: how often we coalesced
+        self.frames_in = 0
+
+    def configure(self, in_specs: Dict[str, TensorsSpec]) -> Dict[str, TensorsSpec]:
+        spec = in_specs["sink"]
+        if not spec.tensors_fixed:
+            raise NegotiationError(
+                f"{self.name}: dynbatch needs fixed upstream tensors, got {spec}"
+            )
+        out = tuple(
+            TensorSpec(dtype=t.dtype, shape=(None,) + tuple(t.shape))
+            for t in spec.tensors
+        )
+        # batch dim None → downstream pads skip per-frame sig checks and the
+        # jax backend treats each new bucket as spec drift (LRU-cached)
+        return {"src": TensorsSpec(tensors=out, rate=spec.rate)}
+
+    def _ensure_queue(self):
+        if self._q is None:
+            self._q = make_frame_queue(self.max_size)
+
+    def _dispatch(self, pad: Pad, item) -> None:
+        del pad
+        self._ensure_queue()
+        self._q.push(item, leaky="no")
+
+    def spawn_threads(self) -> List[threading.Thread]:
+        self._ensure_queue()
+        return [threading.Thread(target=self._worker, name=f"dynbatch:{self.name}")]
+
+    def _emit_batch(self, frames: List[Frame]) -> None:
+        n = len(frames)
+        b = _bucket(n, self.max_batch)
+        pad_rows = b - n
+        stacked = []
+        for ti in range(frames[0].num_tensors):
+            rows = [np.asarray(f.tensors[ti]) for f in frames]
+            rows.extend([rows[-1]] * pad_rows)  # pad: repeat last frame
+            stacked.append(np.stack(rows, axis=0))
+        meta = {
+            "dynbatch": {
+                "n": n,
+                "pts": [f.pts for f in frames],
+                "duration": [f.duration for f in frames],
+            }
+        }
+        self.frames_in += n
+        self.batches_emitted += 1
+        self.push(Frame(tensors=tuple(stacked), pts=frames[0].pts,
+                        duration=frames[0].duration, meta=meta))
+
+    def _worker(self) -> None:
+        q = self._q
+        pending: List[Frame] = []
+        while True:
+            status, item = q.pop(_POLL_MS)
+            if status == SHUTDOWN:
+                return
+            if status != OK:
+                continue
+            try:
+                if isinstance(item, Event):
+                    if pending:  # events never reorder past queued frames
+                        self._emit_batch(pending)
+                        pending = []
+                    if self._event(item):
+                        return
+                    continue
+                pending.append(item)
+                # coalesce whatever else is already waiting (never block)
+                while len(pending) < self.max_batch:
+                    status, nxt = q.pop(0)
+                    if status != OK:
+                        break
+                    if isinstance(nxt, Event):
+                        self._emit_batch(pending)
+                        pending = []
+                        if self._event(nxt):
+                            return
+                        break
+                    pending.append(nxt)
+                if pending:
+                    self._emit_batch(pending)
+                    pending = []
+            except BaseException as exc:  # noqa: BLE001
+                if self.pipeline is not None:
+                    self.pipeline.post_error(self, exc)
+                return
+
+    def _event(self, event: Event) -> bool:
+        """Handle an in-band event on the worker thread; True = stream over.
+        Caps events renegotiate THIS node (the batched spec downstream must
+        track the new per-frame spec — same discipline as queue.py)."""
+        if event.kind == "eos":
+            self.sink_pads["sink"].eos = True
+            self._on_eos()
+            return True
+        if event.kind == "caps":
+            self._handle_caps(self.sink_pads["sink"], event.payload)
+        else:
+            self.on_event(self.sink_pads["sink"], event)
+        return False
+
+    def interrupt(self) -> None:
+        if self._q is not None:
+            self._q.shutdown()
+
+    def stop(self) -> None:
+        if self._q is not None:
+            self._q.shutdown()
+            self._q = None
+        super().stop()
+
+
+@register_element("tensor_dynunbatch")
+class DynUnbatch(Node):
+    """Inverse of :class:`DynBatch`: split a batched frame back into its
+    original per-frame stream using the ``dynbatch`` meta (padding rows
+    dropped, per-frame timing restored)."""
+
+    def __init__(self, name: Optional[str] = None):
+        super().__init__(name)
+        self.add_sink_pad("sink")
+        self.add_src_pad("src")
+
+    def configure(self, in_specs: Dict[str, TensorsSpec]) -> Dict[str, TensorsSpec]:
+        spec = in_specs["sink"]
+        out = []
+        for t in spec.tensors:
+            if t.rank < 1:
+                raise NegotiationError(
+                    f"{self.name}: expected batched tensors, got {t}"
+                )
+            out.append(TensorSpec(dtype=t.dtype, shape=tuple(t.shape[1:])))
+        return {"src": TensorsSpec(tensors=tuple(out), rate=spec.rate)}
+
+    def process(self, pad: Pad, frame: Frame):
+        del pad
+        info = frame.meta.get("dynbatch")
+        n = info["n"] if info else frame.tensors[0].shape[0]
+        # one host materialization per batched tensor (numpy row views after)
+        mats = [np.asarray(t) for t in frame.tensors]
+        out = []
+        for i in range(n):
+            pts = info["pts"][i] if info else frame.pts
+            dur = info["duration"][i] if info else frame.duration
+            out.append(Frame(
+                tensors=tuple(m[i] for m in mats), pts=pts, duration=dur,
+                meta={},
+            ))
+        return out

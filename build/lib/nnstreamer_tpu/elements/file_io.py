@@ -1,0 +1,108 @@
+"""``filesrc`` / ``filesink``: raw-byte file endpoints.
+
+The reference's SSAT tests are built on these: ``filesrc`` feeds raw frames
+into ``tensor_converter`` via ``application/octet-stream`` and ``filesink``
+captures output for golden comparison (e.g.
+``tests/nnstreamer_filter_tensorflow_lite/runTest.sh:70-80``).  ``.npy``
+files additionally load as typed arrays (our golden fixtures are numpy).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Iterable, Optional
+
+import numpy as np
+
+from ..buffer import Frame
+from ..graph.node import Pad, SinkTerminal, SourceNode
+from ..graph.registry import register_element
+from ..spec import TensorSpec, TensorsSpec
+
+
+@register_element("filesrc")
+class FileSrc(SourceNode):
+    """Reads ``location``; yields raw uint8 chunks of ``blocksize`` bytes
+    (-1 = whole file in one frame), or a typed array for ``.npy`` input.
+    """
+
+    def __init__(
+        self,
+        name: Optional[str] = None,
+        location: str = "",
+        blocksize: int = -1,
+        num_buffers: int = -1,
+    ):
+        super().__init__(name)
+        if not location:
+            raise ValueError("filesrc requires location=")
+        self.location = os.fspath(location)
+        self.blocksize = int(blocksize)
+        self.num_buffers = int(num_buffers)
+        self._is_npy = self.location.endswith(".npy")
+
+    def output_spec(self) -> TensorsSpec:
+        if self._is_npy:
+            arr = np.load(self.location, mmap_mode="r")
+            return TensorsSpec.of(TensorSpec(dtype=arr.dtype, shape=tuple(arr.shape)))
+        size = os.path.getsize(self.location)
+        n = size if self.blocksize <= 0 else self.blocksize
+        return TensorsSpec.of(TensorSpec(dtype=np.uint8, shape=(n,)))
+
+    def frames(self) -> Iterable[Frame]:
+        if self._is_npy:
+            yield Frame.of(np.load(self.location))
+            return
+        with open(self.location, "rb") as f:
+            idx = 0
+            while self.num_buffers < 0 or idx < self.num_buffers:
+                if self.stopped:
+                    return
+                n = -1 if self.blocksize <= 0 else self.blocksize
+                chunk = f.read(n)
+                if not chunk:
+                    return
+                if self.blocksize > 0 and len(chunk) < self.blocksize:
+                    return  # trailing partial chunk dropped (raw frame streams)
+                yield Frame.of(np.frombuffer(chunk, dtype=np.uint8))
+                if self.blocksize <= 0:
+                    return
+                idx += 1
+
+
+@register_element("filesink")
+class FileSink(SinkTerminal):
+    """Appends the raw bytes of every tensor in arrival order — byte-exact
+    with the reference's filesink capture for golden comparison."""
+
+    def __init__(self, name: Optional[str] = None, location: str = "", buffer_mode: str = "unbuffered"):
+        super().__init__(name)
+        del buffer_mode
+        if not location:
+            raise ValueError("filesink requires location=")
+        self.location = os.fspath(location)
+        self._f = None
+        self.num_frames = 0
+
+    def start(self) -> None:
+        super().start()
+        self._f = open(self.location, "wb")
+        self.num_frames = 0
+
+    def process(self, pad: Pad, frame: Frame):
+        del pad
+        for t in frame.tensors:
+            self._f.write(np.ascontiguousarray(np.asarray(t)).tobytes())
+        self.num_frames += 1
+        return None
+
+    def drain(self):
+        if self._f is not None:
+            self._f.flush()
+        return None
+
+    def stop(self) -> None:
+        if self._f is not None:
+            self._f.close()
+            self._f = None
+        super().stop()

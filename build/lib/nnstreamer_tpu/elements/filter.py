@@ -1,0 +1,278 @@
+"""``tensor_filter``: the central element — invokes an NN model on the stream.
+
+Analog of ``gst/nnstreamer/tensor_filter/tensor_filter.c`` (the
+GstBaseTransform at ``:132``):
+
+- ``framework=`` selects a backend from the registry (lazy import — the
+  ``dlopen`` analog, ``nnstreamer_subplugin.c:74-103``);
+- the model opens on start (``:873-888``);
+- negotiation reconciles model metadata, user ``input``/``inputtype``/
+  ``output``/``outputtype`` property overrides, and the upstream stream spec
+  (``load_tensor_info``/``configure_tensor``, ``:442-505,513-623``),
+  failing loudly on mismatch;
+- steady state maps input tensors → backend ``invoke`` → output frame
+  (``:316-436``); device-resident backends keep outputs on TPU (the
+  ``allocate_in_invoke`` generalization).
+
+Per-invoke wall time is recorded when profiling is enabled
+(:mod:`nnstreamer_tpu.utils.profiling`).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, Optional
+
+from ..backends.base import FilterBackend, get_backend
+from ..buffer import Frame
+from ..graph.node import NegotiationError, Node, Pad
+from ..graph.registry import register_element
+from ..spec import TensorSpec, TensorsSpec
+
+
+@register_element("tensor_filter")
+class TensorFilter(Node):
+    def __init__(
+        self,
+        name: Optional[str] = None,
+        framework: str = "",
+        model: object = None,
+        custom: str = "",
+        input: str = "",
+        inputtype: str = "",
+        output: str = "",
+        outputtype: str = "",
+        backend: Optional[FilterBackend] = None,
+    ):
+        super().__init__(name)
+        self.add_sink_pad("sink")
+        self.add_src_pad("src")
+        if backend is not None:
+            self.backend = backend
+        else:
+            if not framework:
+                raise ValueError("tensor_filter requires framework=")
+            self.backend = get_backend(framework)
+        self.framework = framework or self.backend.name
+        self.model = model
+        self.custom = str(custom)
+        self._prop_in = self._parse_spec_props(input, inputtype)
+        self._prop_out = self._parse_spec_props(output, outputtype)
+        self._opened = False
+        self._downstream_host = False  # set at configure from topology
+        self._fused_pre: list = []  # TensorTransforms folded in (optimize.py)
+        self._fused_post: list = []
+        self._fusion_dirty = False
+        self.invoke_ns: list = []  # per-invoke latency when profiling
+
+    def set_fused_transforms(self, pre: list, post: list) -> None:
+        """Install transforms fused into this filter's XLA program (called
+        by the graph optimizer, ``graph/optimize.py``)."""
+        self._fused_pre = list(pre)
+        self._fused_post = list(post)
+        self._fusion_dirty = True  # next wrapper install must drop the cache
+
+    @staticmethod
+    def _parse_spec_props(dims: str, types: str) -> Optional[TensorsSpec]:
+        """Parse reference-style ``input=3:224:224:1.1:10`` + ``inputtype=...``
+        property pairs (``tensor_filter_common.c:261-292``; '.' separates
+        multiple tensors)."""
+        if not dims and not types:
+            return None
+        dim_list = [d for d in str(dims).split(".") if d] if dims else []
+        type_list = [t for t in str(types).split(",") if t] if types else []
+        n = max(len(dim_list), len(type_list))
+        tensors = []
+        for i in range(n):
+            d = dim_list[i] if i < len(dim_list) else None
+            t = type_list[i] if i < len(type_list) else None
+            if d is not None:
+                tensors.append(TensorSpec.from_dims_string(d, t))
+            else:
+                from ..spec import dtype_from_name
+
+                tensors.append(TensorSpec(dtype=dtype_from_name(t)))
+        return TensorsSpec(tensors=tuple(tensors))
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> None:
+        super().start()
+        if not self._opened:
+            if self.model is None and getattr(self.backend, "model", None) is not None:
+                # injected pre-opened backend (model already loaded, possibly
+                # with pre-compiled executables in its cache): re-opening
+                # would discard that warm state
+                self._opened = True
+            else:
+                self.backend.open(self.model, self.custom)
+                self._opened = True
+
+    def stop(self) -> None:
+        if self._opened:
+            self.backend.close()
+            self._opened = False
+        super().stop()
+
+    # -- negotiation --------------------------------------------------------
+
+    def sink_spec(self, pad_name: str) -> TensorsSpec:
+        del pad_name
+        if self._fused_pre:
+            # the stream spec is pre-transform; the model spec (and any
+            # input= property, which describes the MODEL input) only applies
+            # after the fused pre-ops run — checked in _install_fusion
+            return TensorsSpec()
+        spec = self.backend.model_spec() if self._opened else None
+        if spec is not None and self._prop_in is not None:
+            merged = spec.intersect(self._prop_in)
+            if merged is None:
+                raise NegotiationError(
+                    f"{self.name}: input property {self._prop_in} conflicts "
+                    f"with model spec {spec}"
+                )
+            return merged
+        return self._prop_in or spec or TensorsSpec()
+
+    def _upstream_device_resident(self) -> bool:
+        from ..graph.residency import chain_device_resident
+
+        return chain_device_resident(self, "up")
+
+    def _downstream_device_resident(self) -> bool:
+        from ..graph.residency import chain_device_resident
+
+        return chain_device_resident(self, "down")
+
+    def configure(self, in_specs: Dict[str, TensorsSpec]) -> Dict[str, TensorsSpec]:
+        in_spec = in_specs["sink"]
+        if hasattr(self.backend, "expect_device_input"):
+            self.backend.expect_device_input = self._upstream_device_resident()
+        # downstream host consumers (decoders, numpy sinks) will call
+        # np.asarray on our outputs: start the device→host copy at emit
+        # time so their blocking read finds local data instead of paying a
+        # full round trip per frame (matters on tunneled chips)
+        self._downstream_host = not self._downstream_device_resident()
+        if self._fused_pre or self._fused_post:
+            self._install_fusion(in_spec)  # validates model spec vs chain
+            # compile against the RAW stream spec: the fused program's
+            # entry point consumes pre-transform frames
+            out_spec = self.backend.reconfigure_fused(in_spec)
+            if hasattr(self.backend, "set_drift_hook"):
+                # un-renegotiated shape/dtype drift (polymorphic upstream
+                # pad) must rebuild the fused chain, not just recompile
+                self.backend.set_drift_hook(self._drift_reinstall)
+        else:
+            out_spec = self.backend.reconfigure(in_spec)
+        # output= property describes the MODEL output; with fused post-
+        # transforms the pad spec is post-transform, so the check happened
+        # against the model output inside _install_fusion instead.
+        if self._prop_out is not None and not self._fused_post:
+            merged = out_spec.intersect(self._prop_out)
+            if merged is None:
+                raise NegotiationError(
+                    f"{self.name}: model output {out_spec} conflicts with "
+                    f"output property {self._prop_out}"
+                )
+            out_spec = merged
+        if in_spec.rate is not None and out_spec.rate is None:
+            out_spec = TensorsSpec(tensors=out_spec.tensors, rate=in_spec.rate)
+        return {"src": out_spec}
+
+    def _drift_reinstall(self, drifted_spec: TensorsSpec) -> None:
+        """Rebind the fused chain to a drifted input spec: stage functions
+        bake per-spec geometry (transpose/dimchg), so drift re-runs the
+        install before recompiling (the executable cache keys by spec, so
+        alternating shapes stay cheap)."""
+        self._install_fusion(drifted_spec)
+        self.backend.reconfigure_fused(drifted_spec)
+
+    def _install_fusion(self, in_spec: TensorsSpec) -> TensorsSpec:
+        """Compose fused pre/post transforms around the backend fn so the
+        whole chain compiles as ONE XLA program.  Returns the spec the model
+        actually sees (post-pre-transforms)."""
+        import jax.numpy as jnp
+
+        pre_stages = []
+        spec_cur = in_spec
+        for tr in self._fused_pre:
+            pre_stages.append([tr.build_fn(t) for t in spec_cur.tensors])
+            spec_cur = TensorsSpec(
+                tensors=tuple(tr.out_spec_for(t) for t in spec_cur.tensors),
+                rate=spec_cur.rate,
+            )
+        model_spec = self.backend.model_spec()
+        if model_spec is not None and model_spec.intersect(spec_cur) is None:
+            raise NegotiationError(
+                f"{self.name}: fused pre-transform output {spec_cur} is "
+                f"incompatible with model spec {model_spec}"
+            )
+        # input= property describes the MODEL input, which with fusion is the
+        # pre-transform chain's output — enforce it here (the unfused path
+        # enforces it in sink_spec).
+        if self._prop_in is not None and self._prop_in.intersect(spec_cur) is None:
+            raise NegotiationError(
+                f"{self.name}: fused pre-transform output {spec_cur} "
+                f"conflicts with input property {self._prop_in}"
+            )
+        post_stages = []
+        if self._fused_post:
+            spec_o = self.backend.trace_output_spec(spec_cur)
+            if self._prop_out is not None and self._prop_out.intersect(spec_o) is None:
+                raise NegotiationError(
+                    f"{self.name}: model output {spec_o} conflicts with "
+                    f"output property {self._prop_out}"
+                )
+            for tr in self._fused_post:
+                post_stages.append([tr.build_fn(t) for t in spec_o.tensors])
+                spec_o = TensorsSpec(
+                    tensors=tuple(tr.out_spec_for(t) for t in spec_o.tensors),
+                    rate=spec_o.rate,
+                )
+
+        def wrapper(orig):
+            def fn(*xs):
+                for stage in pre_stages:
+                    xs = tuple(f(x, jnp) for f, x in zip(stage, xs))
+                out = orig(*xs)
+                single = not isinstance(out, (tuple, list))
+                outs = (out,) if single else tuple(out)
+                for stage in post_stages:
+                    outs = tuple(f(x, jnp) for f, x in zip(stage, outs))
+                if single:
+                    return outs[0]
+                if hasattr(out, "_fields"):  # namedtuple output
+                    return type(out)(*outs)
+                return type(out)(outs)
+            return fn
+
+        # a spec-derived rebuild of the SAME fused chain keeps the backend's
+        # executable cache (mid-stream renegotiation alternating A/B shapes
+        # hits the cache); only a changed transform list invalidates
+        self.backend.set_wrapper(wrapper, invalidate=self._fusion_dirty)
+        self._fusion_dirty = False
+        return spec_cur
+
+    # -- hot loop -----------------------------------------------------------
+
+    def process(self, pad: Pad, frame: Frame):
+        del pad
+        from ..utils import profiling
+
+        if profiling.enabled():
+            t0 = time.perf_counter_ns()
+            outs = self.backend.invoke(frame.tensors)
+            profiling.block_outputs(outs)
+            dt = time.perf_counter_ns() - t0
+            self.invoke_ns.append(dt)
+            profiling.record(self.name, dt)
+        else:
+            outs = self.backend.invoke(frame.tensors)
+        if not outs:
+            return None  # backend dropped the frame (FLOW_DROPPED analog)
+        if self._downstream_host:
+            for o in outs:
+                start = getattr(o, "copy_to_host_async", None)
+                if start is not None:
+                    start()  # non-blocking; overlaps the d2h with dispatches
+        return frame.with_tensors(outs)

@@ -1,0 +1,107 @@
+"""``queue``: the thread-decoupling element.
+
+In the reference, GStreamer ``queue`` elements give each pipeline segment its
+own streaming thread — the core of its single-node pipeline parallelism
+(``README.md:41-44``: converter/filter run while the sink consumes).  This
+node reproduces that: ``_dispatch`` enqueues into a bounded buffer (returning
+immediately to the upstream thread, or blocking when full = backpressure),
+and a dedicated worker thread drains the buffer into the downstream chain.
+
+The buffer itself is the native C++ frame queue
+(:mod:`nnstreamer_tpu.native.queue`) when the runtime library is available —
+blocking waits then happen outside the GIL — with a pure-Python twin as
+fallback.  Leak modes mirror GStreamer's: ``no`` (backpressure),
+``downstream`` (drop oldest queued frame), ``upstream`` (drop newest
+incoming frame); in-band events are never dropped.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import List, Optional
+
+from ..buffer import Event
+from ..graph.node import Node, Pad
+from ..graph.registry import register_element
+from ..native import OK, SHUTDOWN
+from ..native.queue import make_frame_queue
+
+_POLL_MS = 100  # wake periodically so shutdown is never missed
+
+
+@register_element("queue")
+class Queue(Node):
+    def __init__(
+        self,
+        name: Optional[str] = None,
+        max_size_buffers: int = 200,
+        leaky: str = "no",
+    ):
+        super().__init__(name)
+        self.add_sink_pad("sink")
+        self.add_src_pad("src")
+        self.max_size = int(max_size_buffers)
+        if leaky not in ("no", "downstream", "upstream"):
+            raise ValueError(f"unknown leaky mode {leaky!r}")
+        self.leaky = str(leaky)
+        self._q = None
+
+    @property
+    def backend_kind(self) -> str:
+        """'native' or 'python' — which queue implementation is active."""
+        from ..native.queue import NativeFrameQueue
+
+        if self._q is None:
+            self._ensure_queue()
+        return "native" if isinstance(self._q, NativeFrameQueue) else "python"
+
+    def _ensure_queue(self) -> None:
+        if self._q is None:
+            self._q = make_frame_queue(self.max_size)
+
+    def _dispatch(self, pad: Pad, item) -> None:
+        del pad
+        self._ensure_queue()
+        self._q.push(item, leaky=self.leaky)
+
+    def spawn_threads(self) -> List[threading.Thread]:
+        self._ensure_queue()
+        return [threading.Thread(target=self._worker, name=f"queue:{self.name}")]
+
+    def _worker(self) -> None:
+        q = self._q  # stop() may null the attribute while we drain
+        while True:
+            status, item = q.pop(_POLL_MS)
+            if status == SHUTDOWN:
+                return
+            if status != OK:
+                continue  # timeout poll: retry
+            try:
+                if isinstance(item, Event):
+                    if item.kind == "eos":
+                        self.sink_pads["sink"].eos = True
+                        self._on_eos()
+                        return
+                    if item.kind == "caps":
+                        # renegotiate our pads + forward (a NegotiationError
+                        # downstream must reach post_error, not kill the
+                        # worker silently)
+                        self._handle_caps(self.sink_pads["sink"], item.payload)
+                    else:
+                        self.on_event(self.sink_pads["sink"], item)
+                else:
+                    self.push(item)
+            except BaseException as exc:  # noqa: BLE001
+                if self.pipeline is not None:
+                    self.pipeline.post_error(self, exc)
+                return
+
+    def interrupt(self) -> None:
+        if self._q is not None:
+            self._q.shutdown()
+
+    def stop(self) -> None:
+        if self._q is not None:
+            self._q.shutdown()
+            self._q = None
+        super().stop()

@@ -1,0 +1,270 @@
+"""``tensor_repo`` + ``tensor_reposink`` / ``tensor_reposrc``: recurrence.
+
+Analog of ``gst/nnstreamer/tensor_repo/`` — the reference's feedback
+mechanism for cyclic (LSTM/RNN) topologies that a dataflow graph otherwise
+forbids (survey §3.4):
+
+- a **process-global repository** of slots, each a single-frame mailbox with
+  a mutex + condvars (``tensor_repo.h:77-103``);
+- ``tensor_reposink slot-index=N`` publishes every frame into slot N
+  (``gst_tensor_repo_set_buffer``);
+- ``tensor_reposrc slot-index=N`` is a source that, on its **first** create,
+  emits a zeroed dummy frame shaped by its ``caps`` property — bootstrapping
+  the cycle — then blocks on the slot condvar for each subsequent frame
+  (``tensor_reposrc.c:312-325``);
+- slot payloads carry their spec as metadata (the ``GstMetaRepo`` analog,
+  ``tensor_repo.h:37-54``) and are re-validated on the src side;
+- slot indices are runtime-changeable → dynamic graph rewiring
+  (``tests/nnstreamer_repo_dynamicity/``), via :meth:`set_slot`.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Iterable, Optional, Tuple
+
+import numpy as np
+
+from ..buffer import Frame
+from ..graph.node import Pad, SinkTerminal, SourceNode
+from ..graph.registry import register_element
+from ..spec import TensorsSpec
+
+
+class _Slot:
+    __slots__ = ("cond", "frame", "spec", "eos", "restored")
+
+    def __init__(self):
+        self.cond = threading.Condition()
+        self.frame: Optional[Frame] = None
+        self.spec: Optional[TensorsSpec] = None
+        self.eos = False
+        # set by checkpoint restore: the next pipeline start must keep the
+        # slot contents and skip the zero-bootstrap frame
+        self.restored = False
+
+
+class TensorRepo:
+    """Process-global slot registry (the ``_GstTensorRepo`` singleton).
+
+    Each slot is a lossless single-frame handoff: ``set_buffer`` blocks while
+    an unconsumed frame is pending (the push condvar) and ``get_buffer``
+    blocks until one arrives (the pull condvar) — the two-condition discipline
+    of ``tensor_repo.h:77-92`` that makes cycles flow frame-for-frame.
+    """
+
+    def __init__(self):
+        self._slots: Dict[int, _Slot] = {}
+        self._lock = threading.Lock()
+
+    def slot(self, idx: int) -> _Slot:
+        with self._lock:
+            if idx not in self._slots:
+                self._slots[idx] = _Slot()
+            return self._slots[idx]
+
+    def set_buffer(
+        self,
+        idx: int,
+        frame: Frame,
+        spec: Optional[TensorsSpec],
+        poll: float = 0.1,
+        should_abort=None,
+    ) -> bool:
+        """Publish one frame; blocks until the previous one is consumed.
+        Returns False if the slot reached EOS instead."""
+        s = self.slot(idx)
+        with s.cond:
+            while s.frame is not None and not s.eos:
+                s.cond.wait(poll)
+                if should_abort is not None and should_abort():
+                    return False
+            if s.eos:
+                return False
+            s.frame = frame
+            s.spec = spec
+            s.cond.notify_all()
+            return True
+
+    def get_buffer(
+        self, idx: int, timeout: Optional[float] = None
+    ) -> Tuple[Optional[Frame], Optional[TensorsSpec], bool]:
+        """Consume the pending frame (blocking).  Returns (frame, spec, eos);
+        (None, None, False) on poll timeout."""
+        s = self.slot(idx)
+        with s.cond:
+            while s.frame is None and not s.eos:
+                if not s.cond.wait(timeout if timeout is not None else 0.1):
+                    if timeout is not None:
+                        return None, None, s.eos
+            if s.frame is None and s.eos:
+                return None, None, True
+            frame, spec = s.frame, s.spec
+            s.frame = None
+            s.cond.notify_all()
+            return frame, spec, False
+
+    def set_eos(self, idx: int) -> None:
+        s = self.slot(idx)
+        with s.cond:
+            s.eos = True
+            s.cond.notify_all()
+
+    def clear(self, idx: int) -> None:
+        """Reset a slot for a fresh run (the reference removes repo data on
+        element stop); EOS from a previous run must not poison the next."""
+        s = self.slot(idx)
+        with s.cond:
+            s.frame = None
+            s.spec = None
+            s.eos = False
+            s.restored = False
+            s.cond.notify_all()
+
+    def reset(self, idx: Optional[int] = None) -> None:
+        with self._lock:
+            if idx is None:
+                self._slots.clear()
+            else:
+                self._slots.pop(idx, None)
+
+
+# The process-global repository (matches the reference's global `_repo`).
+GLOBAL_REPO = TensorRepo()
+
+
+@register_element("tensor_reposink")
+class TensorRepoSink(SinkTerminal):
+    def __init__(
+        self,
+        name: Optional[str] = None,
+        slot_index: int = 0,
+        signal_rate: int = 0,
+        repo: Optional[TensorRepo] = None,
+    ):
+        super().__init__(name)
+        del signal_rate  # accepted for launch-string parity
+        self.slot_index = int(slot_index)
+        self.repo = repo or GLOBAL_REPO
+        self._spec: Optional[TensorsSpec] = None
+
+    def set_slot(self, idx: int) -> None:
+        self.slot_index = int(idx)
+
+    def configure(self, in_specs):
+        self._spec = in_specs["sink"]
+        return {}
+
+    def start(self) -> None:
+        super().start()
+        s = self.repo.slot(self.slot_index)
+        with s.cond:
+            if not s.restored:  # keep checkpoint-restored contents
+                s.frame = None
+                s.spec = None
+            s.eos = False
+            s.cond.notify_all()
+        self.dropped = 0
+
+    def process(self, pad: Pad, frame: Frame):
+        del pad
+        ok = self.repo.set_buffer(
+            self.slot_index,
+            frame,
+            self._spec,
+            should_abort=lambda: self.pipeline is not None
+            and self.pipeline.state == "STOPPED",
+        )
+        if not ok:
+            # Consumer side ended (slot at EOS) or we aborted: the frame was
+            # NOT published.  Surface it rather than vanish silently.
+            self.dropped += 1
+            if self.dropped == 1:
+                import warnings
+
+                warnings.warn(
+                    f"{self.name}: repo slot {self.slot_index} is at EOS; "
+                    "dropping published frames",
+                    RuntimeWarning,
+                    stacklevel=2,
+                )
+        return None
+
+    def drain(self):
+        self.repo.set_eos(self.slot_index)
+        return None
+
+    def interrupt(self) -> None:
+        self.repo.set_eos(self.slot_index)
+
+
+@register_element("tensor_reposrc")
+class TensorRepoSrc(SourceNode):
+    def __init__(
+        self,
+        name: Optional[str] = None,
+        slot_index: int = 0,
+        caps: str = "",
+        repo: Optional[TensorRepo] = None,
+    ):
+        super().__init__(name)
+        self.slot_index = int(slot_index)
+        self.repo = repo or GLOBAL_REPO
+        if isinstance(caps, TensorsSpec):
+            self._spec = caps
+        elif caps:
+            self._spec = TensorsSpec.from_caps_string(caps)
+        else:
+            raise ValueError("tensor_reposrc requires caps= (cycle bootstrap spec)")
+
+    def set_slot(self, idx: int) -> None:
+        self.slot_index = int(idx)
+
+    def start(self) -> None:
+        super().start()
+        # Un-poison EOS left by a previous run's interrupt(); keep any
+        # pending frame (a producer may legitimately have published already).
+        s = self.repo.slot(self.slot_index)
+        with s.cond:
+            s.eos = False
+            s.cond.notify_all()
+
+    def output_spec(self) -> TensorsSpec:
+        return self._spec.fixate() if not self._spec.is_fixed else self._spec
+
+    def _dummy_frame(self) -> Frame:
+        spec = self.output_spec()
+        arrays = tuple(
+            np.zeros(t.shape, dtype=t.dtype) for t in spec.tensors
+        )
+        return Frame(tensors=arrays, pts=0, duration=0)
+
+    def frames(self) -> Iterable[Frame]:
+        # Cycle bootstrap: first create emits zeros (tensor_reposrc.c:312-325)
+        # — unless a checkpoint restored this slot, in which case the
+        # restored frame takes the bootstrap's place (resume must not inject
+        # a zero frame the uninterrupted run never saw).
+        s = self.repo.slot(self.slot_index)
+        with s.cond:
+            was_restored = s.restored
+            s.restored = False
+        if not was_restored:
+            yield self._dummy_frame()
+        my_spec = self.output_spec()
+        while not self.stopped:
+            frame, spec, eos = self.repo.get_buffer(self.slot_index, timeout=0.1)
+            if eos:
+                return
+            if frame is None:
+                continue  # poll timeout; re-check stop flag
+            if spec is not None and my_spec.intersect(spec) is None:
+                raise ValueError(
+                    f"{self.name}: repo slot {self.slot_index} spec {spec} "
+                    f"incompatible with caps {my_spec}"
+                )
+            yield frame
+
+    def interrupt(self) -> None:
+        self.request_stop()
+        # wake any waiter
+        self.repo.set_eos(self.slot_index)

@@ -1,0 +1,181 @@
+"""``tensor_save`` / ``tensor_load``: typed tensor-stream persistence.
+
+The reference lists these as *planned, never implemented*
+(``Documentation/component-description.md:67-68``); here they are
+first-class.  ``tensor_save`` is a sink writing a self-describing stream
+container; ``tensor_load`` replays it as a source with the original specs
+and timestamps — golden capture, stream replay, and the storage half of
+checkpoint/resume (:mod:`nnstreamer_tpu.utils.checkpoint`).
+
+Container format (``NNSTPU1``): magic line, then per frame a JSON header
+line (pts/duration/per-tensor dtype+shape) followed by the tensors' raw
+C-order bytes.  Append-friendly: a truncated tail loses at most the last
+frame.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import os
+from typing import Iterable, Optional
+
+import numpy as np
+
+from ..buffer import NONE_TS, Frame
+from ..graph.node import Pad, SinkTerminal, SourceNode
+from ..graph.registry import register_element
+from ..spec import TensorSpec, TensorsSpec, dtype_from_name
+
+MAGIC = b"NNSTPU1\n"
+
+
+def _encode_meta(meta: dict) -> dict:
+    """Frame.meta → JSON: arrays inline (base64), plain values as-is."""
+    out = {}
+    for k, v in meta.items():
+        if hasattr(v, "shape") and hasattr(v, "dtype"):
+            a = np.ascontiguousarray(np.asarray(v))
+            out[k] = {
+                "__nd__": [a.dtype.name, list(a.shape),
+                           base64.b64encode(a.tobytes()).decode()]
+            }
+        else:
+            try:
+                json.dumps(v)
+            except TypeError:
+                raise TypeError(
+                    f"tensor_save: frame meta[{k!r}] of type "
+                    f"{type(v).__name__} is not serializable"
+                ) from None
+            out[k] = v
+    return out
+
+
+def _decode_meta(meta: dict) -> dict:
+    out = {}
+    for k, v in meta.items():
+        if isinstance(v, dict) and "__nd__" in v:
+            dtype_s, shape, data = v["__nd__"]
+            out[k] = np.frombuffer(
+                base64.b64decode(data), dtype=dtype_from_name(dtype_s)
+            ).reshape(shape).copy()
+        else:
+            out[k] = v
+    return out
+
+
+def write_frame(f, frame: Frame) -> None:
+    arrays = [np.ascontiguousarray(np.asarray(t)) for t in frame.tensors]
+    header = {
+        "pts": frame.pts,
+        "duration": frame.duration,
+        "tensors": [
+            {"dtype": a.dtype.name, "shape": list(a.shape)} for a in arrays
+        ],
+    }
+    if frame.meta:
+        header["meta"] = _encode_meta(frame.meta)
+    f.write(json.dumps(header).encode() + b"\n")
+    for a in arrays:
+        f.write(a.tobytes())
+
+
+def read_frames(path: str) -> Iterable[Frame]:
+    with open(path, "rb") as f:
+        if f.read(len(MAGIC)) != MAGIC:
+            raise ValueError(f"{path}: not an NNSTPU1 tensor stream")
+        while True:
+            line = f.readline()
+            if not line:
+                return
+            try:
+                header = json.loads(line)
+            except json.JSONDecodeError:
+                return  # truncated mid-header: drop the partial frame
+            if not isinstance(header, dict) or "tensors" not in header:
+                return
+            tensors = []
+            for t in header["tensors"]:
+                dtype = dtype_from_name(t["dtype"])
+                count = int(np.prod(t["shape"])) if t["shape"] else 1
+                raw = f.read(count * dtype.itemsize)
+                if len(raw) != count * dtype.itemsize:
+                    return  # truncated tail: drop the partial frame
+                tensors.append(
+                    np.frombuffer(raw, dtype=dtype).reshape(t["shape"]).copy()
+                )
+            yield Frame(
+                tensors=tuple(tensors),
+                pts=header.get("pts", NONE_TS),
+                duration=header.get("duration", NONE_TS),
+                meta=_decode_meta(header.get("meta", {})),
+            )
+
+
+@register_element("tensor_save")
+class TensorSave(SinkTerminal):
+    """Persist every arriving frame to ``location``."""
+
+    def __init__(self, name: Optional[str] = None, location: str = ""):
+        super().__init__(name)
+        if not location:
+            raise ValueError("tensor_save requires location=")
+        self.location = os.fspath(location)
+        self._file = None
+        self.num_frames = 0
+
+    def start(self) -> None:
+        self._file = open(self.location, "wb")
+        self._file.write(MAGIC)
+        self.num_frames = 0
+
+    def process(self, pad: Pad, frame: Frame):
+        del pad
+        write_frame(self._file, frame)
+        self.num_frames += 1
+        return None
+
+    def drain(self):
+        if self._file is not None:
+            self._file.flush()
+        return None
+
+    def stop(self) -> None:
+        if self._file is not None:
+            self._file.close()
+            self._file = None
+
+
+@register_element("tensor_load")
+class TensorLoad(SourceNode):
+    """Replay a saved tensor stream; specs come from the first frame's
+    header (all frames must share it, as a negotiated stream does)."""
+
+    def __init__(
+        self,
+        name: Optional[str] = None,
+        location: str = "",
+        num_buffers: int = -1,
+    ):
+        super().__init__(name)
+        if not location:
+            raise ValueError("tensor_load requires location=")
+        self.location = os.fspath(location)
+        self.num_buffers = int(num_buffers)
+
+    def output_spec(self) -> TensorsSpec:
+        for frame in read_frames(self.location):
+            return TensorsSpec(
+                tensors=tuple(
+                    TensorSpec(dtype=np.asarray(t).dtype, shape=np.asarray(t).shape)
+                    for t in frame.tensors
+                )
+            )
+        raise ValueError(f"{self.location}: empty tensor stream")
+
+    def frames(self) -> Iterable[Frame]:
+        for i, frame in enumerate(read_frames(self.location)):
+            if self.stopped or (0 <= self.num_buffers <= i):
+                return
+            yield frame

@@ -1,0 +1,100 @@
+"""``tensor_sink``: the app-facing stream terminal.
+
+Analog of the reference's ``tensor_sink`` (``gst/nnstreamer/tensor_sink/``):
+emits ``new-data`` / ``stream-start`` / ``eos`` callbacks, rate-limited by a
+``signal-rate`` property (``tensor_sink/README.md:13-37``).  Also provides
+``fakesink`` (discard everything) for benchmarks and tests.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, List, Optional
+
+from ..buffer import Frame
+from ..graph.node import Pad, SinkTerminal
+from ..graph.registry import register_element
+
+
+@register_element("tensor_sink")
+class TensorSink(SinkTerminal):
+    """Terminal node invoking an application callback per frame.
+
+    ``signal_rate`` limits emitted signals per second (0 = emit all frames,
+    matching the reference's default behavior of its ``signal-rate`` prop).
+    ``collect`` (test convenience) keeps frames in :attr:`frames`.
+    """
+
+    def __init__(
+        self,
+        name: Optional[str] = None,
+        signal_rate: int = 0,
+        collect: bool = False,
+        sync: bool = False,
+        callback: Optional[Callable[[Frame], None]] = None,
+    ):
+        super().__init__(name)
+        self.signal_rate = int(signal_rate)
+        self.collect = collect in (True, "true", "TRUE", "1")
+        self.sync = sync in (True, "true", "TRUE", "1")
+        self.callbacks: List[Callable[[Frame], None]] = []
+        self.eos_callbacks: List[Callable[[], None]] = []
+        if callback is not None:
+            self.callbacks.append(callback)
+        self.frames: List[Frame] = []
+        self.num_frames = 0
+        self._last_signal_ns = 0
+        self._eos_evt = threading.Event()
+
+    def connect(self, signal: str, callback: Callable) -> None:
+        """GObject-signal-style connection: 'new-data' or 'eos'."""
+        if signal == "new-data":
+            self.callbacks.append(callback)
+        elif signal == "eos":
+            self.eos_callbacks.append(callback)
+        else:
+            raise ValueError(f"unknown signal {signal!r}")
+
+    def process(self, pad: Pad, frame: Frame):
+        del pad
+        self.num_frames += 1
+        if self.signal_rate > 0:
+            now = time.monotonic_ns()
+            if now - self._last_signal_ns < 1_000_000_000 // self.signal_rate:
+                return None
+            self._last_signal_ns = now
+        if self.collect:
+            self.frames.append(frame)
+        for cb in self.callbacks:
+            cb(frame)
+        return None
+
+    def drain(self):
+        self._eos_evt.set()
+        for cb in self.eos_callbacks:
+            cb()
+        return None
+
+    def wait_eos(self, timeout: Optional[float] = None) -> bool:
+        return self._eos_evt.wait(timeout)
+
+    def start(self) -> None:
+        super().start()
+        self.frames = []
+        self.num_frames = 0
+        self._eos_evt.clear()
+
+
+@register_element("fakesink")
+class FakeSink(SinkTerminal):
+    """Discard all frames (benchmark terminal)."""
+
+    def __init__(self, name: Optional[str] = None, **_ignored):
+        super().__init__(name)
+        self.num_frames = 0
+
+    def process(self, pad: Pad, frame: Frame):
+        del pad, frame
+        self.num_frames += 1
+        return None

@@ -1,0 +1,33 @@
+"""``tee``: 1→N fan-out, enabling the reference's branch parallelism
+(``tee`` + mux/merge multi-model graphs, ``README.md:43-45``).
+
+Frames are pushed to every linked src pad in order.  Payload arrays are
+immutable by convention (numpy views / jax Arrays), so no copy is made —
+the zero-copy ref-counted ``GstBuffer`` sharing analog.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from ..buffer import Frame
+from ..graph.node import Node, Pad
+from ..graph.registry import register_element
+from ..spec import TensorsSpec
+
+
+@register_element("tee")
+class Tee(Node):
+    REQUEST_SRC_PADS = True
+
+    def __init__(self, name: Optional[str] = None):
+        super().__init__(name)
+        self.add_sink_pad("sink")
+
+    def configure(self, in_specs: Dict[str, TensorsSpec]) -> Dict[str, TensorsSpec]:
+        spec = in_specs["sink"]
+        return {name: spec for name in self.src_pads}
+
+    def process(self, pad: Pad, frame: Frame):
+        del pad
+        return [(name, frame) for name in self.src_pads]

@@ -1,0 +1,334 @@
+"""``tensor_transform``: element-wise / layout ops on tensor streams.
+
+Analog of ``gst/nnstreamer/tensor_transform/tensor_transform.c`` with its
+five modes (``tensor_transform.h:56-65``) plus ``clamp``:
+
+- ``typecast``   — option = target dtype name.
+- ``arithmetic`` — option = chained ops ``[typecast:T,]add:V|mul:V|div:V...``
+  parsed like the reference's regex chain (``tensor_transform.c:768-887``).
+- ``transpose``  — option = NNS innermost-first axis permutation ``a:b:c:d``
+  (``:888-909``).
+- ``dimchg``     — option = ``from:to`` NNS dim move (``:1026-1120``).
+- ``stand``      — option = ``default`` | ``default:per-channel``:
+  standardize to zero-mean unit-variance.
+- ``clamp``      — option = ``min:max``.
+
+The transform compiles to a **pure function on jnp arrays** at negotiation
+time.  ``acceleration=True`` (the analog of the reference's Orc SIMD path,
+``tensor_transform.c:330-405``) wraps it in ``jax.jit`` so XLA fuses the
+elementwise chain into one kernel; with device-resident inputs it runs on
+TPU and stays on device.  ``acceleration="pallas"`` lowers the elementwise
+modes (typecast/arithmetic/clamp) through the hand-written Pallas VPU
+kernel (:func:`nnstreamer_tpu.ops.pallas_kernels.fused_arith`) — the
+closest analog of the reference's *generated* Orc kernels.
+``acceleration=False`` runs numpy on host — bit-exact with the reference's
+C loops and cheaper for tiny host frames.  When an adjacent
+``tensor_filter`` runs, its fusion pass can absorb this node's function
+into the model's XLA graph (survey §7 step 4).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..buffer import Frame
+from ..graph.node import NegotiationError, Node, Pad
+from ..graph.registry import register_element
+from ..spec import (
+    NNS_TENSOR_RANK_LIMIT,
+    TensorSpec,
+    TensorsSpec,
+    dtype_from_name,
+)
+
+MODES = ("typecast", "arithmetic", "transpose", "dimchg", "stand", "clamp")
+
+
+def _parse_arith_ops(option: str) -> List[Tuple[str, object]]:
+    """Parse 'typecast:float32,add:-127.5,div:127.5' into an op chain."""
+    ops: List[Tuple[str, object]] = []
+    for part in option.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        op, _, val = part.partition(":")
+        op = op.strip().lower()
+        if op == "typecast":
+            ops.append(("typecast", dtype_from_name(val)))
+        elif op in ("add", "sub", "mul", "div"):
+            # integer literals stay integral so int streams keep their
+            # dtype (the reference computes in the tensor's own type);
+            # float literals / div promote per jnp rules.
+            try:
+                num: object = int(val)
+            except ValueError:
+                num = float(val)
+            ops.append((op, num))
+        else:
+            raise ValueError(f"unknown arithmetic op {op!r} in {option!r}")
+    if not ops:
+        raise ValueError(f"empty arithmetic option: {option!r}")
+    return ops
+
+
+def _parse_clamp(option: str) -> Tuple[object, object]:
+    lo_s, _, hi_s = option.partition(":")
+
+    def num(s: str) -> object:
+        try:
+            return int(s)
+        except ValueError:
+            return float(s)
+
+    return num(lo_s), num(hi_s)
+
+
+def _bind_num(v: object, dtype: np.dtype) -> object:
+    """Keep an integer literal integral only when it is representable in
+    the current stream dtype; otherwise demote to float so the op promotes
+    (a negative literal on an unsigned stream must not wrap/overflow)."""
+    if isinstance(v, int) and np.issubdtype(dtype, np.integer):
+        info = np.iinfo(dtype)
+        if info.min <= v <= info.max:
+            return v
+        return float(v)
+    return v
+
+
+def _bind_chain(ops: List[Tuple[str, object]], in_dtype) -> List[Tuple[str, object]]:
+    """Bind op literals to the dtype flowing through the chain, tracking
+    dtype changes from typecasts and promotion as we go."""
+    from ..ops.pallas_kernels import chain_out_dtype
+
+    cur = np.dtype(in_dtype)
+    bound: List[Tuple[str, object]] = []
+    for op, val in ops:
+        if op == "typecast":
+            bound.append((op, val))
+        elif op == "clamp":
+            lo, hi = val
+            bound.append((op, (_bind_num(lo, cur), _bind_num(hi, cur))))
+        else:
+            bound.append((op, _bind_num(val, cur)))
+        cur = np.dtype(chain_out_dtype(cur, [bound[-1]]))
+    return bound
+
+
+@register_element("tensor_transform")
+class TensorTransform(Node):
+    def __init__(
+        self,
+        name: Optional[str] = None,
+        mode: str = "typecast",
+        option: str = "",
+        acceleration: bool = True,
+    ):
+        super().__init__(name)
+        self.add_sink_pad("sink")
+        self.add_src_pad("src")
+        if mode not in MODES:
+            raise ValueError(f"unknown transform mode {mode!r}; known: {MODES}")
+        self.mode = mode
+        self.option = str(option)
+        if acceleration in ("pallas", "orc"):  # "orc" = reference prop name
+            self.acceleration = "pallas"
+        else:
+            self.acceleration = acceleration in (True, "true", "1")
+        self._fns: Optional[List[Callable]] = None  # per-tensor ops
+        self._jitted = None
+
+    # -- op construction ----------------------------------------------------
+
+    def out_spec_for(self, t: TensorSpec) -> TensorSpec:
+        """Output spec given a fixed input tensor spec (transform_caps)."""
+        if self.mode == "typecast":
+            return TensorSpec(dtype=dtype_from_name(self.option), shape=t.shape)
+        if self.mode == "arithmetic":
+            # Negotiate the true result dtype, including implicit promotion
+            # (e.g. div / float operands on int streams → float32); all
+            # three execution paths are cast to this.
+            from ..ops.pallas_kernels import chain_out_dtype
+
+            ops = _bind_chain(_parse_arith_ops(self.option), t.dtype)
+            return TensorSpec(dtype=np.dtype(chain_out_dtype(t.dtype, ops)),
+                              shape=t.shape)
+        if self.mode == "transpose":
+            perm = [int(x) for x in self.option.split(":")]
+            if sorted(perm) != list(range(len(perm))):
+                raise NegotiationError(f"bad transpose option {self.option!r}")
+            nns = list(t.nns_dims)
+            out_nns = [nns[p] for p in perm]
+            while len(out_nns) > 1 and out_nns[-1] == 1:
+                out_nns.pop()
+            return TensorSpec(dtype=t.dtype, shape=tuple(reversed(out_nns)))
+        if self.mode == "dimchg":
+            frm, _, to = self.option.partition(":")
+            frm, to = int(frm), int(to)
+            nns = list(t.nns_dims)
+            d = nns.pop(frm)
+            nns.insert(to, d)
+            while len(nns) > 1 and nns[-1] == 1:
+                nns.pop()
+            return TensorSpec(dtype=t.dtype, shape=tuple(reversed(nns)))
+        if self.mode == "stand":
+            return TensorSpec(dtype=np.float32, shape=t.shape)
+        if self.mode == "clamp":
+            from ..ops.pallas_kernels import chain_out_dtype
+
+            ops = _bind_chain([("clamp", _parse_clamp(self.option))], t.dtype)
+            return TensorSpec(dtype=np.dtype(chain_out_dtype(t.dtype, ops)),
+                              shape=t.shape)
+        raise AssertionError(self.mode)
+
+    def build_fn(self, t: TensorSpec) -> Callable:
+        """Build the pure array function (xp = numpy or jax.numpy)."""
+        mode, option = self.mode, self.option
+        rank = t.rank
+
+        if mode == "typecast":
+            dtype = dtype_from_name(option)
+
+            def fn(x, xp):
+                return x.astype(dtype)
+
+        elif mode == "arithmetic":
+            ops = _bind_chain(_parse_arith_ops(option), t.dtype)
+
+            def fn(x, xp):
+                for op, val in ops:
+                    if op == "typecast":
+                        x = x.astype(val)
+                    elif op == "add":
+                        x = x + val
+                    elif op == "sub":
+                        x = x - val
+                    elif op == "mul":
+                        x = x * val
+                    elif op == "div":
+                        x = x / val
+                return x
+
+        elif mode == "transpose":
+            perm = [int(x) for x in option.split(":")]
+            # NNS innermost-first perm → numpy axes on the rank-4 padded view.
+            r = NNS_TENSOR_RANK_LIMIT
+            np_perm = tuple(r - 1 - perm[r - 1 - j] for j in range(r))
+            pad_shape = tuple(reversed(t.nns_dims))  # rank-4 numpy shape
+            out_rank = len(self.out_spec_for(t).shape)
+
+            def fn(x, xp):
+                y = x.reshape(pad_shape).transpose(np_perm)
+                return y.reshape(y.shape[r - out_rank:])
+
+        elif mode == "dimchg":
+            frm_s, _, to_s = option.partition(":")
+            frm, to = int(frm_s), int(to_s)
+            r = NNS_TENSOR_RANK_LIMIT
+            pad_shape = tuple(reversed(t.nns_dims))
+            out_rank = len(self.out_spec_for(t).shape)
+            src_ax, dst_ax = r - 1 - frm, r - 1 - to
+
+            def fn(x, xp):
+                y = xp.moveaxis(x.reshape(pad_shape), src_ax, dst_ax)
+                return y.reshape(y.shape[r - out_rank:])
+
+        elif mode == "stand":
+            per_channel = option.endswith("per-channel")
+
+            def fn(x, xp):
+                x = x.astype(xp.float32)
+                if per_channel and x.ndim >= 2:
+                    axes = tuple(range(x.ndim - 1))
+                    mean = x.mean(axis=axes, keepdims=True)
+                    std = x.std(axis=axes, keepdims=True)
+                else:
+                    mean, std = x.mean(), x.std()
+                return (x - mean) / (std + 1e-10)
+
+        elif mode == "clamp":
+            lo, hi = _bind_chain(
+                [("clamp", _parse_clamp(option))], t.dtype
+            )[0][1]
+
+            def fn(x, xp):
+                return xp.clip(x, lo, hi)
+
+        else:
+            raise AssertionError(mode)
+        del rank
+        return fn
+
+    # -- negotiation --------------------------------------------------------
+
+    def configure(self, in_specs: Dict[str, TensorsSpec]) -> Dict[str, TensorsSpec]:
+        spec = in_specs["sink"]
+        outs = tuple(self.out_spec_for(t) for t in spec.tensors)
+        self._out_dtypes = [t.dtype for t in outs]
+        # Shape-dependent modes (transpose/dimchg) bake per-tensor geometry,
+        # so each tensor in the frame gets its own compiled fn (the reference
+        # likewise transforms each tensor independently).
+        self._fns = [self.build_fn(t) for t in spec.tensors]
+        self._jitted = None
+        chains = [self._chain_ops(t) for t in spec.tensors]
+        if self.acceleration == "pallas" and all(
+            c is not None for c in chains
+        ):
+            import jax
+
+            from ..ops.pallas_kernels import fused_arith
+
+            self._jitted = [
+                jax.jit(lambda x, c=tuple(chain): fused_arith(x, c))
+                for chain in chains
+            ]
+        elif self.acceleration:
+            import jax
+
+            self._jitted = [
+                jax.jit(lambda x, fn=fn: fn(x, _jnp())) for fn in self._fns
+            ]
+        return {"src": TensorsSpec(tensors=outs, rate=spec.rate)}
+
+    def _chain_ops(self, t: TensorSpec):
+        """Elementwise op chain for the Pallas kernel (literals bound to
+        the stream dtype), or None when the mode is shape-changing (those
+        stay on the XLA path)."""
+        if self.mode == "typecast":
+            return [("typecast", dtype_from_name(self.option))]
+        if self.mode == "arithmetic":
+            return _bind_chain(_parse_arith_ops(self.option), t.dtype)
+        if self.mode == "clamp":
+            return _bind_chain([("clamp", _parse_clamp(self.option))], t.dtype)
+        return None
+
+    # -- dataflow -----------------------------------------------------------
+
+    def process(self, pad: Pad, frame: Frame):
+        del pad
+        out = []
+        for i, x in enumerate(frame.tensors):
+            if self.acceleration:
+                out.append(self._jitted[i](x))
+            else:
+                # numpy promotes to float64 where jnp picks float32; the
+                # negotiated spec (jnp rules) is the contract, so cast.
+                y = self._fns[i](np.asarray(x), np)
+                out.append(y.astype(self._out_dtypes[i], copy=False))
+        return frame.with_tensors(tuple(out))
+
+    # -- fusion hook (survey §7 step 4) -------------------------------------
+
+    def pure_fn(self, index: int = 0):
+        """The jnp-level function, for upstream/downstream XLA fusion."""
+        if self._fns is None:
+            raise RuntimeError(f"{self.name}: not configured yet")
+        fn = self._fns[index]
+        return lambda x: fn(x, _jnp())
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp

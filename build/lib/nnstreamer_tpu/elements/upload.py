@@ -1,0 +1,116 @@
+"""``tensor_upload``: move the host→device transfer off the dispatch thread.
+
+SURVEY §7 hard part (b) — "keep the hot loop Python-light: prefetch,
+donated buffers" — and the round-2 verdict's weak #2 ("no prefetch or
+overlap exists") both name the missing discipline: in a plain
+``src → filter`` chain the filter's invoke pays the host→device wire
+*serially* before it can dispatch, so per-frame time = transfer + dispatch.
+This element splits the phases:
+
+    src → tensor_upload → queue → tensor_filter(jax)
+
+``tensor_upload`` runs in the upstream (source) thread and device_puts each
+payload in **wire layout** (flat 1-D for rank ≥ 2 — the cheap transfer path,
+see ``backends/jax_backend.py``); the ``queue`` boundary hands the
+device-resident :class:`~nnstreamer_tpu.buffer.WireTensor` to the filter's
+thread, which only dispatches.  Transfer of frame N+1 overlaps dispatch of
+frame N; per-frame time drops toward max(transfer, dispatch).
+
+The reference's analog is GStreamer's queue-decoupled map/invoke chain
+(``tensor_filter.c:316-436`` never copies on the dispatch path); here the
+"map" is an explicit async wire hop because the accelerator is remote.
+
+Spec-transparent: output specs equal input specs (the wrapper preserves
+logical shape/dtype), so decoders or sinks downstream of an un-filtered
+upload still see logical arrays via ``np.asarray``.  Transform fusion hops
+over upload/queue nodes when folding transforms into the filter program
+(``graph/optimize.py``), so ``transform → upload → queue → filter`` still
+compiles as one XLA program fed raw wire bytes.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import numpy as np
+
+from ..buffer import Frame, WireTensor
+from ..graph.node import Node, Pad
+from ..graph.registry import register_element
+from ..spec import TensorsSpec
+
+
+@register_element("tensor_upload")
+class TensorUpload(Node):
+    def __init__(self, name: Optional[str] = None):
+        super().__init__(name)
+        self.add_sink_pad("sink")
+        self.add_src_pad("src")
+        self._wire_shape = None  # downstream backend's wire rule
+        self._backend = None  # downstream backend (sharding queried lazily)
+        self._shardings = None  # per-tensor-index device_put shardings
+
+    def _downstream_backend(self):
+        from ..elements.queue import Queue
+        from ..graph.residency import hop_plumbing
+
+        pad = hop_plumbing(
+            self.src_pads["src"].peer, "down", (Queue, TensorUpload)
+        )
+        return getattr(pad.node, "backend", None) if pad is not None else None
+
+    def _downstream_wire_rule(self):
+        """The wire layout is the *consumer's* contract: the base jax
+        backend flattens rank ≥ 2 fully, the sharded backend keeps the
+        leading (batch) dim so the mesh sharding still applies.  Ask the
+        first filter downstream (hopping queue/upload plumbing) for its
+        rule; default to the base backend's."""
+        from ..backends.jax_backend import JaxBackend
+
+        self._backend = self._downstream_backend()
+        rule = getattr(self._backend, "_wire_shape", None)
+        return rule if callable(rule) else JaxBackend._wire_shape
+
+    def _sharding_for(self, idx: int):
+        """Mesh sharding for tensor ``idx`` (sharded consumers): resolved
+        lazily at first frame — the consumer compiles during negotiation
+        AFTER this node configures, so its mesh exists only by stream
+        time.  Uploading pre-sharded keeps the scatter off the dispatch
+        thread."""
+        if self._shardings is None:
+            self._shardings = {}
+        if idx not in self._shardings:
+            get = getattr(self._backend, "wire_input_sharding", None)
+            self._shardings[idx] = get(idx) if callable(get) else None
+        return self._shardings[idx]
+
+    def configure(self, in_specs: Dict[str, TensorsSpec]) -> Dict[str, TensorsSpec]:
+        self._wire_shape = self._downstream_wire_rule()
+        self._shardings = None
+        return {"src": in_specs["sink"]}
+
+    def process(self, pad: Pad, frame: Frame):
+        del pad
+        import jax
+
+        if self._wire_shape is None:
+            self._wire_shape = self._downstream_wire_rule()
+        out = []
+        for i, t in enumerate(frame.tensors):
+            if isinstance(t, (jax.Array, WireTensor)):
+                out.append(t)  # already device-resident: nothing to move
+                continue
+            arr = np.asarray(t)
+            wire = self._wire_shape(tuple(arr.shape))
+            if wire != tuple(arr.shape):
+                arr_w = np.ascontiguousarray(arr).reshape(wire)
+            else:
+                arr_w = arr
+            sharding = self._sharding_for(i)
+            put = (
+                jax.device_put(arr_w, sharding)
+                if sharding is not None
+                else jax.device_put(arr_w)
+            )
+            out.append(WireTensor(put, arr.shape, arr.dtype))
+        return frame.with_tensors(out)

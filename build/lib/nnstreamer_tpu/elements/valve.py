@@ -1,0 +1,32 @@
+"""``valve``: runtime-controllable frame gate.
+
+Analog of GStreamer's valve used by the reference C-API
+(``ml_pipeline_valve_set_open``, ``nnstreamer.h:439-566``): when closed,
+frames are dropped; events always pass.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..buffer import Frame
+from ..graph.node import Node, Pad
+from ..graph.registry import register_element
+
+
+@register_element("valve")
+class Valve(Node):
+    def __init__(self, name: Optional[str] = None, drop: bool = False):
+        super().__init__(name)
+        self.add_sink_pad("sink")
+        self.add_src_pad("src")
+        self.drop = drop in (True, "true", "1")
+
+    def set_open(self, is_open: bool) -> None:
+        self.drop = not is_open
+
+    def process(self, pad: Pad, frame: Frame):
+        del pad
+        if self.drop:
+            return None
+        return frame

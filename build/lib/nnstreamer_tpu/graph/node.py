@@ -1,0 +1,391 @@
+"""Graph nodes and pads: the dataflow skeleton of the framework.
+
+This replaces the reference's GStreamer substrate (L0) + element plumbing
+(L3): ``GstElement``/``GstPad`` linking, two-phase caps negotiation
+(propose via ``transform_caps``, commit via ``set_caps`` — the flow at
+``tensor_filter.c:666-839``), chained synchronous pad pushes, and in-band
+events (EOS/flush).  It is deliberately *not* a port of GStreamer: nodes are
+small Python objects, negotiation is an explicit topological pass over the
+graph (:mod:`nnstreamer_tpu.graph.pipeline`), and the hot path keeps frame
+payloads device-resident whenever adjacent nodes are XLA-backed.
+
+Threading model (mirrors the reference's, ``README.md:41-44``):
+
+- each source node runs its own streaming thread;
+- a pad push runs the downstream chain synchronously in the pusher's thread;
+- :class:`~nnstreamer_tpu.elements.queue.Queue` nodes introduce thread
+  boundaries with bounded buffering (the ``queue`` element analog);
+- nodes with multiple sink pads serialize internally (CollectPads analog).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Iterable, List, Optional, Tuple, Union
+
+from ..buffer import Event, Frame
+from ..spec import ANY, TensorsSpec
+
+
+class NegotiationError(Exception):
+    """Raised when pad specs cannot be reconciled (caps-negotiation failure,
+    the analog of ``GST_FLOW_NOT_NEGOTIATED``)."""
+
+
+class StreamError(Exception):
+    """Raised for unrecoverable dataflow errors (``GST_FLOW_ERROR``)."""
+
+
+def _frame_sig(tensors) -> tuple:
+    """Cheap (dtype, shape) signature of a frame's payloads."""
+    return tuple((t.dtype, tuple(t.shape)) for t in tensors)
+
+
+# Sentinel for pads whose negotiated spec is not fully fixed (polymorphic
+# sinks): per-frame signature checking is skipped there — a downstream pad
+# with a fixed spec still catches any change.
+_UNCHECKED = object()
+
+
+class Pad:
+    """One endpoint of a link.  Direction is "sink" (input) or "src" (output)."""
+
+    __slots__ = ("node", "name", "direction", "peer", "spec", "eos", "sig")
+
+    def __init__(self, node: "Node", name: str, direction: str):
+        self.node = node
+        self.name = name
+        self.direction = direction
+        self.peer: Optional[Pad] = None
+        self.spec: Optional[TensorsSpec] = None
+        self.eos = False
+        # last-seen frame signature; None = derive from spec on first frame
+        self.sig = None
+
+    @property
+    def full_name(self) -> str:
+        return f"{self.node.name}.{self.name}"
+
+    def link(self, other: "Pad") -> None:
+        if self.direction != "src" or other.direction != "sink":
+            raise ValueError(f"can only link src→sink, got {self.full_name}→{other.full_name}")
+        if self.peer is not None or other.peer is not None:
+            raise ValueError(f"pad already linked: {self.full_name} or {other.full_name}")
+        self.peer = other
+        other.peer = self
+
+    def push(self, item: Union[Frame, Event]) -> None:
+        """Push a frame/event to the linked downstream node (synchronous,
+        runs the downstream chain in the calling thread).
+
+        Frames are signature-checked against the negotiated spec: a
+        mid-stream (dtype, shape) change emits a caps event downstream
+        *before* the frame — triggering explicit renegotiation (and backend
+        recompiles) instead of a silent jit retrace.  The reference
+        re-enters ``transform_caps`` the same way (``tensor_filter.c:666``).
+        """
+        if self.direction != "src":
+            raise ValueError("push() is only valid on src pads")
+        if self.peer is None:
+            return  # unlinked src pad: drop (like an unlinked tee branch)
+        if isinstance(item, Frame) and self.sig is not _UNCHECKED:
+            sig = _frame_sig(item.tensors)
+            if sig != self.sig:
+                self._spec_changed(sig, item)
+        self.peer.node._dispatch(self.peer, item)
+
+    def _spec_changed(self, sig: tuple, frame: Frame) -> None:
+        if self.sig is None:
+            # first frame: bind the signature from the negotiated spec
+            if self.spec is not None and self.spec.tensors_fixed:
+                expected = tuple(
+                    (t.dtype, tuple(t.shape)) for t in self.spec.tensors
+                )
+                if sig == expected:
+                    self.sig = sig
+                    return
+            else:
+                self.sig = _UNCHECKED  # polymorphic pad: stop checking
+                return
+        # genuine mid-stream change: renegotiate downstream from here
+        new_spec = TensorsSpec.from_arrays(
+            frame.tensors, rate=self.spec.rate if self.spec else None
+        )
+        self.spec = new_spec
+        self.sig = sig
+        self.peer.node._dispatch(self.peer, Event.caps(new_spec))
+
+    def __repr__(self) -> str:
+        return f"Pad({self.full_name}, {self.direction})"
+
+
+# What process() may return: nothing, one frame (goes to "src"), a list of
+# frames (all to "src"), or (pad_name, frame) tuples for multi-output nodes.
+ProcessResult = Union[None, Frame, Iterable[Union[Frame, Tuple[str, Frame]]]]
+
+
+class Node:
+    """Base class for all elements.
+
+    Subclasses override some of:
+
+    - :meth:`sink_spec` — partial spec this node accepts on a sink pad
+      (pad template caps).
+    - :meth:`src_spec` — partial spec this node can produce before inputs
+      are known (source nodes / decoders).
+    - :meth:`configure` — commit phase: given fixed input specs, validate and
+      return fixed output specs (``set_caps`` + ``configure_tensor`` analog,
+      ``tensor_filter.c:513-623``).
+    - :meth:`process` — steady-state per-frame work.
+    - :meth:`start` / :meth:`stop` — resource lifecycle (model open/close).
+    """
+
+    # Set by subclasses that create sink pads on demand (mux/merge).
+    REQUEST_SINK_PADS = False
+    # Set by subclasses that create src pads on demand (demux/split/tee).
+    REQUEST_SRC_PADS = False
+
+    def __init__(self, name: Optional[str] = None):
+        self.name = name or f"{type(self).__name__.lower()}{id(self) % 10000}"
+        self.sink_pads: Dict[str, Pad] = {}
+        self.src_pads: Dict[str, Pad] = {}
+        self.pipeline = None  # set on add
+        self._lock = threading.Lock()
+        self._started = False
+
+    # -- pad management -----------------------------------------------------
+
+    def add_sink_pad(self, name: str = "sink") -> Pad:
+        if name in self.sink_pads:
+            raise ValueError(f"duplicate sink pad {name} on {self.name}")
+        pad = Pad(self, name, "sink")
+        self.sink_pads[name] = pad
+        return pad
+
+    def add_src_pad(self, name: str = "src") -> Pad:
+        if name in self.src_pads:
+            raise ValueError(f"duplicate src pad {name} on {self.name}")
+        pad = Pad(self, name, "src")
+        self.src_pads[name] = pad
+        return pad
+
+    def _get_pad(self, pads: Dict[str, Pad], request: bool, kind: str,
+                 name: Optional[str]) -> Pad:
+        if name is None:
+            for pad in pads.values():  # prefer the first unlinked pad
+                if pad.peer is None:
+                    return pad
+            if request:
+                name = f"{kind}_{len(pads)}"
+            elif not pads:
+                raise ValueError(f"{self.name} has no {kind} pads")
+            else:
+                raise ValueError(f"{self.name}: all {kind} pads linked")
+        if name in pads:
+            return pads[name]
+        if request:
+            adder = self.add_sink_pad if kind == "sink" else self.add_src_pad
+            return adder(name)
+        raise ValueError(f"{self.name} has no {kind} pad {name!r}")
+
+    def get_sink_pad(self, name: Optional[str] = None) -> Pad:
+        """Existing pad by name, or a fresh request pad if supported."""
+        return self._get_pad(self.sink_pads, self.REQUEST_SINK_PADS, "sink", name)
+
+    def get_src_pad(self, name: Optional[str] = None) -> Pad:
+        return self._get_pad(self.src_pads, self.REQUEST_SRC_PADS, "src", name)
+
+    # -- negotiation --------------------------------------------------------
+
+    def sink_spec(self, pad_name: str) -> TensorsSpec:
+        """Partial spec accepted on a sink pad (template caps).  ANY default."""
+        del pad_name
+        return ANY
+
+    def src_spec(self, pad_name: str) -> TensorsSpec:
+        """Partial spec producible on a src pad before negotiation."""
+        del pad_name
+        return ANY
+
+    def configure(self, in_specs: Dict[str, TensorsSpec]) -> Dict[str, TensorsSpec]:
+        """Commit negotiated input specs; return fixed specs per src pad.
+
+        Default: identity passthrough (first input spec to every src pad) —
+        correct for filters that do not change the stream type.
+        """
+        if in_specs:
+            spec = next(iter(in_specs.values()))
+        else:
+            spec = ANY
+        return {name: spec for name in self.src_pads}
+
+    # -- dataflow -----------------------------------------------------------
+
+    def _dispatch(self, pad: Pad, item: Union[Frame, Event]) -> None:
+        """Entry point for items arriving on a sink pad.  Serializes the
+        element by default (safe for multi-upstream fan-in); queue-like
+        nodes override this to decouple threads."""
+        with self._lock:
+            if isinstance(item, Event):
+                self._handle_event(pad, item)
+            else:
+                self._handle_frame(pad, item)
+
+    def _handle_frame(self, pad: Pad, frame: Frame) -> None:
+        result = self.process(pad, frame)
+        self._emit(result)
+
+    def _emit(self, result: ProcessResult) -> None:
+        if result is None:
+            return
+        if isinstance(result, Frame):
+            self.push(result)
+            return
+        for item in result:
+            if isinstance(item, tuple):
+                pad_name, frame = item
+                self.push(frame, pad_name)
+            else:
+                self.push(item)
+
+    def _handle_event(self, pad: Pad, event: Event) -> None:
+        if event.kind == "eos":
+            pad.eos = True
+            if all(p.eos for p in self.sink_pads.values()):
+                self._on_eos()
+        elif event.kind == "caps":
+            self._handle_caps(pad, event.payload)
+        else:
+            self.on_event(pad, event)
+
+    def _handle_caps(self, pad: Pad, new_spec: TensorsSpec) -> None:
+        """Mid-stream renegotiation from this node downstream: re-check the
+        new spec against the pad template, re-run the commit phase, and
+        propagate a caps event on any src pad whose spec changed.  An
+        incompatible change raises (loud pipeline error, never a silent
+        retrace) — ``tensor_filter.c:799-839`` fails negotiation the same
+        way."""
+        for spad, event in self._recompute_caps(pad, new_spec):
+            spad.peer.node._dispatch(spad.peer, event)
+
+    def _recompute_caps(self, pad: Pad, new_spec: TensorsSpec):
+        """Commit a mid-stream spec change locally; return the caps events
+        to propagate (pad, event) — pushed by the caller, which lets nodes
+        with their own emission discipline (CollectNode) defer them."""
+        template = self.sink_spec(pad.name)
+        merged = template.intersect(new_spec)
+        if merged is None:
+            raise NegotiationError(
+                f"{pad.full_name}: mid-stream spec change to {new_spec} "
+                f"rejected (template {template})"
+            )
+        pad.spec = merged
+        pad.sig = None
+        in_specs = {
+            p.name: p.spec
+            for p in self.sink_pads.values()
+            if p.peer is not None and p.spec is not None
+        }
+        out_specs = self.reconfigure(in_specs)
+        events = []
+        for name, spad in self.src_pads.items():
+            if spad.peer is None:
+                continue
+            spec = out_specs.get(name)
+            if spec is None or spec == spad.spec:
+                continue
+            spad.spec = spec
+            spad.sig = None
+            events.append((spad, Event.caps(spec)))
+        return events
+
+    def reconfigure(self, in_specs: Dict[str, TensorsSpec]) -> Dict[str, TensorsSpec]:
+        """Mid-stream re-negotiation hook; defaults to the same commit phase
+        as startup.  Stateful nodes (windowing aggregators) may override to
+        flush or reject."""
+        return self.configure(in_specs)
+
+    def _on_eos(self) -> None:
+        """All sink pads reached EOS: drain and forward."""
+        self._emit(self.drain())
+        if self.src_pads:
+            for spad in self.src_pads.values():
+                spad.push(Event.eos())
+        if self.pipeline is not None:
+            self.pipeline._node_eos(self)
+
+    def on_event(self, pad: Pad, event: Event) -> None:
+        """Non-EOS events: forward downstream by default."""
+        del pad
+        for spad in self.src_pads.values():
+            spad.push(event)
+
+    def process(self, pad: Pad, frame: Frame) -> ProcessResult:
+        """Per-frame work.  Default: passthrough."""
+        del pad
+        return frame
+
+    def drain(self) -> ProcessResult:
+        """Flush internal state at EOS (aggregator partial windows etc.)."""
+        return None
+
+    def push(self, frame: Frame, pad_name: Optional[str] = None) -> None:
+        """Push a frame out of a src pad (helper for process/sources)."""
+        if pad_name is None:
+            if len(self.src_pads) != 1:
+                raise ValueError(f"{self.name}: pad_name required with multiple src pads")
+            pad = next(iter(self.src_pads.values()))
+        else:
+            pad = self.src_pads[pad_name]
+        pad.push(frame)
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> None:
+        """Acquire resources (open models, mmap files).  Called before
+        negotiation — the 'open on READY' step (``tensor_filter.c:873-888``)."""
+        self._started = True
+
+    def stop(self) -> None:
+        self._started = False
+
+    def __repr__(self) -> str:
+        return f"<{type(self).__name__} {self.name!r}>"
+
+
+class SourceNode(Node):
+    """Base for push sources: the pipeline runs :meth:`frames` in a dedicated
+    streaming thread and pushes each yielded frame, then EOS."""
+
+    def __init__(self, name: Optional[str] = None):
+        super().__init__(name)
+        self.add_src_pad("src")
+        self._stop_evt = threading.Event()
+
+    def frames(self) -> Iterable[Frame]:
+        """Yield frames until exhausted.  Implementations should check
+        :attr:`stopped` regularly."""
+        raise NotImplementedError
+
+    @property
+    def stopped(self) -> bool:
+        return self._stop_evt.is_set()
+
+    def request_stop(self) -> None:
+        self._stop_evt.set()
+
+    def output_spec(self) -> TensorsSpec:
+        """Fixed spec of produced frames (sources must know their caps)."""
+        raise NotImplementedError
+
+    def configure(self, in_specs: Dict[str, TensorsSpec]) -> Dict[str, TensorsSpec]:
+        del in_specs
+        return {"src": self.output_spec()}
+
+
+class SinkTerminal(Node):
+    """Base for sinks (no src pads)."""
+
+    def __init__(self, name: Optional[str] = None):
+        super().__init__(name)
+        self.add_sink_pad("sink")

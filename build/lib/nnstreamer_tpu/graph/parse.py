@@ -1,0 +1,112 @@
+"""gst-launch style pipeline string parser.
+
+The analog of ``gst_parse_launch`` — the reference's C-API builds every
+pipeline from these strings (``ml_pipeline_construct``,
+``nnstreamer-capi-pipeline.c:426``), and all 25 SSAT test scripts drive
+``gst-launch`` lines, so string parity matters for API and test parity.
+
+Supported grammar (the subset the reference's pipelines exercise)::
+
+    pipeline   := chain (chain)*
+    chain      := endpoint ('!' endpoint)*
+    endpoint   := element | padref
+    element    := TYPE (KEY=VALUE)*
+    padref     := NAME '.' [PADNAME]       # reference to a named element
+
+Examples::
+
+    videotestsrc num-buffers=10 ! tensor_converter ! tensor_sink name=out
+    tensor_mux name=mix sync-mode=slowest ! tensor_filter framework=jax ...
+        src_a ! mix.  src_b ! mix.
+    tee name=t ! queue ! tensor_sink t. ! queue ! tensor_filter ...
+"""
+
+from __future__ import annotations
+
+import shlex
+from typing import Dict, List, Optional, Tuple
+
+from . import registry
+from .node import Node
+from .pipeline import Pipeline
+
+
+class ParseError(Exception):
+    pass
+
+
+def _tokenize(description: str) -> List[str]:
+    lex = shlex.shlex(description, posix=True)
+    lex.whitespace_split = True
+    lex.commenters = ""
+    return list(lex)
+
+
+def parse_launch(description: str, pipeline: Optional[Pipeline] = None) -> Pipeline:
+    """Build a :class:`Pipeline` from a launch string."""
+    pipe = pipeline or Pipeline()
+    tokens = _tokenize(description)
+    i = 0
+    last: Optional[Tuple[Node, Optional[str]]] = None  # (node, src pad name)
+    pending_link = False
+    auto_idx = 0
+
+    def is_padref(tok: str) -> bool:
+        head = tok.split(".", 1)[0]
+        return "." in tok and head in pipe.nodes and "=" not in tok
+
+    while i < len(tokens):
+        tok = tokens[i]
+        if tok == "!":
+            if last is None:
+                raise ParseError(f"dangling '!' in {description!r}")
+            pending_link = True
+            i += 1
+            continue
+
+        if is_padref(tok):
+            name, _, pad = tok.partition(".")
+            node = pipe.nodes[name]
+            pad = pad or None
+            if pending_link:
+                # "... ! name."  → link into the named element's sink pad
+                src_node, src_pad = last
+                src_node.get_src_pad(src_pad).link(node.get_sink_pad(pad))
+                pending_link = False
+                last = None  # chain terminated at a named sink ref
+            else:
+                # chain starts from a named element's src pad: "t. ! ..."
+                last = (node, pad)
+            i += 1
+            continue
+
+        # An element instantiation: TYPE key=value key=value ...
+        etype = tok
+        props: Dict[str, str] = {}
+        i += 1
+        while i < len(tokens) and "=" in tokens[i] and tokens[i] != "!" \
+                and not is_padref(tokens[i]):
+            key, _, value = tokens[i].partition("=")
+            props[key.replace("-", "_")] = value
+            i += 1
+        name = props.pop("name", None)
+        try:
+            node = registry.make(etype, element_name=name, **props)
+        except TypeError as exc:
+            raise ParseError(f"bad properties for {etype}: {exc}") from exc
+        if node.name in pipe.nodes:
+            if name is not None:
+                raise ParseError(f"duplicate element name {node.name!r}")
+            while f"{etype}{auto_idx}" in pipe.nodes:
+                auto_idx += 1
+            node.name = f"{etype}{auto_idx}"
+        pipe.add(node)
+        if pending_link:
+            src_node, src_pad = last
+            src_node.get_src_pad(src_pad).link(node.get_sink_pad(None))
+            pending_link = False
+        last = (node, None)
+
+    if pending_link:
+        raise ParseError(f"trailing '!' in {description!r}")
+    return pipe

@@ -1,0 +1,110 @@
+"""Element registry: name → factory, the analog of the reference's plugin
+registrar (``gst_nnstreamer_init``, ``nnstreamer.c:78-96``) combined with its
+subplugin registry (``nnstreamer_subplugin.c:56-165``).
+
+The reference discovers subplugins by scanning configured directories for
+``libnnstreamer_*.so`` and lazily ``dlopen``-ing on first lookup.  The
+Python-native equivalent here is a process-global name→factory dict populated
+by import-time registration decorators, plus lazy import of the built-in
+element modules on first lookup (so importing :mod:`nnstreamer_tpu` stays
+cheap) and entry-point-style external registration via
+:func:`register_element`.
+"""
+
+from __future__ import annotations
+
+import importlib
+import threading
+from typing import Callable, Dict, Optional
+
+from .node import Node
+
+_FACTORIES: Dict[str, Callable[..., Node]] = {}
+_LOCK = threading.Lock()
+
+# Built-in modules registered lazily (the dlopen analog): element name →
+# module that defines it.  Populated below, consumed by make().
+_BUILTIN_MODULES: Dict[str, str] = {}
+
+
+def register_element(name: str) -> Callable:
+    """Class decorator: register an element factory under a pipeline name."""
+
+    def deco(cls):
+        with _LOCK:
+            _FACTORIES[name] = cls
+        return cls
+
+    return deco
+
+
+def _lazy_builtin(name: str, module: str) -> None:
+    _BUILTIN_MODULES[name] = module
+
+
+def make(factory_name: str, /, element_name: Optional[str] = None, **props) -> Node:
+    """Instantiate an element by registered name (``gst_element_factory_make``).
+    The instance name may come as ``name=`` (gst-property style) or
+    ``element_name=``."""
+    factory = _FACTORIES.get(factory_name)
+    if factory is None and factory_name in _BUILTIN_MODULES:
+        importlib.import_module(_BUILTIN_MODULES[factory_name])
+        factory = _FACTORIES.get(factory_name)
+    if factory is None:
+        # external-plugin fallback (conf-scanned nnstpu_*.py, the dlopen
+        # analog): load once, retry.
+        from ..conf import lookup_with_plugin_fallback
+
+        factory = lookup_with_plugin_fallback(lambda: _FACTORIES.get(factory_name))
+    if factory is None:
+        raise ValueError(
+            f"unknown element {factory_name!r}; known: {sorted(known_elements())}"
+        )
+    if element_name is not None:
+        props["name"] = element_name
+    return factory(**props)
+
+
+def known_elements():
+    return set(_FACTORIES) | set(_BUILTIN_MODULES)
+
+
+# Built-in element table (the 13 reference elements + runtime extras),
+# mirroring the registrations at nnstreamer.c:78-96.
+for _el, _mod in {
+    "tensor_converter": "nnstreamer_tpu.elements.converter",
+    "tensor_transform": "nnstreamer_tpu.elements.transform",
+    "tensor_filter": "nnstreamer_tpu.elements.filter",
+    "tensor_decoder": "nnstreamer_tpu.elements.decoder",
+    "tensor_mux": "nnstreamer_tpu.elements.mux",
+    "tensor_demux": "nnstreamer_tpu.elements.demux",
+    "tensor_merge": "nnstreamer_tpu.elements.merge",
+    "tensor_split": "nnstreamer_tpu.elements.split",
+    "tensor_aggregator": "nnstreamer_tpu.elements.aggregator",
+    "tensor_sink": "nnstreamer_tpu.elements.sink",
+    "tensor_reposink": "nnstreamer_tpu.elements.repo",
+    "tensor_reposrc": "nnstreamer_tpu.elements.repo",
+    "tensor_src_iio": "nnstreamer_tpu.elements.iio_src",
+    "tensor_batch": "nnstreamer_tpu.elements.batch",
+    "tensor_unbatch": "nnstreamer_tpu.elements.batch",
+    "tensor_upload": "nnstreamer_tpu.elements.upload",
+    "tensor_dynbatch": "nnstreamer_tpu.elements.dynbatch",
+    "tensor_dynunbatch": "nnstreamer_tpu.elements.dynbatch",
+    # runtime/plumbing elements (GStreamer-provided in the reference)
+    "queue": "nnstreamer_tpu.elements.queue",
+    "tee": "nnstreamer_tpu.elements.tee",
+    "valve": "nnstreamer_tpu.elements.valve",
+    "input-selector": "nnstreamer_tpu.elements.selector",
+    "output-selector": "nnstreamer_tpu.elements.selector",
+    "appsrc": "nnstreamer_tpu.elements.app",
+    "appsink": "nnstreamer_tpu.elements.app",
+    "videotestsrc": "nnstreamer_tpu.elements.testsrc",
+    "audiotestsrc": "nnstreamer_tpu.elements.testsrc",
+    "datasrc": "nnstreamer_tpu.elements.testsrc",
+    "filesrc": "nnstreamer_tpu.elements.file_io",
+    "filesink": "nnstreamer_tpu.elements.file_io",
+    "tensor_save": "nnstreamer_tpu.elements.save_load",
+    "tensor_load": "nnstreamer_tpu.elements.save_load",
+    "fakesink": "nnstreamer_tpu.elements.sink",
+}.items():
+    _lazy_builtin(_el, _mod)

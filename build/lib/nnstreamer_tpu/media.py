@@ -1,0 +1,137 @@
+"""Media stream specs: the non-tensor side of converter/decoder negotiation.
+
+Analog of the media caps the reference's ``tensor_converter`` accepts
+(``video/x-raw`` RGB/BGRx/GRAY8, ``audio/x-raw``, ``text/x-raw``,
+``application/octet-stream`` — ``tensor_converter.c:930-1135``) and the media
+caps its decoders emit.  We model each media kind as a small frozen dataclass
+that knows how to map itself to a :class:`~nnstreamer_tpu.spec.TensorSpec`
+(``gst_tensor_config_from_media_info``, ``nnstreamer_plugin_api.h:204-230``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from fractions import Fraction
+from typing import Optional, Tuple
+
+import numpy as np
+
+from .spec import TensorSpec, TensorsSpec
+
+# Video formats supported by the reference converter (tensor_converter.c:930+).
+# channels + whether the raster is padded to 4-byte strides by upstream
+# producers (the reference strips stride padding for RGB/GRAY8 when
+# width % 4 != 0, tensor_converter.c:611-648).
+VIDEO_FORMATS = {
+    "RGB": 3,
+    "BGR": 3,
+    "RGBA": 4,
+    "BGRA": 4,
+    "BGRx": 4,
+    "GRAY8": 1,
+}
+
+AUDIO_FORMATS = {
+    "S8": np.dtype(np.int8),
+    "U8": np.dtype(np.uint8),
+    "S16LE": np.dtype(np.int16),
+    "U16LE": np.dtype(np.uint16),
+    "S32LE": np.dtype(np.int32),
+    "U32LE": np.dtype(np.uint32),
+    "F32LE": np.dtype(np.float32),
+    "F64LE": np.dtype(np.float64),
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class VideoSpec:
+    """``video/x-raw``: frames arrive as (height, width, channels) uint8."""
+
+    format: str = "RGB"
+    width: Optional[int] = None
+    height: Optional[int] = None
+    rate: Optional[Fraction] = None
+
+    def __post_init__(self):
+        if self.format not in VIDEO_FORMATS:
+            raise ValueError(f"unsupported video format: {self.format}")
+        if self.rate is not None:
+            object.__setattr__(self, "rate", Fraction(self.rate))
+
+    @property
+    def channels(self) -> int:
+        return VIDEO_FORMATS[self.format]
+
+    def tensor_spec(self, frames_per_tensor: int = 1) -> TensorsSpec:
+        """Derived tensor caps: NNS dim ``channels:width:height:frames``
+        == numpy shape ``(frames, height, width, channels)`` (squeezed to
+        (h, w, c) when frames==1, matching NNS trailing-1 squeeze)."""
+        shape: Tuple[Optional[int], ...] = (self.height, self.width, self.channels)
+        if frames_per_tensor != 1:
+            shape = (frames_per_tensor,) + shape
+        rate = None
+        if self.rate is not None:
+            rate = self.rate / frames_per_tensor if frames_per_tensor != 1 else self.rate
+        return TensorsSpec(
+            tensors=(TensorSpec(dtype=np.uint8, shape=shape),), rate=rate
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class AudioSpec:
+    """``audio/x-raw``: frames arrive as (samples, channels)."""
+
+    format: str = "S16LE"
+    channels: Optional[int] = None
+    sample_rate: Optional[int] = None
+
+    def __post_init__(self):
+        if self.format not in AUDIO_FORMATS:
+            raise ValueError(f"unsupported audio format: {self.format}")
+
+    @property
+    def dtype(self) -> np.dtype:
+        return AUDIO_FORMATS[self.format]
+
+    def tensor_spec(self, frames_per_tensor: int = 1) -> TensorsSpec:
+        """NNS dim ``channels:samples`` == numpy (samples, channels)."""
+        rate = None
+        if self.sample_rate is not None:
+            rate = Fraction(self.sample_rate, frames_per_tensor)
+        return TensorsSpec(
+            tensors=(
+                TensorSpec(dtype=self.dtype, shape=(frames_per_tensor, self.channels)),
+            ),
+            rate=rate,
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class TextSpec:
+    """``text/x-raw``: utf8 text, fixed-size uint8 buffer of ``size`` bytes
+    (the reference requires ``input-dim`` for text, null-padded)."""
+
+    size: Optional[int] = None
+
+    def tensor_spec(self, frames_per_tensor: int = 1) -> TensorsSpec:
+        del frames_per_tensor
+        return TensorsSpec(tensors=(TensorSpec(dtype=np.uint8, shape=(self.size,)),))
+
+
+@dataclasses.dataclass(frozen=True)
+class OctetSpec:
+    """``application/octet-stream``: opaque bytes reinterpreted via a
+    user-supplied tensor spec (converter ``input-dim``/``input-type`` props)."""
+
+    spec: Optional[TensorsSpec] = None
+
+    def tensor_spec(self, frames_per_tensor: int = 1) -> TensorsSpec:
+        del frames_per_tensor
+        if self.spec is None:
+            raise ValueError(
+                "application/octet-stream requires explicit input-dim/input-type"
+            )
+        return self.spec
+
+
+MediaSpec = (VideoSpec, AudioSpec, TextSpec, OctetSpec)

@@ -1,0 +1,5 @@
+"""Built-in model zoo: the networks behind the five benchmark configs
+(BASELINE.md): MobileNet-v2 labeling, SSD-MobileNet boxes, PoseNet
+heatmaps, LSTM recurrence, and batched multi-stream classification."""
+
+from . import lstm, mobilenet_v2, posenet, ssd_mobilenet, transformer  # noqa: F401

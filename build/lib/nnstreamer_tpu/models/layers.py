@@ -1,0 +1,119 @@
+"""Functional NN layers for the built-in model zoo.
+
+Pure-JAX (params as explicit pytrees, no framework state) so models compose
+directly with the filter backend's AOT compile path and shard cleanly under
+``NamedSharding``.  Layout is NHWC with HWIO kernels — the TPU-native layout
+XLA tiles onto the MXU; compute dtype is configurable (bfloat16 by default on
+TPU, the MXU's native matmul type) with float32 params.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.quant import maybe_dequantize
+
+Params = Dict[str, Any]
+
+
+def np_rng(key) -> np.random.Generator:
+    """A numpy Generator seeded from a jax PRNG key.
+
+    Param init runs on the host with numpy: ``jax.random.normal`` /
+    ``jnp.zeros`` would trigger one small XLA compile per distinct shape
+    (~60 for MobileNet), turning model *construction* into tens of seconds
+    of compile time on a cold cache.  Weights are random anyway (zero-egress
+    env); determinism per key is preserved.
+    """
+    raw = np.asarray(jax.random.key_data(key)).ravel()
+    return np.random.default_rng([int(x) for x in raw])
+
+
+def _normal(key, shape, stddev: float) -> jnp.ndarray:
+    w = np_rng(key).standard_normal(shape, dtype=np.float32) * stddev
+    return jnp.asarray(w)
+
+
+def conv_init(key, kh, kw, cin, cout, groups: int = 1) -> Params:
+    fan_in = kh * kw * cin // groups
+    return {
+        "w": _normal(key, (kh, kw, cin // groups, cout), np.sqrt(2.0 / fan_in))
+    }
+
+
+def bn_init(c) -> Params:
+    return {
+        "scale": jnp.asarray(np.ones((c,), np.float32)),
+        "bias": jnp.asarray(np.zeros((c,), np.float32)),
+        "mean": jnp.asarray(np.zeros((c,), np.float32)),
+        "var": jnp.asarray(np.ones((c,), np.float32)),
+    }
+
+
+def dense_init(key, cin, cout) -> Params:
+    return {
+        "w": _normal(key, (cin, cout), np.sqrt(1.0 / cin)),
+        "b": jnp.asarray(np.zeros((cout,), np.float32)),
+    }
+
+
+def conv2d(
+    params: Params,
+    x: jnp.ndarray,
+    stride: int = 1,
+    padding: str = "SAME",
+    groups: int = 1,
+    dtype=None,
+) -> jnp.ndarray:
+    # int8 QuantizedWeight leaves dequantize here, fusing into the conv
+    w = maybe_dequantize(params["w"], dtype)
+    return jax.lax.conv_general_dilated(
+        x,
+        w,
+        window_strides=(stride, stride),
+        padding=padding,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        feature_group_count=groups,
+    )
+
+
+def batch_norm(params: Params, x: jnp.ndarray, eps: float = 1e-3) -> jnp.ndarray:
+    """Inference-mode BN (folded running stats) — streams never train."""
+    dtype = x.dtype
+    scale = (params["scale"] / jnp.sqrt(params["var"] + eps)).astype(dtype)
+    bias = (params["bias"] - params["mean"] * scale).astype(dtype)
+    return x * scale + bias
+
+
+def relu6(x: jnp.ndarray) -> jnp.ndarray:
+    return jnp.clip(x, 0.0, 6.0)
+
+
+def dense(params: Params, x: jnp.ndarray, dtype=None) -> jnp.ndarray:
+    w, b = maybe_dequantize(params["w"], dtype), params["b"]
+    if dtype is not None:
+        b = b.astype(dtype)
+    return x @ w + b
+
+
+def conv_bn_relu6_init(key, kh, kw, cin, cout, groups: int = 1) -> Params:
+    return {"conv": conv_init(key, kh, kw, cin, cout, groups), "bn": bn_init(cout)}
+
+
+def conv_bn_relu6(
+    params: Params, x, stride=1, groups=1, dtype=None, act=True
+) -> jnp.ndarray:
+    y = conv2d(params["conv"], x, stride=stride, groups=groups, dtype=dtype)
+    y = batch_norm(params["bn"], y)
+    return relu6(y) if act else y
+
+
+def ensure_batched(x: jnp.ndarray, rank: int) -> Tuple[jnp.ndarray, bool]:
+    """Add a batch dim if the stream frame is unbatched (rank-3 image)."""
+    if x.ndim == rank - 1:
+        return x[None], True
+    return x, False

@@ -1,0 +1,108 @@
+"""LSTM models: north-star config #4 (recurrent filter pipeline).
+
+Two forms, matching the two ways the reference streams recurrence:
+
+- :func:`build_cell` — a stateless per-step LSTM cell as a stream filter:
+  inputs (h, c, x) → outputs (h', c'), wired through repo slots exactly like
+  the reference's ``custom_example_LSTM/dummy_LSTM.c`` fixture topology
+  (``tests/nnstreamer_repo_lstm/runTest.sh:10-22``).  State stays
+  device-resident around the cycle (the backend is device_resident).
+- :func:`build_sequence` — a whole-sequence model via ``lax.scan`` (the
+  XLA-idiomatic form: one compiled program, no Python loop), for windowed
+  streams coming out of ``tensor_aggregator``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..backends.jax_backend import JaxModel
+from ..spec import TensorSpec, TensorsSpec
+from .layers import Params, dense_init
+
+
+def init_params(key, input_size: int, hidden_size: int) -> Params:
+    k1, k2 = jax.random.split(key)
+    return {
+        "wx": dense_init(k1, input_size, 4 * hidden_size),
+        "wh": dense_init(k2, hidden_size, 4 * hidden_size),
+        "hidden_size": hidden_size,
+    }
+
+
+def cell_step(params: Params, h, c, x):
+    """One LSTM step (batched or not: shapes (..., H) / (..., I))."""
+    hs = params["hidden_size"]
+    gates = x @ params["wx"]["w"] + params["wx"]["b"] + h @ params["wh"]["w"] + params["wh"]["b"]
+    i, f, g, o = (gates[..., k * hs:(k + 1) * hs] for k in range(4))
+    c_new = jax.nn.sigmoid(f + 1.0) * c + jax.nn.sigmoid(i) * jnp.tanh(g)
+    h_new = jax.nn.sigmoid(o) * jnp.tanh(c_new)
+    return h_new, c_new
+
+
+def build_cell(
+    input_size: int = 64,
+    hidden_size: int = 64,
+    batch: Optional[int] = None,
+    seed: int = 0,
+    params: Optional[Params] = None,
+) -> JaxModel:
+    """Stream filter: (h, c, x) → (h', c') for repo-slot recurrence."""
+    if params is None:
+        params = init_params(jax.random.PRNGKey(seed), input_size, hidden_size)
+
+    def apply_fn(p, h, c, x):
+        return cell_step(p, h, c, x)
+
+    hshape: Tuple[int, ...] = (hidden_size,) if batch is None else (batch, hidden_size)
+    xshape: Tuple[int, ...] = (input_size,) if batch is None else (batch, input_size)
+    spec = TensorsSpec.of(
+        TensorSpec(dtype=np.float32, shape=hshape, name="h"),
+        TensorSpec(dtype=np.float32, shape=hshape, name="c"),
+        TensorSpec(dtype=np.float32, shape=xshape, name="x"),
+    )
+    return JaxModel(
+        apply=apply_fn, params=params, input_spec=spec, name="lstm_cell"
+    )
+
+
+def build_sequence(
+    input_size: int = 64,
+    hidden_size: int = 64,
+    seq_len: int = 32,
+    batch: Optional[int] = None,
+    seed: int = 0,
+    params: Optional[Params] = None,
+) -> JaxModel:
+    """Whole-sequence LSTM via lax.scan: (T, I) or (B, T, I) → (T, H)/(B, T, H)."""
+    if params is None:
+        params = init_params(jax.random.PRNGKey(seed), input_size, hidden_size)
+
+    def run_seq(p, xs):
+        hs = p["hidden_size"]
+        batch_dims = xs.shape[:-2]
+        h0 = jnp.zeros(batch_dims + (hs,), xs.dtype)
+        c0 = jnp.zeros(batch_dims + (hs,), xs.dtype)
+
+        def step(carry, x):
+            h, c = carry
+            h, c = cell_step(p, h, c, x)
+            return (h, c), h
+
+        xs_t = jnp.moveaxis(xs, -2, 0)  # time-major for scan
+        (_, _), hs_t = jax.lax.scan(step, (h0, c0), xs_t)
+        return jnp.moveaxis(hs_t, 0, -2)
+
+    shape: Tuple[int, ...] = (seq_len, input_size)
+    if batch is not None:
+        shape = (batch,) + shape
+    return JaxModel(
+        apply=run_seq,
+        params=params,
+        input_spec=TensorsSpec.of(TensorSpec(dtype=np.float32, shape=shape)),
+        name="lstm_sequence",
+    )

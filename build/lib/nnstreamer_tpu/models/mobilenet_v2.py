@@ -1,0 +1,208 @@
+"""MobileNet-v2: the flagship classifier for the image-labeling pipeline.
+
+The reference's north-star config #1 runs MobileNet image labeling through
+tflite (``tests/nnstreamer_decoder_image_labeling``); this is the TPU-native
+equivalent: a pure-JAX inverted-residual network (Sandler et al. 2018),
+NHWC/HWIO for MXU tiling, bfloat16 compute with float32 params, one fused
+XLA program end-to-end.
+
+Weights initialize randomly (no network egress here); ``load_params`` can
+overlay a checkpoint pytree with the same structure (orbax/msgpack).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..backends.jax_backend import JaxModel
+from ..spec import TensorSpec, TensorsSpec
+from .layers import (
+    Params,
+    conv_bn_relu6,
+    conv_bn_relu6_init,
+    dense,
+    dense_init,
+    ensure_batched,
+)
+
+# (expansion t, out channels c, repeats n, stride s) — the paper's Table 2.
+_CFG: Sequence[Tuple[int, int, int, int]] = (
+    (1, 16, 1, 1),
+    (6, 24, 2, 2),
+    (6, 32, 3, 2),
+    (6, 64, 4, 2),
+    (6, 96, 3, 1),
+    (6, 160, 3, 2),
+    (6, 320, 1, 1),
+)
+
+
+def _make_divisible(v: float, divisor: int = 8) -> int:
+    new_v = max(divisor, int(v + divisor / 2) // divisor * divisor)
+    if new_v < 0.9 * v:
+        new_v += divisor
+    return new_v
+
+
+def init_params(
+    key, num_classes: int = 1001, width_mult: float = 1.0
+) -> Params:
+    keys = iter(jax.random.split(key, 64))
+    params: Params = {}
+    cin = _make_divisible(32 * width_mult)
+    params["stem"] = conv_bn_relu6_init(next(keys), 3, 3, 3, cin)
+    blocks = []
+    for t, c, n, s in _CFG:
+        cout = _make_divisible(c * width_mult)
+        for i in range(n):
+            stride = s if i == 0 else 1
+            hidden = cin * t
+            block: Params = {}
+            if t != 1:
+                block["expand"] = conv_bn_relu6_init(next(keys), 1, 1, cin, hidden)
+            block["depthwise"] = conv_bn_relu6_init(
+                next(keys), 3, 3, hidden, hidden, groups=hidden
+            )
+            block["project"] = conv_bn_relu6_init(next(keys), 1, 1, hidden, cout)
+            block["stride"] = stride
+            block["residual"] = stride == 1 and cin == cout
+            blocks.append(block)
+            cin = cout
+    params["blocks"] = blocks
+    chead = _make_divisible(1280 * max(1.0, width_mult))
+    params["head"] = conv_bn_relu6_init(next(keys), 1, 1, cin, chead)
+    params["classifier"] = dense_init(next(keys), chead, num_classes)
+    return params
+
+
+def _block_apply(block: Params, x, dtype):
+    y = x
+    if "expand" in block:
+        y = conv_bn_relu6(block["expand"], y, dtype=dtype)
+    y = conv_bn_relu6(
+        block["depthwise"],
+        y,
+        stride=block["stride"],
+        groups=y.shape[-1],
+        dtype=dtype,
+    )
+    y = conv_bn_relu6(block["project"], y, dtype=dtype, act=False)
+    if block["residual"]:
+        y = y + x
+    return y
+
+
+def apply(params: Params, x, dtype=jnp.bfloat16):
+    """Forward: (N,H,W,3) or (H,W,3) float input → (N,classes) or (classes,)
+    float32 logits."""
+    x, squeezed = ensure_batched(x, 4)
+    y = x.astype(dtype)
+    y = conv_bn_relu6(params["stem"], y, stride=2, dtype=dtype)
+    for block in params["blocks"]:
+        y = _block_apply(block, y, dtype)
+    y = conv_bn_relu6(params["head"], y, dtype=dtype)
+    y = y.mean(axis=(1, 2))  # global average pool
+    logits = dense(params["classifier"], y, dtype=dtype).astype(jnp.float32)
+    return logits[0] if squeezed else logits
+
+
+def quantize_params(params: Params) -> Params:
+    """Weight-only int8 quantization of every conv/dense kernel (per output
+    channel).  The TPU-native analog of the reference's uint8-quantized
+    tflite flagship (survey §7f): weights live in HBM at 1 byte/element and
+    dequantize inside the fused XLA program; BN/bias stay float."""
+    from ..ops.quant import quantize_weight
+
+    def walk(node):
+        if isinstance(node, dict):
+            out = {}
+            for k, v in node.items():
+                if k == "w" and hasattr(v, "ndim") and v.ndim >= 2:
+                    out[k] = quantize_weight(v, axis=-1)
+                else:
+                    out[k] = walk(v)
+            return out
+        if isinstance(node, list):
+            return [walk(v) for v in node]
+        return node
+
+    return walk(params)
+
+
+def apply_quantized_int8_head(params: Params, x, dtype=jnp.bfloat16):
+    """Forward pass with the classifier matmul on the int8 MXU path:
+    dynamic activation quantization feeding the Pallas
+    :func:`~nnstreamer_tpu.ops.pallas_kernels.int8_matmul` kernel (int8×int8
+    → int32 accumulate → fused dequant+bias)."""
+    from ..ops.pallas_kernels import int8_matmul
+    from ..ops.quant import QuantizedWeight, quantize_activations
+
+    head = params["classifier"]
+    assert isinstance(head["w"], QuantizedWeight), "quantize_params first"
+    x, squeezed = ensure_batched(x, 4)
+    y = x.astype(dtype)
+    y = conv_bn_relu6(params["stem"], y, stride=2, dtype=dtype)
+    for block in params["blocks"]:
+        y = _block_apply(block, y, dtype)
+    y = conv_bn_relu6(params["head"], y, dtype=dtype)
+    y = y.mean(axis=(1, 2)).astype(jnp.float32)
+    feats_q, feats_scale = quantize_activations(y)
+    logits = int8_matmul(
+        feats_q,
+        head["w"].q,
+        feats_scale,
+        head["w"].scale.reshape(1, -1),
+        head["b"],
+    )
+    return logits[0] if squeezed else logits
+
+
+def build_quantized(
+    num_classes: int = 1001,
+    width_mult: float = 1.0,
+    image_size: int = 224,
+    batch: Optional[int] = None,
+    dtype=jnp.bfloat16,
+    seed: int = 0,
+    params: Optional[Params] = None,
+    int8_head: bool = False,
+) -> JaxModel:
+    """Quantized stream-ready model (int8 weights, on-device dequant);
+    ``int8_head=True`` additionally runs the classifier on the int8 MXU
+    kernel."""
+    m = build(num_classes, width_mult, image_size, batch, dtype, seed, params)
+    fwd = apply_quantized_int8_head if int8_head else apply
+    return JaxModel(
+        apply=lambda p, x: fwd(p, x, dtype=dtype),
+        params=quantize_params(m.params),
+        input_spec=m.input_spec,
+        name=f"mobilenet_v2_q8_{width_mult}_{image_size}",
+    )
+
+
+def build(
+    num_classes: int = 1001,
+    width_mult: float = 1.0,
+    image_size: int = 224,
+    batch: Optional[int] = None,
+    dtype=jnp.bfloat16,
+    seed: int = 0,
+    params: Optional[Params] = None,
+) -> JaxModel:
+    """Build a stream-ready model.  ``batch=None`` accepts a single (H,W,3)
+    frame; an int fixes a batched (B,H,W,3) input (the mux/pmap path)."""
+    if params is None:
+        params = init_params(jax.random.PRNGKey(seed), num_classes, width_mult)
+    shape: Tuple[Optional[int], ...] = (image_size, image_size, 3)
+    if batch is not None:
+        shape = (batch,) + shape
+    return JaxModel(
+        apply=lambda p, x: apply(p, x, dtype=dtype),
+        params=params,
+        input_spec=TensorsSpec.of(TensorSpec(dtype=np.float32, shape=shape)),
+        name=f"mobilenet_v2_{width_mult}_{image_size}",
+    )

@@ -1,0 +1,100 @@
+"""PoseNet: north-star config #3 (pose-estimation pipeline).
+
+The reference's pose pipeline (``tests/nnstreamer_decoder_pose``) feeds
+14-keypoint heatmaps to the ``pose_estimation`` decoder
+(``tensordec-pose.c:47``, input asserted ``14:w:h``).  This model is a
+MobileNet-v2 backbone truncated at stride 16 with a 1×1 heatmap head
+emitting (grid, grid, 14) — decoder-contract-compatible, TPU-native.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..backends.jax_backend import JaxModel
+from ..spec import TensorSpec, TensorsSpec
+from .layers import Params, conv_bn_relu6, conv_init, conv2d, ensure_batched
+from . import mobilenet_v2
+
+POSE_KEYPOINTS = 14
+
+
+def init_params(key, width_mult: float = 1.0) -> Params:
+    k1, k2 = jax.random.split(key)
+    backbone = mobilenet_v2.init_params(k1, num_classes=1, width_mult=width_mult)
+    # truncate after the 96-channel stage (stride 16)
+    blocks = backbone["blocks"][:13]
+    cin = blocks[-1]["project"]["conv"]["w"].shape[-1]
+    return {
+        "stem": backbone["stem"],
+        "blocks": blocks,
+        "head": conv_init(k2, 1, 1, cin, POSE_KEYPOINTS),
+    }
+
+
+def apply(params: Params, x, dtype=jnp.bfloat16):
+    """(N,H,W,3) or (H,W,3) → (N,H/16,W/16,14) or (H/16,W/16,14) heatmaps."""
+    x, squeezed = ensure_batched(x, 4)
+    y = x.astype(dtype)
+    y = conv_bn_relu6(params["stem"], y, stride=2, dtype=dtype)
+    for block in params["blocks"]:
+        y = mobilenet_v2._block_apply(block, y, dtype)
+    hm = jax.nn.sigmoid(conv2d(params["head"], y, dtype=dtype)).astype(jnp.float32)
+    return hm[0] if squeezed else hm
+
+
+def decode_keypoints(hm):
+    """On-device keypoint decode: (…,H,W,14) heatmaps → (…,14,3) rows of
+    ``[x, y, score]`` in grid coordinates — the argmax loop of
+    ``tensordec-pose.c:473-493`` fused into the model's XLA program, so a
+    tiny (14,3) tensor crosses device→host instead of the full heatmap
+    volume (whose small minor dims pay heavy tiled-layout padding)."""
+    squeezed = hm.ndim == 3
+    if squeezed:
+        hm = hm[None]
+    n, h, w, k = hm.shape
+    flat = hm.reshape(n, h * w, k)
+    idx = jnp.argmax(flat, axis=1)
+    score = jnp.take_along_axis(flat, idx[:, None, :], axis=1)[:, 0, :]
+    xs = (idx % w).astype(jnp.float32)
+    ys = (idx // w).astype(jnp.float32)
+    out = jnp.stack([xs, ys, score], axis=-1)
+    return out[0] if squeezed else out
+
+
+def build(
+    image_size: int = 224,
+    batch: Optional[int] = None,
+    dtype=jnp.bfloat16,
+    seed: int = 0,
+    params: Optional[Params] = None,
+    fused_decode: bool = False,
+) -> JaxModel:
+    """``fused_decode=True`` appends :func:`decode_keypoints`: the model
+    then emits ``(14, 3)`` keypoints (grid coords) that the
+    ``pose_estimation`` decoder consumes directly."""
+    if params is None:
+        params = init_params(jax.random.PRNGKey(seed))
+    shape: Tuple[Optional[int], ...] = (image_size, image_size, 3)
+    if batch is not None:
+        shape = (batch,) + shape
+    if fused_decode:
+        def fwd(p, x):
+            return decode_keypoints(apply(p, x, dtype=dtype))
+    else:
+        def fwd(p, x):
+            return apply(p, x, dtype=dtype)
+    return JaxModel(
+        apply=fwd,
+        params=params,
+        input_spec=TensorsSpec.of(TensorSpec(dtype=np.float32, shape=shape)),
+        name="posenet_mobilenet_v2",
+    )
+
+
+def grid_size(image_size: int = 224) -> int:
+    return image_size // 16

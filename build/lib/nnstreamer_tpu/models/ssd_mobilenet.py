@@ -1,0 +1,207 @@
+"""SSD-MobileNet detector: north-star config #2 (bounding-box pipeline).
+
+The reference pipeline (``tests/nnstreamer_decoder_boundingbox``) runs a
+tflite SSD with **1917 box priors** whose outputs the ``bounding_boxes``
+decoder consumes (``tensordec-boundingbox.c:66-107``).  This model
+reproduces that contract TPU-natively:
+
+- MobileNet-v2 backbone truncated at two feature scales (19×19, 10×10 for a
+  300×300 input) + 4 extra downsampling blocks (5,3,2,1) — the classic SSD
+  feature pyramid whose anchor grid totals 1917:
+  ``19²·3 + 10²·6 + 5²·6 + 3²·6 + 2²·6 + 1²·6 = 1917``.
+- conv heads emit per-anchor box encodings ``(1917, 4)`` and class scores
+  ``(1917, num_labels)`` — exactly what the decoder's tflite-ssd sub-mode
+  expects.
+- :func:`generate_priors` writes the matching priors file (4 rows:
+  ycenter/xcenter/h/w) so decode geometry is self-consistent.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..backends.jax_backend import JaxModel
+from ..spec import TensorSpec, TensorsSpec
+from .layers import (
+    Params,
+    conv_bn_relu6,
+    conv_bn_relu6_init,
+    conv2d,
+    conv_init,
+    ensure_batched,
+)
+from . import mobilenet_v2
+
+# (grid, anchors-per-cell) per feature map for 300×300 — totals 1917.
+FEATURE_GRIDS: Tuple[Tuple[int, int], ...] = (
+    (19, 3), (10, 6), (5, 6), (3, 6), (2, 6), (1, 6),
+)
+NUM_PRIORS = sum(g * g * a for g, a in FEATURE_GRIDS)  # 1917
+
+
+def init_params(key, num_labels: int = 91, width_mult: float = 1.0) -> Params:
+    keys = iter(jax.random.split(key, 64))
+    backbone = mobilenet_v2.init_params(next(keys), num_classes=1, width_mult=width_mult)
+    params: Params = {
+        "stem": backbone["stem"],
+        "blocks": backbone["blocks"],
+    }
+    # feature channels at the two backbone taps (width_mult=1): 576-expand
+    # level (19x19) uses the expansion of the first stride-2 block of the
+    # 160-channel stage; we instead tap post-block outputs: 96ch @19x19
+    # (stage 5 end) and 320ch @10x10 (stage 7 end) — simpler and equivalent
+    # for a from-scratch model.
+    c19 = params["blocks"][12]["project"]["conv"]["w"].shape[-1]  # 96
+    c10 = params["blocks"][16]["project"]["conv"]["w"].shape[-1]  # 320
+    extra_channels = [256, 256, 128, 128]
+    extras = []
+    cin = c10
+    for c in extra_channels:
+        extras.append(conv_bn_relu6_init(next(keys), 3, 3, cin, c))
+        cin = c
+    params["extras"] = extras
+    head_cins = [c19, c10] + extra_channels
+    box_heads, cls_heads = [], []
+    for (grid, anchors), cin in zip(FEATURE_GRIDS, head_cins):
+        del grid
+        box_heads.append(conv_init(next(keys), 3, 3, cin, anchors * 4))
+        cls_heads.append(conv_init(next(keys), 3, 3, cin, anchors * num_labels))
+    params["box_heads"] = box_heads
+    params["cls_heads"] = cls_heads
+    params["num_labels"] = num_labels
+    return params
+
+
+def apply(params: Params, x, dtype=jnp.bfloat16):
+    """(N,300,300,3) or (300,300,3) → (boxes (…,1917,4), scores (…,1917,L))."""
+    x, squeezed = ensure_batched(x, 4)
+    y = x.astype(dtype)
+    y = conv_bn_relu6(params["stem"], y, stride=2, dtype=dtype)
+    features: List[jnp.ndarray] = []
+    for i, block in enumerate(params["blocks"]):
+        y = mobilenet_v2._block_apply(block, y, dtype)
+        if i == 12:  # end of the 96-channel stage: 19×19
+            features.append(y)
+    features.append(y)  # 10×10, 320 channels
+    for extra in params["extras"]:
+        y = conv_bn_relu6(extra, y, stride=2, dtype=dtype)
+        features.append(y)
+    num_labels = params["num_labels"]
+    boxes, scores = [], []
+    for feat, bh, ch in zip(features, params["box_heads"], params["cls_heads"]):
+        b = conv2d(bh, feat, dtype=dtype)
+        c = conv2d(ch, feat, dtype=dtype)
+        n = feat.shape[0]
+        boxes.append(b.reshape(n, -1, 4))
+        scores.append(c.reshape(n, -1, num_labels))
+    boxes = jnp.concatenate(boxes, axis=1).astype(jnp.float32)
+    scores = jnp.concatenate(scores, axis=1).astype(jnp.float32)
+    if squeezed:
+        return boxes[0], scores[0]
+    return boxes, scores
+
+
+def decode_topk(boxes, scores, priors, k: int = 100):
+    """On-device SSD decode head: the XLA replacement for the host-side
+    per-box loop in ``tensordec-boundingbox.c:631-678`` (mirrored by
+    ``decoders.bounding_boxes.decode_tflite_ssd``).
+
+    sigmoid scores → per-box best non-background class → ``lax.top_k`` →
+    prior decode, all fused into the detector's own program, so only a
+    ``(k, 6)`` tensor ever crosses device→host (instead of 1917×(4+L)
+    floats).  Rows: ``[x, y, w, h, class, score]``, box geometry normalized
+    to [0, 1] image space; host-side thresholding + NMS stay cheap on ≤k
+    candidates.
+    """
+    squeezed = boxes.ndim == 2
+    if squeezed:
+        boxes, scores = boxes[None], scores[None]
+    s = jax.nn.sigmoid(scores[..., 1:].astype(jnp.float32))
+    best = s.max(axis=-1)
+    cls = (s.argmax(axis=-1) + 1).astype(jnp.float32)  # class 0 = background
+    top_s, top_i = jax.lax.top_k(best, k)
+    loc = jnp.take_along_axis(
+        boxes.astype(jnp.float32), top_i[..., None], axis=1
+    )
+    pri = jnp.asarray(priors, jnp.float32).T[top_i]  # (..., k, 4) yc/xc/h/w
+    ycenter = loc[..., 0] / 10.0 * pri[..., 2] + pri[..., 0]
+    xcenter = loc[..., 1] / 10.0 * pri[..., 3] + pri[..., 1]
+    h = jnp.exp(loc[..., 2] / 5.0) * pri[..., 2]
+    w = jnp.exp(loc[..., 3] / 5.0) * pri[..., 3]
+    top_c = jnp.take_along_axis(cls, top_i, axis=1)
+    out = jnp.stack(
+        [xcenter - w / 2.0, ycenter - h / 2.0, w, h, top_c, top_s], axis=-1
+    )
+    return out[0] if squeezed else out
+
+
+def generate_priors() -> np.ndarray:
+    """Anchor grid (4, 1917): ycenter/xcenter/h/w rows, matching the decoder's
+    priors-file contract (``load_box_priors``)."""
+    rows = [[], [], [], []]
+    scales = np.linspace(0.2, 0.95, len(FEATURE_GRIDS))
+    ratios6 = [1.0, 2.0, 0.5, 3.0, 1.0 / 3.0, 1.0]
+    for (grid, anchors), scale in zip(FEATURE_GRIDS, scales):
+        ratios = ratios6[:anchors]
+        for gy in range(grid):
+            for gx in range(grid):
+                cy = (gy + 0.5) / grid
+                cx = (gx + 0.5) / grid
+                for k, r in enumerate(ratios):
+                    s = scale * (1.1 if (anchors == 6 and k == 5) else 1.0)
+                    rows[0].append(cy)
+                    rows[1].append(cx)
+                    rows[2].append(s / np.sqrt(r))
+                    rows[3].append(s * np.sqrt(r))
+    priors = np.asarray(rows, np.float32)
+    assert priors.shape == (4, NUM_PRIORS), priors.shape
+    return priors
+
+
+def write_priors_file(path: str) -> str:
+    priors = generate_priors()
+    with open(path, "w", encoding="utf-8") as f:
+        for row in priors:
+            f.write(" ".join(f"{v:.6f}" for v in row) + "\n")
+    return path
+
+
+def build(
+    num_labels: int = 91,
+    image_size: int = 300,
+    batch: Optional[int] = None,
+    dtype=jnp.bfloat16,
+    seed: int = 0,
+    params: Optional[Params] = None,
+    fused_decode: Optional[int] = None,
+) -> JaxModel:
+    """``fused_decode=K`` appends :func:`decode_topk` to the program: the
+    model then emits one small ``(K, 6)`` detection tensor (the
+    ``fused-ssd`` decoder sub-mode consumes it) instead of raw
+    boxes+scores."""
+    if params is None:
+        params = init_params(jax.random.PRNGKey(seed), num_labels)
+    shape: Tuple[Optional[int], ...] = (image_size, image_size, 3)
+    if batch is not None:
+        shape = (batch,) + shape
+    if fused_decode:
+        priors = generate_priors()
+
+        def fwd(p, x):
+            boxes, scores = apply(p, x, dtype=dtype)
+            return decode_topk(boxes, scores, priors, k=fused_decode)
+
+    else:
+        def fwd(p, x):
+            return apply(p, x, dtype=dtype)
+
+    return JaxModel(
+        apply=fwd,
+        params=params,
+        input_spec=TensorsSpec.of(TensorSpec(dtype=np.float32, shape=shape)),
+        name="ssd_mobilenet_v2",
+    )

@@ -1,0 +1,267 @@
+"""Streaming transformer encoder — the long-context model family.
+
+The reference's model zoo stops at CNN/LSTM-era nets (survey §2.3/§4
+fixtures); a TPU-native streaming framework must also carry long sequences
+(aggregated sensor windows, token streams) through attention models.  This
+encoder runs its attention in one of three modes, all producing identical
+results:
+
+- ``full``    — single-device attention (golden path),
+- ``ring``    — sequence-parallel ring attention over a mesh axis
+  (:func:`nnstreamer_tpu.parallel.ring_attention.ring_attention`),
+- ``ulysses`` — all-to-all head-parallel attention
+  (:func:`nnstreamer_tpu.parallel.sequence.ulysses_attention`).
+
+Pre-LN blocks, bfloat16-friendly, pure pytree params (shards under
+``NamedSharding`` like the rest of the zoo).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..backends.jax_backend import JaxModel
+from ..spec import TensorSpec, TensorsSpec
+from .layers import Params, dense_init, ensure_batched
+
+
+def _layernorm(p: Params, x, eps: float = 1e-5):
+    mean = x.mean(-1, keepdims=True)
+    var = ((x - mean) ** 2).mean(-1, keepdims=True)
+    y = (x - mean) * jax.lax.rsqrt(var + eps)
+    # keep the residual stream in the compute dtype (f32 params would
+    # silently promote bf16 activations)
+    return y * p["scale"].astype(x.dtype) + p["bias"].astype(x.dtype)
+
+
+def _ln_init(d) -> Params:
+    return {"scale": jnp.ones((d,), jnp.float32), "bias": jnp.zeros((d,), jnp.float32)}
+
+
+def init_params(
+    key,
+    d_model: int = 128,
+    n_heads: int = 8,
+    n_layers: int = 2,
+    d_ff: int = 512,
+    d_in: int = 64,
+    n_out: int = 16,
+    moe_experts: int = 0,
+) -> Params:
+    """``moe_experts > 0`` replaces every block's dense FFN with a switch
+    MoE of that many experts (:mod:`nnstreamer_tpu.parallel.moe`) — the
+    expert dim shards over an ``ep`` mesh axis."""
+    if d_model % n_heads != 0:
+        raise ValueError(f"d_model {d_model} not divisible by n_heads {n_heads}")
+    keys = iter(jax.random.split(key, 4 + 6 * n_layers))
+    params: Params = {
+        "embed": dense_init(next(keys), d_in, d_model),
+        "blocks": [],
+        "ln_f": _ln_init(d_model),
+        "head": dense_init(next(keys), d_model, n_out),
+        "n_heads": n_heads,
+    }
+    for _ in range(n_layers):
+        blk = {
+            "ln1": _ln_init(d_model),
+            "qkv": dense_init(next(keys), d_model, 3 * d_model),
+            "proj": dense_init(next(keys), d_model, d_model),
+            "ln2": _ln_init(d_model),
+        }
+        if moe_experts > 0:
+            from ..parallel.moe import init_moe_params
+
+            blk["moe"] = init_moe_params(next(keys), d_model, d_ff, moe_experts)
+        else:
+            blk["ff1"] = dense_init(next(keys), d_model, d_ff)
+            blk["ff2"] = dense_init(next(keys), d_ff, d_model)
+        params["blocks"].append(blk)
+    return params
+
+
+def _block_apply(
+    blk: Params,
+    y,
+    h: int,
+    attn: str,
+    mesh,
+    axis: str,
+    causal: bool,
+    dtype,
+    moe_mesh=None,
+    moe_axis: str = "ep",
+):
+    """One pre-LN encoder block (attention + FFN/MoE with residuals)."""
+    b, t, d = y.shape
+    z = _layernorm(blk["ln1"], y)
+    qkv = z @ blk["qkv"]["w"].astype(dtype) + blk["qkv"]["b"].astype(dtype)
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    q, k, v = (a.reshape(b, t, h, d // h) for a in (q, k, v))
+    o = _attention(q, k, v, attn, mesh, axis, causal).reshape(b, t, d)
+    y = y + o @ blk["proj"]["w"].astype(dtype) + blk["proj"]["b"].astype(dtype)
+    z = _layernorm(blk["ln2"], y)
+    if "moe" in blk:
+        from ..parallel.moe import moe_ffn
+
+        y = y + moe_ffn(blk["moe"], z, mesh=moe_mesh, axis=moe_axis, dtype=dtype)
+    else:
+        z = jax.nn.gelu(z @ blk["ff1"]["w"].astype(dtype) + blk["ff1"]["b"].astype(dtype))
+        y = y + z @ blk["ff2"]["w"].astype(dtype) + blk["ff2"]["b"].astype(dtype)
+    return y
+
+
+def _attention(q, k, v, attn: str, mesh, axis: str, causal: bool):
+    if attn == "full":
+        from ..parallel.ring_attention import full_attention
+
+        return full_attention(q, k, v, causal=causal)
+    if attn == "ring":
+        from ..parallel.ring_attention import ring_attention
+
+        return ring_attention(q, k, v, mesh, axis=axis, causal=causal)
+    if attn == "ulysses":
+        from ..parallel.sequence import ulysses_attention
+
+        return ulysses_attention(q, k, v, mesh, axis=axis, causal=causal)
+    raise ValueError(f"unknown attention mode {attn!r}")
+
+
+def apply(
+    params: Params,
+    x,
+    attn: str = "full",
+    mesh=None,
+    axis: str = "sp",
+    causal: bool = True,
+    dtype=jnp.float32,
+    moe_mesh=None,
+    moe_axis: str = "ep",
+):
+    """(B, T, d_in) or (T, d_in) features → (B, T, n_out) / (T, n_out)."""
+    x, squeezed = ensure_batched(x, 3)
+    h = params["n_heads"]
+    y = (x.astype(dtype) @ params["embed"]["w"].astype(dtype)
+         + params["embed"]["b"].astype(dtype))
+    for blk in params["blocks"]:
+        y = _block_apply(
+            blk, y, h, attn, mesh, axis, causal, dtype,
+            moe_mesh=moe_mesh, moe_axis=moe_axis,
+        )
+    y = _layernorm(params["ln_f"], y)
+    out = (y @ params["head"]["w"].astype(dtype)
+           + params["head"]["b"].astype(dtype)).astype(jnp.float32)
+    return out[0] if squeezed else out
+
+
+def build(
+    seq_len: int = 256,
+    d_in: int = 64,
+    n_out: int = 16,
+    d_model: int = 128,
+    n_heads: int = 8,
+    n_layers: int = 2,
+    attn: str = "full",
+    mesh=None,
+    axis: str = "sp",
+    causal: bool = True,
+    batch: Optional[int] = None,
+    dtype=jnp.float32,
+    seed: int = 0,
+    params: Optional[Params] = None,
+    moe_experts: int = 0,
+    moe_mesh=None,
+    moe_axis: str = "ep",
+) -> JaxModel:
+    """Stream-ready encoder: one frame = one (T, d_in) feature window (the
+    tensor_aggregator output shape)."""
+    if params is None:
+        params = init_params(
+            jax.random.PRNGKey(seed), d_model, n_heads, n_layers,
+            4 * d_model, d_in, n_out, moe_experts=moe_experts,
+        )
+    shape: Tuple[Optional[int], ...] = (seq_len, d_in)
+    if batch is not None:
+        shape = (batch,) + shape
+    return JaxModel(
+        apply=lambda p, x: apply(
+            p, x, attn=attn, mesh=mesh, axis=axis, causal=causal, dtype=dtype,
+            moe_mesh=moe_mesh, moe_axis=moe_axis,
+        ),
+        params=params,
+        input_spec=TensorsSpec.of(TensorSpec(dtype=np.float32, shape=shape)),
+        name=f"transformer_{attn}_{d_model}x{n_layers}",
+    )
+
+
+def build_pipelined(
+    mesh,
+    axis: str = "pp",
+    seq_len: int = 64,
+    d_in: int = 64,
+    n_out: int = 16,
+    d_model: int = 128,
+    n_heads: int = 8,
+    n_layers: int = 4,
+    batch: int = 8,
+    microbatches: Optional[int] = None,
+    causal: bool = True,
+    dtype=jnp.float32,
+    seed: int = 0,
+) -> JaxModel:
+    """Encoder with its block stack **pipelined over the ``pp`` mesh axis**
+    (GPipe microbatch rotation, :mod:`nnstreamer_tpu.parallel.pipeline_par`).
+
+    ``n_layers`` must divide evenly into ``mesh.shape[axis]`` stages;
+    embed/head run replicated around the pipelined trunk.  Numerics match
+    the sequential :func:`apply` exactly — pinned by tests."""
+    from ..parallel.pipeline_par import gpipe_apply, stack_stage_params
+
+    s = mesh.shape[axis]
+    if n_layers % s:
+        raise ValueError(f"n_layers {n_layers} not divisible by {s} stages")
+    per_stage = n_layers // s
+    params = init_params(
+        jax.random.PRNGKey(seed), d_model, n_heads, n_layers,
+        4 * d_model, d_in, n_out,
+    )
+    h = n_heads
+
+    # blocks → (stage, layer_within_stage) stacked pytree
+    blocks = params["blocks"]
+    stages = [
+        jax.tree.map(lambda *ls: jnp.stack(ls), *blocks[i * per_stage:(i + 1) * per_stage])
+        for i in range(s)
+    ]
+    stage_stacked = stack_stage_params(stages)
+    outer = {k: v for k, v in params.items() if k != "blocks"}
+
+    def stage_fn(stage_params, x):
+        def body(y, blk):
+            return _block_apply(blk, y, h, "full", None, "sp", causal, dtype), None
+
+        y, _ = jax.lax.scan(body, x, stage_params)
+        return y
+
+    def pipelined_apply(p, x):
+        outer_p, stacked = p
+        y = (x.astype(dtype) @ outer_p["embed"]["w"].astype(dtype)
+             + outer_p["embed"]["b"].astype(dtype))
+        y = gpipe_apply(
+            stage_fn, stacked, y, mesh, axis=axis, microbatches=microbatches
+        )
+        y = _layernorm(outer_p["ln_f"], y)
+        return (y @ outer_p["head"]["w"].astype(dtype)
+                + outer_p["head"]["b"].astype(dtype)).astype(jnp.float32)
+
+    return JaxModel(
+        apply=pipelined_apply,
+        params=(outer, stage_stacked),
+        input_spec=TensorsSpec.of(
+            TensorSpec(dtype=np.float32, shape=(batch, seq_len, d_in))
+        ),
+        name=f"transformer_pp{s}_{d_model}x{n_layers}",
+    )

@@ -1,0 +1,93 @@
+"""Native runtime core: build + load the C++ support library.
+
+The reference's runtime substrate (GStreamer's queueing/threading) is native
+C; this package is the TPU framework's native layer.  The library is built
+from source on first use with the toolchain's ``g++`` (no external deps) and
+cached next to the source; set ``NNSTPU_COMMON_NATIVE_RUNTIME=off`` to force
+the pure-Python fallbacks.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "frame_queue.cpp")
+_BUILD_DIR = os.path.join(_HERE, "_build")
+_SO = os.path.join(_BUILD_DIR, "libnns_runtime.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_load_failed = False
+
+# status codes (keep in sync with frame_queue.cpp)
+OK = 0
+OK_DROPPED_OLDEST = 1
+DROPPED_INCOMING = 2
+SHUTDOWN = -1
+TIMEOUT = -2
+
+EVENT_BIT = 1 << 63
+
+
+def _build() -> None:
+    os.makedirs(_BUILD_DIR, exist_ok=True)
+    tmp = _SO + ".tmp"
+    cmd = [
+        "g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
+        _SRC, "-o", tmp,
+    ]
+    subprocess.run(cmd, check=True, capture_output=True, text=True)
+    os.replace(tmp, _SO)  # atomic: concurrent importers see old or new
+
+
+def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
+    lib.nns_queue_new.argtypes = [ctypes.c_uint64]
+    lib.nns_queue_new.restype = ctypes.c_void_p
+    lib.nns_queue_free.argtypes = [ctypes.c_void_p]
+    lib.nns_queue_free.restype = None
+    lib.nns_queue_shutdown.argtypes = [ctypes.c_void_p]
+    lib.nns_queue_shutdown.restype = None
+    lib.nns_queue_len.argtypes = [ctypes.c_void_p]
+    lib.nns_queue_len.restype = ctypes.c_int64
+    lib.nns_queue_push.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint64, ctypes.c_int, ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_uint64),
+    ]
+    lib.nns_queue_push.restype = ctypes.c_int
+    lib.nns_queue_pop.argtypes = [
+        ctypes.c_void_p, ctypes.c_int64, ctypes.POINTER(ctypes.c_uint64),
+    ]
+    lib.nns_queue_pop.restype = ctypes.c_int
+    return lib
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """The loaded library, building it if needed; None when unavailable."""
+    global _lib, _load_failed
+    if _lib is not None or _load_failed:
+        return _lib
+    with _lock:
+        if _lib is not None or _load_failed:
+            return _lib
+        try:
+            src_mtime = os.path.getmtime(_SRC)
+            if not os.path.exists(_SO) or os.path.getmtime(_SO) < src_mtime:
+                _build()
+            _lib = _bind(ctypes.CDLL(_SO))
+        except (OSError, subprocess.CalledProcessError):
+            _load_failed = True
+            _lib = None
+    return _lib
+
+
+def available() -> bool:
+    from ..conf import conf
+
+    if not conf.get_bool("common", "native_runtime", True):
+        return False
+    return load() is not None

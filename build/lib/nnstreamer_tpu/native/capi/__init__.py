@@ -1,0 +1,77 @@
+"""Build + locate the native C application API library.
+
+``libnnstreamer_tpu_capi.so`` is the analog of the reference's
+``libcapi-nnstreamer.so`` (api/capi/meson.build): a C ABI for apps written
+in C/C++, implemented here by embedding CPython (capi.cpp).  Built on
+demand with ``g++`` like the rest of ``nnstreamer_tpu.native``.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import subprocess
+import sys
+import sysconfig
+import threading
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "capi.cpp")
+HEADER = os.path.join(_HERE, "nnstreamer-capi.h")
+_BUILD_DIR = os.path.join(_HERE, "_build")
+_SO = os.path.join(_BUILD_DIR, "libnnstreamer_tpu_capi.so")
+_STAMP = _SO + ".stamp"
+
+_lock = threading.Lock()
+
+
+def _build_key() -> str:
+    """Content hash of the source plus the interpreter ABI.
+
+    Keying the rebuild on (source hash, python version) rather than mtimes
+    means a stale/foreign binary — e.g. one produced on a machine with a
+    different libpython — is never loaded: its stamp won't match, so it is
+    rebuilt in place.
+    """
+    with open(_SRC, "rb") as f:
+        src = f.read()
+    abi = f"{sys.version_info.major}.{sys.version_info.minor}"
+    return hashlib.sha256(src + abi.encode()).hexdigest()
+
+
+def python_link_flags() -> list:
+    """Include + link flags for embedding this interpreter."""
+    inc = sysconfig.get_config_var("INCLUDEPY")
+    libdir = sysconfig.get_config_var("LIBDIR")
+    ver = sysconfig.get_config_var("LDVERSION") or sysconfig.get_config_var(
+        "VERSION"
+    )
+    return [
+        f"-I{inc}",
+        f"-L{libdir}",
+        f"-lpython{ver}",
+        f"-Wl,-rpath,{libdir}",
+    ]
+
+
+def build_capi(force: bool = False) -> str:
+    """Compile (once) and return the path to libnnstreamer_tpu_capi.so."""
+    with _lock:
+        key = _build_key()
+        if not force and os.path.exists(_SO) and os.path.exists(_STAMP):
+            with open(_STAMP) as f:
+                if f.read().strip() == key:
+                    return _SO
+        os.makedirs(_BUILD_DIR, exist_ok=True)
+        # pid-unique tmp: two *processes* may build concurrently (_lock only
+        # covers threads); os.replace keeps the publish atomic either way
+        tmp = _SO + f".tmp.{os.getpid()}"
+        cmd = (
+            ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", _SRC, "-o", tmp]
+            + python_link_flags()
+        )
+        subprocess.run(cmd, check=True, capture_output=True, text=True)
+        os.replace(tmp, _SO)
+        with open(_STAMP, "w") as f:
+            f.write(key)
+        return _SO

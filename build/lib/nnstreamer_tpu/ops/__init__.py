@@ -1,0 +1,16 @@
+"""TPU compute ops that go beyond plain XLA fusion.
+
+- :mod:`quant` — weight/activation quantization (the TPU-native answer to
+  the reference's uint8-quantized tflite flagship model, survey §7 hard
+  part f: dequant-on-device / int8 MXU path instead of uint8 CPU loops).
+- :mod:`pallas_kernels` — hand-written Pallas TPU kernels for the hot
+  elementwise chains (the Orc-SIMD analog, ``tensor_transform.c:330-405``)
+  and an int8 matmul with int32 MXU accumulation.
+"""
+
+from .quant import (  # noqa: F401
+    QuantizedWeight,
+    dequantize,
+    maybe_dequantize,
+    quantize_weight,
+)

@@ -1,0 +1,88 @@
+"""Weight quantization: int8 storage, dequant-on-device.
+
+The reference's flagship model is a **uint8-quantized** tflite MobileNet
+(``tests/test_models``, survey §4/§7f) executed by CPU integer kernels.
+The TPU-native equivalent implemented here:
+
+- **weight-only symmetric int8** per output channel: weights live in HBM at
+  1 byte/element (halving weight bandwidth — the usual inference bottleneck)
+  and dequantize on the fly inside the XLA program, fusing into the conv /
+  matmul that consumes them;
+- optionally, the **int8 MXU path**: quantize activations dynamically and
+  accumulate int8×int8 in int32 on the MXU
+  (:func:`nnstreamer_tpu.ops.pallas_kernels.int8_matmul`).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any
+
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclass
+class QuantizedWeight:
+    """Symmetric per-output-channel int8 weight.
+
+    ``q`` has the original shape; ``scale`` broadcasts against it (shape
+    ``(1, ..., 1, cout)``).  Registered as a pytree so it flows through
+    jit/sharding like any other param leaf.
+    """
+
+    q: Any        # int8 ndarray, original weight shape (..., cout)
+    scale: Any    # float32, broadcastable to q's shape
+
+    def dequantize(self, dtype=jnp.float32):
+        return self.q.astype(dtype) * self.scale.astype(dtype)
+
+
+try:  # register as a pytree node (available on all supported jax versions)
+    import jax.tree_util as _jtu
+
+    _jtu.register_pytree_node(
+        QuantizedWeight,
+        lambda qw: ((qw.q, qw.scale), None),
+        lambda aux, leaves: QuantizedWeight(*leaves),
+    )
+except Exception:  # pragma: no cover
+    pass
+
+
+def quantize_weight(w, axis: int = -1) -> QuantizedWeight:
+    """Symmetric int8 quantization per slice along ``axis`` (the output
+    channel for HWIO conv kernels and (cin, cout) dense kernels)."""
+    w = np.asarray(w, np.float32)
+    reduce_axes = tuple(i for i in range(w.ndim) if i != (axis % w.ndim))
+    amax = np.max(np.abs(w), axis=reduce_axes, keepdims=True)
+    scale = np.where(amax > 0, amax / 127.0, 1.0).astype(np.float32)
+    q = np.clip(np.round(w / scale), -127, 127).astype(np.int8)
+    return QuantizedWeight(q=jnp.asarray(q), scale=jnp.asarray(scale))
+
+
+def dequantize(qw: QuantizedWeight, dtype=jnp.float32):
+    return qw.dequantize(dtype)
+
+
+def maybe_dequantize(w, dtype=None):
+    """Materialize a weight leaf: pass floats through, dequantize
+    :class:`QuantizedWeight` (the hook the layer library calls, so any model
+    in the zoo runs quantized by swapping its param leaves)."""
+    if isinstance(w, QuantizedWeight):
+        return w.dequantize(dtype if dtype is not None else jnp.float32)
+    if dtype is not None:
+        return w.astype(dtype)
+    return w
+
+
+def quantize_activations(x, dtype=jnp.int8):
+    """Dynamic symmetric per-tensor activation quantization.
+
+    Returns ``(q, scale)`` with ``q ≈ x / scale`` in int8.  Computed on
+    device; fuses into the producing XLA program.
+    """
+    amax = jnp.max(jnp.abs(x))
+    scale = jnp.where(amax > 0, amax / 127.0, 1.0).astype(jnp.float32)
+    q = jnp.clip(jnp.round(x / scale), -127, 127).astype(dtype)
+    return q, scale

@@ -1,0 +1,7 @@
+from .mesh import batch_sharding, init_distributed, make_mesh, replicated  # noqa: F401
+from .ring_attention import (  # noqa: F401
+    full_attention,
+    ring_attention,
+    sequence_sharding,
+)
+from .sequence import ulysses_attention  # noqa: F401

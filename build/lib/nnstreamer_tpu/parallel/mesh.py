@@ -1,0 +1,74 @@
+"""Device-mesh helpers: the TPU-native replacement for the reference's
+per-backend accelerator offload (survey §2.6).
+
+The reference never shards — one Interpreter per element, NNAPI/Movidius
+offload per frame.  Here parallel invocation is first-class: a
+:func:`make_mesh` over the chip's cores (or a CPU-device mesh in tests via
+``XLA_FLAGS=--xla_force_host_platform_device_count=N``), batch sharding via
+``NamedSharding`` and XLA-inserted collectives over ICI.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_mesh(
+    shape: Optional[Sequence[int]] = None,
+    axis_names: Tuple[str, ...] = ("dp",),
+    devices=None,
+) -> Mesh:
+    """Build a mesh over available devices.  Default: 1-D data-parallel mesh
+    over all devices."""
+    devices = list(devices if devices is not None else jax.devices())
+    if shape is None:
+        shape = (len(devices),) + (1,) * (len(axis_names) - 1)
+    n = 1
+    for s in shape:
+        n *= s
+    if n > len(devices):
+        raise ValueError(f"mesh shape {shape} needs {n} devices, have {len(devices)}")
+    import numpy as np
+
+    arr = np.array(devices[:n]).reshape(shape)
+    return Mesh(arr, axis_names)
+
+
+def init_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> int:
+    """Join a multi-host JAX job (the DCN side of the comm backend).
+
+    The reference's concurrency never leaves one process (no NCCL/MPI —
+    survey §2.6); scaling past one host here is the standard JAX recipe:
+    every host calls this (TPU pods auto-discover via the metadata server,
+    so all arguments may be None; explicit coordinator/process args cover
+    CPU/GPU clusters), after which ``jax.devices()`` spans the whole job.
+    A :func:`make_mesh` over that global device list lays dp/tp axes so
+    XLA routes collectives over ICI within a slice and DCN across hosts —
+    the ``jax.distributed`` analog of the NCCL/MPI backends the reference
+    never had.  Returns the process count.  Idempotent: a second call is a
+    no-op.
+    """
+    if jax.distributed.is_initialized():
+        return jax.process_count()  # already joined: no-op
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    return jax.process_count()
+
+
+def batch_sharding(mesh: Mesh, rank: int, axis: str = "dp") -> NamedSharding:
+    """Shard the leading (batch) dim over ``axis``, replicate the rest."""
+    return NamedSharding(mesh, P(axis, *([None] * (rank - 1))))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())

@@ -1,0 +1,137 @@
+"""Mixture-of-experts FFN with expert parallelism (the ``ep`` mesh axis).
+
+The reference's distributed story stops at process-level stream branching;
+a TPU-native framework must also scale *within* a model.  This is the
+canonical GSPMD switch-routing MoE (top-1 gating, capacity-bounded einsum
+dispatch — the Mesh-TensorFlow/Switch-Transformer formulation, kept fully
+static for XLA):
+
+- ``gate``: tokens → expert logits (replicated weights);
+- dispatch: one-hot ``(tokens, experts, capacity)`` mask built from a
+  cumsum position-in-expert — no dynamic shapes, dropped tokens fall out
+  of the mask (standard capacity-factor semantics);
+- expert FFN: ``(experts, capacity, d)`` batch, with the **expert dim
+  sharded over the ``ep`` axis** via sharding constraints — XLA inserts
+  the all_to_all exchanges on the way in and out;
+- combine: gate-weighted un-dispatch back to ``(tokens, d)``.
+
+Everything is an einsum over static shapes, so the same code runs single
+-device (mesh=None) and expert-parallel with identical numerics — tests
+pin that equivalence.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.layers import Params, _normal, dense_init
+
+
+def init_moe_params(
+    key,
+    d_model: int,
+    d_ff: int,
+    n_experts: int,
+) -> Params:
+    kg, kw1, kw2 = jax.random.split(key, 3)
+    gate = dense_init(kg, d_model, n_experts)
+    # per-expert FFN weights, stacked on the (shardable) expert dim;
+    # host-numpy init at the zoo's He scale (layers.py conventions)
+    return {
+        "gate": gate,
+        "w1": _normal(kw1, (n_experts, d_model, d_ff), np.sqrt(2.0 / d_model)),
+        "b1": jnp.zeros((n_experts, d_ff), jnp.float32),
+        "w2": _normal(kw2, (n_experts, d_ff, d_model), np.sqrt(2.0 / d_ff)),
+        "b2": jnp.zeros((n_experts, d_model), jnp.float32),
+    }
+
+
+def _expert_sharding(mesh, axis: str, rank: int):
+    from .mesh import batch_sharding
+
+    return batch_sharding(mesh, rank, axis)
+
+
+def moe_ffn(
+    params: Params,
+    x,
+    mesh=None,
+    axis: str = "ep",
+    capacity_factor: float = 2.0,
+    dtype=jnp.float32,
+):
+    """Switch-style top-1 MoE over the trailing feature dim.
+
+    ``x``: (..., d_model) → same shape.  With ``mesh``, the expert batch
+    shards over ``axis`` (sharding constraints; XLA places the
+    all_to_all); without, it is an ordinary local einsum chain.
+    """
+    orig_shape = x.shape
+    d = orig_shape[-1]
+    t = 1
+    for s in orig_shape[:-1]:
+        t *= s
+    xt = x.reshape(t, d).astype(dtype)
+    e = params["w1"].shape[0]
+    cap = max(1, int(np.ceil(t * capacity_factor / e)))
+
+    logits = xt @ params["gate"]["w"].astype(dtype) + params["gate"]["b"].astype(dtype)
+    probs = jax.nn.softmax(logits, axis=-1)  # (t, e)
+    expert = jnp.argmax(probs, axis=-1)  # (t,)
+    gate_w = jnp.max(probs, axis=-1)  # (t,)
+
+    onehot = jax.nn.one_hot(expert, e, dtype=dtype)  # (t, e)
+    # position of each token within its expert's capacity buffer
+    pos = (jnp.cumsum(onehot, axis=0) - onehot) * onehot  # (t, e)
+    pos_idx = jnp.sum(pos, axis=-1).astype(jnp.int32)  # (t,)
+    keep = (pos_idx < cap).astype(dtype)  # overflow tokens drop
+    pos_onehot = jax.nn.one_hot(pos_idx, cap, dtype=dtype)  # (t, cap)
+    # dispatch mask (t, e, cap): token t → slot (expert, position)
+    dispatch = onehot[:, :, None] * pos_onehot[:, None, :] * keep[:, None, None]
+
+    expert_in = jnp.einsum("tec,td->ecd", dispatch, xt)  # (e, cap, d)
+    if mesh is not None:
+        expert_in = jax.lax.with_sharding_constraint(
+            expert_in, _expert_sharding(mesh, axis, 3)
+        )
+    h = jax.nn.gelu(
+        jnp.einsum("ecd,edf->ecf", expert_in, params["w1"].astype(dtype))
+        + params["b1"].astype(dtype)[:, None, :]
+    )
+    expert_out = (
+        jnp.einsum("ecf,efd->ecd", h, params["w2"].astype(dtype))
+        + params["b2"].astype(dtype)[:, None, :]
+    )
+    if mesh is not None:
+        expert_out = jax.lax.with_sharding_constraint(
+            expert_out, _expert_sharding(mesh, axis, 3)
+        )
+    combine = dispatch * gate_w[:, None, None]  # (t, e, cap)
+    out = jnp.einsum("tec,ecd->td", combine, expert_out)
+    # a dropped (over-capacity) token has an all-zero combine row → zero
+    # MoE output; the caller's residual connection carries it through
+    # (standard switch-transformer drop semantics)
+    return out.reshape(orig_shape).astype(x.dtype)
+
+
+def place_moe_params(params: Params, mesh, axis: str = "ep") -> Params:
+    """Shard the stacked expert weights over the ``ep`` axis; gate
+    replicates (every token computes routing locally)."""
+    from .mesh import replicated
+
+    def shard_expert(a, rank):
+        return jax.device_put(a, _expert_sharding(mesh, axis, rank))
+
+    return {
+        "gate": jax.tree.map(
+            lambda a: jax.device_put(a, replicated(mesh)), params["gate"]
+        ),
+        "w1": shard_expert(params["w1"], 3),
+        "b1": shard_expert(params["b1"], 2),
+        "w2": shard_expert(params["w2"], 3),
+        "b2": shard_expert(params["b2"], 2),
+    }

@@ -1,0 +1,420 @@
+"""Tensor type system and stream-spec ("caps") negotiation algebra.
+
+This is the L1 layer of the framework: the analog of the reference's
+``tensor_typedef.h`` + ``nnstreamer_plugin_api.h`` (GstTensorInfo /
+GstTensorsInfo / GstTensorConfig structs, caps (de)serialization, validation,
+and intersection), re-designed for a JAX/XLA substrate:
+
+- dtypes are numpy/JAX dtypes (the reference's 10 integer/float types,
+  ``tensor_typedef.h:85-99``, plus TPU-first ``bfloat16``/``float16``).
+- dimension strings stay wire-compatible with the reference's
+  ``dim1:dim2:dim3:dim4`` innermost-first notation
+  (``nnstreamer_plugin_api.h:280-295``), while the in-memory ``shape`` is
+  standard numpy/JAX order (outermost first) — the same reversal the
+  reference performs when importing tflite dims
+  (``tensor_filter_tensorflow_lite_core.cc:272-278``).
+- partial specs (``None`` entries) + ``intersect``/``fixate`` form the caps
+  negotiation algebra used by the graph runtime's two-phase negotiation.
+
+Unlike the reference we are N-rank capable (XLA has no rank-4 limit), but we
+keep the compat constants ``NNS_TENSOR_RANK_LIMIT = 4`` and
+``NNS_TENSOR_SIZE_LIMIT = 16`` (``tensor_typedef.h:34-35``) for wire parity.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from fractions import Fraction
+from typing import Iterable, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+try:  # ml_dtypes ships with jax; bfloat16 as a numpy dtype.
+    import ml_dtypes
+
+    _BFLOAT16 = np.dtype(ml_dtypes.bfloat16)
+except ImportError:  # pragma: no cover - ml_dtypes is a jax dependency
+    _BFLOAT16 = None
+
+# Wire-compat constants (tensor_typedef.h:34-35).
+NNS_TENSOR_RANK_LIMIT = 4
+NNS_TENSOR_SIZE_LIMIT = 16
+
+# The reference's 10 dtypes (tensor_typedef.h:85-99) plus TPU-first types.
+_DTYPE_NAMES = {
+    "int8": np.dtype(np.int8),
+    "uint8": np.dtype(np.uint8),
+    "int16": np.dtype(np.int16),
+    "uint16": np.dtype(np.uint16),
+    "int32": np.dtype(np.int32),
+    "uint32": np.dtype(np.uint32),
+    "int64": np.dtype(np.int64),
+    "uint64": np.dtype(np.uint64),
+    "float32": np.dtype(np.float32),
+    "float64": np.dtype(np.float64),
+    "float16": np.dtype(np.float16),
+}
+if _BFLOAT16 is not None:
+    _DTYPE_NAMES["bfloat16"] = _BFLOAT16
+
+_NAME_BY_DTYPE = {v: k for k, v in _DTYPE_NAMES.items()}
+
+
+def dtype_from_name(name: str) -> np.dtype:
+    """Parse a dtype name (the analog of ``gst_tensor_get_type``)."""
+    try:
+        return _DTYPE_NAMES[name.strip().lower()]
+    except KeyError:
+        raise ValueError(f"unknown tensor dtype name: {name!r}") from None
+
+
+def dtype_name(dtype: Union[np.dtype, type, str, None]) -> str:
+    """Canonical name for a dtype (the analog of ``gst_tensor_get_type_string``)."""
+    if dtype is None:
+        raise ValueError("dtype is None")
+    d = np.dtype(dtype)
+    try:
+        return _NAME_BY_DTYPE[d]
+    except KeyError:
+        raise ValueError(f"unsupported tensor dtype: {dtype!r}") from None
+
+
+def supported_dtypes() -> Tuple[str, ...]:
+    return tuple(_DTYPE_NAMES)
+
+
+DimsLike = Sequence[Optional[int]]
+
+
+def _normalize_dims(dims: Optional[DimsLike]) -> Optional[Tuple[Optional[int], ...]]:
+    if dims is None:
+        return None
+    out = []
+    for d in dims:
+        if d is None:
+            out.append(None)
+        else:
+            d = int(d)
+            if d < 1:
+                raise ValueError(f"tensor dimension must be >= 1, got {d}")
+            out.append(d)
+    return tuple(out)
+
+
+@dataclasses.dataclass(frozen=True)
+class TensorSpec:
+    """Type+shape of one tensor in a stream (analog of ``GstTensorInfo``,
+    ``tensor_typedef.h:148-156``).
+
+    ``shape`` is numpy/JAX order (outermost first).  ``None`` means
+    "not yet negotiated" — either the whole shape, or individual dims.
+    ``name`` is an optional per-tensor name (the reference carries names for
+    the tensorflow backend's input/output node lookup).
+    """
+
+    dtype: Optional[np.dtype] = None
+    shape: Optional[Tuple[Optional[int], ...]] = None
+    name: Optional[str] = None
+
+    def __post_init__(self):
+        object.__setattr__(
+            self, "dtype", np.dtype(self.dtype) if self.dtype is not None else None
+        )
+        if self.dtype is not None and self.dtype not in _NAME_BY_DTYPE:
+            raise ValueError(f"unsupported tensor dtype: {self.dtype}")
+        object.__setattr__(self, "shape", _normalize_dims(self.shape))
+
+    # -- predicates ---------------------------------------------------------
+
+    @property
+    def is_fixed(self) -> bool:
+        """True iff dtype and every dim are concrete (``gst_tensor_info_validate``)."""
+        return (
+            self.dtype is not None
+            and self.shape is not None
+            and all(d is not None for d in self.shape)
+        )
+
+    @property
+    def rank(self) -> Optional[int]:
+        return None if self.shape is None else len(self.shape)
+
+    @property
+    def num_elements(self) -> int:
+        if not self.is_fixed:
+            raise ValueError(f"spec not fixed: {self}")
+        n = 1
+        for d in self.shape:  # type: ignore[union-attr]
+            n *= d
+        return n
+
+    @property
+    def nbytes(self) -> int:
+        """Frame size in bytes (``gst_tensor_info_get_size``)."""
+        return self.num_elements * self.dtype.itemsize  # type: ignore[union-attr]
+
+    # -- NNS wire compatibility --------------------------------------------
+
+    @property
+    def nns_dims(self) -> Tuple[int, ...]:
+        """Dims in the reference's innermost-first order, padded with 1s to
+        rank 4 (``tensor_typedef.h:34``, reversal as in tflite import
+        ``_core.cc:272-278``)."""
+        if self.shape is None or any(d is None for d in self.shape):
+            raise ValueError(f"spec shape not fixed: {self}")
+        dims = list(reversed(self.shape))  # type: ignore[arg-type]
+        while len(dims) < NNS_TENSOR_RANK_LIMIT:
+            dims.append(1)
+        return tuple(dims)
+
+    def dims_string(self) -> str:
+        """``dim1:dim2:dim3:dim4`` innermost-first (``gst_tensor_get_dimension_string``)."""
+        return ":".join(str(d) for d in self.nns_dims)
+
+    @classmethod
+    def from_dims_string(
+        cls, dims: str, dtype: Union[np.dtype, str, None] = None, name: Optional[str] = None
+    ) -> "TensorSpec":
+        """Parse ``d1:d2:d3:d4`` (innermost first) into a numpy-order spec
+        (``gst_tensor_parse_dimension``, ``nnstreamer_plugin_api.h:280-287``).
+
+        Trailing 1s beyond the first dim are squeezed so that ``3:224:224:1``
+        round-trips to shape ``(224, 224, 3)``.
+        """
+        parts = [p for p in dims.strip().split(":") if p]
+        if not parts or len(parts) > NNS_TENSOR_RANK_LIMIT:
+            raise ValueError(f"bad dimension string: {dims!r}")
+        nns = [int(p) for p in parts]
+        if any(d < 1 for d in nns):
+            raise ValueError(f"bad dimension string: {dims!r}")
+        while len(nns) > 1 and nns[-1] == 1:
+            nns.pop()
+        if isinstance(dtype, str):
+            dtype = dtype_from_name(dtype)
+        return cls(dtype=dtype, shape=tuple(reversed(nns)), name=name)
+
+    @classmethod
+    def from_array(cls, arr) -> "TensorSpec":
+        return cls(dtype=np.dtype(arr.dtype), shape=tuple(int(d) for d in arr.shape))
+
+    # -- negotiation algebra ------------------------------------------------
+
+    def intersect(self, other: "TensorSpec") -> Optional["TensorSpec"]:
+        """Greatest lower bound of two partial specs; None if incompatible
+        (the analog of caps intersection in ``transform_caps``,
+        ``tensor_filter.c:666-763``)."""
+        if self.dtype is None:
+            dtype = other.dtype
+        elif other.dtype is None or other.dtype == self.dtype:
+            dtype = self.dtype
+        else:
+            return None
+
+        if self.shape is None:
+            shape = other.shape
+        elif other.shape is None:
+            shape = self.shape
+        elif len(self.shape) != len(other.shape):
+            return None
+        else:
+            merged = []
+            for a, b in zip(self.shape, other.shape):
+                if a is None:
+                    merged.append(b)
+                elif b is None or a == b:
+                    merged.append(a)
+                else:
+                    return None
+            shape = tuple(merged)
+        name = self.name if self.name is not None else other.name
+        return TensorSpec(dtype=dtype, shape=shape, name=name)
+
+    def is_compatible(self, other: "TensorSpec") -> bool:
+        return self.intersect(other) is not None
+
+    def fixate(self, default_dim: int = 1, default_dtype: str = "uint8") -> "TensorSpec":
+        """Replace unknowns with defaults (caps fixation)."""
+        dtype = self.dtype if self.dtype is not None else dtype_from_name(default_dtype)
+        if self.shape is None:
+            shape: Tuple[int, ...] = (default_dim,)
+        else:
+            shape = tuple(default_dim if d is None else d for d in self.shape)
+        return TensorSpec(dtype=dtype, shape=shape, name=self.name)
+
+    def validate_array(self, arr) -> None:
+        """Check an array against this (fixed) spec; raises on mismatch."""
+        got = TensorSpec.from_array(arr)
+        if self.intersect(got) is None:
+            raise ValueError(f"array {got} does not match spec {self}")
+
+    def __str__(self) -> str:
+        dt = dtype_name(self.dtype) if self.dtype is not None else "?"
+        if self.shape is None:
+            sh = "?"
+        else:
+            sh = "(" + ",".join("?" if d is None else str(d) for d in self.shape) + ")"
+        nm = f" name={self.name}" if self.name else ""
+        return f"TensorSpec[{dt} {sh}{nm}]"
+
+
+@dataclasses.dataclass(frozen=True)
+class TensorsSpec:
+    """Spec of a full frame: 1..16 tensors + framerate (analog of
+    ``GstTensorsInfo`` + ``GstTensorsConfig``, ``tensor_typedef.h:161-184``).
+
+    ``rate`` is frames/sec as a Fraction; ``None`` = unnegotiated,
+    ``Fraction(0)`` = no natural rate (matches the reference's ``0/1``).
+    """
+
+    tensors: Tuple[TensorSpec, ...] = ()
+    rate: Optional[Fraction] = None
+
+    def __post_init__(self):
+        tensors = tuple(self.tensors)
+        if len(tensors) > NNS_TENSOR_SIZE_LIMIT:
+            raise ValueError(
+                f"at most {NNS_TENSOR_SIZE_LIMIT} tensors per frame, got {len(tensors)}"
+            )
+        object.__setattr__(self, "tensors", tensors)
+        if self.rate is not None:
+            object.__setattr__(self, "rate", Fraction(self.rate))
+
+    @classmethod
+    def of(cls, *tensors: TensorSpec, rate: Optional[Fraction] = None) -> "TensorsSpec":
+        return cls(tensors=tensors, rate=rate)
+
+    @classmethod
+    def from_arrays(cls, arrays: Iterable, rate: Optional[Fraction] = None) -> "TensorsSpec":
+        return cls(tensors=tuple(TensorSpec.from_array(a) for a in arrays), rate=rate)
+
+    @property
+    def num_tensors(self) -> int:
+        return len(self.tensors)
+
+    @property
+    def tensors_fixed(self) -> bool:
+        """All tensor dtypes/shapes concrete (rate may stay open)."""
+        return len(self.tensors) > 0 and all(t.is_fixed for t in self.tensors)
+
+    @property
+    def is_fixed(self) -> bool:
+        return self.tensors_fixed and self.rate is not None
+
+    def intersect(self, other: "TensorsSpec") -> Optional["TensorsSpec"]:
+        if self.tensors and other.tensors:
+            if len(self.tensors) != len(other.tensors):
+                return None
+            merged = []
+            for a, b in zip(self.tensors, other.tensors):
+                m = a.intersect(b)
+                if m is None:
+                    return None
+                merged.append(m)
+            tensors = tuple(merged)
+        else:
+            tensors = self.tensors or other.tensors
+
+        if self.rate is None:
+            rate = other.rate
+        elif other.rate is None or other.rate == self.rate:
+            rate = self.rate
+        else:
+            return None
+        return TensorsSpec(tensors=tensors, rate=rate)
+
+    def is_compatible(self, other: "TensorsSpec") -> bool:
+        return self.intersect(other) is not None
+
+    def fixate(self) -> "TensorsSpec":
+        rate = self.rate if self.rate is not None else Fraction(0)
+        tensors = tuple(t.fixate() for t in self.tensors) or (TensorSpec().fixate(),)
+        return TensorsSpec(tensors=tensors, rate=rate)
+
+    # -- wire format --------------------------------------------------------
+
+    def to_caps_string(self) -> str:
+        """Serialize in the reference's caps style (``tensor_typedef.h:57-80``):
+        ``other/tensor`` for a single tensor, ``other/tensors`` otherwise."""
+        rate = self.rate if self.rate is not None else Fraction(0)
+        rs = f"{rate.numerator}/{rate.denominator if rate.denominator else 1}"
+        if len(self.tensors) == 1:
+            t = self.tensors[0]
+            return (
+                "other/tensor, "
+                f"dimension=(string){t.dims_string()}, "
+                f"type=(string){dtype_name(t.dtype)}, "
+                f"framerate=(fraction){rs}"
+            )
+        dims = ",".join(t.dims_string() for t in self.tensors)
+        types = ",".join(dtype_name(t.dtype) for t in self.tensors)
+        return (
+            "other/tensors, "
+            f"num_tensors=(int){len(self.tensors)}, "
+            f"dimensions=(string){dims}, "
+            f"types=(string){types}, "
+            f"framerate=(fraction){rs}"
+        )
+
+    @classmethod
+    def from_caps_string(cls, caps: str) -> "TensorsSpec":
+        """Parse the caps string format emitted by :meth:`to_caps_string`
+        (analog of ``gst_tensors_config_from_cap``)."""
+        caps = caps.strip()
+        fields = {}
+        head, _, rest = caps.partition(",")
+        media = head.strip()
+        if media not in ("other/tensor", "other/tensors"):
+            raise ValueError(f"not a tensor caps string: {caps!r}")
+        for part in rest.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            key, _, val = part.partition("=")
+            val = val.strip()
+            if val.startswith("("):  # strip "(string)" / "(int)" / "(fraction)"
+                val = val.partition(")")[2]
+            fields[key.strip()] = val
+        rate = None
+        if "framerate" in fields:
+            num, _, den = fields["framerate"].partition("/")
+            rate = Fraction(int(num), int(den) if den else 1)
+        if media == "other/tensor":
+            t = TensorSpec.from_dims_string(fields["dimension"], fields.get("type"))
+            return cls(tensors=(t,), rate=rate)
+        # other/tensors: the per-tensor dims/types lists are themselves
+        # comma-separated, so we must re-split carefully: "dimensions" holds
+        # colon-grouped entries between commas; we rebuild from raw string.
+        return cls._parse_tensors_caps(caps, rate)
+
+    @classmethod
+    def _parse_tensors_caps(cls, caps: str, rate) -> "TensorsSpec":
+        import re
+
+        m_dims = re.search(r"dimensions=(?:\([a-z]+\))?([0-9:,]+)", caps)
+        m_types = re.search(r"types=(?:\([a-z]+\))?([A-Za-z0-9_,]+?)(?:,\s*[a-z_]+=|$)", caps)
+        m_num = re.search(r"num_tensors=(?:\([a-z]+\))?(\d+)", caps)
+        if not (m_dims and m_types):
+            raise ValueError(f"bad tensors caps string: {caps!r}")
+        dims_list = [d for d in m_dims.group(1).split(",") if d]
+        types_list = [t for t in m_types.group(1).split(",") if t]
+        if len(dims_list) != len(types_list):
+            raise ValueError(f"dims/types arity mismatch in caps: {caps!r}")
+        if m_num and int(m_num.group(1)) != len(dims_list):
+            raise ValueError(f"num_tensors mismatch in caps: {caps!r}")
+        tensors = tuple(
+            TensorSpec.from_dims_string(d, t) for d, t in zip(dims_list, types_list)
+        )
+        return cls(tensors=tensors, rate=rate)
+
+    def __str__(self) -> str:
+        ts = ", ".join(str(t) for t in self.tensors) or "?"
+        r = "?" if self.rate is None else str(self.rate)
+        return f"TensorsSpec[{ts} @ {r}fps]"
+
+
+# Convenience: the "ANY" spec used by passthrough-ish elements.
+ANY = TensorsSpec()
+
+
+def spec_of(*arrays, rate: Optional[Fraction] = None) -> TensorsSpec:
+    return TensorsSpec.from_arrays(arrays, rate=rate)

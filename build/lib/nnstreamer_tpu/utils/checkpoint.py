@@ -1,0 +1,180 @@
+"""Pipeline checkpoint / resume.
+
+The reference has **no** checkpoint subsystem (survey §5: "State lives in
+model files + repo slot contents"); this module captures exactly that
+runtime state so a streaming pipeline can stop and resume mid-stream:
+
+- every node exposing ``state_dict()`` / ``load_state()`` (e.g.
+  ``tensor_aggregator`` window contents),
+- the process-global ``tensor_repo`` slots (the recurrence state of
+  LSTM/RNN cycles).
+
+Serialization is a single ``.npz``: ndarray leaves are stored natively,
+the nesting skeleton as one JSON entry — no pickle, so checkpoints are
+portable and safe to load.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..buffer import Frame
+from ..elements.repo import GLOBAL_REPO
+
+
+# -- nested-structure packing (arrays out-of-band, JSON skeleton) -----------
+
+def _pack(obj, arrays: List[np.ndarray]):
+    if isinstance(obj, dict):
+        return {"t": "d", "v": {k: _pack(v, arrays) for k, v in obj.items()}}
+    if isinstance(obj, (list, tuple)):
+        return {
+            "t": "l" if isinstance(obj, list) else "T",
+            "v": [_pack(v, arrays) for v in obj],
+        }
+    if hasattr(obj, "shape") and hasattr(obj, "dtype"):
+        arrays.append(np.asarray(obj))
+        return {"t": "a", "v": len(arrays) - 1}
+    if obj is None or isinstance(obj, (bool, int, float, str)):
+        return {"t": "s", "v": obj}
+    raise TypeError(f"cannot checkpoint leaf of type {type(obj).__name__}")
+
+
+def _unpack(node, arrays) -> Any:
+    t, v = node["t"], node["v"]
+    if t == "d":
+        return {k: _unpack(x, arrays) for k, x in v.items()}
+    if t == "l":
+        return [_unpack(x, arrays) for x in v]
+    if t == "T":
+        return tuple(_unpack(x, arrays) for x in v)
+    if t == "a":
+        return arrays[v]
+    return v
+
+
+def save_state(state: Dict[str, Any], path: str) -> None:
+    arrays: List[np.ndarray] = []
+    skeleton = _pack(state, arrays)
+    np.savez(
+        path,
+        __skeleton__=np.frombuffer(
+            json.dumps(skeleton).encode(), dtype=np.uint8
+        ),
+        **{f"a{i}": a for i, a in enumerate(arrays)},
+    )
+
+
+def load_state(path: str) -> Dict[str, Any]:
+    with np.load(path if str(path).endswith(".npz") else f"{path}.npz") as z:
+        skeleton = json.loads(bytes(z["__skeleton__"].tobytes()).decode())
+        arrays = {
+            int(k[1:]): z[k] for k in z.files if k != "__skeleton__"
+        }
+    return _unpack(skeleton, [arrays[i] for i in range(len(arrays))])
+
+
+# -- repo slots --------------------------------------------------------------
+
+def snapshot_repo(repo=None) -> Dict[str, Any]:
+    repo = repo if repo is not None else GLOBAL_REPO
+    slots = {}
+    with repo._lock:
+        items = list(repo._slots.items())
+    for idx, slot in items:
+        with slot.cond:
+            slots[str(idx)] = {
+                "eos": slot.eos,
+                "frame": None
+                if slot.frame is None
+                else {
+                    "tensors": [np.asarray(t) for t in slot.frame.tensors],
+                    "pts": slot.frame.pts,
+                    "duration": slot.frame.duration,
+                    "meta": dict(slot.frame.meta),
+                },
+            }
+    return slots
+
+
+def restore_repo(slots: Dict[str, Any], repo=None) -> None:
+    repo = repo if repo is not None else GLOBAL_REPO
+    for idx_s, entry in slots.items():
+        idx = int(idx_s)
+        slot = repo.slot(idx)
+        with slot.cond:
+            slot.eos = bool(entry["eos"])
+            fr = entry["frame"]
+            slot.frame = (
+                None
+                if fr is None
+                else Frame(
+                    tensors=tuple(fr["tensors"]),
+                    pts=int(fr["pts"]),
+                    duration=int(fr["duration"]),
+                    meta=dict(fr.get("meta", {})),
+                )
+            )
+            # signal the repo elements that the next start is a resume:
+            # reposink keeps the contents, reposrc skips its zero bootstrap
+            slot.restored = True
+            slot.cond.notify_all()
+
+
+# -- pipeline-level API ------------------------------------------------------
+
+def _pipeline_repo(pipeline):
+    """The repo a pipeline's repo elements actually use (falls back to the
+    global one; a pipeline mixing several custom repos must checkpoint them
+    explicitly via snapshot_repo)."""
+    repos = {
+        id(node.repo): node.repo
+        for node in pipeline.nodes.values()
+        if hasattr(node, "repo")
+    }
+    if len(repos) == 1:
+        return next(iter(repos.values()))
+    return GLOBAL_REPO
+
+
+def checkpoint_pipeline(
+    pipeline, path: str, include_repo: bool = True, repo=None
+) -> Dict[str, Any]:
+    """Capture the resumable state of ``pipeline`` into ``path``(.npz).
+
+    Call while the pipeline is stopped (between runs) — node state is not
+    synchronized against concurrent dataflow.
+    """
+    nodes = {}
+    for name, node in pipeline.nodes.items():
+        fn = getattr(node, "state_dict", None)
+        if fn is not None:
+            nodes[name] = fn()
+    state: Dict[str, Any] = {"nodes": nodes}
+    if include_repo:
+        state["repo"] = snapshot_repo(
+            repo if repo is not None else _pipeline_repo(pipeline)
+        )
+    save_state(state, path)
+    return state
+
+
+def restore_pipeline(pipeline, path: str, repo=None) -> None:
+    """Restore state captured by :func:`checkpoint_pipeline` into a pipeline
+    with matching node names (typically the same launch description)."""
+    state = load_state(path)
+    for name, node_state in state.get("nodes", {}).items():
+        node = pipeline.nodes.get(name)
+        if node is None:
+            continue
+        fn = getattr(node, "load_state", None)
+        if fn is not None:
+            fn(node_state)
+    if "repo" in state:
+        restore_repo(
+            state["repo"],
+            repo if repo is not None else _pipeline_repo(pipeline),
+        )

@@ -1,0 +1,88 @@
+"""Per-node timing + jax.profiler integration.
+
+The reference documents external tracing tools (gst-instruments/HawkTracer,
+``tools/profiling/README.md``) and per-element GST debug categories; here
+profiling is built in: a process-global registry of per-node invoke
+latencies, toggled at runtime, plus helpers to bracket regions with
+``jax.profiler`` traces.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Dict, List
+
+_enabled = False
+_lock = threading.Lock()
+_records: Dict[str, List[int]] = {}
+
+
+def enable(on: bool = True) -> None:
+    global _enabled
+    _enabled = on
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def record(node_name: str, duration_ns: int) -> None:
+    with _lock:
+        _records.setdefault(node_name, []).append(duration_ns)
+
+
+def block_outputs(outs) -> None:
+    """Synchronize device outputs so recorded times are real (JAX dispatch is
+    async; without this, invoke times measure only dispatch)."""
+    for o in outs:
+        if hasattr(o, "block_until_ready"):
+            o.block_until_ready()
+
+
+def stats() -> Dict[str, Dict[str, float]]:
+    """Per-node latency summary in milliseconds."""
+    out = {}
+    with _lock:
+        for name, ns in _records.items():
+            if not ns:
+                continue
+            s = sorted(ns)
+            n = len(s)
+            out[name] = {
+                "count": n,
+                "mean_ms": sum(s) / n / 1e6,
+                "p50_ms": s[n // 2] / 1e6,
+                "p99_ms": s[min(n - 1, int(n * 0.99))] / 1e6,
+                "min_ms": s[0] / 1e6,
+                "max_ms": s[-1] / 1e6,
+            }
+    return out
+
+
+def reset() -> None:
+    with _lock:
+        _records.clear()
+
+
+@contextlib.contextmanager
+def profiled():
+    """Context manager: enable, yield, restore."""
+    prev = _enabled
+    enable(True)
+    try:
+        yield
+    finally:
+        enable(prev)
+
+
+@contextlib.contextmanager
+def device_trace(logdir: str):
+    """Capture an XLA/TPU xplane trace (jax.profiler) around a region."""
+    import jax
+
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

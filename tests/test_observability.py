@@ -52,3 +52,18 @@ def test_stats_scoped_to_pipeline(monkeypatch):
     p = simple_pipeline(got)
     p.run(timeout=30)
     assert "not_in_this_pipeline" not in p.stats()
+
+
+def test_xplane_trace_dir(tmp_path, monkeypatch):
+    """conf-driven jax.profiler trace around the PLAYING interval (SURVEY
+    §5's device-level tracing analog); trace files land in the dir."""
+    trace_dir = tmp_path / "xplane"
+    monkeypatch.setenv("NNSTPU_COMMON_XPLANE_TRACE_DIR", str(trace_dir))
+    got = []
+    simple_pipeline(got).run(timeout=60)
+    assert len(got) == 5
+    files = [
+        os.path.join(r, f)
+        for r, _, fs in os.walk(trace_dir) for f in fs
+    ]
+    assert files, "no xplane trace files were written"
