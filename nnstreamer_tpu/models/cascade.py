@@ -1,0 +1,110 @@
+"""Fused detection cascade: detect → crop → classify as ONE XLA program.
+
+The reference ecosystem's flagship demo pattern is a multi-element
+pipeline: SSD detector → host box decode → ``videocrop`` per detection →
+re-scale → second ``tensor_filter`` classifier — every stage a host round
+trip.  TPU-first, the whole cascade compiles into a single program:
+
+- detector backbone + fused top-k box decode (``ssd_mobilenet.decode_topk``)
+  stay on device;
+- per-detection crops are **gather-free device resamples**
+  (``jax.image.scale_and_translate`` — scale/translation are traced values
+  computed from the box tensor, output shape is static, so XLA compiles one
+  resample kernel vmapped over the K detections);
+- the classifier runs once, batched over the K crops (MXU-friendly), and
+  only ``(K, 6)`` boxes + ``(K, classes)`` logits cross to host.
+
+No intermediate tensor ever leaves the device; the host sees one dispatch
+per frame for the entire cascade.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..backends.jax_backend import JaxModel
+from ..spec import TensorSpec, TensorsSpec
+from . import mobilenet_v2, ssd_mobilenet
+
+
+def crop_and_resize(image, boxes_xywh, crop_size: int):
+    """Resample ``(H, W, C)`` regions into ``(K, crop_size, crop_size, C)``.
+
+    ``boxes_xywh``: (K, 4) ``[x, y, w, h]`` normalized to [0, 1] image
+    space (the fused-SSD decode layout).  Boxes are clamped to the image
+    and floored at 1e-3 extent, so degenerate detections resample a thin
+    sliver instead of dividing by zero.
+    """
+    h_px, w_px = image.shape[0], image.shape[1]
+    cs = crop_size
+
+    def one(box):
+        x, y, w, h = box[0], box[1], box[2], box[3]
+        x = jnp.clip(x, 0.0, 1.0)
+        y = jnp.clip(y, 0.0, 1.0)
+        w = jnp.clip(w, 1e-3, 1.0 - x + 1e-3)
+        h = jnp.clip(h, 1e-3, 1.0 - y + 1e-3)
+        # output pixel o samples input at  start_px + (o+0.5)*extent_px/cs:
+        # scale_and_translate's inverse map is (o + 0.5 - t)/s - 0.5, so
+        # s = cs/extent_px and t = -start_px * s.
+        sy = cs / (h * h_px)
+        sx = cs / (w * w_px)
+        scale = jnp.stack([sy, sx])
+        translation = jnp.stack([-(y * h_px) * sy, -(x * w_px) * sx])
+        return jax.image.scale_and_translate(
+            image.astype(jnp.float32), (cs, cs, image.shape[2]), (0, 1),
+            scale, translation, method="linear",
+        )
+
+    return jax.vmap(one)(boxes_xywh)
+
+
+def build_detect_classify(
+    num_labels: int = 91,
+    det_size: int = 300,
+    k: int = 8,
+    crop_size: int = 96,
+    num_classes: int = 1001,
+    width_mult: float = 1.0,
+    dtype=jnp.bfloat16,
+    seed: int = 0,
+    det_params=None,
+    cls_params=None,
+) -> JaxModel:
+    """One-program cascade model for the streaming filter.
+
+    Input: ``(det_size, det_size, 3)`` float32 (normalized upstream — the
+    transform fuses into this same program).  Outputs: detections
+    ``(k, 6)`` and per-detection classifier logits ``(k, num_classes)``.
+    """
+    if det_params is None:
+        det_params = ssd_mobilenet.init_params(
+            jax.random.PRNGKey(seed), num_labels
+        )
+    if cls_params is None:
+        cls_params = mobilenet_v2.init_params(
+            jax.random.PRNGKey(seed + 1), num_classes=num_classes,
+            width_mult=width_mult,
+        )
+    priors = ssd_mobilenet.generate_priors(det_size)
+    params = {"det": det_params, "cls": cls_params}
+
+    def fwd(p, x):
+        boxes, scores = ssd_mobilenet.apply(p["det"], x, dtype=dtype)
+        dets = ssd_mobilenet.decode_topk(boxes, scores, priors, k=k)
+        crops = crop_and_resize(x, dets[:, :4], crop_size)
+        logits = mobilenet_v2.apply(p["cls"], crops, dtype=dtype)
+        return dets, logits.astype(jnp.float32)
+
+    return JaxModel(
+        apply=fwd,
+        params=params,
+        input_spec=TensorsSpec.of(
+            TensorSpec(dtype=np.float32, shape=(det_size, det_size, 3))
+        ),
+        name=f"cascade_ssd_mobilenet_k{k}",
+    )

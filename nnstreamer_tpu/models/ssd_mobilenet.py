@@ -36,11 +36,29 @@ from .layers import (
 )
 from . import mobilenet_v2
 
-# (grid, anchors-per-cell) per feature map for 300×300 — totals 1917.
-FEATURE_GRIDS: Tuple[Tuple[int, int], ...] = (
-    (19, 3), (10, 6), (5, 6), (3, 6), (2, 6), (1, 6),
-)
-NUM_PRIORS = sum(g * g * a for g, a in FEATURE_GRIDS)  # 1917
+# anchors-per-cell at the six detection scales (tflite-SSD convention)
+ANCHORS_PER_SCALE: Tuple[int, ...] = (3, 6, 6, 6, 6, 6)
+
+
+def feature_grids(image_size: int = 300) -> Tuple[Tuple[int, int], ...]:
+    """(grid, anchors) per feature map, derived from the backbone's conv
+    geometry: taps at stride 16 and 32, then four stride-2 'SAME' extras
+    (each ``ceil``-halves).  300 → 19/10/5/3/2/1 (the tflite flagship's
+    1917 anchors); any other input size gets matching priors instead of
+    silently mis-indexing the 300-sized table."""
+    g = [-(-image_size // 16), -(-image_size // 32)]
+    for _ in range(4):
+        g.append(max(1, -(-g[-1] // 2)))
+    return tuple(zip(g, ANCHORS_PER_SCALE))
+
+
+def num_priors(image_size: int = 300) -> int:
+    return sum(g * g * a for g, a in feature_grids(image_size))
+
+
+# the 300×300 flagship constants (decoder priors-file contract)
+FEATURE_GRIDS: Tuple[Tuple[int, int], ...] = feature_grids(300)
+NUM_PRIORS = num_priors(300)  # 1917
 
 
 def init_params(key, num_labels: int = 91, width_mult: float = 1.0) -> Params:
@@ -120,6 +138,12 @@ def decode_topk(boxes, scores, priors, k: int = 100):
     squeezed = boxes.ndim == 2
     if squeezed:
         boxes, scores = boxes[None], scores[None]
+    if boxes.shape[-2] != np.shape(priors)[-1]:
+        raise ValueError(
+            f"decode_topk: {boxes.shape[-2]} boxes vs {np.shape(priors)[-1]} "
+            "priors — priors must come from generate_priors(image_size) for "
+            "the model's actual input size"
+        )
     s = jax.nn.sigmoid(scores[..., 1:].astype(jnp.float32))
     best = s.max(axis=-1)
     cls = (s.argmax(axis=-1) + 1).astype(jnp.float32)  # class 0 = background
@@ -139,13 +163,15 @@ def decode_topk(boxes, scores, priors, k: int = 100):
     return out[0] if squeezed else out
 
 
-def generate_priors() -> np.ndarray:
-    """Anchor grid (4, 1917): ycenter/xcenter/h/w rows, matching the decoder's
-    priors-file contract (``load_box_priors``)."""
+def generate_priors(image_size: int = 300) -> np.ndarray:
+    """Anchor grid (4, num_priors(image_size)): ycenter/xcenter/h/w rows,
+    matching the decoder's priors-file contract (``load_box_priors``);
+    1917 columns for the 300×300 flagship."""
+    grids = feature_grids(image_size)
     rows = [[], [], [], []]
-    scales = np.linspace(0.2, 0.95, len(FEATURE_GRIDS))
+    scales = np.linspace(0.2, 0.95, len(grids))
     ratios6 = [1.0, 2.0, 0.5, 3.0, 1.0 / 3.0, 1.0]
-    for (grid, anchors), scale in zip(FEATURE_GRIDS, scales):
+    for (grid, anchors), scale in zip(grids, scales):
         ratios = ratios6[:anchors]
         for gy in range(grid):
             for gx in range(grid):
@@ -158,7 +184,7 @@ def generate_priors() -> np.ndarray:
                     rows[2].append(s / np.sqrt(r))
                     rows[3].append(s * np.sqrt(r))
     priors = np.asarray(rows, np.float32)
-    assert priors.shape == (4, NUM_PRIORS), priors.shape
+    assert priors.shape == (4, num_priors(image_size)), priors.shape
     return priors
 
 
@@ -189,7 +215,7 @@ def build(
     if batch is not None:
         shape = (batch,) + shape
     if fused_decode:
-        priors = generate_priors()
+        priors = generate_priors(image_size)
 
         def fwd(p, x):
             boxes, scores = apply(p, x, dtype=dtype)

@@ -35,7 +35,14 @@ def _build_key() -> str:
     """
     with open(_SRC, "rb") as f:
         src = f.read()
-    abi = f"{sys.version_info.major}.{sys.version_info.minor}"
+    # platform + resolved link flags in the key: a wheel may SHIP a prebuilt
+    # .so + stamp (pyproject package-data), and one built for another arch
+    # or a different libpython location must be rebuilt, not dlopen'd
+    abi = "|".join([
+        f"{sys.version_info.major}.{sys.version_info.minor}",
+        sysconfig.get_platform(),
+        " ".join(python_link_flags()),
+    ])
     return hashlib.sha256(src + abi.encode()).hexdigest()
 
 

@@ -1,0 +1,110 @@
+"""Fused detect→crop→classify cascade (models/cascade.py).
+
+The crop resampler is pinned against exact numpy goldens (identity and
+integer-downscale cases where linear resampling has closed forms); the
+full cascade is pinned for shape/consistency and driven through the
+streaming filter element.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from nnstreamer_tpu.models import cascade
+
+
+class TestCropAndResize:
+    def test_full_image_box_is_resize(self):
+        """Box covering the whole image == plain resize of the image."""
+        rng = np.random.default_rng(0)
+        img = rng.random((32, 32, 3)).astype(np.float32)
+        box = jnp.asarray([[0.0, 0.0, 1.0, 1.0]])
+        out = cascade.crop_and_resize(jnp.asarray(img), box, 16)
+        ref = jax.image.resize(jnp.asarray(img), (16, 16, 3), method="linear")
+        np.testing.assert_allclose(np.asarray(out[0]), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_aligned_unit_scale_crop_is_slice(self):
+        """A crop whose pixel extent equals crop_size (scale=1, aligned)
+        reproduces the exact image slice."""
+        rng = np.random.default_rng(1)
+        img = rng.random((32, 32, 3)).astype(np.float32)
+        # region starting at pixel (8, 4), extent 16x16, crop_size 16
+        box = jnp.asarray([[4 / 32, 8 / 32, 16 / 32, 16 / 32]])  # x,y,w,h
+        out = cascade.crop_and_resize(jnp.asarray(img), box, 16)
+        np.testing.assert_allclose(
+            np.asarray(out[0]), img[8:24, 4:20], rtol=1e-5, atol=1e-5
+        )
+
+    def test_degenerate_box_does_not_nan(self):
+        img = jnp.ones((16, 16, 3), jnp.float32)
+        box = jnp.asarray([[0.5, 0.5, 0.0, 0.0], [1.0, 1.0, 0.5, 0.5]])
+        out = cascade.crop_and_resize(img, box, 8)
+        assert np.isfinite(np.asarray(out)).all()
+
+
+class TestCascadeModel:
+    @pytest.fixture(scope="class")
+    def model(self):
+        return cascade.build_detect_classify(
+            num_labels=11, det_size=96, k=4, crop_size=32, num_classes=16,
+            width_mult=0.35, dtype=jnp.float32,
+        )
+
+    def test_one_program_outputs(self, model):
+        x = np.random.default_rng(2).random((96, 96, 3)).astype(np.float32)
+        # close params over (block configs carry static python ints)
+        dets, logits = jax.jit(lambda a: model.apply(model.params, a))(x)
+        assert dets.shape == (4, 6) and logits.shape == (4, 16)
+        d = np.asarray(dets)
+        assert (d[:, 5] >= 0).all() and (d[:, 5] <= 1).all()  # scores
+        assert np.isfinite(np.asarray(logits)).all()
+
+    def test_matches_unfused_composition(self, model):
+        """The fused program == running detector decode, crop, classifier
+        as separate steps on the same params."""
+        from nnstreamer_tpu.models import mobilenet_v2, ssd_mobilenet
+
+        x = np.random.default_rng(3).random((96, 96, 3)).astype(np.float32)
+        dets, logits = jax.jit(lambda a: model.apply(model.params, a))(x)
+
+        boxes, scores = ssd_mobilenet.apply(
+            model.params["det"], jnp.asarray(x), dtype=jnp.float32
+        )
+        priors = ssd_mobilenet.generate_priors(96)
+        ref_dets = ssd_mobilenet.decode_topk(boxes, scores, priors, k=4)
+        crops = cascade.crop_and_resize(jnp.asarray(x), ref_dets[:, :4], 32)
+        ref_logits = mobilenet_v2.apply(
+            model.params["cls"], crops, dtype=jnp.float32
+        )
+        np.testing.assert_allclose(np.asarray(dets), np.asarray(ref_dets),
+                                   rtol=1e-5, atol=1e-5)
+        # jit fuses/reassociates float32 math through ~60 conv layers:
+        # observed |delta| ~3e-4 on O(3) logits — tolerance reflects that
+        np.testing.assert_allclose(np.asarray(logits), np.asarray(ref_logits),
+                                   rtol=2e-3, atol=2e-3)
+
+    def test_streams_through_filter(self, model):
+        from nnstreamer_tpu import Pipeline
+        from nnstreamer_tpu.elements.filter import TensorFilter
+        from nnstreamer_tpu.elements.sink import TensorSink
+        from nnstreamer_tpu.elements.testsrc import DataSrc
+
+        frames = [
+            np.random.default_rng(i).random((96, 96, 3)).astype(np.float32)
+            for i in range(3)
+        ]
+        got = []
+        p = Pipeline()
+        src = p.add(DataSrc(data=frames))
+        filt = p.add(TensorFilter(framework="jax", model=model))
+        sink = p.add(TensorSink())
+        sink.connect("new-data", lambda f: got.append(f))
+        p.link_chain(src, filt, sink)
+        p.run(timeout=300)
+        assert len(got) == 3
+        assert got[0].num_tensors == 2
+        assert np.asarray(got[0].tensor(0)).shape == (4, 6)
+        assert np.asarray(got[0].tensor(1)).shape == (4, 16)

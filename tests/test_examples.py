@@ -137,6 +137,7 @@ class TestCodegen:
         ("pose_estimation.py", "golden=OK"),
         ("fused_detection.py", "golden=OK"),
         ("parallel_inference.py", "sp-ring: 2 frames"),
+        ("cascade_detect_classify.py", "cascade=OK"),
     ],
 )
 def test_pipeline_demo_runs(script, expect):
