@@ -105,6 +105,11 @@ def load_tpu_cache():
         return None
 
 
+class _Skipped(RuntimeError):
+    """A leg deliberately skipped (0-frame env override): recorded in the
+    errors list for transparency but never with a traceback."""
+
+
 def pin_cpu():
     os.environ["JAX_PLATFORMS"] = "cpu"
     import jax
@@ -474,6 +479,8 @@ def measure_frame_breakdown(image_u8, n=None):
     and framework overhead measured separately."""
     if n is None:
         n = int(os.environ.get("BENCH_BREAKDOWN_FRAMES", "100"))
+    if n <= 0:
+        return {"skipped": "0 frames"}
     import jax
     import jax.numpy as jnp
 
@@ -753,14 +760,18 @@ def main():
 
         jax_model = mobilenet_v2.build(num_classes=1001, image_size=224)
         n_tpu = int(os.environ.get("BENCH_FRAMES", "400"))
-        tpu_frames = [image_u8.copy() for _ in range(n_tpu)]
-        tpu_fps = run_pipeline_fps("jax", jax_model, tpu_frames)
-        results["config1_stream_fps"] = round(tpu_fps, 2)
-        results["config1_frames"] = n_tpu
-        log(f"# config1 jax streaming fps: {tpu_fps:.2f}")
+        if n_tpu <= 0:
+            errors.append("config1 jax leg: skipped (0 frames)")
+        if n_tpu > 0:
+            tpu_frames = [image_u8.copy() for _ in range(n_tpu)]
+            tpu_fps = run_pipeline_fps("jax", jax_model, tpu_frames)
+            results["config1_stream_fps"] = round(tpu_fps, 2)
+            results["config1_frames"] = n_tpu
+            log(f"# config1 jax streaming fps: {tpu_fps:.2f}")
     except Exception as exc:
         errors.append(f"config1 jax leg: {exc!r}"[:400])
-        log(traceback.format_exc())
+        if not isinstance(exc, _Skipped):
+            log(traceback.format_exc())
 
     # -- config #1u: same pipeline with tensor_upload + queue — transfer of
     #    frame N+1 (source thread) overlaps dispatch of frame N (worker)
@@ -771,6 +782,8 @@ def main():
             jax_model = mobilenet_v2.build(num_classes=1001, image_size=224)
         n_u = int(os.environ.get("BENCH_UPLOAD_FRAMES",
                                  os.environ.get("BENCH_FRAMES", "400")))
+        if n_u <= 0:
+            raise _Skipped("skipped (0 frames)")
         u_fps = run_pipeline_fps(
             "jax", jax_model, [image_u8.copy() for _ in range(n_u)],
             upload=True,
@@ -780,12 +793,15 @@ def main():
         log(f"# config1 upload-overlap fps: {u_fps:.2f}")
     except Exception as exc:
         errors.append(f"config1 upload leg: {exc!r}"[:400])
-        log(traceback.format_exc())
+        if not isinstance(exc, _Skipped):
+            log(traceback.format_exc())
 
     # -- config #1d: adaptive micro-batching (tensor_dynbatch) -------------
     try:
         n_d = int(os.environ.get("BENCH_DYNBATCH_FRAMES",
                                  os.environ.get("BENCH_FRAMES", "400")))
+        if n_d <= 0:
+            raise _Skipped("skipped (0 frames)")
         d_fps, d_batches, d_frames = run_dynbatch_fps(
             [image_u8.copy() for _ in range(n_d)]
         )
@@ -796,7 +812,8 @@ def main():
             f"({d_batches} invokes / {d_frames} frames)")
     except Exception as exc:
         errors.append(f"config1 dynbatch leg: {exc!r}"[:400])
-        log(traceback.format_exc())
+        if not isinstance(exc, _Skipped):
+            log(traceback.format_exc())
 
     # -- config #1q: uint8-quantized flagship (int8 weights, on-device
     #    dequant — the reference's flagship model is uint8-quant MobileNet)
@@ -805,6 +822,8 @@ def main():
 
         quant_model = mobilenet_v2.build_quantized(num_classes=1001, image_size=224)
         n_q = int(os.environ.get("BENCH_QUANT_FRAMES", "200"))
+        if n_q <= 0:
+            raise _Skipped("skipped (0 frames)")
         q_fps = run_pipeline_fps(
             "jax", quant_model, [image_u8.copy() for _ in range(n_q)]
         )
@@ -813,7 +832,8 @@ def main():
         log(f"# config1 quantized fps: {q_fps:.2f}")
     except Exception as exc:
         errors.append(f"config1 quant leg: {exc!r}"[:400])
-        log(traceback.format_exc())
+        if not isinstance(exc, _Skipped):
+            log(traceback.format_exc())
 
     # -- config #2: SSD-MobileNet bounding-box pipeline --------------------
     # fused on-device decode head (lax.top_k inside the model's program) +
@@ -826,6 +846,8 @@ def main():
                                   fused_decode=100)
         img300 = rng.integers(0, 256, (300, 300, 3)).astype(np.uint8)
         n_ssd = int(os.environ.get("BENCH_SSD_FRAMES", "100"))
+        if n_ssd <= 0:
+            raise _Skipped("skipped (0 frames)")
         ssd_fps = run_pipeline_fps(
             "jax", ssd, [img300.copy() for _ in range(n_ssd)],
             decoder=("bounding_boxes", {
@@ -838,7 +860,8 @@ def main():
         log(f"# config2 ssd fps: {ssd_fps:.2f}")
     except Exception as exc:
         errors.append(f"config2 ssd leg: {exc!r}"[:400])
-        log(traceback.format_exc())
+        if not isinstance(exc, _Skipped):
+            log(traceback.format_exc())
 
     # -- config #3: PoseNet pose-estimation pipeline -----------------------
     # fused on-device keypoint decode (heatmap argmax in the model's XLA
@@ -849,6 +872,8 @@ def main():
         pose = posenet.build(image_size=224, fused_decode=True)
         grid = posenet.grid_size(224)
         n_pose = int(os.environ.get("BENCH_POSE_FRAMES", "100"))
+        if n_pose <= 0:
+            raise _Skipped("skipped (0 frames)")
         pose_fps = run_pipeline_fps(
             "jax", pose, [image_u8.copy() for _ in range(n_pose)],
             decoder=("pose_estimation", {
@@ -860,18 +885,48 @@ def main():
         log(f"# config3 pose fps: {pose_fps:.2f}")
     except Exception as exc:
         errors.append(f"config3 pose leg: {exc!r}"[:400])
-        log(traceback.format_exc())
+        if not isinstance(exc, _Skipped):
+            log(traceback.format_exc())
+
+    # -- config #2c: fused detect→crop→classify cascade --------------------
+    # the reference runs this as detector → host decode → videocrop×K →
+    # scaler → second filter; here the whole cascade is ONE program/frame
+    try:
+        from nnstreamer_tpu.models import cascade as cascade_mod
+
+        n_casc = int(os.environ.get("BENCH_CASCADE_FRAMES", "50"))
+        if n_casc <= 0:
+            errors.append("config2c cascade leg: skipped (0 frames)")
+        if n_casc > 0 and not over_budget("config2c cascade"):
+            casc = cascade_mod.build_detect_classify(
+                num_labels=91, det_size=300, k=16, crop_size=96,
+                num_classes=1001,
+            )
+            img300c = rng.integers(0, 256, (300, 300, 3)).astype(np.uint8)
+            c_fps = run_pipeline_fps(
+                "jax", casc, [img300c.copy() for _ in range(n_casc)]
+            )
+            results["config2c_cascade_fps"] = round(c_fps, 2)
+            results["config2c_frames"] = n_casc
+            log(f"# config2c cascade (detect+crop+classify x16) fps: {c_fps:.2f}")
+    except Exception as exc:
+        errors.append(f"config2c cascade leg: {exc!r}"[:400])
+        if not isinstance(exc, _Skipped):
+            log(traceback.format_exc())
 
     # -- config #4: LSTM recurrence through repo slots ---------------------
     try:
         n_steps = int(os.environ.get("BENCH_LSTM_STEPS", "200"))
+        if n_steps <= 0:
+            raise _Skipped("skipped (0 steps)")
         lstm_fps = run_lstm_recurrence_fps(n_steps)
         results["config4_lstm_steps_per_sec"] = round(lstm_fps, 2)
         results["config4_steps"] = n_steps
         log(f"# config4 lstm recurrence steps/sec: {lstm_fps:.2f}")
     except Exception as exc:
         errors.append(f"config4 lstm leg: {exc!r}"[:400])
-        log(traceback.format_exc())
+        if not isinstance(exc, _Skipped):
+            log(traceback.format_exc())
 
     # -- config #4b: windowed sequence LSTM (lax.scan) ----------------------
     # The TPU-native recurrence: tensor_aggregator windows → ONE compiled
@@ -886,6 +941,8 @@ def main():
             input_size=width, hidden_size=width, seq_len=seq_len
         )
         n_win = int(os.environ.get("BENCH_SEQ_WINDOWS", "100"))
+        if n_win <= 0:
+            raise _Skipped("skipped (0 windows)")
         windows = [
             rng.standard_normal((seq_len, width)).astype(np.float32)
             for _ in range(n_win)
@@ -898,7 +955,8 @@ def main():
             f"({win_fps * seq_len:.0f} steps/s)")
     except Exception as exc:
         errors.append(f"config4b seq leg: {exc!r}"[:400])
-        log(traceback.format_exc())
+        if not isinstance(exc, _Skipped):
+            log(traceback.format_exc())
 
     # -- config #5: mux → batched classifier, with a stream-scaling sweep --
     # (jax-sharded: the batch dim shards over however many chips exist; on
@@ -911,6 +969,8 @@ def main():
         n_dev = max(1, len(_jax.devices()))
         n_streams = int(os.environ.get("BENCH_MUX_STREAMS", "4"))
         per_stream = int(os.environ.get("BENCH_MUX_FRAMES", "50"))
+        if per_stream <= 0:
+            raise _Skipped("skipped (0 frames)")
         sweep_set = {
             int(v) for v in
             os.environ.get("BENCH_MUX_SWEEP", "1,2,4,8").split(",") if v
@@ -935,11 +995,13 @@ def main():
                 log(f"# config5 mux-batched fps ({streams} streams): {fps:.2f}")
             except Exception as exc:
                 errors.append(f"config5 sweep {streams}: {exc!r}"[:300])
-                log(traceback.format_exc())
+                if not isinstance(exc, _Skipped):
+                    log(traceback.format_exc())
         results["config5_mux_batched_fps"] = scaling.get(n_streams)
     except Exception as exc:
         errors.append(f"config5 mux leg: {exc!r}"[:400])
-        log(traceback.format_exc())
+        if not isinstance(exc, _Skipped):
+            log(traceback.format_exc())
 
     # -- per-frame breakdown (where the time goes, config #1) --------------
     try:

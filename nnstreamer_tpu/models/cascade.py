@@ -20,8 +20,6 @@ per frame for the entire cascade.
 
 from __future__ import annotations
 
-from typing import Optional, Tuple
-
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -93,12 +91,21 @@ def build_detect_classify(
     priors = ssd_mobilenet.generate_priors(det_size)
     params = {"det": det_params, "cls": cls_params}
 
-    def fwd(p, x):
+    def fwd_one(p, x):
         boxes, scores = ssd_mobilenet.apply(p["det"], x, dtype=dtype)
         dets = ssd_mobilenet.decode_topk(boxes, scores, priors, k=k)
         crops = crop_and_resize(x, dets[:, :4], crop_size)
         logits = mobilenet_v2.apply(p["cls"], crops, dtype=dtype)
         return dets, logits.astype(jnp.float32)
+
+    def fwd(p, x):
+        if x.ndim == 3:
+            return fwd_one(p, x)
+        if x.ndim == 4:  # batched frames: vmap the whole cascade
+            return jax.vmap(lambda a: fwd_one(p, a))(x)
+        raise ValueError(
+            f"cascade expects (H, W, 3) or (N, H, W, 3), got rank {x.ndim}"
+        )
 
     return JaxModel(
         apply=fwd,

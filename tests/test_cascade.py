@@ -108,3 +108,15 @@ class TestCascadeModel:
         assert got[0].num_tensors == 2
         assert np.asarray(got[0].tensor(0)).shape == (4, 6)
         assert np.asarray(got[0].tensor(1)).shape == (4, 16)
+
+    def test_batched_frames(self, model):
+        """(N, H, W, 3) batches vmap the whole cascade."""
+        x = np.random.default_rng(5).random((2, 96, 96, 3)).astype(np.float32)
+        dets, logits = jax.jit(lambda a: model.apply(model.params, a))(x)
+        assert dets.shape == (2, 4, 6) and logits.shape == (2, 4, 16)
+        # each batch row equals the unbatched cascade on that frame
+        d0, l0 = jax.jit(lambda a: model.apply(model.params, a))(x[0])
+        np.testing.assert_allclose(np.asarray(dets[0]), np.asarray(d0),
+                                   rtol=2e-3, atol=2e-3)
+        np.testing.assert_allclose(np.asarray(logits[0]), np.asarray(l0),
+                                   rtol=5e-3, atol=5e-3)
