@@ -110,6 +110,16 @@ class _Skipped(RuntimeError):
     errors list for transparency but never with a traceback."""
 
 
+def leg_error(errors, label, exc):
+    """Uniform per-leg failure/skip recording: deliberate skips get their
+    plain message, real failures get repr + a stderr traceback."""
+    if isinstance(exc, _Skipped):
+        errors.append(f"{label}: {exc}")
+    else:
+        errors.append(f"{label}: {exc!r}"[:400])
+        log(traceback.format_exc())
+
+
 def pin_cpu():
     os.environ["JAX_PLATFORMS"] = "cpu"
     import jax
@@ -769,9 +779,7 @@ def main():
             results["config1_frames"] = n_tpu
             log(f"# config1 jax streaming fps: {tpu_fps:.2f}")
     except Exception as exc:
-        errors.append(f"config1 jax leg: {exc!r}"[:400])
-        if not isinstance(exc, _Skipped):
-            log(traceback.format_exc())
+        leg_error(errors, "config1 jax leg", exc)
 
     # -- config #1u: same pipeline with tensor_upload + queue — transfer of
     #    frame N+1 (source thread) overlaps dispatch of frame N (worker)
@@ -792,9 +800,7 @@ def main():
         results["config1_upload_frames"] = n_u
         log(f"# config1 upload-overlap fps: {u_fps:.2f}")
     except Exception as exc:
-        errors.append(f"config1 upload leg: {exc!r}"[:400])
-        if not isinstance(exc, _Skipped):
-            log(traceback.format_exc())
+        leg_error(errors, "config1 upload leg", exc)
 
     # -- config #1d: adaptive micro-batching (tensor_dynbatch) -------------
     try:
@@ -811,19 +817,17 @@ def main():
         log(f"# config1 dynbatch fps: {d_fps:.2f} "
             f"({d_batches} invokes / {d_frames} frames)")
     except Exception as exc:
-        errors.append(f"config1 dynbatch leg: {exc!r}"[:400])
-        if not isinstance(exc, _Skipped):
-            log(traceback.format_exc())
+        leg_error(errors, "config1 dynbatch leg", exc)
 
     # -- config #1q: uint8-quantized flagship (int8 weights, on-device
     #    dequant — the reference's flagship model is uint8-quant MobileNet)
     try:
         from nnstreamer_tpu.models import mobilenet_v2
 
-        quant_model = mobilenet_v2.build_quantized(num_classes=1001, image_size=224)
         n_q = int(os.environ.get("BENCH_QUANT_FRAMES", "200"))
         if n_q <= 0:
             raise _Skipped("skipped (0 frames)")
+        quant_model = mobilenet_v2.build_quantized(num_classes=1001, image_size=224)
         q_fps = run_pipeline_fps(
             "jax", quant_model, [image_u8.copy() for _ in range(n_q)]
         )
@@ -831,9 +835,7 @@ def main():
         results["config1_quant_frames"] = n_q
         log(f"# config1 quantized fps: {q_fps:.2f}")
     except Exception as exc:
-        errors.append(f"config1 quant leg: {exc!r}"[:400])
-        if not isinstance(exc, _Skipped):
-            log(traceback.format_exc())
+        leg_error(errors, "config1 quant leg", exc)
 
     # -- config #2: SSD-MobileNet bounding-box pipeline --------------------
     # fused on-device decode head (lax.top_k inside the model's program) +
@@ -842,12 +844,12 @@ def main():
     try:
         from nnstreamer_tpu.models import ssd_mobilenet
 
-        ssd = ssd_mobilenet.build(num_labels=91, image_size=300,
-                                  fused_decode=100)
-        img300 = rng.integers(0, 256, (300, 300, 3)).astype(np.uint8)
         n_ssd = int(os.environ.get("BENCH_SSD_FRAMES", "100"))
         if n_ssd <= 0:
             raise _Skipped("skipped (0 frames)")
+        ssd = ssd_mobilenet.build(num_labels=91, image_size=300,
+                                  fused_decode=100)
+        img300 = rng.integers(0, 256, (300, 300, 3)).astype(np.uint8)
         ssd_fps = run_pipeline_fps(
             "jax", ssd, [img300.copy() for _ in range(n_ssd)],
             decoder=("bounding_boxes", {
@@ -859,9 +861,7 @@ def main():
         results["config2_frames"] = n_ssd
         log(f"# config2 ssd fps: {ssd_fps:.2f}")
     except Exception as exc:
-        errors.append(f"config2 ssd leg: {exc!r}"[:400])
-        if not isinstance(exc, _Skipped):
-            log(traceback.format_exc())
+        leg_error(errors, "config2 ssd leg", exc)
 
     # -- config #3: PoseNet pose-estimation pipeline -----------------------
     # fused on-device keypoint decode (heatmap argmax in the model's XLA
@@ -869,11 +869,11 @@ def main():
     try:
         from nnstreamer_tpu.models import posenet
 
-        pose = posenet.build(image_size=224, fused_decode=True)
-        grid = posenet.grid_size(224)
         n_pose = int(os.environ.get("BENCH_POSE_FRAMES", "100"))
         if n_pose <= 0:
             raise _Skipped("skipped (0 frames)")
+        pose = posenet.build(image_size=224, fused_decode=True)
+        grid = posenet.grid_size(224)
         pose_fps = run_pipeline_fps(
             "jax", pose, [image_u8.copy() for _ in range(n_pose)],
             decoder=("pose_estimation", {
@@ -884,9 +884,7 @@ def main():
         results["config3_frames"] = n_pose
         log(f"# config3 pose fps: {pose_fps:.2f}")
     except Exception as exc:
-        errors.append(f"config3 pose leg: {exc!r}"[:400])
-        if not isinstance(exc, _Skipped):
-            log(traceback.format_exc())
+        leg_error(errors, "config3 pose leg", exc)
 
     # -- config #2c: fused detect→crop→classify cascade --------------------
     # the reference runs this as detector → host decode → videocrop×K →
@@ -910,9 +908,7 @@ def main():
             results["config2c_frames"] = n_casc
             log(f"# config2c cascade (detect+crop+classify x16) fps: {c_fps:.2f}")
     except Exception as exc:
-        errors.append(f"config2c cascade leg: {exc!r}"[:400])
-        if not isinstance(exc, _Skipped):
-            log(traceback.format_exc())
+        leg_error(errors, "config2c cascade leg", exc)
 
     # -- config #4: LSTM recurrence through repo slots ---------------------
     try:
@@ -924,9 +920,7 @@ def main():
         results["config4_steps"] = n_steps
         log(f"# config4 lstm recurrence steps/sec: {lstm_fps:.2f}")
     except Exception as exc:
-        errors.append(f"config4 lstm leg: {exc!r}"[:400])
-        if not isinstance(exc, _Skipped):
-            log(traceback.format_exc())
+        leg_error(errors, "config4 lstm leg", exc)
 
     # -- config #4b: windowed sequence LSTM (lax.scan) ----------------------
     # The TPU-native recurrence: tensor_aggregator windows → ONE compiled
@@ -936,13 +930,13 @@ def main():
     try:
         from nnstreamer_tpu.models import lstm as lstm_mod
 
+        n_win = int(os.environ.get("BENCH_SEQ_WINDOWS", "100"))
+        if n_win <= 0:
+            raise _Skipped("skipped (0 windows)")
         seq_len, width = 128, 512
         seq_model = lstm_mod.build_sequence(
             input_size=width, hidden_size=width, seq_len=seq_len
         )
-        n_win = int(os.environ.get("BENCH_SEQ_WINDOWS", "100"))
-        if n_win <= 0:
-            raise _Skipped("skipped (0 windows)")
         windows = [
             rng.standard_normal((seq_len, width)).astype(np.float32)
             for _ in range(n_win)
@@ -954,9 +948,7 @@ def main():
         log(f"# config4b sequence-lstm windows/sec: {win_fps:.2f} "
             f"({win_fps * seq_len:.0f} steps/s)")
     except Exception as exc:
-        errors.append(f"config4b seq leg: {exc!r}"[:400])
-        if not isinstance(exc, _Skipped):
-            log(traceback.format_exc())
+        leg_error(errors, "config4b seq leg", exc)
 
     # -- config #5: mux → batched classifier, with a stream-scaling sweep --
     # (jax-sharded: the batch dim shards over however many chips exist; on
@@ -999,9 +991,7 @@ def main():
                     log(traceback.format_exc())
         results["config5_mux_batched_fps"] = scaling.get(n_streams)
     except Exception as exc:
-        errors.append(f"config5 mux leg: {exc!r}"[:400])
-        if not isinstance(exc, _Skipped):
-            log(traceback.format_exc())
+        leg_error(errors, "config5 mux leg", exc)
 
     # -- per-frame breakdown (where the time goes, config #1) --------------
     try:

@@ -1,0 +1,64 @@
+"""Worker for the 2-process multi-host test (tests/test_multihost.py).
+
+Each process contributes 2 virtual CPU devices; after init_distributed the
+global mesh spans 4 devices across both processes, and the psum/matmul
+collectives run over the distributed backend (the DCN analog).
+"""
+
+import os
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from nnstreamer_tpu.parallel.mesh import init_distributed
+
+
+def main() -> None:
+    pid, port = int(sys.argv[1]), sys.argv[2]
+    n = init_distributed(f"localhost:{port}", num_processes=2, process_id=pid)
+    assert n == 2 and jax.process_count() == 2
+    devs = jax.devices()
+    assert len(devs) == 4, devs
+    mesh = Mesh(np.array(devs), ("dp",))
+    row_sharding = NamedSharding(mesh, P("dp", None))
+
+    # per-process data: this host's rows carry (pid + 1)
+    arr = jax.make_array_from_callback(
+        (4, 8), row_sharding,
+        lambda idx: np.full((1, 8), pid + 1.0, np.float32),
+    )
+
+    # cross-process reduction (psum over DCN): 8*(1+1+2+2) = 48
+    total = jax.jit(
+        lambda a: a.sum(), out_shardings=NamedSharding(mesh, P())
+    )(arr)
+    assert float(np.asarray(total)) == 48.0, float(np.asarray(total))
+
+    # model forward with batch sharded over the GLOBAL mesh, params
+    # replicated: each output row = (pid_of_row + 1) * colsum(w)
+    w = np.arange(8 * 3, dtype=np.float32).reshape(8, 3)
+    wd = jax.make_array_from_callback(
+        (8, 3), NamedSharding(mesh, P()), lambda idx: w
+    )
+    out = jax.jit(
+        lambda a, ww: a @ ww, out_shardings=row_sharding
+    )(arr, wd)
+    colsum = w.sum(axis=0)
+    for shard in out.addressable_shards:
+        row = shard.index[0].start
+        expect = (1.0 if row < 2 else 2.0) * colsum
+        np.testing.assert_allclose(np.asarray(shard.data)[0], expect, rtol=1e-6)
+
+    print(f"proc {pid}: MULTIHOST_OK", flush=True)
+
+
+if __name__ == "__main__":
+    main()

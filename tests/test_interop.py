@@ -129,3 +129,41 @@ class TestDeviceResidency:
         assert JaxBackend.device_resident is True
         assert TorchBackend.device_resident is False
         assert TFLiteBackend.device_resident is False
+
+
+class TestWireTensorInterop:
+    """WireTensor (wire-layout device payloads from tensor_upload) must
+    materialize with logical geometry through every interop bridge."""
+
+    def _wt(self):
+        import jax
+
+        from nnstreamer_tpu.buffer import WireTensor
+
+        arr = np.arange(12, dtype=np.float32).reshape(3, 4)
+        return WireTensor(jax.device_put(arr.reshape(-1)), arr.shape, arr.dtype), arr
+
+    def test_to_torch(self):
+        from nnstreamer_tpu.backends.interop import to_torch
+
+        wt, arr = self._wt()
+        t = to_torch(wt)
+        assert tuple(t.shape) == (3, 4)
+        np.testing.assert_array_equal(t.numpy(), arr)
+
+    def test_to_tf(self):
+        tf = pytest.importorskip("tensorflow")
+        from nnstreamer_tpu.backends.interop import to_tf
+
+        wt, arr = self._wt()
+        t = to_tf(wt)
+        assert tuple(np.shape(t)) == (3, 4)
+        np.testing.assert_array_equal(np.asarray(t), arr)
+
+    def test_to_jax_materializes_logical(self):
+        from nnstreamer_tpu.backends.interop import to_jax
+
+        wt, arr = self._wt()
+        out = to_jax(wt)
+        assert tuple(np.shape(out)) == (3, 4)
+        np.testing.assert_array_equal(np.asarray(out), arr)
