@@ -195,3 +195,34 @@ class TestDynBatchPipeline:
     def test_non_power_of_two_max_batch_rejected(self):
         with pytest.raises(ValueError, match="power of two"):
             DynBatch(max_batch=6)
+
+    def test_dynbatch_plus_upload_overlap(self):
+        """dynbatch -> upload -> queue -> filter: coalesced batches cross
+        the wire as WireTensors (transfer in the upload hop, dispatch in
+        the queue worker) — the combined adaptive-batching + overlap
+        topology."""
+        from nnstreamer_tpu.elements.queue import Queue
+        from nnstreamer_tpu.elements.upload import TensorUpload
+
+        model = JaxModel(
+            apply=lambda p, x: x * 2.0,
+            input_spec=TensorsSpec.of(
+                TensorSpec(dtype=np.float32, shape=(None, 4))
+            ),
+        )
+        frames = [Frame.of(np.full((4,), i, np.float32), pts=i) for i in range(10)]
+        got = []
+        p = Pipeline()
+        src = p.add(DataSrc(data=frames))
+        dyn = p.add(DynBatch(max_batch=4))
+        up = p.add(TensorUpload())
+        q = p.add(Queue(max_size_buffers=8))
+        filt = p.add(TensorFilter(framework="jax", model=model))
+        unb = p.add(DynUnbatch())
+        sink = p.add(TensorSink())
+        sink.connect("new-data", lambda f: got.append(np.asarray(f.tensor(0))))
+        p.link_chain(src, dyn, up, q, filt, unb, sink)
+        p.run(timeout=120)
+        assert len(got) == 10
+        for i, a in enumerate(got):
+            np.testing.assert_allclose(a, 2.0 * i, rtol=1e-6)
