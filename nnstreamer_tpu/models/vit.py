@@ -1,0 +1,84 @@
+"""Vision Transformer classifier on the streaming transformer encoder.
+
+The reference's model zoo is CNN/LSTM-era; the TPU-native zoo also carries
+attention models (``models/transformer.py``).  This wires them to vision:
+non-overlapping patches become the token stream, the encoder runs any of
+its attention modes (``full`` single-device, ``ring``/``ulysses``
+sequence-parallel over a mesh — long-context machinery applied to image
+tokens), and the classifier head is the mean over per-token logits (for a
+linear head this equals pooling before the head, so no extra params).
+
+MXU notes: patch extraction is a pure reshape/transpose (fuses into the
+embed matmul); every matmul is (tokens × d) shaped — batched and dense.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..backends.jax_backend import JaxModel
+from ..spec import TensorSpec, TensorsSpec
+from . import transformer
+
+
+def patchify(x, patch: int):
+    """(..., H, W, C) → (..., H/W patches, patch*patch*C) token stream."""
+    h, w, c = x.shape[-3], x.shape[-2], x.shape[-1]
+    if h % patch or w % patch:
+        raise ValueError(f"image {h}x{w} not divisible by patch {patch}")
+    gh, gw = h // patch, w // patch
+    lead = x.shape[:-3]
+    y = x.reshape(*lead, gh, patch, gw, patch, c)
+    y = jnp.moveaxis(y, -3, -4)  # (..., gh, gw, patch, patch, c)
+    return y.reshape(*lead, gh * gw, patch * patch * c)
+
+
+def build(
+    num_classes: int = 1000,
+    image_size: int = 224,
+    patch: int = 16,
+    d_model: int = 192,
+    n_heads: int = 3,
+    n_layers: int = 6,
+    attn: str = "full",
+    mesh=None,
+    axis: str = "sp",
+    batch: Optional[int] = None,
+    dtype=jnp.bfloat16,
+    seed: int = 0,
+    params=None,
+) -> JaxModel:
+    """Stream-ready ViT: one frame = one (H, W, 3) image (uint8/float —
+    normalize upstream; the transform fuses into this program).  With
+    ``attn="ring"`` and a mesh, the patch-token sequence shards over the
+    ``sp`` axis — sequence parallelism for high-resolution imagery."""
+    if image_size % patch:
+        raise ValueError(f"image_size {image_size} not divisible by patch {patch}")
+    d_in = patch * patch * 3
+    if params is None:
+        params = transformer.init_params(
+            jax.random.PRNGKey(seed), d_model, n_heads,
+            n_layers, 4 * d_model, d_in, num_classes,
+        )
+
+    def fwd(p, x):
+        toks = patchify(x.astype(dtype), patch)
+        per_token = transformer.apply(
+            p, toks, attn=attn, mesh=mesh, axis=axis, causal=False,
+            dtype=dtype,
+        )
+        return per_token.mean(axis=-2).astype(jnp.float32)
+
+    shape: Tuple[Optional[int], ...] = (image_size, image_size, 3)
+    if batch is not None:
+        shape = (batch,) + shape
+    return JaxModel(
+        apply=fwd,
+        params=params,
+        input_spec=TensorsSpec.of(TensorSpec(dtype=np.float32, shape=shape)),
+        name=f"vit_{attn}_p{patch}_{d_model}x{n_layers}",
+    )
