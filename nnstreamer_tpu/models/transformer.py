@@ -146,6 +146,9 @@ def apply(
     h = params["n_heads"]
     y = (x.astype(dtype) @ params["embed"]["w"].astype(dtype)
          + params["embed"]["b"].astype(dtype))
+    pe = params.get("pos_embed")
+    if pe is not None:  # learned positional embeddings (ViT-style callers)
+        y = y + pe.astype(dtype)
     for blk in params["blocks"]:
         y = _block_apply(
             blk, y, h, attn, mesh, axis, causal, dtype,

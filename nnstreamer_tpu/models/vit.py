@@ -59,11 +59,17 @@ def build(
     if image_size % patch:
         raise ValueError(f"image_size {image_size} not divisible by patch {patch}")
     d_in = patch * patch * 3
+    tokens = (image_size // patch) ** 2
     if params is None:
+        from .layers import _normal
+
+        key, kpos = jax.random.split(jax.random.PRNGKey(seed))
         params = transformer.init_params(
-            jax.random.PRNGKey(seed), d_model, n_heads,
-            n_layers, 4 * d_model, d_in, num_classes,
+            key, d_model, n_heads, n_layers, 4 * d_model, d_in, num_classes,
         )
+        # learned positional embeddings: without them attention + mean-pool
+        # is permutation-invariant over patches — no spatial structure
+        params["pos_embed"] = _normal(kpos, (tokens, d_model), 0.02)
 
     def fwd(p, x):
         toks = patchify(x.astype(dtype), patch)

@@ -344,3 +344,24 @@ class TestViT:
         ref = np.asarray(jax.jit(lambda a: full.apply(full.params, a))(x))
         out = np.asarray(jax.jit(lambda a: ring.apply(ring.params, a))(x))
         np.testing.assert_allclose(out, ref, rtol=2e-4, atol=2e-4)
+
+    def test_positional_embeddings_break_permutation_invariance(self):
+        """Patch-shuffled images must NOT produce identical logits (the
+        pos-embed slot exists and carries spatial structure)."""
+        import jax
+        import jax.numpy as jnp
+
+        from nnstreamer_tpu.models import vit
+
+        model = vit.build(num_classes=6, image_size=16, patch=4,
+                          d_model=16, n_heads=2, n_layers=1,
+                          dtype=jnp.float32, seed=9)
+        assert model.params.get("pos_embed") is not None
+        rng = np.random.default_rng(8)
+        x = rng.random((16, 16, 3)).astype(np.float32)
+        # swap two patch blocks (top-left <-> bottom-right)
+        xs = x.copy()
+        xs[:4, :4], xs[12:, 12:] = x[12:, 12:], x[:4, :4]
+        f = jax.jit(lambda a: model.apply(model.params, a))
+        a, b = np.asarray(f(x)), np.asarray(f(xs))
+        assert not np.allclose(a, b, atol=1e-5)

@@ -234,3 +234,23 @@ class TestUploadElement:
         np.testing.assert_array_equal(
             np.concatenate(got, axis=0).reshape(4, 6), frames[0]
         )
+
+    def test_midstream_renegotiation_through_upload(self):
+        """Mid-stream shape change: upload recomputes the wire layout per
+        frame and the caps event renegotiates downstream."""
+        model = JaxModel(
+            apply=lambda p, x: x.reshape(-1).sum()[None],
+        )
+        a = [np.full((2, 3), float(i), np.float32) for i in range(2)]
+        b = [np.full((4, 2), 10.0 + i, np.float32) for i in range(2)]
+        got = []
+        p = Pipeline()
+        src = p.add(DataSrc(data=a + b))
+        up = p.add(TensorUpload())
+        q = p.add(Queue(max_size_buffers=4))
+        filt = p.add(TensorFilter(framework="jax", model=model))
+        sink = p.add(TensorSink())
+        sink.connect("new-data", lambda f: got.append(float(np.asarray(f.tensor(0))[0])))
+        p.link_chain(src, up, q, filt, sink)
+        p.run(timeout=120)
+        assert got == [0.0, 6.0, 8 * 10.0, 8 * 11.0]

@@ -1,0 +1,90 @@
+#!/usr/bin/env python
+"""Diagnose the axon TPU tunnel's state: ALIVE / SICK / WEDGED.
+
+Round-3 field observations (see .claude/skills/verify/SKILL.md):
+- the tunnel wedges for hours (backend init never returns);
+- short of a wedge, the transfer path oscillates >100× (150 KB put:
+  0.3 ms healthy ↔ 30 ms sick) while async dispatch of device-resident
+  work stays fast.
+
+This probe runs each stage in a subprocess with a timeout (a wedged PJRT
+client can't be interrupted in-process) and prints one JSON verdict:
+
+    {"state": "ALIVE|SICK|WEDGED|NO_ACCEL", "init_s": ..,
+     "put_150k_ms": .., "dispatch_ms": .., "matmul_ms": ..}
+
+Exit code: 0 ALIVE, 1 SICK, 2 WEDGED/NO_ACCEL.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+PROBE = r"""
+import time, json
+t0 = time.perf_counter()
+import jax, jax.numpy as jnp
+import numpy as np
+dev = jax.devices()[0]
+init_s = time.perf_counter() - t0
+out = {"platform": dev.platform, "init_s": round(init_s, 2)}
+x = jnp.ones((256, 256), jnp.bfloat16)
+(x @ x).block_until_ready()
+t0 = time.perf_counter()
+for _ in range(10):
+    y = x @ x
+y.block_until_ready()
+out["matmul_ms"] = round((time.perf_counter() - t0) / 10 * 1e3, 3)
+rng = np.random.default_rng(0)
+arrs = [rng.integers(0, 256, 150_528).astype(np.uint8) for _ in range(10)]
+t0 = time.perf_counter()
+ds = [jax.device_put(a) for a in arrs]
+jax.block_until_ready(ds)
+out["put_150k_ms"] = round((time.perf_counter() - t0) / 10 * 1e3, 3)
+t0 = time.perf_counter()
+for d in ds:
+    z = d + 1
+z.block_until_ready()
+out["dispatch_ms"] = round((time.perf_counter() - t0) / 10 * 1e3, 3)
+print(json.dumps(out))
+"""
+
+
+def main() -> int:
+    timeout = float(os.environ.get("DOCTOR_TIMEOUT", "90"))
+    t0 = time.time()
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", PROBE],
+            capture_output=True, text=True, timeout=timeout,
+        )
+    except subprocess.TimeoutExpired:
+        print(json.dumps({
+            "state": "WEDGED",
+            "detail": f"probe did not return within {timeout:g}s "
+                      "(symptom: stuck in make_c_api_client; wedges can "
+                      "last hours — pin CPU and keep working)",
+        }))
+        return 2
+    if proc.returncode != 0:
+        print(json.dumps({
+            "state": "WEDGED",
+            "detail": proc.stderr.strip()[-300:],
+        }))
+        return 2
+    info = json.loads(proc.stdout.strip().splitlines()[-1])
+    if info.get("platform") == "cpu":
+        info["state"] = "NO_ACCEL"
+        print(json.dumps(info))
+        return 2
+    sick = info["put_150k_ms"] > 5.0 or info["matmul_ms"] > 20.0
+    info["state"] = "SICK" if sick else "ALIVE"
+    info["wall_s"] = round(time.time() - t0, 1)
+    print(json.dumps(info))
+    return 1 if sick else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
