@@ -1,0 +1,78 @@
+"""Soak: the new concurrency machinery under sustained mixed load.
+
+One stream fans out through a tee into (a) the adaptive-batching +
+transfer-overlap chain (dynbatch → upload → queue → filter → dynunbatch)
+and (b) a plain queued filter branch; the source changes its frame shape
+mid-stream twice, so caps renegotiation rides through the dynbatch worker
+and the upload wire-rule while both branches are busy.  Every frame must
+come out of both branches exactly once, in order, with correct values.
+"""
+
+import numpy as np
+
+from nnstreamer_tpu import Pipeline
+from nnstreamer_tpu.backends.jax_backend import JaxModel
+from nnstreamer_tpu.buffer import Frame
+from nnstreamer_tpu.elements.dynbatch import DynBatch, DynUnbatch
+from nnstreamer_tpu.elements.filter import TensorFilter
+from nnstreamer_tpu.elements.queue import Queue
+from nnstreamer_tpu.elements.sink import TensorSink
+from nnstreamer_tpu.elements.tee import Tee
+from nnstreamer_tpu.elements.testsrc import DataSrc
+from nnstreamer_tpu.elements.upload import TensorUpload
+from nnstreamer_tpu.spec import TensorSpec, TensorsSpec
+
+
+def test_soak_mixed_topology_with_renegotiation():
+    n_phase = 300  # per shape phase; 3 phases
+    shapes = [(4,), (2, 3), (4,)]
+    frames = []
+    seq = 0
+    for shape in shapes:
+        for _ in range(n_phase):
+            frames.append(Frame.of(np.full(shape, float(seq), np.float32),
+                                   pts=seq))
+            seq += 1
+    total = len(frames)
+
+    # sum-reducing model, polymorphic over both rank and batch
+    batched = JaxModel(
+        apply=lambda p, x: x.reshape(x.shape[0], -1).sum(axis=1),
+    )
+    single = JaxModel(apply=lambda p, x: x.reshape(-1).sum()[None])
+
+    got_a, got_b = [], []
+    p = Pipeline()
+    src = p.add(DataSrc(data=frames))
+    tee = p.add(Tee())
+    # branch a: adaptive batching + wire overlap
+    dyn = p.add(DynBatch(max_batch=4))
+    up = p.add(TensorUpload())
+    qa = p.add(Queue(max_size_buffers=32))
+    fa = p.add(TensorFilter(framework="jax", model=batched))
+    unb = p.add(DynUnbatch())
+    sa = p.add(TensorSink(name="a"))
+    sa.connect("new-data", lambda f: got_a.append(float(np.asarray(f.tensor(0)))))
+    # branch b: plain queued filter
+    qb = p.add(Queue(max_size_buffers=32))
+    fb = p.add(TensorFilter(framework="jax", model=single))
+    sb = p.add(TensorSink(name="b"))
+    sb.connect("new-data", lambda f: got_b.append(float(np.asarray(f.tensor(0))[0])))
+
+    p.link(src, tee)
+    p.link(tee, dyn)
+    p.link_chain(dyn, up, qa, fa, unb, sa)
+    p.link(tee, qb)
+    p.link_chain(qb, fb, sb)
+    p.run(timeout=600)
+
+    # golden: frame i in phase k sums to value*elements(shape_k)
+    def golden(i):
+        phase = min(i // n_phase, 2)
+        return float(i) * int(np.prod(shapes[phase]))
+
+    assert len(got_a) == total, (len(got_a), total)
+    assert len(got_b) == total, (len(got_b), total)
+    for i in range(total):
+        assert got_a[i] == golden(i), (i, got_a[i], golden(i))
+        assert got_b[i] == golden(i), (i, got_b[i], golden(i))

@@ -69,8 +69,13 @@ def main() -> int:
         }))
         return 2
     if proc.returncode != 0:
+        # a fast non-zero exit is a broken environment (missing jax, bad
+        # config), not a wedged tunnel — don't tell the operator to "pin
+        # CPU and keep working" when the fix is the install
+        wall = time.time() - t0
         print(json.dumps({
-            "state": "WEDGED",
+            "state": "WEDGED" if wall > timeout * 0.5 else "PROBE_ERROR",
+            "probe_s": round(wall, 1),
             "detail": proc.stderr.strip()[-300:],
         }))
         return 2
