@@ -103,15 +103,21 @@ def _block_apply(
     q, k, v = (a.reshape(b, t, h, d // h) for a in (q, k, v))
     o = _attention(q, k, v, attn, mesh, axis, causal).reshape(b, t, d)
     y = y + o @ blk["proj"]["w"].astype(dtype) + blk["proj"]["b"].astype(dtype)
+    return _ffn_residual(blk, y, dtype, moe_mesh, moe_axis)
+
+
+def _ffn_residual(blk: Params, y, dtype, moe_mesh=None, moe_axis: str = "ep"):
+    """ln2 + (dense-gelu FFN | switch MoE) + residual — shared by the
+    full-sequence block and the stepwise decode path so the
+    stepwise == full equivalence can't drift."""
     z = _layernorm(blk["ln2"], y)
     if "moe" in blk:
         from ..parallel.moe import moe_ffn
 
-        y = y + moe_ffn(blk["moe"], z, mesh=moe_mesh, axis=moe_axis, dtype=dtype)
-    else:
-        z = jax.nn.gelu(z @ blk["ff1"]["w"].astype(dtype) + blk["ff1"]["b"].astype(dtype))
-        y = y + z @ blk["ff2"]["w"].astype(dtype) + blk["ff2"]["b"].astype(dtype)
-    return y
+        return y + moe_ffn(blk["moe"], z, mesh=moe_mesh, axis=moe_axis,
+                           dtype=dtype)
+    z = jax.nn.gelu(z @ blk["ff1"]["w"].astype(dtype) + blk["ff1"]["b"].astype(dtype))
+    return y + z @ blk["ff2"]["w"].astype(dtype) + blk["ff2"]["b"].astype(dtype)
 
 
 def _attention(q, k, v, attn: str, mesh, axis: str, causal: bool):
@@ -197,6 +203,113 @@ def build(
         params=params,
         input_spec=TensorsSpec.of(TensorSpec(dtype=np.float32, shape=shape)),
         name=f"transformer_{attn}_{d_model}x{n_layers}",
+    )
+
+
+def decode_step(params: Params, x_t, cache, pos, dtype=jnp.float32):
+    """One autoregressive step with a KV cache.
+
+    The reference's streaming recurrence is the LSTM cell cycled through
+    repo slots (``tests/nnstreamer_repo_lstm``); this is the transformer-era
+    analog: per-step state is the layers' K/V cache, carried through the
+    same repo-slot machinery (or any stream state channel).
+
+    - ``x_t``: (d_in,) — one step's features;
+    - ``cache``: (L, 2, T_max, d_model) — per-layer K and V, concatenated
+      head-merged (static shape; position ``pos`` indexes the write slot);
+    - ``pos``: (1,) int32 — current step index (< T_max).
+
+    Returns ``(y_t (n_out,), cache', pos+1)``.  Equivalent to running the
+    full causal :func:`apply` over the whole prefix and taking the last
+    token's output — pinned by tests.  Past ``T_max`` the output saturates
+    to NaN (loudly wrong beats silently-stale attention; size the cache
+    for the stream or reset the slots).  MoE blocks are rejected: switch
+    capacity is a sequence-level quantity, so a per-token step cannot
+    reproduce the full pass's drop semantics.
+    """
+    if any("moe" in blk for blk in params["blocks"]):
+        raise NotImplementedError(
+            "decode_step does not support MoE blocks (capacity semantics "
+            "are sequence-level); use the dense-FFN encoder for decode"
+        )
+    h = params["n_heads"]
+    t_max = cache.shape[2]
+    p_idx = pos[0]
+    y = (x_t[None].astype(dtype) @ params["embed"]["w"].astype(dtype)
+         + params["embed"]["b"].astype(dtype))  # (1, d)
+    pe = params.get("pos_embed")
+    if pe is not None:
+        y = y + jax.lax.dynamic_slice_in_dim(pe, p_idx, 1, 0).astype(dtype)
+    d = y.shape[-1]
+    new_cache = []
+    for li, blk in enumerate(params["blocks"]):
+        z = _layernorm(blk["ln1"], y[None])[0]
+        qkv = z @ blk["qkv"]["w"].astype(dtype) + blk["qkv"]["b"].astype(dtype)
+        q, k, v = jnp.split(qkv, 3, axis=-1)  # (1, d) each
+        ck = jax.lax.dynamic_update_slice_in_dim(
+            cache[li, 0].astype(dtype), k, p_idx, 0
+        )
+        cv = jax.lax.dynamic_update_slice_in_dim(
+            cache[li, 1].astype(dtype), v, p_idx, 0
+        )
+        new_cache.append(jnp.stack([ck, cv]))
+        # causal attention of the single query against the cached prefix
+        qh = q.reshape(1, h, d // h)
+        kh = ck.reshape(t_max, h, d // h)
+        vh = cv.reshape(t_max, h, d // h)
+        s = jnp.einsum("qhd,khd->hqk", qh, kh) * (d // h) ** -0.5
+        live = jnp.arange(t_max) <= p_idx
+        s = jnp.where(live[None, None, :], s, -jnp.inf)
+        w = jax.nn.softmax(s, axis=-1)
+        o = jnp.einsum("hqk,khd->qhd", w, vh).reshape(1, d)
+        y = y + o @ blk["proj"]["w"].astype(dtype) + blk["proj"]["b"].astype(dtype)
+        y = _ffn_residual(blk, y[None], dtype)[0]
+    y = _layernorm(params["ln_f"], y[None])[0]
+    out = (y @ params["head"]["w"].astype(dtype)
+           + params["head"]["b"].astype(dtype)).astype(jnp.float32)
+    # overflow: a step past the cache capacity would clamp the write slot
+    # and attend over stale state — saturate to NaN so the caller notices
+    out = jnp.where(p_idx < t_max, out, jnp.nan)
+    return out[0], jnp.stack(new_cache).astype(cache.dtype), pos + 1
+
+
+def init_decode_cache(n_layers: int, d_model: int, t_max: int,
+                      dtype=jnp.float32):
+    """Zeroed KV cache for :func:`decode_step`."""
+    return jnp.zeros((n_layers, 2, t_max, d_model), dtype)
+
+
+def build_decode_cell(
+    t_max: int = 128,
+    d_in: int = 64,
+    n_out: int = 16,
+    d_model: int = 128,
+    n_heads: int = 8,
+    n_layers: int = 2,
+    dtype=jnp.float32,
+    seed: int = 0,
+    params: Optional[Params] = None,
+) -> JaxModel:
+    """Stream-ready decode cell: inputs ``(x_t, cache, pos)`` → outputs
+    ``(y_t, cache', pos')`` — cycle cache/pos through repo slots exactly
+    like the LSTM cell's (h, c)."""
+    if params is None:
+        params = init_params(
+            jax.random.PRNGKey(seed), d_model, n_heads, n_layers,
+            4 * d_model, d_in, n_out,
+        )
+    return JaxModel(
+        apply=lambda p, x_t, cache, pos: decode_step(
+            p, x_t, cache, pos, dtype=dtype
+        ),
+        params=params,
+        input_spec=TensorsSpec(tensors=(
+            TensorSpec(dtype=np.float32, shape=(d_in,)),
+            TensorSpec(dtype=np.float32,
+                       shape=(n_layers, 2, t_max, d_model)),
+            TensorSpec(dtype=np.int32, shape=(1,)),
+        )),
+        name=f"transformer_decode_{d_model}x{n_layers}",
     )
 
 

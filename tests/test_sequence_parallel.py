@@ -128,3 +128,120 @@ class TestTransformerModel:
         p.run(timeout=180)
         assert sink.num_frames == 2  # 128/64 windows
         assert sink.frames[0].tensor(0).shape == (64, 8)
+
+
+class TestDecodeCell:
+    """KV-cache autoregressive decode (transformer.decode_step): the
+    transformer-era analog of the reference's repo-slot LSTM recurrence."""
+
+    def test_stepwise_equals_full_causal(self):
+        import jax
+        import jax.numpy as jnp
+
+        from nnstreamer_tpu.models import transformer
+
+        t, d_in, n_out, d_model = 7, 6, 5, 16
+        params = transformer.init_params(
+            jax.random.PRNGKey(2), d_model, 2, 2, 32, d_in, n_out
+        )
+        xs = np.random.default_rng(3).standard_normal((t, d_in)).astype(np.float32)
+        full = np.asarray(transformer.apply(params, jnp.asarray(xs), causal=True))
+
+        step = jax.jit(lambda x, c, p: transformer.decode_step(params, x, c, p))
+        cache = transformer.init_decode_cache(2, d_model, t)
+        pos = jnp.zeros((1,), jnp.int32)
+        for i in range(t):
+            y, cache, pos = step(jnp.asarray(xs[i]), cache, pos)
+            np.testing.assert_allclose(
+                np.asarray(y), full[i], rtol=2e-4, atol=2e-4
+            )
+        assert int(pos[0]) == t
+
+    def test_decode_cell_through_repo_slots(self):
+        """The decode cell cycles cache/pos through repo slots exactly like
+        the LSTM cell cycles (h, c) — streamed via mux/demux."""
+        import jax.numpy as jnp
+
+        import nnstreamer_tpu as nns
+        from nnstreamer_tpu.buffer import SECOND, Frame
+        from nnstreamer_tpu.elements.filter import TensorFilter
+        from nnstreamer_tpu.elements.repo import GLOBAL_REPO, TensorRepoSink, TensorRepoSrc
+        from nnstreamer_tpu.elements.sink import TensorSink
+        from nnstreamer_tpu.elements.testsrc import DataSrc
+        from nnstreamer_tpu.models import transformer
+        from nnstreamer_tpu.spec import TensorSpec, TensorsSpec
+
+        t_max, d_in, n_out, d_model, layers = 6, 4, 3, 8, 1
+        cell = transformer.build_decode_cell(
+            t_max=t_max, d_in=d_in, n_out=n_out, d_model=d_model,
+            n_heads=2, n_layers=layers, seed=5,
+        )
+        xs = [np.random.default_rng(10 + i).standard_normal(d_in).astype(np.float32)
+              for i in range(t_max)]
+        dur = SECOND // 30
+        data = [Frame.of(x, pts=i * dur, duration=dur) for i, x in enumerate(xs)]
+
+        cache_caps = TensorsSpec.of(
+            TensorSpec(dtype=np.float32, shape=(layers, 2, t_max, d_model)))
+        pos_caps = TensorsSpec.of(TensorSpec(dtype=np.int32, shape=(1,)))
+
+        got = []
+        p = nns.Pipeline()
+        x_src = p.add(DataSrc(name="x", data=data))
+        c_src = p.add(TensorRepoSrc(name="c", slot_index=70, caps=cache_caps))
+        p_src = p.add(TensorRepoSrc(name="p", slot_index=71, caps=pos_caps))
+        mux = p.add(nns.make("tensor_mux", sync_mode="nosync"))
+        filt = p.add(TensorFilter(framework="jax", model=cell))
+        demux = p.add(nns.make("tensor_demux", name="dm"))
+        out = p.add(TensorSink())
+        out.connect("new-data", lambda f: got.append(np.asarray(f.tensor(0))))
+        p.link(x_src, f"{mux.name}.sink_0")
+        p.link(c_src, f"{mux.name}.sink_1")
+        p.link(p_src, f"{mux.name}.sink_2")
+        p.link_chain(mux, filt, demux)
+        p.link("dm.src_0", out)
+        p.link("dm.src_1", p.add(TensorRepoSink(name="cs", slot_index=70)))
+        p.link("dm.src_2", p.add(TensorRepoSink(name="ps", slot_index=71)))
+        try:
+            p.run(timeout=300)
+        finally:
+            GLOBAL_REPO.reset(70)
+            GLOBAL_REPO.reset(71)
+
+        assert len(got) == t_max
+        full = np.asarray(transformer.apply(
+            cell.params, jnp.asarray(np.stack(xs)), causal=True))
+        for i in range(t_max):
+            np.testing.assert_allclose(got[i], full[i], rtol=2e-4, atol=2e-4)
+
+    def test_decode_overflow_saturates_nan(self):
+        import jax
+        import jax.numpy as jnp
+
+        from nnstreamer_tpu.models import transformer
+
+        params = transformer.init_params(jax.random.PRNGKey(0), 8, 2, 1, 16, 4, 3)
+        step = jax.jit(lambda x, c, p: transformer.decode_step(params, x, c, p))
+        cache = transformer.init_decode_cache(1, 8, t_max=2)
+        pos = jnp.zeros((1,), jnp.int32)
+        x = jnp.ones((4,), jnp.float32)
+        y0, cache, pos = step(x, cache, pos)
+        y1, cache, pos = step(x, cache, pos)
+        assert np.isfinite(np.asarray(y0)).all() and np.isfinite(np.asarray(y1)).all()
+        y2, cache, pos = step(x, cache, pos)  # past capacity
+        assert np.isnan(np.asarray(y2)).all()
+
+    def test_decode_rejects_moe(self):
+        import jax
+
+        from nnstreamer_tpu.models import transformer
+
+        params = transformer.init_params(
+            jax.random.PRNGKey(0), 8, 2, 1, 16, 4, 3, moe_experts=2
+        )
+        cache = transformer.init_decode_cache(1, 8, t_max=2)
+        import jax.numpy as jnp
+        with pytest.raises(NotImplementedError, match="MoE"):
+            transformer.decode_step(
+                params, jnp.ones((4,)), cache, jnp.zeros((1,), jnp.int32)
+            )

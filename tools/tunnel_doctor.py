@@ -10,10 +10,11 @@ Round-3 field observations (see .claude/skills/verify/SKILL.md):
 This probe runs each stage in a subprocess with a timeout (a wedged PJRT
 client can't be interrupted in-process) and prints one JSON verdict:
 
-    {"state": "ALIVE|SICK|WEDGED|NO_ACCEL", "init_s": ..,
+    {"state": "ALIVE|SICK|WEDGED|NO_ACCEL|PROBE_ERROR", "init_s": ..,
      "put_150k_ms": .., "dispatch_ms": .., "matmul_ms": ..}
 
-Exit code: 0 ALIVE, 1 SICK, 2 WEDGED/NO_ACCEL.
+Exit code: 0 ALIVE, 1 SICK, 2 WEDGED/NO_ACCEL, 3 PROBE_ERROR (broken
+environment — fix the install, don't pin CPU).
 """
 
 import json
@@ -73,12 +74,13 @@ def main() -> int:
         # config), not a wedged tunnel — don't tell the operator to "pin
         # CPU and keep working" when the fix is the install
         wall = time.time() - t0
+        wedged = wall > timeout * 0.5
         print(json.dumps({
-            "state": "WEDGED" if wall > timeout * 0.5 else "PROBE_ERROR",
+            "state": "WEDGED" if wedged else "PROBE_ERROR",
             "probe_s": round(wall, 1),
             "detail": proc.stderr.strip()[-300:],
         }))
-        return 2
+        return 2 if wedged else 3
     info = json.loads(proc.stdout.strip().splitlines()[-1])
     if info.get("platform") == "cpu":
         info["state"] = "NO_ACCEL"
