@@ -138,6 +138,7 @@ class TestCodegen:
         ("fused_detection.py", "golden=OK"),
         ("parallel_inference.py", "sp-ring: 2 frames"),
         ("cascade_detect_classify.py", "cascade=OK"),
+        ("decode_stream.py", "golden=OK"),
     ],
 )
 def test_pipeline_demo_runs(script, expect):
