@@ -73,8 +73,9 @@ def main():
 
     full = np.asarray(transformer.apply(
         cell.params, jnp.asarray(np.stack(xs)), causal=True))
-    ok = all(np.allclose(got[i], full[i], rtol=2e-4, atol=2e-4)
-             for i in range(t_max))
+    ok = len(got) == t_max and all(
+        np.allclose(got[i], full[i], rtol=2e-4, atol=2e-4)
+        for i in range(t_max))
     for i, y in enumerate(got[:3]):
         print(f"step {i}: y={np.round(y, 3).tolist()}")
     print(f"golden={'OK' if ok else 'MISMATCH'} "

@@ -114,3 +114,51 @@ class TestPipelineGlue:
         g.pipeline_stop(h)
         assert seen == {"a": 1, "b": 1}
         g.pipeline_destroy(h)
+
+
+class TestCapiBuildKey:
+    """The prebuilt-.so stamp keys on source + python ABI + platform +
+    resolved libpython flags: a wheel-shipped foreign binary must rebuild
+    instead of being dlopen'd (capi/__init__.py)."""
+
+    def test_build_key_components(self, monkeypatch):
+        from nnstreamer_tpu.native import capi as capi_mod
+
+        key = capi_mod._build_key()
+        assert key == capi_mod._build_key()  # deterministic per-process
+        import sysconfig
+
+        monkeypatch.setattr(
+            sysconfig, "get_platform", lambda: "foreign-arch-1.0"
+        )
+        assert capi_mod._build_key() != key  # platform is in the key
+
+    def test_stamp_mismatch_forces_rebuild(self, tmp_path, monkeypatch):
+        """A shipped .so whose stamp doesn't match this env's key is
+        rebuilt in place, never dlopen'd (build_capi contract)."""
+        import os
+
+        from nnstreamer_tpu.native import capi as capi_mod
+
+        so = str(tmp_path / "libnnstreamer_tpu_capi.so")
+        stamp = so + ".stamp"
+        monkeypatch.setattr(capi_mod, "_BUILD_DIR", str(tmp_path))
+        monkeypatch.setattr(capi_mod, "_SO", so)
+        monkeypatch.setattr(capi_mod, "_STAMP", stamp)
+
+        built = capi_mod.build_capi()
+        assert built == so and os.path.exists(stamp)
+        first_mtime = os.path.getmtime(so)
+
+        # matching stamp: no rebuild
+        assert capi_mod.build_capi() == so
+        assert os.path.getmtime(so) == first_mtime
+
+        # foreign stamp: must rebuild (mtime moves, stamp restored)
+        with open(stamp, "w") as f:
+            f.write("foreign-key")
+        os.utime(so, (1, 1))
+        capi_mod.build_capi()
+        assert os.path.getmtime(so) != 1
+        with open(stamp) as f:
+            assert f.read().strip() == capi_mod._build_key()
