@@ -220,14 +220,17 @@ class JaxBackend(FilterBackend):
             path = os.fspath(model)
             if path.endswith(".py"):
                 self.model = _load_py_model(path, custom)
-            elif path.endswith(".npz"):
+            elif path.endswith(".npz") or os.path.isdir(path):
+                # .npz (utils.checkpoint format) or an orbax checkpoint
+                # directory — both resolve through load_state + builder
                 self.model = _load_checkpoint_model(
                     path, custom, reserved=self.RESERVED_CUSTOM_KEYS)
             else:
                 raise ValueError(
                     f"jax backend cannot load {path!r}; use a .py model file "
-                    "defining get_model(), a .npz params checkpoint with "
-                    "custom=\"builder=...\", or pass a JaxModel object"
+                    "defining get_model(), a .npz params checkpoint or orbax "
+                    "checkpoint directory with custom=\"builder=...\", or "
+                    "pass a JaxModel object"
                 )
         else:
             raise TypeError(f"unsupported model object: {type(model)}")

@@ -69,7 +69,22 @@ def save_state(state: Dict[str, Any], path: str) -> None:
 
 
 def load_state(path: str) -> Dict[str, Any]:
-    with np.load(path if str(path).endswith(".npz") else f"{path}.npz") as z:
+    """Load a params/state pytree: the native ``.npz`` format, or an
+    **orbax checkpoint directory** (the JAX ecosystem's standard — users
+    arriving with orbax-trained weights load them straight into the jax
+    backend's ``model=<dir>`` path)."""
+    import os
+
+    p = str(path)
+    npz = p if p.endswith(".npz") else f"{p}.npz"
+    # the native format keeps precedence: load_state("x") has always meant
+    # x.npz — a sibling orbax DIRECTORY named x must not shadow it
+    if not os.path.exists(npz) and os.path.isdir(p):
+        import orbax.checkpoint as ocp
+
+        with ocp.PyTreeCheckpointer() as ckptr:
+            return ckptr.restore(p)
+    with np.load(npz) as z:
         skeleton = json.loads(bytes(z["__skeleton__"].tobytes()).decode())
         arrays = {
             int(k[1:]): z[k] for k in z.files if k != "__skeleton__"

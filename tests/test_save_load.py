@@ -230,3 +230,51 @@ class TestCheckpoint:
         np.testing.assert_array_equal(
             np.asarray(sink2.frames[0].tensor(0)), want
         )
+
+
+class TestOrbaxInterop:
+    """Orbax checkpoint directories (the JAX ecosystem standard) load
+    through the same load_state + jax-backend model=<dir> path as .npz."""
+
+    def _save_orbax(self, tmp_path, tree):
+        ocp = pytest.importorskip("orbax.checkpoint")
+
+        path = str(tmp_path / "ckpt")
+        with ocp.PyTreeCheckpointer() as ckptr:
+            ckptr.save(path, tree)
+        return path
+
+    def test_load_state_from_orbax_dir(self, tmp_path):
+        from nnstreamer_tpu.utils.checkpoint import load_state
+
+        tree = {"w": np.arange(6, dtype=np.float32).reshape(2, 3),
+                "b": np.ones((3,), np.float32)}
+        path = self._save_orbax(tmp_path, tree)
+        got = load_state(path)
+        np.testing.assert_array_equal(np.asarray(got["w"]), tree["w"])
+        np.testing.assert_array_equal(np.asarray(got["b"]), tree["b"])
+
+    def test_jax_backend_opens_orbax_dir(self, tmp_path):
+        """model=<orbax dir> + custom builder runs through SingleShot."""
+        from nnstreamer_tpu.api.single import SingleShot
+
+        tree = {"w": np.arange(12, dtype=np.float32).reshape(4, 3)}
+        path = self._save_orbax(tmp_path, tree)
+        builder = tmp_path / "builder.py"
+        builder.write_text(
+            "import numpy as np\n"
+            "from nnstreamer_tpu.backends.jax_backend import JaxModel\n"
+            "from nnstreamer_tpu.spec import TensorSpec, TensorsSpec\n"
+            "def build(params):\n"
+            "    return JaxModel(\n"
+            "        apply=lambda p, x: x @ p['w'],\n"
+            "        params=params,\n"
+            "        input_spec=TensorsSpec.of(\n"
+            "            TensorSpec(dtype=np.float32, shape=(4,))),\n"
+            "    )\n"
+        )
+        x = np.arange(4, dtype=np.float32)
+        with SingleShot(framework="jax", model=path,
+                        custom=f"builder={builder}:build") as s:
+            (out,) = s.invoke(x)
+        np.testing.assert_allclose(np.asarray(out), x @ tree["w"], rtol=1e-6)
