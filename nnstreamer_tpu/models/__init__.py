@@ -2,4 +2,4 @@
 (BASELINE.md): MobileNet-v2 labeling, SSD-MobileNet boxes, PoseNet
 heatmaps, LSTM recurrence, and batched multi-stream classification."""
 
-from . import lstm, mobilenet_v2, posenet, ssd_mobilenet, transformer  # noqa: F401
+from . import audio_cnn, lstm, mobilenet_v2, posenet, ssd_mobilenet, transformer  # noqa: F401

@@ -1,0 +1,98 @@
+"""1-D conv audio classifier (keyword-spotting shape) for audio streams.
+
+The reference's audio path stops at caps/conversion (``audio/x-raw`` →
+tensors, ``tensor_aggregator`` windowing); its model zoo has no audio
+network.  This closes the loop TPU-natively: an ``audiotestsrc →
+tensor_converter → tensor_aggregator`` window of raw samples feeds a
+small conv stack — frontend (stride-reduce convs standing in for a
+filterbank), residual-free conv trunk, global average pool, linear head.
+
+MXU notes: conv1d lowers to ``conv_general_dilated`` with NWC/WIO layouts;
+all channels ≥ 32 keep the MXU tiles busy; bf16 by default.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..backends.jax_backend import JaxModel
+from ..spec import TensorSpec, TensorsSpec
+from .layers import Params, _normal, dense, dense_init, ensure_batched
+
+
+def _conv1d_init(key, width: int, cin: int, cout: int) -> Params:
+    return {
+        "w": _normal(key, (width, cin, cout), np.sqrt(2.0 / (width * cin))),
+        "b": jnp.zeros((cout,), jnp.float32),
+    }
+
+
+def _conv1d(p: Params, x, stride: int, dtype):
+    y = jax.lax.conv_general_dilated(
+        x.astype(dtype), p["w"].astype(dtype), (stride,), "SAME",
+        dimension_numbers=("NWC", "WIO", "NWC"),
+    )
+    return jax.nn.relu(y + p["b"].astype(dtype))
+
+
+def init_params(
+    key,
+    num_classes: int = 12,
+    channels: Tuple[int, ...] = (32, 64, 64),
+    width: int = 9,
+    in_channels: int = 1,
+) -> Params:
+    keys = iter(jax.random.split(key, len(channels) + 2))
+    convs = []
+    cin = in_channels
+    for cout in channels:
+        convs.append(_conv1d_init(next(keys), width, cin, cout))
+        cin = cout
+    return {
+        "convs": convs,
+        "head": dense_init(next(keys), cin, num_classes),
+    }
+
+
+def apply(params: Params, x, dtype=jnp.bfloat16):
+    """(samples, channels) or (N, samples, channels) int/float audio →
+    (num_classes,) / (N, num_classes) logits."""
+    x, squeezed = ensure_batched(x, 3)
+    y = x.astype(dtype)
+    for p in params["convs"]:
+        y = _conv1d(p, y, stride=4, dtype=dtype)
+    y = y.mean(axis=1)  # global average pool over time
+    out = dense(params["head"], y, dtype=dtype).astype(jnp.float32)
+    return out[0] if squeezed else out
+
+
+def build(
+    num_classes: int = 12,
+    window: int = 16000,
+    in_channels: int = 1,
+    channels: Tuple[int, ...] = (32, 64, 64),
+    dtype=jnp.bfloat16,
+    seed: int = 0,
+    params: Optional[Params] = None,
+    in_dtype=np.float32,
+) -> JaxModel:
+    """Stream-ready audio classifier: one frame = one aggregator window of
+    ``(window, in_channels)`` samples (normalize/typecast upstream — the
+    transform fuses into this program like the vision models)."""
+    if params is None:
+        params = init_params(
+            jax.random.PRNGKey(seed), num_classes, tuple(channels),
+            in_channels=in_channels,
+        )
+    return JaxModel(
+        apply=lambda p, x: apply(p, x, dtype=dtype),
+        params=params,
+        input_spec=TensorsSpec.of(
+            TensorSpec(dtype=np.dtype(in_dtype), shape=(window, in_channels))
+        ),
+        name=f"audio_cnn_{'x'.join(map(str, channels))}",
+    )

@@ -80,7 +80,14 @@ def load_state(path: str) -> Dict[str, Any]:
     # the native format keeps precedence: load_state("x") has always meant
     # x.npz — a sibling orbax DIRECTORY named x must not shadow it
     if not os.path.exists(npz) and os.path.isdir(p):
-        import orbax.checkpoint as ocp
+        try:
+            import orbax.checkpoint as ocp
+        except ImportError as exc:
+            raise ImportError(
+                f"{p!r} looks like an orbax checkpoint directory, but "
+                "orbax-checkpoint is not installed — pip install "
+                "nnstreamer-tpu[checkpoints]"
+            ) from exc
 
         with ocp.PyTreeCheckpointer() as ckptr:
             return ckptr.restore(p)
