@@ -1,0 +1,55 @@
+"""Audio classification from the raw audio surface.
+
+audiotestsrc (S16LE sine) → tensor_converter → tensor_transform
+(normalize; fused into the model's XLA program) → tensor_aggregator
+(512-sample windows, `frames_dim=1` = stack steps into rows) →
+tensor_filter (1-D conv classifier, `models/audio_cnn`) → sink.
+
+The printed logits are pinned against running the model directly on the
+same aggregated window (independent golden).
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+import nnstreamer_tpu as nns
+from nnstreamer_tpu.models import audio_cnn
+
+
+def main():
+    import jax.numpy as jnp
+
+    window, spb = 512, 128
+    model = audio_cnn.build(num_classes=3, window=window, channels=(8, 8),
+                            dtype=jnp.float32)
+    got = []
+    p = nns.parse_launch(
+        "audiotestsrc name=a num-buffers=8 samplesperbuffer=128 rate=16000 "
+        "freq=440 ! tensor_converter ! "
+        "tensor_transform mode=arithmetic option=typecast:float32,div:32768.0 ! "
+        "tensor_aggregator frames-out=4 frames-dim=1 ! "
+        "tensor_filter framework=jax name=f ! tensor_sink name=out"
+    )
+    p["f"].model = model
+    p["out"].connect("new-data", lambda fr: got.append(np.asarray(fr.tensor(0))))
+    p.run(timeout=120)
+
+    from nnstreamer_tpu.elements.testsrc import AudioTestSrc
+
+    src = AudioTestSrc(num_buffers=8, samplesperbuffer=spb, rate=16000, freq=440)
+    samples = np.concatenate([f.tensor(0) for f in src.frames()], axis=0)
+    w0 = samples[:window].astype(np.float32) / 32768.0
+    ref = np.asarray(audio_cnn.apply(model.params, jnp.asarray(w0),
+                                     dtype=jnp.float32))
+    ok = len(got) == 2 and np.allclose(got[0], ref, rtol=1e-4, atol=1e-5)
+    for i, y in enumerate(got):
+        print(f"window {i}: logits={np.round(y, 4).tolist()}")
+    print(f"golden={'OK' if ok else 'MISMATCH'}")
+
+
+if __name__ == "__main__":
+    main()

@@ -27,7 +27,7 @@ from .layers import Params, _normal, dense, dense_init, ensure_batched
 def _conv1d_init(key, width: int, cin: int, cout: int) -> Params:
     return {
         "w": _normal(key, (width, cin, cout), np.sqrt(2.0 / (width * cin))),
-        "b": jnp.zeros((cout,), jnp.float32),
+        "b": jnp.asarray(np.zeros((cout,), np.float32)),
     }
 
 

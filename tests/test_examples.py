@@ -139,6 +139,7 @@ class TestCodegen:
         ("parallel_inference.py", "sp-ring: 2 frames"),
         ("cascade_detect_classify.py", "cascade=OK"),
         ("decode_stream.py", "golden=OK"),
+        ("audio_classify.py", "golden=OK"),
     ],
 )
 def test_pipeline_demo_runs(script, expect):
