@@ -686,6 +686,24 @@ def write_notes(results, platform, errors):
         "",
         f"- date: {time.strftime('%Y-%m-%d %H:%M:%S')}",
         f"- jax platform: **{platform or 'unavailable (CPU fallback)'}**",
+    ]
+    if platform in (None, "cpu"):
+        note = (
+            "- **READ THIS FIRST**: no accelerator was reachable for this "
+            "run (the axon tunnel's relay can die with its orchestrator "
+            "pipe — see the verify skill notes), so every number below is "
+            "the JAX-CPU path on the same host as the tflite baselines: "
+            "`vs_baseline` ratios compare two CPU stacks and say nothing "
+            "about TPU performance."
+        )
+        if "last_accelerator_run" in results:
+            note += (
+                "  The most recent REAL-chip evidence is carried in the "
+                "`last_accelerator_run` rows below (timestamped; produced "
+                "by this same bench on a live accelerator)."
+            )
+        lines.append(note)
+    lines += [
         f"- host CPUs: {multiprocessing.cpu_count()}",
         "- metric: frames/sec/chip through the tensor_filter invoke path",
         "- CPU baselines run in **isolated subprocesses** (no TPU runtime "
