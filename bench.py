@@ -50,7 +50,7 @@ print(jax.devices()[0].platform)
 """
 
 
-def probe_accelerator():
+def probe_accelerator(retries=None):
     """Run a tiny matmul in a subprocess; returns the platform string
     ('tpu'/'axon'/'cpu') or None if the backend hangs or errors.
 
@@ -59,8 +59,13 @@ def probe_accelerator():
     BENCH_PROBE_RETRIES attempts with a pause between them ride out a
     briefly-sick tunnel (seen round 3: wedges can last minutes to hours).
     """
-    retries = max(1, int(os.environ.get("BENCH_PROBE_RETRIES", "3")))
+    if retries is None:
+        retries = int(os.environ.get("BENCH_PROBE_RETRIES", "3"))
+    retries = max(1, retries)
     pause = float(os.environ.get("BENCH_PROBE_PAUSE_S", "30"))
+    # pin_cpu() exports JAX_PLATFORMS=cpu into OUR environ; the probe child
+    # must not inherit it or a post-pin re-probe can only ever see 'cpu'
+    env = {k: v for k, v in os.environ.items() if k != "JAX_PLATFORMS"}
     for attempt in range(1, retries + 1):
         try:
             out = subprocess.run(
@@ -68,6 +73,7 @@ def probe_accelerator():
                 capture_output=True,
                 text=True,
                 timeout=PROBE_TIMEOUT,
+                env=env,
             )
             if out.returncode == 0 and out.stdout.strip():
                 return out.stdout.strip().splitlines()[-1]
@@ -728,14 +734,31 @@ def write_notes(results, platform, errors):
         "so throughput numbers are only comparable against a similar "
         "`put_150k_ms`.  Healthy ≈ 0.3-1 ms; sick ≈ 15-30 ms.",
         "",
-        "| measurement | value |",
-        "|---|---|",
+        "| measurement | value | measured on |",
+        "|---|---|---|",
     ]
     flat = []
     for k, v in results.items():
         _flat_items(k, v, flat)
+
+    def stamp(key: str) -> str:
+        """Platform provenance per row (r3 verdict weak #4: a CPU artifact
+        number must never be mistakable for a chip result)."""
+        if key.startswith("baselines."):
+            return "cpu (isolated subprocess)"
+        if key.startswith("last_accelerator_run."):
+            cached = (results.get("last_accelerator_run") or {})
+            return f"{cached.get('platform') or 'accel'} (cached)"
+        if key.startswith("cpu_fallback_run."):
+            return "cpu-fallback"
+        if key == "tflite_cpu_fps":  # copied from baselines.config1
+            return "cpu (isolated subprocess)"
+        if key.startswith("vs_baseline_per_config."):
+            return f"{platform or 'cpu-fallback'} / cpu"
+        return platform or "cpu-fallback"
+
     for k, v in flat:
-        lines.append(f"| {k} | {v} |")
+        lines.append(f"| {k} | {v} | {stamp(k)} |")
     if errors:
         lines += ["", "## Errors", ""]
         lines += [f"- `{e}`" for e in errors]
@@ -1042,11 +1065,17 @@ def main():
         log(f"# mfu: {results['mfu']}")
     except Exception as exc:
         errors.append(f"mfu: {exc!r}"[:400])
-    try:
-        results["pallas"] = measure_pallas()
-        log(f"# pallas: {results['pallas']}")
-    except Exception as exc:
-        errors.append(f"pallas: {exc!r}"[:400])
+    if on_accel:
+        try:
+            results["pallas"] = measure_pallas()
+            log(f"# pallas: {results['pallas']}")
+        except Exception as exc:
+            errors.append(f"pallas: {exc!r}"[:400])
+    else:
+        # CPU-interpreter Pallas numbers are noise (r3: 22x "slowdown", 7x
+        # "autotune win" — both artifacts); skip rather than report them
+        results["pallas"] = {"skipped": "pallas/autotune legs run on the "
+                                        "accelerator only (r3 verdict weak #4)"}
     if on_accel:
         try:
             results["wire_health_end"] = measure_wire_health()
@@ -1057,8 +1086,8 @@ def main():
     # -- CPU baselines: the reference stack, isolated subprocesses ---------
     baselines = {}
     if os.environ.get("BENCH_SKIP_BASELINES", "") != "1":
-        for which in ("config1", "config1_quant", "config2", "config3",
-                      "config4", "config4b", "config5"):
+        for which in ("config1", "config1_quant", "config2", "config2c",
+                      "config3", "config4", "config4b", "config5"):
             if over_budget(f"baseline {which}"):
                 continue
             try:
@@ -1070,6 +1099,51 @@ def main():
             except Exception as exc:
                 errors.append(f"baseline {which}: {exc!r}"[:300])
     results["baselines"] = baselines
+
+    # -- late re-probe: round 3 lost every accel number because one failed
+    #    probe pinned the WHOLE session to CPU.  If the tunnel came back
+    #    while the CPU legs + baselines ran (~20 min), grab it now: re-run
+    #    the accel legs in a fresh subprocess (this process is already
+    #    pinned) and adopt its numbers, keeping our baselines.
+    if platform in (None, "cpu") and os.environ.get("BENCH_NO_RETRY") != "1":
+        late = probe_accelerator(retries=1)
+        if late not in (None, "cpu"):
+            log("# accelerator reachable again — re-running accel legs")
+            try:
+                env = {k: v for k, v in os.environ.items()
+                       if k != "JAX_PLATFORMS"}  # don't inherit the CPU pin
+                env.update(BENCH_NO_RETRY="1", BENCH_SKIP_BASELINES="1",
+                           BENCH_PROBE_RETRIES="1")
+                proc = subprocess.run(
+                    [sys.executable, os.path.abspath(__file__)],
+                    capture_output=True, text=True, timeout=3600, env=env,
+                )
+                child = json.loads(proc.stdout.strip().splitlines()[-1])
+                if child.get("platform") not in (None, "cpu", "cpu-fallback"):
+                    child_extra = child.get("extra") or {}
+                    child_extra["baselines"] = baselines
+                    # snapshot of the fallback run, minus its baselines copy
+                    # (those rows are already present with the right stamp)
+                    child_extra["cpu_fallback_run"] = {
+                        k: v for k, v in results.items() if k != "baselines"
+                    }
+                    results, tpu_fps = child_extra, None
+                    platform, on_accel = child["platform"], True
+                    errors = [
+                        e for e in errors
+                        if not e.startswith("accelerator backend failed")
+                    ]
+                    if child.get("error"):
+                        errors.append(
+                            f"late-accel rerun: {child['error']}"[:400])
+                else:
+                    errors.append(
+                        "late-accel rerun attempted but the child also fell "
+                        f"back (platform={child.get('platform')}); keeping "
+                        "the CPU numbers"
+                    )
+            except Exception as exc:
+                errors.append(f"late accel rerun failed: {exc!r}"[:300])
 
     # -- vs_baseline per config --------------------------------------------
     def ratio(tpu_key, base_key, base_field="fps"):
@@ -1084,6 +1158,7 @@ def main():
         "config1": ratio("config1_stream_fps", "config1"),
         "config1_quant": ratio("config1_quant_fps", "config1_quant"),
         "config2": ratio("config2_ssd_fps", "config2"),
+        "config2c": ratio("config2c_cascade_fps", "config2c"),
         "config3": ratio("config3_pose_fps", "config3"),
         "config4": ratio("config4_lstm_steps_per_sec", "config4",
                          "steps_per_sec"),
@@ -1096,7 +1171,30 @@ def main():
         if (baselines.get("config1") or {}).get("ok") else None
     if cpu_fps:
         results["tflite_cpu_fps"] = round(cpu_fps, 2)
+
+    # Headline = the best config1 variant (plain stream / upload-overlap /
+    # dynbatch).  All three are the SAME streaming pipeline + semantics —
+    # upload overlaps the h2d transfer with dispatch, dynbatch coalesces a
+    # pile-up adaptively; the reference pipelines the same way with queues
+    # (r3 verdict #2: "drive the benched config through upload+dynbatch").
+    variants = {
+        "stream": results.get("config1_stream_fps"),
+        "upload": results.get("config1_upload_fps"),
+        "dynbatch": results.get("config1_dynbatch_fps"),
+    }
+    best_variant, best_fps = None, None
+    for name, v in variants.items():
+        if v is not None and (best_fps is None or v > best_fps):
+            best_variant, best_fps = name, v
     vs_baseline = vs["config1"]
+    if best_fps is not None:
+        tpu_fps = best_fps
+        results["headline_variant"] = best_variant
+        if cpu_fps:
+            # keep vs['config1'] the matched stream-vs-stream ratio; the
+            # best-of-variants headline gets its own labeled key
+            vs["config1_best"] = round(best_fps / cpu_fps, 2)
+            vs_baseline = vs["config1_best"]
 
     if platform in (None, "cpu"):
         cached = load_tpu_cache()
@@ -1116,9 +1214,14 @@ def main():
     except Exception as exc:
         errors.append(f"notes: {exc!r}"[:200])
 
+    results["measured_on"] = platform or "cpu-fallback"
+    variant_note = (
+        f", best variant: {results['headline_variant']}"
+        if results.get("headline_variant") else ""
+    )
     out = {
         "metric": "mobilenet_v2_224 image-labeling pipeline throughput "
-                  "(tensor_filter invoke, batch=1 streaming)",
+                  f"(tensor_filter invoke, streaming{variant_note})",
         "value": round(tpu_fps, 2) if tpu_fps else None,
         "unit": "frames/sec/chip",
         "vs_baseline": vs_baseline,

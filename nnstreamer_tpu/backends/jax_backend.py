@@ -469,7 +469,9 @@ class JaxBackend(FilterBackend):
                 tuple(t.shape) for t in self._in_spec.tensors
             )
             xs = tuple(t.data if isinstance(t, WireTensor) else t for t in tensors)
-            if all(tuple(x.shape) == tuple(w) for x, w in zip(xs, expected)):
+            if len(xs) == len(expected) and all(
+                tuple(x.shape) == tuple(w) for x, w in zip(xs, expected)
+            ):
                 out = (
                     self._flat_compiled(*xs)
                     if self._flat_compiled is not None
@@ -477,9 +479,9 @@ class JaxBackend(FilterBackend):
                 )
             else:
                 return self.invoke(tuple(np.asarray(t) for t in tensors))
-        elif self._flat_compiled is not None and not any(
-            isinstance(t, jax.Array) for t in tensors
-        ):
+        elif self._flat_compiled is not None and len(tensors) == len(
+            self._wire_shapes
+        ) and not any(isinstance(t, jax.Array) for t in tensors):
             # host frames cross the wire flat (1-D view — no copy for
             # C-contiguous arrays) and reshape on device; device-resident
             # frames take the shaped entry untouched

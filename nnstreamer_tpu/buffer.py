@@ -100,6 +100,14 @@ class WireTensor:
         self.dtype = np.dtype(dtype)
 
     def __array__(self, dtype=None, copy=None):
+        if copy is False:
+            # numpy-2 semantics: materializing the wire layout ALWAYS
+            # device-to-host copies; honoring copy=False by copying anyway
+            # would mask an unintended d2h on a believed-zero-copy path
+            raise ValueError(
+                "WireTensor cannot be materialized without a copy "
+                "(device-resident wire layout)"
+            )
         arr = np.asarray(self.data).reshape(self.shape)
         if dtype is not None and np.dtype(dtype) != arr.dtype:
             return arr.astype(dtype)

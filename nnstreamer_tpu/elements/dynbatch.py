@@ -116,6 +116,7 @@ class DynBatch(Node):
                 "n": n,
                 "pts": [f.pts for f in frames],
                 "duration": [f.duration for f in frames],
+                "meta": [f.meta for f in frames],
             }
         }
         self.frames_in += n
@@ -215,11 +216,12 @@ class DynUnbatch(Node):
         # one host materialization per batched tensor (numpy row views after)
         mats = [np.asarray(t) for t in frame.tensors]
         out = []
+        metas = info.get("meta") if info else None
         for i in range(n):
             pts = info["pts"][i] if info else frame.pts
             dur = info["duration"][i] if info else frame.duration
             out.append(Frame(
                 tensors=tuple(m[i] for m in mats), pts=pts, duration=dur,
-                meta={},
+                meta=metas[i] if metas else {},
             ))
         return out

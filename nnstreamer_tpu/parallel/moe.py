@@ -84,11 +84,15 @@ def moe_ffn(
     expert = jnp.argmax(probs, axis=-1)  # (t,)
     gate_w = jnp.max(probs, axis=-1)  # (t,)
 
-    onehot = jax.nn.one_hot(expert, e, dtype=dtype)  # (t, e)
+    # Routing bookkeeping stays in int32 regardless of the compute dtype:
+    # in bf16 a cumsum above 256 rounds, colliding tokens in capacity slots
+    # and silently corrupting dispatch/combine (advisor r3, medium).
+    onehot_i = jax.nn.one_hot(expert, e, dtype=jnp.int32)  # (t, e)
     # position of each token within its expert's capacity buffer
-    pos = (jnp.cumsum(onehot, axis=0) - onehot) * onehot  # (t, e)
-    pos_idx = jnp.sum(pos, axis=-1).astype(jnp.int32)  # (t,)
+    pos = (jnp.cumsum(onehot_i, axis=0) - onehot_i) * onehot_i  # (t, e)
+    pos_idx = jnp.sum(pos, axis=-1)  # (t,) int32
     keep = (pos_idx < cap).astype(dtype)  # overflow tokens drop
+    onehot = onehot_i.astype(dtype)
     pos_onehot = jax.nn.one_hot(pos_idx, cap, dtype=dtype)  # (t, cap)
     # dispatch mask (t, e, cap): token t → slot (expert, position)
     dispatch = onehot[:, :, None] * pos_onehot[:, None, :] * keep[:, None, None]

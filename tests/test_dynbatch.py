@@ -104,6 +104,36 @@ class TestDynBatchPipeline:
         assert [int(np.asarray(f.tensor(0))[0]) // 2 for f in got] == list(range(23))
         assert all(b in (1, 2, 4) for b in be.batch_sizes)
 
+    def test_per_frame_meta_survives_batching(self):
+        """Upstream per-frame meta must ride across the dynbatch segment
+        (advisor r3 low: only pts/duration were carried; meta was dropped).
+        Exercise _emit_batch → DynUnbatch directly with distinct meta."""
+        dyn = DynBatch(max_batch=4)
+        spec = TensorsSpec(tensors=(TensorSpec(np.float32, (4,)),))
+        dyn.configure({"sink": spec})
+        frames = [
+            Frame.of(np.full((4,), i, np.float32), pts=i,
+                     stream_id=i, tag=f"f{i}")
+            for i in range(3)
+        ]
+        emitted = []
+        dyn.push = emitted.append  # capture the emitted frame, no graph
+        dyn._emit_batch(frames)
+        assert len(emitted) == 1
+        batched = emitted[0]
+        assert batched.meta["dynbatch"]["meta"] == [f.meta for f in frames]
+
+        unb = DynUnbatch()
+        unb.configure({"sink": TensorsSpec(
+            tensors=(TensorSpec(np.float32, (None, 4)),))})
+        out = unb.process(None, batched)
+        assert [f.meta for f in out] == [
+            {"stream_id": 0, "tag": "f0"},
+            {"stream_id": 1, "tag": "f1"},
+            {"stream_id": 2, "tag": "f2"},
+        ]
+        assert [f.pts for f in out] == [0, 1, 2]
+
     def test_unblocked_stream_is_batch1_and_exact(self):
         """Fast consumer: results identical, each frame exact."""
         be = BlockingDouble()

@@ -31,12 +31,61 @@ class TestWireTensor:
         assert wt.dtype == np.float32
         np.testing.assert_array_equal(np.asarray(wt), arr)
 
+    def test_asarray_copy_false_raises(self):
+        """numpy-2 ``copy=False`` semantics: materializing the wire layout
+        always d2h-copies, so it must raise instead of silently copying
+        (advisor r3 low — masks an unintended transfer)."""
+        arr = np.arange(6, dtype=np.float32).reshape(2, 3)
+        wt = WireTensor(jax.device_put(arr.reshape(-1)), arr.shape, arr.dtype)
+        with pytest.raises(ValueError, match="copy"):
+            wt.__array__(copy=False)
+        # copy=None / copy=True still materialize
+        np.testing.assert_array_equal(wt.__array__(copy=True), arr)
+
     def test_spec_derivation_sees_logical_geometry(self):
         arr = np.zeros((4, 5), np.int16)
         wt = WireTensor(jax.device_put(arr.reshape(-1)), arr.shape, arr.dtype)
         spec = TensorsSpec.from_arrays((wt,))
         assert spec.tensors[0].shape == (4, 5)
         assert spec.tensors[0].dtype == np.int16
+
+
+class TestWireArityGuard:
+    def test_arity_mismatch_skips_flat_fast_path(self):
+        """Fewer WireTensors than the wire expects must NOT dispatch the
+        flat entry (advisor r3 low: zip() truncated the shape guard, so an
+        arity mismatch passed and failed later inside XLA instead of taking
+        the documented host-materialize fallback)."""
+        from nnstreamer_tpu.backends.jax_backend import JaxBackend
+
+        model = JaxModel(
+            apply=lambda p, a, b: a + b,
+            params=None,
+            input_spec=TensorsSpec.of(
+                TensorSpec(dtype=np.float32, shape=(2, 3)),
+                TensorSpec(dtype=np.float32, shape=(2, 3)),
+            ),
+        )
+        be = JaxBackend()
+        be.open(model)
+        be.reconfigure(model.input_spec)
+        a = np.ones((2, 3), np.float32)
+        ok = be.invoke((
+            WireTensor(jax.device_put(a.reshape(-1)), a.shape, a.dtype),
+            WireTensor(jax.device_put(a.reshape(-1)), a.shape, a.dtype),
+        ))
+        np.testing.assert_allclose(np.asarray(ok[0]), 2.0)
+
+        flat_calls = []
+        orig = be._flat_compiled
+        if orig is not None:
+            be._flat_compiled = lambda *xs: flat_calls.append(len(xs)) or orig(*xs)
+        with pytest.raises(Exception):
+            be.invoke((
+                WireTensor(jax.device_put(a.reshape(-1)), a.shape, a.dtype),
+            ))
+        # the flat entry was never dispatched with the wrong arity
+        assert all(n == 2 for n in flat_calls)
 
 
 class TestUploadElement:

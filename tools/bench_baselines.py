@@ -7,7 +7,8 @@ threads — the round-2 advisor flagged an unexplained 132→13.7 fps baseline
 swing; isolation + pinned threads + recorded env is the fix) and prints
 exactly one JSON line.
 
-Usage: python tools/bench_baselines.py {config1|config1_quant|config2|config3|config4|config5}
+Usage: python tools/bench_baselines.py
+       {config1|config1_quant|config2|config2c|config3|config4|config4b|config5}
 
 Models for configs 2/3/4 are the *exact same jax models* the TPU legs run,
 converted with ``tf.lite.TFLiteConverter.experimental_from_jax`` — matched
@@ -172,6 +173,86 @@ def config3():
     return {"fps": fps, "frames": n, "model": "jax posenet → tflite"}
 
 
+def config2c():
+    """Detect→crop→classify cascade, the reference way: tflite SSD →
+    host box decode (numpy) → host crop+resize (tf.image, the C++
+    videocrop/videoscale analog) → second tflite classifier batched over
+    the K crops.  Same models/weights as bench.py's fused one-program
+    config2c leg (models/cascade.py), every stage a host round trip —
+    exactly the multi-element topology under
+    ``tests/nnstreamer_decoder_boundingbox/`` in the reference."""
+    import jax.numpy as jnp
+    tf = _tf()
+
+    from nnstreamer_tpu.models import mobilenet_v2, ssd_mobilenet
+
+    k, crop_size, det_size = 16, 96, 300
+    rng = np.random.default_rng(0)
+    det = ssd_mobilenet.build(num_labels=91, image_size=det_size,
+                              dtype=jnp.float32)
+    x_det = rng.standard_normal((1, det_size, det_size, 3)).astype(np.float32)
+    det_blob = tflite_from_jax(det.fn(), [x_det])
+
+    cls = mobilenet_v2.build(num_classes=1001, image_size=crop_size,
+                             batch=k, dtype=jnp.float32)
+    x_cls = rng.standard_normal((k, crop_size, crop_size, 3)).astype(np.float32)
+    cls_blob = tflite_from_jax(cls.fn(), [x_cls])
+
+    priors = ssd_mobilenet.generate_priors(det_size).T.astype(np.float32)
+
+    def decode_topk_np(boxes, scores):
+        s = 1.0 / (1.0 + np.exp(-scores[:, 1:].astype(np.float32)))
+        best = s.max(axis=-1)
+        top_i = np.argpartition(-best, k)[:k]
+        top_i = top_i[np.argsort(-best[top_i])]
+        loc, pri = boxes[top_i], priors[top_i]  # (k,4); pri: yc/xc/h/w
+        yc = loc[:, 0] / 10.0 * pri[:, 2] + pri[:, 0]
+        xc = loc[:, 1] / 10.0 * pri[:, 3] + pri[:, 1]
+        h = np.exp(loc[:, 2] / 5.0) * pri[:, 2]
+        w = np.exp(loc[:, 3] / 5.0) * pri[:, 3]
+        return np.stack([xc - w / 2, yc - h / 2, w, h], axis=-1)
+
+    def make_interp(blob):
+        interp = tf.lite.Interpreter(model_content=blob,
+                                     num_threads=N_THREADS)
+        interp.allocate_tensors()
+        return interp
+
+    det_i, cls_i = make_interp(det_blob), make_interp(cls_blob)
+    d_in = det_i.get_input_details()[0]["index"]
+    d_out = [o["index"] for o in det_i.get_output_details()]
+    c_in = cls_i.get_input_details()[0]["index"]
+
+    img = rng.integers(0, 256, (det_size, det_size, 3)).astype(np.uint8)
+    n = max(20, N_FRAMES // 10)
+
+    def one_frame():
+        xf = (img.astype(np.float32) - 127.5) / 127.5
+        det_i.set_tensor(d_in, xf[None])
+        det_i.invoke()
+        o0 = det_i.get_tensor(d_out[0])[0]
+        o1 = det_i.get_tensor(d_out[1])[0]
+        boxes, scores = (o0, o1) if o0.shape[-1] == 4 else (o1, o0)
+        xywh = decode_topk_np(boxes, scores)
+        # x/y/w/h → normalized y1,x1,y2,x2 for crop_and_resize
+        y1, x1 = xywh[:, 1], xywh[:, 0]
+        bx = np.stack([y1, x1, y1 + xywh[:, 3], x1 + xywh[:, 2]], axis=-1)
+        crops = tf.image.crop_and_resize(
+            xf[None], np.clip(bx, 0.0, 1.0), np.zeros(k, np.int32),
+            (crop_size, crop_size),
+        ).numpy()
+        cls_i.set_tensor(c_in, crops)
+        cls_i.invoke()
+
+    one_frame()  # warmup
+    t0 = time.perf_counter()
+    for _ in range(n):
+        one_frame()
+    fps = n / (time.perf_counter() - t0)
+    return {"fps": fps, "frames": n, "k": k,
+            "model": "tflite ssd + host decode/crop + tflite classifier"}
+
+
 def config4():
     """The repo-slot LSTM recurrence with the cell on tflite-CPU — identical
     topology to bench.run_lstm_recurrence_fps, backend swapped."""
@@ -239,6 +320,8 @@ def main():
             out = config1(quantize=True)
         elif which == "config2":
             out = config2()
+        elif which == "config2c":
+            out = config2c()
         elif which == "config3":
             out = config3()
         elif which == "config4":
