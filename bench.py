@@ -208,12 +208,18 @@ def run_pipeline_fps(framework, model, frames, warmup=3, normalize=True,
     return run(len(frames))
 
 
-def run_dynbatch_fps(frames, max_batch=8):
+def run_dynbatch_fps(frames, max_batch=8, upload=False):
     """Config #1d: adaptive micro-batching on ONE stream — datasrc →
     tensor_dynbatch → jax filter (polymorphic batch, normalize fused in
     the model fn) → tensor_dynunbatch → sink.  Frames that pile up behind
     the device coalesce into bucketed batched invokes; transfer+dispatch
     amortize over the pile-up automatically.
+
+    With ``upload=True`` (config #1du) a tensor_upload+queue pair sits
+    between dynbatch and the filter: the coalesced batch crosses the wire
+    in the dynbatch worker thread while the queue worker dispatches the
+    PREVIOUS batch — transfer/dispatch overlap on top of amortization,
+    the full stack of the streaming machinery.
 
     EVERY bucket executable is pre-compiled into the backend's LRU cache
     and the warm backend is injected into the filter — which pile-ups
@@ -260,10 +266,18 @@ def run_dynbatch_fps(frames, max_batch=8):
     p = Pipeline()
     src = p.add(DataSrc(data=frames))
     dyn = p.add(DynBatch(max_batch=max_batch))
+    chain = [src, dyn]
+    if upload:
+        from nnstreamer_tpu.elements.queue import Queue
+        from nnstreamer_tpu.elements.upload import TensorUpload
+
+        chain.append(p.add(TensorUpload()))
+        chain.append(p.add(Queue(max_size_buffers=8)))
     filt = p.add(TensorFilter(framework="jax", backend=backend))
     unb = p.add(DynUnbatch())
     sink = p.add(TensorSink(callback=cb))
-    p.link_chain(src, dyn, filt, unb, sink)
+    chain += [filt, unb, sink]
+    p.link_chain(*chain)
     p.run(timeout=600)
     state["batches"] = dyn.batches_emitted
     if state["first"] is None or state["count"] < 2:
@@ -275,9 +289,14 @@ def run_dynbatch_fps(frames, max_batch=8):
 
 
 def run_mux_batched_fps(model, n_streams, frames_per_stream, image_u8,
-                        framework="jax", custom="", accel=True):
+                        framework="jax", custom="", accel=True,
+                        upload=False):
     """Config #5: src×N → mux → batch → filter → unbatch → demux →
-    sink×N.  Throughput counted in *frames* (N per batched invoke)."""
+    sink×N.  Throughput counted in *frames* (N per batched invoke).
+    ``upload=True`` inserts tensor_upload+queue after the (fused-away)
+    normalize so the batched wire transfer overlaps the previous round's
+    dispatch — without it the mux worker pays transfer+dispatch serially
+    per round, which is what lost config5 on chip in round 2."""
     from nnstreamer_tpu import Pipeline
     from nnstreamer_tpu.elements.batch import TensorBatch, TensorUnbatch
     from nnstreamer_tpu.elements.demux import TensorDemux
@@ -306,10 +325,17 @@ def run_mux_batched_fps(model, n_streams, frames_per_stream, image_u8,
         batch = p.add(TensorBatch())
         norm = p.add(TensorTransform(mode="arithmetic", option=NORMALIZE,
                                      acceleration=accel))
+        mids = [batch, norm]
+        if upload:
+            from nnstreamer_tpu.elements.queue import Queue
+            from nnstreamer_tpu.elements.upload import TensorUpload
+
+            mids.append(p.add(TensorUpload()))
+            mids.append(p.add(Queue(max_size_buffers=8)))
         filt = p.add(TensorFilter(framework=framework, model=model, custom=custom))
         unbatch = p.add(TensorUnbatch())
         demux = p.add(TensorDemux())
-        p.link_chain(mux, batch, norm, filt, unbatch, demux)
+        p.link_chain(mux, *mids, filt, unbatch, demux)
         for i in range(n_streams):
             sink = p.add(TensorSink(callback=sink_cb, name=f"out{i}"))
             p.link(f"{demux.name}.src_{i}", sink)
@@ -386,6 +412,76 @@ def run_lstm_recurrence_fps(steps, hidden=64, framework="jax", model=None,
         GLOBAL_REPO.reset(91)
         if state["first"] is None or state["count"] < 2:
             raise RuntimeError(f"lstm pipeline delivered {state['count']} steps")
+        return (state["count"] - 1) / (time.perf_counter() - state["first"])
+
+    run(3)  # compile
+    return run(steps)
+
+
+def run_kvdecode_fps(steps, t_max=128, d_model=256, n_layers=2):
+    """Config #4c: transformer KV-cache decode cell through repo slots
+    (models/transformer.py decode_step — the transformer-era analog of the
+    reference's repo-LSTM, ``tests/nnstreamer_repo_lstm/runTest.sh:10-22``).
+    The (L, 2, T_max, d) cache rides a repo slot as a device-resident jax
+    Array — only the (n_out,) output row ever needs the host — so steps/sec
+    measures the dispatch-bound recurrence with state kept on device
+    (r3 verdict 'next' #9)."""
+    import nnstreamer_tpu as nns
+    from nnstreamer_tpu.buffer import SECOND, Frame
+    from nnstreamer_tpu.elements.filter import TensorFilter
+    from nnstreamer_tpu.elements.repo import GLOBAL_REPO, TensorRepoSink, TensorRepoSrc
+    from nnstreamer_tpu.elements.sink import TensorSink
+    from nnstreamer_tpu.elements.testsrc import DataSrc
+    from nnstreamer_tpu.models import transformer
+    from nnstreamer_tpu.spec import TensorSpec, TensorsSpec
+
+    d_in, n_out = 64, 16
+    model = transformer.build_decode_cell(
+        t_max=t_max, d_in=d_in, n_out=n_out, d_model=d_model,
+        n_heads=8, n_layers=n_layers,
+    )
+    cache_spec = TensorsSpec(tensors=(
+        TensorSpec(dtype=np.float32, shape=(n_layers, 2, t_max, d_model)),))
+    pos_spec = TensorsSpec(tensors=(TensorSpec(dtype=np.int32, shape=(1,)),))
+    dur = SECOND // 30
+
+    def run(n):
+        data = [
+            Frame.of(np.full((d_in,), 0.01 * i, np.float32), pts=i * dur,
+                     duration=dur)
+            for i in range(n)
+        ]
+        state = {"first": None, "count": 0}
+
+        def cb(frame):
+            state["count"] += 1
+            if state["first"] is None:
+                state["first"] = time.perf_counter()
+
+        p = nns.Pipeline()
+        x_src = p.add(DataSrc(name="x", data=data))
+        cache_src = p.add(TensorRepoSrc(name="kv", slot_index=92,
+                                        caps=cache_spec))
+        pos_src = p.add(TensorRepoSrc(name="pos", slot_index=93,
+                                      caps=pos_spec))
+        mux = p.add(nns.make("tensor_mux", sync_mode="nosync"))
+        filt = p.add(TensorFilter(framework="jax", model=model))
+        demux = p.add(nns.make("tensor_demux"))
+        out = p.add(TensorSink(callback=cb))
+        p.link(x_src, f"{mux.name}.sink_0")
+        p.link(cache_src, f"{mux.name}.sink_1")
+        p.link(pos_src, f"{mux.name}.sink_2")
+        p.link_chain(mux, filt, demux)
+        p.link(f"{demux.name}.src_0", out)
+        p.link(f"{demux.name}.src_1",
+               p.add(TensorRepoSink(name="kvs", slot_index=92)))
+        p.link(f"{demux.name}.src_2",
+               p.add(TensorRepoSink(name="poss", slot_index=93)))
+        p.run(timeout=600)
+        GLOBAL_REPO.reset(92)
+        GLOBAL_REPO.reset(93)
+        if state["first"] is None or state["count"] < 2:
+            raise RuntimeError(f"kv-decode pipeline delivered {state['count']} steps")
         return (state["count"] - 1) / (time.perf_counter() - state["first"])
 
     run(3)  # compile
@@ -728,6 +824,19 @@ def write_notes(results, platform, errors):
         "latency-per-step.  The TPU-native recurrence for throughput is "
         "config4b (tensor_aggregator windows → one lax.scan program), "
         "where the comparison reverses by an order of magnitude.",
+        "- **MFU target & ceiling** (r3 verdict 'next' #5): MobileNet-v2 at "
+        "224² is ~0.6 GFLOP/frame — a *small* model, so streaming MFU is "
+        "bounded by dispatch+transfer, not the MXU.  The stated targets on "
+        "a healthy v5e chip: batch 8 (latency config) ≥1% MFU; batch 128 "
+        "(throughput config) ≥10% — at 10% MFU the chip sustains ~33k fps, "
+        "far past any single-stream source, which is WHY the streaming "
+        "design favors batch-amortization (dynbatch/mux) over per-frame "
+        "dispatch.  The depthwise convs cap the ceiling: they are "
+        "bandwidth-bound (arithmetic intensity <10 flops/byte), so even "
+        "batch-∞ MobileNet cannot approach the 50%+ MFU a dense ResNet "
+        "reaches; ~15-20% is the realistic asymptote for this architecture "
+        "on v5e.  Interpret the `mfu.sweep` rows against these targets; "
+        "on cpu-fallback rows the sweep only proves plumbing.",
         "- `wire_health_start`/`_end` record the host→device wire state "
         "(150 KB flat put + dispatch) at both ends of the run: the tunneled "
         "chip's transfer path oscillates >100× on a timescale of minutes, "
@@ -878,6 +987,25 @@ def main():
     except Exception as exc:
         leg_error(errors, "config1 dynbatch leg", exc)
 
+    # -- config #1du: dynbatch + upload overlap — coalesced batches cross
+    #    the wire in the dynbatch worker while the queue worker dispatches
+    #    the previous batch (amortization AND overlap stacked)
+    try:
+        n_du = int(os.environ.get("BENCH_DYNBATCH_FRAMES",
+                                  os.environ.get("BENCH_FRAMES", "400")))
+        if n_du <= 0:
+            raise _Skipped("skipped (0 frames)")
+        du_fps, du_batches, du_frames = run_dynbatch_fps(
+            [image_u8.copy() for _ in range(n_du)], upload=True
+        )
+        results["config1_dynupload_fps"] = round(du_fps, 2)
+        results["config1_dynupload_invokes"] = du_batches
+        results["config1_dynupload_frames"] = du_frames
+        log(f"# config1 dynbatch+upload fps: {du_fps:.2f} "
+            f"({du_batches} invokes / {du_frames} frames)")
+    except Exception as exc:
+        leg_error(errors, "config1 dynupload leg", exc)
+
     # -- config #1q: uint8-quantized flagship (int8 weights, on-device
     #    dequant — the reference's flagship model is uint8-quant MobileNet)
     try:
@@ -981,6 +1109,23 @@ def main():
     except Exception as exc:
         leg_error(errors, "config4 lstm leg", exc)
 
+    # -- config #4c: transformer KV-cache decode through repo slots --------
+    # device-resident state: the (L,2,T,d) cache never leaves the chip
+    try:
+        n_kv = int(os.environ.get("BENCH_KV_STEPS",
+                                  os.environ.get("BENCH_LSTM_STEPS", "200")))
+        if n_kv <= 0:
+            raise _Skipped("skipped (0 steps)")
+        if n_kv > 120:  # t_max=128 cache bounds the stream (minus warmup)
+            log(f"# config4c: clamping {n_kv} steps to 120 (cache t_max=128)")
+            n_kv = 120
+        kv_fps = run_kvdecode_fps(n_kv)
+        results["config4c_kvdecode_steps_per_sec"] = round(kv_fps, 2)
+        results["config4c_steps"] = n_kv
+        log(f"# config4c kv-cache decode steps/sec: {kv_fps:.2f}")
+    except Exception as exc:
+        leg_error(errors, "config4c kvdecode leg", exc)
+
     # -- config #4b: windowed sequence LSTM (lax.scan) ----------------------
     # The TPU-native recurrence: tensor_aggregator windows → ONE compiled
     # program scans the whole sequence on device.  Config #4 (per-step
@@ -1049,6 +1194,25 @@ def main():
                 if not isinstance(exc, _Skipped):
                     log(traceback.format_exc())
         results["config5_mux_batched_fps"] = scaling.get(n_streams)
+        # upload-overlap variant at the headline stream count: the batched
+        # wire transfer rides the mux worker while the queue worker
+        # dispatches the previous round (round-2's chip loss was serial
+        # transfer+dispatch in this exact topology)
+        if not over_budget("config5 upload variant"):
+            try:
+                batched = mobilenet_v2.build(
+                    num_classes=1001, image_size=224, batch=n_streams
+                )
+                u_fps = run_mux_batched_fps(
+                    batched, n_streams, per_stream, image_u8,
+                    framework="jax-sharded",
+                    custom=f"devices={min(n_dev, n_streams)},axis=dp",
+                    upload=True,
+                )
+                results["config5_mux_upload_fps"] = round(u_fps, 2)
+                log(f"# config5 mux+upload fps ({n_streams} streams): {u_fps:.2f}")
+            except Exception as exc:
+                leg_error(errors, "config5 upload leg", exc)
     except Exception as exc:
         leg_error(errors, "config5 mux leg", exc)
 
@@ -1165,6 +1329,7 @@ def main():
         "config4b": ratio("config4b_seq_windows_per_sec", "config4b",
                           "windows_per_sec"),
         "config5": ratio("config5_mux_batched_fps", "config5"),
+        "config5_upload": ratio("config5_mux_upload_fps", "config5"),
     }
     results["vs_baseline_per_config"] = vs
     cpu_fps = (baselines.get("config1") or {}).get("fps") \
@@ -1181,6 +1346,7 @@ def main():
         "stream": results.get("config1_stream_fps"),
         "upload": results.get("config1_upload_fps"),
         "dynbatch": results.get("config1_dynbatch_fps"),
+        "dynbatch+upload": results.get("config1_dynupload_fps"),
     }
     best_variant, best_fps = None, None
     for name, v in variants.items():
@@ -1202,12 +1368,26 @@ def main():
             # current run had no accelerator: carry the last real-chip
             # numbers alongside (NOT replacing) this run's CPU measurements
             # — added before write_notes so the evidence document shows it
-            results["last_accelerator_run"] = {
+            carry = {
                 "cached_at": cached.get("cached_at"),
                 "value": (cached.get("result") or {}).get("value"),
                 "vs_baseline": (cached.get("result") or {}).get("vs_baseline"),
                 "platform": (cached.get("result") or {}).get("platform"),
             }
+            cached_extra = (cached.get("result") or {}).get("extra") or {}
+            if "baselines" not in cached_extra:
+                # a cached run without the isolated-subprocess baselines
+                # computed its ratio against an in-process denominator —
+                # the discredited methodology (r3 verdict: round-2's
+                # 12.17x divided by an invalid 13.68 fps) — drop the ratio
+                # rather than let it be cited again
+                carry["vs_baseline"] = None
+                carry["note"] = (
+                    "cached ratio dropped: its baseline denominator was "
+                    "measured in-process beside a live PJRT client and is "
+                    "invalid; compare value against baselines.config1.fps"
+                )
+            results["last_accelerator_run"] = carry
 
     try:
         write_notes(results, platform, errors)

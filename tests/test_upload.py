@@ -213,6 +213,59 @@ class TestUploadElement:
             atol=1e-5,
         )
 
+    def test_mux_batch_upload_sharded_roundtrip(self, rng):
+        """The config5-upload bench topology: srcxN -> mux -> batch ->
+        upload -> queue -> jax-sharded filter -> unbatch -> demux ->
+        sinkxN.  The batched wire transfer happens in the mux worker while
+        the queue worker dispatches — every stream must get its own result
+        back, exact and in order."""
+        from nnstreamer_tpu.elements.batch import TensorBatch, TensorUnbatch
+        from nnstreamer_tpu.elements.demux import TensorDemux
+        from nnstreamer_tpu.elements.mux import TensorMux
+
+        n_streams, per_stream = 4, 3
+        w = rng.standard_normal((8, 5)).astype(np.float32)
+
+        def apply(params, x):  # (4, 2, 4) -> (4, 5)
+            return x.reshape(x.shape[0], -1) @ params
+
+        model = JaxModel(
+            apply=apply, params=jax.device_put(w),
+            input_spec=TensorsSpec.of(
+                TensorSpec(dtype=np.float32, shape=(n_streams, 2, 4))
+            ),
+        )
+        streams = [
+            [np.full((2, 4), 10 * s + t, np.float32) for t in range(per_stream)]
+            for s in range(n_streams)
+        ]
+        got = {s: [] for s in range(n_streams)}
+        p = Pipeline()
+        mux = p.add(TensorMux(sync_mode="nosync"))
+        for s in range(n_streams):
+            src = p.add(DataSrc(data=[f.copy() for f in streams[s]],
+                                name=f"cam{s}"))
+            p.link(src, f"{mux.name}.sink_{s}")
+        batch = p.add(TensorBatch())
+        up = p.add(TensorUpload())
+        q = p.add(Queue(max_size_buffers=4))
+        filt = p.add(TensorFilter(framework="jax-sharded", model=model,
+                                  custom="devices=4,axis=dp"))
+        unb = p.add(TensorUnbatch())
+        demux = p.add(TensorDemux())
+        p.link_chain(mux, batch, up, q, filt, unb, demux)
+        for s in range(n_streams):
+            sink = p.add(TensorSink(name=f"out{s}"))
+            sink.connect("new-data",
+                         lambda f, s=s: got[s].append(np.asarray(f.tensor(0))))
+            p.link(f"{demux.name}.src_{s}", sink)
+        p.run(timeout=120)
+        for s in range(n_streams):
+            assert len(got[s]) == per_stream
+            for t, out in enumerate(got[s]):
+                want = streams[s][t].reshape(-1) @ w
+                np.testing.assert_allclose(out, want, rtol=1e-5, atol=1e-5)
+
     def test_upload_into_unbatch_materializes(self, rng):
         """upload -> unbatch (no filter): unbatch must materialize the
         wire payload instead of crashing on WireTensor."""

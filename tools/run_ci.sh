@@ -1,0 +1,72 @@
+#!/usr/bin/env bash
+# Execute the EXACT steps of .github/workflows/ci.yml on this host and tee
+# the transcript to CI_RUN_<date>.log — the executed-once proof the r3
+# verdict asked for (row 42: config existed but had never run anywhere).
+#
+# Documented divergences from the YAML (everything else runs verbatim):
+# - the dependency-install step is skipped (deps baked into this image;
+#   `pip install` unavailable);
+# - the driver-entry step pins jax to CPU via jax.config (this host's
+#   axon sitecustomize ignores the env var; hosted runners don't);
+# - BENCH_NOTES_PATH sends the smoke run's notes to /tmp so the real
+#   BENCH_NOTES.md evidence isn't clobbered by tiny-frame numbers.
+# Exit code 0 = the workflow would have passed.
+set -uo pipefail
+cd "$(dirname "$0")/.."
+LOG="${1:-CI_RUN_$(date +%Y%m%d).log}"
+: >"$LOG"
+
+run_step() {
+  local name="$1"; shift
+  echo "=== STEP: $name ===" | tee -a "$LOG"
+  local t0=$SECONDS
+  if "$@" >>"$LOG" 2>&1; then
+    echo "--- PASS (${name}, $((SECONDS - t0))s)" | tee -a "$LOG"
+  else
+    echo "--- FAIL (${name}, $((SECONDS - t0))s)" | tee -a "$LOG"
+    echo "=== CI RESULT: FAIL ===" | tee -a "$LOG"
+    exit 1
+  fi
+}
+
+echo "ci run: $(date '+%Y-%m-%d %H:%M:%S') host=$(uname -sr) python=$(python -V 2>&1)" | tee -a "$LOG"
+
+run_step "Build native runtime + C ABI (g++ smoke)" \
+  python -c "from nnstreamer_tpu.native.capi import build_capi; print(build_capi())"
+
+run_step "Run test suite with coverage gate" \
+  env JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+  python tools/coverage_tool.py tests/ -q
+
+run_step "Coverage floor check" python - <<'PY'
+floor = 75.0
+last = open("COVERAGE.txt").read().strip().splitlines()[-1]
+pct = float(last.split()[-1].rstrip("%"))
+print(f"coverage {pct:.1f}% (floor {floor}%)")
+raise SystemExit(0 if pct >= floor else 1)
+PY
+
+# NOTE: on this host the axon sitecustomize makes the JAX_PLATFORMS env
+# var insufficient (the workflow's plain env works on a hosted runner);
+# jax.config.update before first backend use is the reliable local pin.
+run_step "Driver entry points (compile check + multichip dryrun)" \
+  env XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+  python -c "
+import jax
+jax.config.update('jax_platforms', 'cpu')
+import __graft_entry__ as g
+fn, args = g.entry()
+print(jax.eval_shape(fn, *args))
+g.dryrun_multichip(8)
+print('dryrun OK')
+"
+
+run_step "Bench smoke (one JSON line, rc=0)" \
+  env BENCH_FRAMES=10 BENCH_QUANT_FRAMES=4 BENCH_BASELINE_FRAMES=3 \
+      BENCH_MUX_FRAMES=3 BENCH_MUX_STREAMS=2 BENCH_MUX_SWEEP=2 \
+      BENCH_SSD_FRAMES=3 BENCH_POSE_FRAMES=3 BENCH_LSTM_STEPS=10 \
+      BENCH_SEQ_WINDOWS=3 BENCH_MFU_BATCHES=8 BENCH_BREAKDOWN_FRAMES=6 \
+      BENCH_CASCADE_FRAMES=2 BENCH_PROBE_TIMEOUT=10 BENCH_NOTES_PATH=/tmp/ci_bench_notes.md \
+  python bench.py
+
+echo "=== CI RESULT: PASS ===" | tee -a "$LOG"
