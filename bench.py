@@ -630,6 +630,34 @@ def measure_frame_breakdown(image_u8, n=None):
     out.block_until_ready()
     res["host_invoke_chain_ms"] = round((time.perf_counter() - t0) / n * 1e3, 3)
 
+    # 3b) overlapped transfer+dispatch (the tensor_upload+queue pattern):
+    # a producer thread device_puts frame N+1 while this thread dispatches
+    # frame N — the achievable pipeline rate is ~max(transfer, dispatch),
+    # which this measures directly (vs 3's serial transfer+dispatch sum)
+    import queue as _q
+    import threading as _t
+
+    hand = _q.Queue(maxsize=4)
+
+    def producer():
+        for f in frames:
+            hand.put(jax.device_put(f))
+        hand.put(None)
+
+    th = _t.Thread(target=producer)
+    t0 = time.perf_counter()
+    th.start()
+    out = None
+    while True:
+        d = hand.get()
+        if d is None:
+            break
+        out = fn(d)
+    if out is not None:
+        out.block_until_ready()
+    th.join()
+    res["overlapped_chain_ms"] = round((time.perf_counter() - t0) / n * 1e3, 3)
+
     # 4) dispatch-only cost (client-side enqueue)
     t0 = time.perf_counter()
     for _ in range(n):
@@ -827,8 +855,9 @@ def write_notes(results, platform, errors):
         "- **MFU target & ceiling** (r3 verdict 'next' #5): MobileNet-v2 at "
         "224² is ~0.6 GFLOP/frame — a *small* model, so streaming MFU is "
         "bounded by dispatch+transfer, not the MXU.  The stated targets on "
-        "a healthy v5e chip: batch 8 (latency config) ≥1% MFU; batch 128 "
-        "(throughput config) ≥10% — at 10% MFU the chip sustains ~33k fps, "
+        "a healthy v5e chip: batch 8 (latency config) ≥1% MFU, batch 32 "
+        "≥3%, batch 128 (throughput config) ≥10% — at 10% MFU the chip "
+        "sustains ~33k fps, "
         "far past any single-stream source, which is WHY the streaming "
         "design favors batch-amortization (dynbatch/mux) over per-frame "
         "dispatch.  The depthwise convs cap the ceiling: they are "
@@ -868,6 +897,30 @@ def write_notes(results, platform, errors):
 
     for k, v in flat:
         lines.append(f"| {k} | {v} | {stamp(k)} |")
+
+    # Per-row MFU interpretation against the stated targets (r3 verdict
+    # 'next' #5: "one sentence of interpretation per row") — only written
+    # for accelerator-measured sweeps; CPU rows prove plumbing, not perf.
+    sweep = (results.get("mfu") or {}).get("sweep") or []
+    if sweep and platform not in (None, "cpu"):
+        lines += ["", "### MFU sweep interpretation", ""]
+        for row in sweep:
+            mfu, b = row.get("mfu"), row.get("batch")
+            if mfu is None:
+                lines.append(f"- batch {b}: no cost-analysis flops on this "
+                             "platform — step time only.")
+                continue
+            target = 0.10 if b >= 128 else (0.03 if b >= 32 else 0.01)
+            verdict = "MEETS" if mfu >= target else "BELOW"
+            lines.append(
+                f"- batch {b}: {mfu:.2%} MFU at {row.get('step_ms')} ms/step "
+                f"({row.get('fps')} fps equivalent) — {verdict} the "
+                f"{target:.0%} target for this batch size; "
+                + ("dispatch/transfer-bound regime, batch further to climb "
+                   "the curve." if mfu < target else
+                   "within the depthwise-conv-limited envelope for "
+                   "MobileNet on v5e.")
+            )
     if errors:
         lines += ["", "## Errors", ""]
         lines += [f"- `{e}`" for e in errors]
@@ -877,11 +930,32 @@ def write_notes(results, platform, errors):
         f.write("\n".join(lines) + "\n")
 
 
+def enable_compile_cache():
+    """Persistent XLA compilation cache: chip-watch re-runs this bench
+    whenever the tunnel comes back, and every executable re-compiled at
+    ~20-40s eats the measurement budget — cache them across processes.
+    (Cache dir is gitignored; harmless on CPU fallback.)"""
+    try:
+        import jax
+
+        cache_dir = os.environ.get(
+            "BENCH_COMPILE_CACHE",
+            os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         ".jax_cache"),
+        )
+        if cache_dir and cache_dir != "0":
+            jax.config.update("jax_compilation_cache_dir", cache_dir)
+            jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
+    except Exception as exc:  # an old jax without the knob must not kill the run
+        log(f"# compile cache unavailable: {exc!r}")
+
+
 def main():
     errors = []
     results = {}
     t_start = time.perf_counter()
     budget_s = float(os.environ.get("BENCH_BUDGET_S", "2700"))
+    enable_compile_cache()
 
     def over_budget(label):
         if time.perf_counter() - t_start > budget_s:
@@ -1175,6 +1249,7 @@ def main():
         scaling = {}
         results["config5_scaling"] = scaling
         results["config5_frames_per_stream"] = per_stream
+        headline_model = None
         for streams in sweep:
             if streams != n_streams and over_budget(f"config5 sweep {streams}"):
                 continue
@@ -1182,6 +1257,8 @@ def main():
                 batched = mobilenet_v2.build(
                     num_classes=1001, image_size=224, batch=streams
                 )
+                if streams == n_streams:
+                    headline_model = batched  # reused by the upload variant
                 fps = run_mux_batched_fps(
                     batched, streams, per_stream, image_u8,
                     framework="jax-sharded",
@@ -1200,11 +1277,12 @@ def main():
         # transfer+dispatch in this exact topology)
         if not over_budget("config5 upload variant"):
             try:
-                batched = mobilenet_v2.build(
-                    num_classes=1001, image_size=224, batch=n_streams
-                )
+                if headline_model is None:
+                    headline_model = mobilenet_v2.build(
+                        num_classes=1001, image_size=224, batch=n_streams
+                    )
                 u_fps = run_mux_batched_fps(
-                    batched, n_streams, per_stream, image_u8,
+                    headline_model, n_streams, per_stream, image_u8,
                     framework="jax-sharded",
                     custom=f"devices={min(n_dev, n_streams)},axis=dp",
                     upload=True,
