@@ -1,0 +1,172 @@
+"""``tensor_trainer``: streaming on-device training inside a pipeline.
+
+Beyond-parity: the reference snapshot is inference-only (survey §2.6);
+upstream GStreamer-nnstreamer later added a ``tensor_trainer`` element with
+exactly this shape — frames in, periodically-updated model out.  Here it is
+TPU-first:
+
+- the whole optimization step (forward + backward + optax update) is ONE
+  jitted XLA program (:func:`nnstreamer_tpu.training.make_train_step`);
+- params + optimizer state stay **device-resident** between steps, with
+  buffer donation so a long stream trains at constant HBM;
+- input frames carry ``(x, y)`` as two tensors (e.g. from ``tensor_mux``
+  of a data source and a label source, the same fan-in the filter uses);
+- per step the element emits a frame ``[loss (f32 scalar), step (int32)]``
+  downstream — stream the learning curve into ``tensor_sink`` exactly like
+  any other tensor;
+- ``state_dict()/load_state()`` plug into ``utils/checkpoint.py`` so a
+  training pipeline checkpoints/resumes like every other stateful element
+  (aggregator windows, repo slots).
+
+Usage::
+
+    x ──┐
+        ├─ tensor_mux → tensor_trainer(model=..., optimizer="adam,lr=1e-3")
+    y ──┘                  → tensor_sink          # loss stream
+
+After (or during) the run, ``trainer.params`` returns the trained
+parameters (host copies) for handoff to a ``tensor_filter``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from ..buffer import Frame
+from ..graph.node import NegotiationError, Node, Pad
+from ..graph.registry import register_element
+from ..spec import TensorSpec, TensorsSpec
+from ..training import make_train_step
+
+
+@register_element("tensor_trainer")
+class TensorTrainer(Node):
+    def __init__(
+        self,
+        name: Optional[str] = None,
+        model=None,
+        loss: Any = "softmax_ce",
+        optimizer: Any = "adam,lr=1e-3",
+        donate: bool = True,
+    ):
+        super().__init__(name)
+        self.add_sink_pad("sink")
+        self.add_src_pad("src")
+        self.model = model  # JaxModel (apply + params) or (apply_fn, params)
+        self.loss = loss
+        self.optimizer = optimizer
+        self.donate = donate in (True, "true", "TRUE", "1")
+        self.step_count = 0
+        self._params = None
+        self._opt_state = None
+        self._step = None
+        self._last_loss = None
+
+    # -- negotiation --------------------------------------------------------
+
+    def configure(self, in_specs: Dict[str, TensorsSpec]) -> Dict[str, TensorsSpec]:
+        spec = in_specs["sink"]
+        if spec.num_tensors != 2:
+            raise NegotiationError(
+                f"{self.name}: trainer wants 2 tensors per frame (x, y), "
+                f"got {spec.num_tensors} — mux a data and a label stream"
+            )
+        if self.model is None:
+            raise NegotiationError(f"{self.name}: no model set")
+        apply_fn = getattr(self.model, "apply", None) or self.model[0]
+        if self._params is None:
+            params = getattr(self.model, "params", None)
+            if params is None and not callable(self.model):
+                params = self.model[1]
+            # deep-copy array leaves: with donation (the default) the first
+            # step hands the initial buffers back to XLA — aliasing the
+            # caller's model.params would destroy the model they passed in
+            import jax
+            import jax.numpy as jnp
+
+            self._params = jax.tree.map(
+                lambda a: jnp.array(a, copy=True)
+                if hasattr(a, "shape") and hasattr(a, "dtype") else a,
+                params,
+            )
+        init_fn, self._step = make_train_step(
+            apply_fn, loss=self.loss, optimizer=self.optimizer,
+            donate=self.donate,
+        )
+        if self._opt_state is None:
+            self._opt_state = init_fn(self._params)
+        # out: [loss scalar f32, step int32] — a learning-curve stream
+        return {"src": TensorsSpec(tensors=(
+            TensorSpec(dtype=np.float32, shape=()),
+            TensorSpec(dtype=np.int32, shape=()),
+        ), rate=spec.rate)}
+
+    # -- streaming ----------------------------------------------------------
+
+    def process(self, pad: Pad, frame: Frame):
+        del pad
+        from ..buffer import WireTensor
+
+        x, y = frame.tensors[0], frame.tensors[1]
+        # device-resident payloads dispatch as-is; only wire-layout
+        # wrappers need materializing (their flat shape would mis-trace)
+        if isinstance(x, WireTensor):
+            x = np.asarray(x)
+        if isinstance(y, WireTensor):
+            y = np.asarray(y)
+        self._params, self._opt_state, loss = self._step(
+            self._params, self._opt_state, x, y
+        )
+        self.step_count += 1
+        self._last_loss = loss  # device scalar: no sync on the hot path
+        return frame.with_tensors(
+            (loss, np.int32(self.step_count)),
+        )
+
+    # -- app access ---------------------------------------------------------
+
+    @staticmethod
+    def _to_host(tree):
+        import jax
+
+        return jax.tree.map(
+            lambda a: np.asarray(a) if hasattr(a, "shape") else a, tree
+        )
+
+    @property
+    def params(self):
+        """Trained parameters as host numpy (synchronizes)."""
+        return self._to_host(self._params)
+
+    @property
+    def last_loss(self) -> Optional[float]:
+        return None if self._last_loss is None else float(self._last_loss)
+
+    # -- checkpoint/resume (utils/checkpoint.py contract) --------------------
+
+    def state_dict(self):
+        return {
+            "params": self._to_host(self._params),
+            "opt_state": self._to_host(self._opt_state),
+            "step_count": self.step_count,
+        }
+
+    def load_state(self, state) -> None:
+        import jax
+
+        def like(saved, current):
+            # restore with the CURRENT tree's structure (opt_state is a
+            # NamedTuple pytree; npz round-trips it as nested lists/dicts)
+            leaves = jax.tree.leaves(saved)
+            treedef = jax.tree.structure(current)
+            return jax.tree.unflatten(treedef, leaves)
+
+        self._params = like(state["params"], self._params) \
+            if self._params is not None else state["params"]
+        if self._opt_state is not None:
+            self._opt_state = like(state["opt_state"], self._opt_state)
+        else:
+            self._opt_state = state["opt_state"]
+        self.step_count = int(state["step_count"])

@@ -90,6 +90,7 @@ for _el, _mod in {
     "tensor_upload": "nnstreamer_tpu.elements.upload",
     "tensor_dynbatch": "nnstreamer_tpu.elements.dynbatch",
     "tensor_dynunbatch": "nnstreamer_tpu.elements.dynbatch",
+    "tensor_trainer": "nnstreamer_tpu.elements.trainer",
     # runtime/plumbing elements (GStreamer-provided in the reference)
     "queue": "nnstreamer_tpu.elements.queue",
     "tee": "nnstreamer_tpu.elements.tee",

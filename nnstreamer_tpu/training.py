@@ -1,0 +1,194 @@
+"""Streaming training: jitted optax update steps for pipeline use.
+
+Beyond-parity capability: the reference is inference-only (survey §2.6 —
+"no training exists to shard"; upstream GStreamer-nnstreamer later grew a
+``tensor_trainer`` element with the same shape as ours).  TPU-first, a
+training step is just another compiled program the streaming graph
+dispatches per frame:
+
+- ``make_train_step`` closes a model-apply + loss + optax optimizer into
+  ONE jitted ``(params, opt_state, x, y) -> (params', opt_state', loss)``
+  function — forward, backward, and update fuse into a single XLA program,
+  so per-step host cost is one dispatch;
+- params and optimizer state live device-resident between steps (the
+  element below holds them; nothing crosses the wire but the batch and a
+  scalar loss);
+- ``donate`` hands the old params/opt-state buffers back to XLA
+  (``donate_argnums``), so a training stream runs at constant HBM — the
+  in-place-update discipline the streaming filter deliberately avoids
+  (`docs/performance.md`, "Why inputs are not donated") IS sound here
+  because the trainer exclusively owns its state;
+- for multi-chip, shard the batch over ``dp`` and replicate params: under
+  ``jit`` XLA inserts the gradient ``psum`` automatically — the NCCL
+  all-reduce analog, compiled (exercised by ``__graft_entry__``'s train
+  leg and ``tests/test_trainer.py``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Tuple
+
+import numpy as np
+
+LOSSES = {}
+
+
+def _register(name):
+    def deco(fn):
+        LOSSES[name] = fn
+        return fn
+
+    return deco
+
+
+@_register("softmax_ce")
+def softmax_cross_entropy(logits, labels):
+    """Mean softmax CE; integer labels ``(B,)`` or one-hot ``(B, C)``."""
+    import jax
+    import jax.numpy as jnp
+
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    if labels.ndim == logits.ndim - 1:
+        picked = jnp.take_along_axis(
+            logp, labels[..., None].astype(jnp.int32), axis=-1
+        )[..., 0]
+    else:
+        picked = jnp.sum(logp * labels.astype(jnp.float32), axis=-1)
+    return -jnp.mean(picked)
+
+
+@_register("mse")
+def mse(pred, target):
+    import jax.numpy as jnp
+
+    d = pred.astype(jnp.float32) - target.astype(jnp.float32)
+    return jnp.mean(d * d)
+
+
+def make_optimizer(spec: str):
+    """``"adam,lr=1e-3"`` / ``"sgd,lr=0.1,momentum=0.9"`` → optax tx.
+    String-typed like the reference's element properties
+    (``tensor_transform.c:741-809`` parses modes the same way)."""
+    import optax
+
+    parts = [p.strip() for p in str(spec).split(",") if p.strip()]
+    if not parts:
+        raise ValueError("empty optimizer spec")
+    name, kw = parts[0].lower(), {}
+    for p in parts[1:]:
+        if "=" not in p:
+            raise ValueError(f"malformed optimizer option {p!r}")
+        k, v = p.split("=", 1)
+        kw[k.strip()] = float(v)
+    lr = kw.pop("lr", 1e-3)
+    if name == "adam":
+        return optax.adam(lr, **kw)
+    if name == "adamw":
+        return optax.adamw(lr, **kw)
+    if name == "sgd":
+        return optax.sgd(lr, **kw)
+    if name == "rmsprop":
+        return optax.rmsprop(lr, **kw)
+    raise ValueError(f"unknown optimizer {name!r} (adam/adamw/sgd/rmsprop)")
+
+
+def make_train_step(
+    apply_fn: Callable,
+    loss: Any = "softmax_ce",
+    optimizer: Any = "adam,lr=1e-3",
+    donate: bool = True,
+) -> Tuple[Callable, Callable]:
+    """Build ``(init_fn, step_fn)``.
+
+    ``init_fn(params) -> opt_state``;
+    ``step_fn(params, opt_state, x, y) -> (params', opt_state', loss)`` —
+    one fused XLA program (value_and_grad + optax update).  ``loss`` is a
+    registered name or a ``(pred, y) -> scalar`` callable; ``apply_fn`` is
+    ``(params, x) -> pred``.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    loss_fn = LOSSES[loss] if isinstance(loss, str) else loss
+    tx = make_optimizer(optimizer) if isinstance(optimizer, str) else optimizer
+
+    DIFF, STATIC_PY, STATIC_ARR = 0, 1, 2
+
+    def _split(params):
+        """Partition leaves three ways: differentiable (inexact arrays),
+        python statics (config ints/bools/None — conv strides etc., which
+        must NOT trace), and non-inexact arrays (int buffers/masks — ride
+        as jit args, untouched by grads)."""
+        flat, treedef = jax.tree_util.tree_flatten(params)
+        mask = []
+        for l in flat:
+            if hasattr(l, "dtype") and hasattr(l, "shape"):
+                mask.append(
+                    DIFF if jnp.issubdtype(l.dtype, jnp.inexact)
+                    else STATIC_ARR
+                )
+            else:
+                mask.append(STATIC_PY)
+        return flat, treedef, tuple(mask)
+
+    def _merge(treedef, mask, diff, static_py, static_arr):
+        d, sp, sa = iter(diff), iter(static_py), iter(static_arr)
+        pick = {DIFF: lambda: next(d), STATIC_PY: lambda: next(sp),
+                STATIC_ARR: lambda: next(sa)}
+        return jax.tree_util.tree_unflatten(
+            treedef, [pick[m]() for m in mask]
+        )
+
+    def init_fn(params):
+        flat, _, mask = _split(params)
+        return tx.init([l for l, m in zip(flat, mask) if m == DIFF])
+
+    # The split runs OUTSIDE jit (python statics stay python values); the
+    # jitted inner closes over treedef/mask/python-statics and takes the
+    # float leaves, non-float arrays, and opt state as arguments.  One
+    # compiled program per (structure, python-statics), cached here —
+    # a fresh jax.jit per fresh closure would recompile every step.
+    _compiled = {}
+
+    def step(params, opt_state, x, y):
+        import optax
+
+        flat, treedef, mask = _split(params)
+        diff = [l for l, m in zip(flat, mask) if m == DIFF]
+        static_py = tuple(l for l, m in zip(flat, mask) if m == STATIC_PY)
+        static_arr = tuple(l for l, m in zip(flat, mask) if m == STATIC_ARR)
+        key = (treedef, mask, static_py)
+        try:
+            inner = _compiled.get(key)
+        except TypeError:  # unhashable python static: don't cache by value
+            key = None
+            inner = None
+        if inner is None:
+            def _inner(diff_leaves, static_arr, opt_state, x, y,
+                       _treedef=treedef, _mask=mask, _static=static_py):
+                def objective(dl):
+                    p = _merge(_treedef, _mask, dl, _static, static_arr)
+                    return loss_fn(apply_fn(p, x), y)
+
+                value, grads = jax.value_and_grad(objective)(
+                    list(diff_leaves)
+                )
+                updates, new_opt = tx.update(
+                    grads, opt_state, list(diff_leaves)
+                )
+                new_diff = optax.apply_updates(list(diff_leaves), updates)
+                return new_diff, new_opt, value
+
+            jit_kw = {"donate_argnums": (0, 2)} if donate else {}
+            inner = jax.jit(_inner, **jit_kw)
+            if key is not None:
+                _compiled[key] = inner
+        new_diff, opt_state, value = inner(
+            tuple(diff), static_arr, opt_state, x, y
+        )
+        return (
+            _merge(treedef, mask, list(new_diff), static_py, static_arr),
+            opt_state, value,
+        )
+
+    return init_fn, step
