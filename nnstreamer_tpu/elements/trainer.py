@@ -50,6 +50,8 @@ class TensorTrainer(Node):
         loss: Any = "softmax_ce",
         optimizer: Any = "adam,lr=1e-3",
         donate: bool = True,
+        devices: int = 0,
+        axis: str = "dp",
     ):
         super().__init__(name)
         self.add_sink_pad("sink")
@@ -58,11 +60,20 @@ class TensorTrainer(Node):
         self.loss = loss
         self.optimizer = optimizer
         self.donate = donate in (True, "true", "TRUE", "1")
+        # data-parallel training: devices=N shards each batch's leading dim
+        # over an N-device 1-D mesh; params/opt-state replicate and XLA
+        # inserts the gradient psum (the compiled NCCL-all-reduce analog) —
+        # same custom-option shape as the jax-sharded filter backend
+        self.devices = int(devices)
+        self.axis = str(axis)
+        self._mesh = None
+        self._x_sharding = None
         self.step_count = 0
         self._params = None
         self._opt_state = None
         self._step = None
         self._last_loss = None
+        self._pending_state = None  # restore arriving before configure()
 
     # -- negotiation --------------------------------------------------------
 
@@ -91,12 +102,44 @@ class TensorTrainer(Node):
                 if hasattr(a, "shape") and hasattr(a, "dtype") else a,
                 params,
             )
+        if self.devices > 1 and self._mesh is None:
+            import jax
+
+            from ..parallel.mesh import batch_sharding, make_mesh, replicated
+
+            try:
+                self._mesh = make_mesh((self.devices,), (self.axis,))
+            except ValueError as exc:
+                raise NegotiationError(f"{self.name}: {exc}") from exc
+            batch_dim = spec.tensors[0].shape[0] if spec.tensors[0].rank else None
+            if batch_dim is not None and batch_dim % self.devices:
+                raise NegotiationError(
+                    f"{self.name}: batch dim {batch_dim} is not divisible "
+                    f"by devices={self.devices}"
+                )
+            self._x_sharding = lambda rank: batch_sharding(
+                self._mesh, rank, self.axis
+            )
+            repl = replicated(self._mesh)
+            self._params = jax.tree.map(
+                lambda a: jax.device_put(a, repl)
+                if hasattr(a, "shape") and hasattr(a, "dtype") else a,
+                self._params,
+            )
         init_fn, self._step = make_train_step(
             apply_fn, loss=self.loss, optimizer=self.optimizer,
             donate=self.donate,
         )
         if self._opt_state is None:
             self._opt_state = init_fn(self._params)
+        if self._pending_state is not None:
+            # a pre-configure restore (restore_pipeline runs before
+            # negotiation): re-apply now that the live tree structures
+            # exist — the npz round-trip demoted optax NamedTuples to
+            # plain tuples, so the saved leaves must be re-unflattened
+            # into the freshly-initialized structures
+            state, self._pending_state = self._pending_state, None
+            self.load_state(state)
         # out: [loss scalar f32, step int32] — a learning-curve stream
         return {"src": TensorsSpec(tensors=(
             TensorSpec(dtype=np.float32, shape=()),
@@ -116,6 +159,15 @@ class TensorTrainer(Node):
             x = np.asarray(x)
         if isinstance(y, WireTensor):
             y = np.asarray(y)
+        if self._mesh is not None:
+            # pre-shard the batch over the mesh (scatter on this thread);
+            # params are replicated, so XLA psums the gradients over
+            # `axis`.  device_put reshards device-resident payloads
+            # device-to-device — no host round trip.
+            import jax
+
+            x = jax.device_put(x, self._x_sharding(np.ndim(x)))
+            y = jax.device_put(y, self._x_sharding(np.ndim(y)))
         self._params, self._opt_state, loss = self._step(
             self._params, self._opt_state, x, y
         )
@@ -154,6 +206,14 @@ class TensorTrainer(Node):
         }
 
     def load_state(self, state) -> None:
+        if self._step is None:
+            # not configured yet (restore_pipeline runs before the
+            # pipeline negotiates): the npz round-trip demoted optax
+            # NamedTuples to plain tuples, and re-unflattening needs the
+            # live structures — defer until configure() builds them
+            self._pending_state = state
+            self.step_count = int(state["step_count"])
+            return
         import jax
 
         def like(saved, current):
@@ -163,10 +223,15 @@ class TensorTrainer(Node):
             treedef = jax.tree.structure(current)
             return jax.tree.unflatten(treedef, leaves)
 
-        self._params = like(state["params"], self._params) \
-            if self._params is not None else state["params"]
-        if self._opt_state is not None:
-            self._opt_state = like(state["opt_state"], self._opt_state)
-        else:
-            self._opt_state = state["opt_state"]
+        self._params = like(state["params"], self._params)
+        self._opt_state = like(state["opt_state"], self._opt_state)
         self.step_count = int(state["step_count"])
+        if self._mesh is not None:
+            # restored leaves are host numpy: re-replicate over the mesh
+            from ..parallel.mesh import replicated
+
+            repl = replicated(self._mesh)
+            place = lambda a: jax.device_put(a, repl) \
+                if hasattr(a, "shape") and hasattr(a, "dtype") else a  # noqa: E731
+            self._params = jax.tree.map(place, self._params)
+            self._opt_state = jax.tree.map(place, self._opt_state)

@@ -267,6 +267,55 @@ class TestTrainerElement:
         p.run(timeout=120)
         assert len(curve) == 3 and all(np.isfinite(v) for v in curve)
 
+    def test_restore_before_configure(self):
+        """The canonical resume flow (restore_pipeline runs BEFORE the
+        pipeline negotiates): load_state defers until configure() rebuilds
+        live tree structures, then training continues exactly (review r4:
+        the raw npz opt_state — NamedTuples demoted to tuples — used to
+        reach tx.update and crash)."""
+        model = linreg_model()
+        rng = np.random.default_rng(10)
+        data = []
+        for i in range(6):
+            x = rng.standard_normal((8, 4)).astype(np.float32)
+            data.append(Frame.of(x, x @ np.ones((4, 2), np.float32), pts=i))
+        spec = TensorsSpec.of(
+            TensorSpec(dtype=np.float32, shape=(8, 4)),
+            TensorSpec(dtype=np.float32, shape=(8, 2)),
+        )
+
+        a = TensorTrainer(model=linreg_model(), loss="mse",
+                          optimizer="adam,lr=0.05")
+        a.configure({"sink": spec})
+        for f in data:
+            a.process(None, f)
+
+        b = TensorTrainer(model=linreg_model(), loss="mse",
+                          optimizer="adam,lr=0.05")
+        b.configure({"sink": spec})
+        for f in data[:3]:
+            b.process(None, f)
+        state = b.state_dict()
+
+        c = TensorTrainer(model=linreg_model(), loss="mse",
+                          optimizer="adam,lr=0.05")
+        c.load_state(state)  # BEFORE configure — must defer, not crash
+        assert c.step_count == 3
+        c.configure({"sink": spec})
+        for f in data[3:]:
+            c.process(None, f)
+        np.testing.assert_allclose(c.params, a.params, rtol=1e-5, atol=1e-6)
+
+    def test_non_divisible_batch_rejected_at_configure(self):
+        from nnstreamer_tpu.graph.node import NegotiationError
+
+        t = TensorTrainer(model=linreg_model(), devices=3)
+        with pytest.raises(NegotiationError, match="divisible"):
+            t.configure({"sink": TensorsSpec.of(
+                TensorSpec(dtype=np.float32, shape=(8, 4)),
+                TensorSpec(dtype=np.float32, shape=(8, 2)),
+            )})
+
     def test_model_params_not_aliased_into_donation(self):
         """The trainer deep-copies params at configure: with donation the
         first step invalidates the trainer's initial buffers, and aliasing
@@ -308,6 +357,35 @@ class TestTrainerElement:
         p2, opt, l2 = step(p1, opt, x, y)
         assert float(l2) < float(l1)
         np.testing.assert_array_equal(np.asarray(p2["mask"]), [1, 0, 1, 0])
+
+    def test_data_parallel_matches_single_device(self):
+        """devices=8: the dp-sharded trainer's params trajectory equals the
+        single-device trainer's on identical data (gradient psum is a pure
+        re-layout, never a numerics change — suite convention)."""
+        rng = np.random.default_rng(9)
+        w_true = rng.standard_normal((4, 2)).astype(np.float32)
+        data = []
+        for i in range(5):
+            x = rng.standard_normal((8, 4)).astype(np.float32)
+            data.append(Frame.of(x, x @ w_true, pts=i))
+
+        def run(devices):
+            t = TensorTrainer(model=linreg_model(), loss="mse",
+                              optimizer="sgd,lr=0.05", devices=devices)
+            t.configure({"sink": TensorsSpec.of(
+                TensorSpec(dtype=np.float32, shape=(8, 4)),
+                TensorSpec(dtype=np.float32, shape=(8, 2)),
+            )})
+            for f in data:
+                t.process(None, f)
+            return t
+
+        single, sharded = run(0), run(8)
+        assert sharded._mesh is not None
+        assert len(sharded._params.sharding.device_set) == 8
+        np.testing.assert_allclose(
+            sharded.params, single.params, rtol=2e-5, atol=2e-6
+        )
 
     def test_rejects_single_tensor_frames(self):
         t = TensorTrainer(model=linreg_model())
