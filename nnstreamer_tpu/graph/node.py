@@ -20,6 +20,7 @@ Threading model (mirrors the reference's, ``README.md:41-44``):
 
 from __future__ import annotations
 
+import itertools
 import threading
 from typing import Dict, Iterable, List, Optional, Tuple, Union
 
@@ -145,8 +146,16 @@ class Node:
     # Set by subclasses that create src pads on demand (demux/split/tee).
     REQUEST_SRC_PADS = False
 
+    # Monotonic auto-name ids (gst's elementN numbering): a process-global
+    # counter — id(self) was used before, but CPython reuses addresses, so
+    # long sessions hit "duplicate node name" at birthday-paradox rates
+    # (found by tools/soak_campaign.py, 4 collisions in 3590 pipelines).
+    _AUTO_IDS = itertools.count()
+
     def __init__(self, name: Optional[str] = None):
-        self.name = name or f"{type(self).__name__.lower()}{id(self) % 10000}"
+        self.name = name or (
+            f"{type(self).__name__.lower()}{next(Node._AUTO_IDS)}"
+        )
         self.sink_pads: Dict[str, Pad] = {}
         self.src_pads: Dict[str, Pad] = {}
         self.pipeline = None  # set on add

@@ -14,6 +14,22 @@ from nnstreamer_tpu.buffer import Frame
 from nnstreamer_tpu.spec import TensorSpec, TensorsSpec
 
 
+def test_auto_names_never_collide():
+    """Anonymous elements get monotonic names (gst's elementN).  The old
+    id(self)%10000 scheme collided once CPython reused addresses — found
+    by the soak campaign as 'duplicate node name' in multi-element
+    pipelines (tools/soak_campaign.py seeds 1785431042/1184/1304/2007)."""
+    from nnstreamer_tpu.graph.node import Node
+
+    names = [Node().name for _ in range(20000)]
+    assert len(set(names)) == len(names)
+    # and they register into a pipeline without duplicate-name errors
+    p = Pipeline()
+    for _ in range(64):
+        p.add(Queue())
+        p.add(TensorSink())
+
+
 def test_datasrc_to_sink():
     data = [np.full((4,), i, np.float32) for i in range(5)]
     p = Pipeline()

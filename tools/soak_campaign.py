@@ -1,0 +1,234 @@
+#!/usr/bin/env python
+"""Randomized soak campaign: many short randomized pipelines, exactness
+checked on every frame.  A failure prints the seed for a one-line repro:
+
+    python tools/soak_campaign.py --seed N
+
+Topology templates (drawn at random per iteration):
+  linear   src → [transform] → [upload+queue | dynbatch | both] → filter → sink
+  tee      src → tee → (queued filter) × 2..3 branches
+  mux      src×K → mux → batch → filter → unbatch → demux → sink×K
+  repo     LSTM-style state cycle through repo slots
+  trainer  (x, y) stream into tensor_trainer, loss must stay finite
+
+Usage: python tools/soak_campaign.py [--minutes 10] [--seed N]
+"""
+
+import argparse
+import os
+import sys
+import time
+import traceback
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")  # soak targets the graph, not the chip
+
+import numpy as np  # noqa: E402
+
+
+def run_linear(rng):
+    import jax.numpy as jnp
+
+    from nnstreamer_tpu import Pipeline
+    from nnstreamer_tpu.backends.jax_backend import JaxModel
+    from nnstreamer_tpu.buffer import Frame
+    from nnstreamer_tpu.elements.dynbatch import DynBatch, DynUnbatch
+    from nnstreamer_tpu.elements.filter import TensorFilter
+    from nnstreamer_tpu.elements.queue import Queue
+    from nnstreamer_tpu.elements.sink import TensorSink
+    from nnstreamer_tpu.elements.testsrc import DataSrc
+    from nnstreamer_tpu.elements.upload import TensorUpload
+
+    n = int(rng.integers(20, 120))
+    d = int(rng.integers(2, 16))
+    scale = float(rng.uniform(0.5, 3.0))
+    use_upload = bool(rng.integers(0, 2))
+    use_dyn = bool(rng.integers(0, 2))
+    frames = [Frame.of(np.full((d,), float(i), np.float32), pts=i)
+              for i in range(n)]
+    if use_dyn:
+        model = JaxModel(apply=lambda p, x: x * scale,
+                         input_spec=None)
+    else:
+        model = JaxModel(apply=lambda p, x: x * scale)
+    got = []
+    p = Pipeline()
+    chain = [p.add(DataSrc(data=frames))]
+    if use_dyn:
+        chain.append(p.add(DynBatch(max_batch=int(2 ** rng.integers(1, 4)))))
+    if use_upload:
+        chain.append(p.add(TensorUpload()))
+        chain.append(p.add(Queue(max_size_buffers=8)))
+    chain.append(p.add(TensorFilter(framework="jax", model=model)))
+    if use_dyn:
+        chain.append(p.add(DynUnbatch()))
+    sink = p.add(TensorSink())
+    sink.connect("new-data", lambda f: got.append(np.asarray(f.tensor(0))))
+    chain.append(sink)
+    p.link_chain(*chain)
+    p.run(timeout=120)
+    assert len(got) == n, f"linear: {len(got)}/{n} frames"
+    for i, a in enumerate(got):
+        np.testing.assert_allclose(a, i * scale, rtol=1e-5,
+                                   err_msg=f"frame {i}")
+
+
+def run_tee(rng):
+    from nnstreamer_tpu import Pipeline
+    from nnstreamer_tpu.backends.jax_backend import JaxModel
+    from nnstreamer_tpu.buffer import Frame
+    from nnstreamer_tpu.elements.filter import TensorFilter
+    from nnstreamer_tpu.elements.queue import Queue
+    from nnstreamer_tpu.elements.sink import TensorSink
+    from nnstreamer_tpu.elements.tee import Tee
+    from nnstreamer_tpu.elements.testsrc import DataSrc
+
+    n = int(rng.integers(20, 100))
+    branches = int(rng.integers(2, 4))
+    frames = [Frame.of(np.full((4,), float(i), np.float32), pts=i)
+              for i in range(n)]
+    got = [[] for _ in range(branches)]
+    p = Pipeline()
+    src = p.add(DataSrc(data=frames))
+    tee = p.add(Tee())
+    p.link(src, tee)
+    for b in range(branches):
+        q = p.add(Queue(max_size_buffers=int(rng.integers(2, 16))))
+        f = p.add(TensorFilter(
+            framework="jax",
+            model=JaxModel(apply=lambda pp, x, b=b: x + float(b)),
+        ))
+        s = p.add(TensorSink())
+        s.connect("new-data",
+                  lambda fr, b=b: got[b].append(np.asarray(fr.tensor(0))))
+        p.link(tee, q)
+        p.link_chain(q, f, s)
+    p.run(timeout=120)
+    for b in range(branches):
+        assert len(got[b]) == n, f"tee branch {b}: {len(got[b])}/{n}"
+        for i, a in enumerate(got[b]):
+            np.testing.assert_allclose(a, i + b, rtol=1e-5)
+
+
+def run_mux(rng):
+    from nnstreamer_tpu import Pipeline, make
+    from nnstreamer_tpu.backends.jax_backend import JaxModel
+    from nnstreamer_tpu.elements.batch import TensorBatch, TensorUnbatch
+    from nnstreamer_tpu.elements.demux import TensorDemux
+    from nnstreamer_tpu.elements.filter import TensorFilter
+    from nnstreamer_tpu.elements.sink import TensorSink
+    from nnstreamer_tpu.elements.testsrc import DataSrc
+
+    k = int(rng.integers(2, 5))
+    per = int(rng.integers(10, 40))
+    d = int(rng.integers(2, 8))
+    got = {s: [] for s in range(k)}
+    p = Pipeline()
+    mux = p.add(make("tensor_mux", sync_mode="nosync"))
+    for s in range(k):
+        src = p.add(DataSrc(
+            data=[np.full((d,), 100.0 * s + t, np.float32)
+                  for t in range(per)], name=f"s{s}"))
+        p.link(src, f"{mux.name}.sink_{s}")
+    batch = p.add(TensorBatch())
+    filt = p.add(TensorFilter(
+        framework="jax", model=JaxModel(apply=lambda pp, x: x * 2.0)))
+    unb = p.add(TensorUnbatch())
+    demux = p.add(TensorDemux())
+    p.link_chain(mux, batch, filt, unb, demux)
+    for s in range(k):
+        sink = p.add(TensorSink(name=f"o{s}"))
+        sink.connect("new-data",
+                     lambda fr, s=s: got[s].append(np.asarray(fr.tensor(0))))
+        p.link(f"{demux.name}.src_{s}", sink)
+    p.run(timeout=120)
+    for s in range(k):
+        assert len(got[s]) == per, f"mux stream {s}: {len(got[s])}/{per}"
+        for t, a in enumerate(got[s]):
+            np.testing.assert_allclose(a, 2.0 * (100.0 * s + t), rtol=1e-5)
+
+
+def run_repo(rng):
+    import bench
+
+    steps = int(rng.integers(10, 40))
+    sps = bench.run_lstm_recurrence_fps(steps, hidden=int(rng.integers(8, 64)))
+    assert sps > 0
+
+
+def run_trainer(rng):
+    import jax.numpy as jnp
+
+    from nnstreamer_tpu import Pipeline
+    from nnstreamer_tpu.backends.jax_backend import JaxModel
+    from nnstreamer_tpu.buffer import Frame
+    from nnstreamer_tpu.elements.sink import TensorSink
+    from nnstreamer_tpu.elements.testsrc import DataSrc
+    from nnstreamer_tpu.elements.trainer import TensorTrainer
+    from nnstreamer_tpu.spec import TensorSpec, TensorsSpec
+
+    n = int(rng.integers(10, 40))
+    d = int(rng.integers(2, 8))
+    b = int(rng.integers(1, 4)) * 2
+    w = rng.standard_normal((d, 2)).astype(np.float32)
+    frames = []
+    for i in range(n):
+        x = rng.standard_normal((b, d)).astype(np.float32)
+        frames.append(Frame.of(x, x @ w, pts=i))
+    model = JaxModel(
+        apply=lambda p, x: x @ p, params=jnp.zeros((d, 2), jnp.float32),
+        input_spec=TensorsSpec.of(TensorSpec(dtype=np.float32, shape=(b, d))),
+    )
+    curve = []
+    p = Pipeline()
+    src = p.add(DataSrc(data=frames))
+    tr = p.add(TensorTrainer(model=model, loss="mse", optimizer="adam,lr=0.05"))
+    sink = p.add(TensorSink())
+    sink.connect("new-data",
+                 lambda f: curve.append(float(np.asarray(f.tensor(0)))))
+    p.link_chain(src, tr, sink)
+    p.run(timeout=120)
+    assert len(curve) == n and all(np.isfinite(v) for v in curve)
+
+
+TEMPLATES = [run_linear, run_tee, run_mux, run_repo, run_trainer]
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--minutes", type=float, default=10.0)
+    ap.add_argument("--seed", type=int, default=None)
+    args = ap.parse_args()
+
+    if args.seed is not None:  # single-iteration repro
+        rng = np.random.default_rng(args.seed)
+        fn = TEMPLATES[int(rng.integers(0, len(TEMPLATES)))]
+        print(f"repro seed={args.seed}: {fn.__name__}")
+        fn(rng)
+        print("OK")
+        return 0
+
+    t_end = time.time() + args.minutes * 60
+    i = fails = 0
+    base = int(time.time())
+    while time.time() < t_end:
+        seed = base + i
+        rng = np.random.default_rng(seed)
+        fn = TEMPLATES[int(rng.integers(0, len(TEMPLATES)))]
+        try:
+            fn(rng)
+            print(f"[{i}] {fn.__name__} seed={seed} OK", flush=True)
+        except Exception:
+            fails += 1
+            print(f"[{i}] {fn.__name__} seed={seed} FAILED", flush=True)
+            traceback.print_exc()
+        i += 1
+    print(f"campaign done: {i} iterations, {fails} failures")
+    return 1 if fails else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
