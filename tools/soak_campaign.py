@@ -5,11 +5,14 @@ checked on every frame.  A failure prints the seed for a one-line repro:
     python tools/soak_campaign.py --seed N
 
 Topology templates (drawn at random per iteration):
-  linear   src → [transform] → [upload+queue | dynbatch | both] → filter → sink
-  tee      src → tee → (queued filter) × 2..3 branches
-  mux      src×K → mux → batch → filter → unbatch → demux → sink×K
-  repo     LSTM-style state cycle through repo slots
-  trainer  (x, y) stream into tensor_trainer, loss must stay finite
+  linear        src → [upload+queue | dynbatch | both] → filter → sink
+  tee           src → tee → (queued filter) × 2..3 branches
+  mux           src×K → mux → batch → filter → unbatch → demux → sink×K
+  repo          LSTM-style state cycle through repo slots
+  trainer       (x, y) stream into tensor_trainer, loss must stay finite
+  renegotiation mid-stream shape changes through random chains
+  valve         event-driven valve close/reopen; order + exactness held
+  interrupt     pipeline.stop() from another thread mid-stream (30s bound)
 
 Usage: python tools/soak_campaign.py [--minutes 10] [--seed N]
 """
@@ -248,7 +251,6 @@ def run_valve_selector(rng):
     """Flow control under load: a valve toggled mid-stream drops a known
     span; frames that pass must stay exact and ordered."""
     import threading
-    import time as _t
 
     from nnstreamer_tpu import Pipeline, make
     from nnstreamer_tpu.buffer import Frame
@@ -282,6 +284,8 @@ def run_valve_selector(rng):
     sink.connect("new-data", on_frame)
     p.link_chain(src, valve, q, sink)
     p.run(timeout=120)
+    # the first close_at deliveries are guaranteed: exactly frames 0..4
+    assert got[:close_at] == list(range(close_at)), got[:close_at]
     # whatever arrived must be strictly increasing (order, no dup)
     assert all(b > a for a, b in zip(got, got[1:])), "reorder/dup past valve"
     assert len(got) >= close_at, f"only {len(got)} frames passed the valve"
@@ -323,18 +327,18 @@ def run_interrupt(rng):
     p.link_chain(*chain)
     p.start()
     _t.sleep(float(rng.uniform(0.01, 0.15)))
-    t0 = _t.monotonic()
     done = threading.Event()
 
     def stopper():
         p.stop()
         done.set()
 
-    th = threading.Thread(target=stopper)
+    # daemon: if stop() truly wedges, the blocked thread must not keep the
+    # campaign process alive past its final summary
+    th = threading.Thread(target=stopper, daemon=True)
     th.start()
     th.join(timeout=30)
     assert done.is_set(), "pipeline.stop() deadlocked (>30s)"
-    assert _t.monotonic() - t0 < 30
 
 
 TEMPLATES = [run_linear, run_tee, run_mux, run_repo, run_trainer,
