@@ -1,0 +1,75 @@
+"""Remote filter offload: tensor_query_client → QueryServer over TCP.
+
+One process owns the accelerator and serves a MobileNet-style classifier;
+any number of edge pipelines stream frames to it.  Here both ends live in
+one script (server on a thread) — across hosts it is the same code with a
+real address.  The offloaded pipeline's labels must match the local
+in-process filter exactly (the transport adds no numerics).
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+import nnstreamer_tpu as nns
+from nnstreamer_tpu.backends.jax_backend import JaxModel
+from nnstreamer_tpu.elements.filter import TensorFilter
+from nnstreamer_tpu.elements.query import QueryServer, TensorQueryClient
+from nnstreamer_tpu.elements.sink import TensorSink
+from nnstreamer_tpu.elements.testsrc import DataSrc
+from nnstreamer_tpu.spec import TensorSpec, TensorsSpec
+
+
+def tiny_classifier():
+    import jax
+    import jax.numpy as jnp
+
+    w = jax.random.normal(jax.random.PRNGKey(0), (16 * 16 * 3, 10),
+                          jnp.float32) * 0.02
+
+    def apply(params, x):
+        return (x.reshape(-1).astype(jnp.float32) / 255.0) @ params
+
+    return JaxModel(
+        apply=apply, params=w,
+        input_spec=TensorsSpec.of(
+            TensorSpec(dtype=np.uint8, shape=(16, 16, 3))),
+    )
+
+
+def run(frames, make_filter):
+    got = []
+    p = nns.Pipeline()
+    src = p.add(DataSrc(data=[f.copy() for f in frames]))
+    filt = p.add(make_filter())
+    sink = p.add(TensorSink())
+    sink.connect("new-data", lambda f: got.append(np.asarray(f.tensor(0))))
+    p.link_chain(src, filt, sink)
+    p.run(timeout=120)
+    return got
+
+
+def main():
+    rng = np.random.default_rng(0)
+    frames = [rng.integers(0, 256, (16, 16, 3)).astype(np.uint8)
+              for _ in range(6)]
+
+    local = run(frames, lambda: TensorFilter(framework="jax",
+                                             model=tiny_classifier()))
+
+    with QueryServer(framework="jax", model=tiny_classifier()) as srv:
+        remote = run(frames, lambda: TensorQueryClient(port=srv.port))
+
+    assert len(local) == len(remote) == 6
+    for a, b in zip(local, remote):
+        np.testing.assert_allclose(a, b, rtol=1e-6)
+        assert np.argmax(a) == np.argmax(b)
+    print(f"offload: {len(remote)} frames served over TCP, "
+          f"labels match local filter — offload=OK")
+
+
+if __name__ == "__main__":
+    main()

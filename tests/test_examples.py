@@ -141,6 +141,7 @@ class TestCodegen:
         ("decode_stream.py", "golden=OK"),
         ("audio_classify.py", "golden=OK"),
         ("train_stream.py", "train_stream OK"),
+        ("offload_query.py", "offload=OK"),
     ],
 )
 def test_pipeline_demo_runs(script, expect):

@@ -1,0 +1,297 @@
+"""tensor_query_client / QueryServer: filter offload over TCP.
+
+Beyond-parity (upstream nnstreamer 2.x's edge-offloading pair; the
+reference snapshot's distributed story is in-process only, survey §2.6).
+Golden strategy: remote results must equal the in-process filter's
+exactly; the transport adds no numerics.
+"""
+
+import socket
+import threading
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from nnstreamer_tpu import Pipeline, parse_launch
+from nnstreamer_tpu.backends.jax_backend import JaxModel
+from nnstreamer_tpu.buffer import Frame
+from nnstreamer_tpu.elements.query import (
+    QueryServer,
+    TensorQueryClient,
+    recv_tensors,
+    send_error,
+    send_tensors,
+)
+from nnstreamer_tpu.elements.sink import TensorSink
+from nnstreamer_tpu.elements.testsrc import DataSrc
+from nnstreamer_tpu.spec import TensorSpec, TensorsSpec
+
+
+def double_model(shape=(4,)):
+    return JaxModel(
+        apply=lambda p, x: x * 2.0,
+        input_spec=TensorsSpec.of(TensorSpec(dtype=np.float32, shape=shape)),
+    )
+
+
+class TestProtocol:
+    def test_roundtrip_multi_tensor(self):
+        a, b = socket.socketpair()
+        try:
+            t0 = np.arange(12, dtype=np.float32).reshape(3, 4)
+            t1 = np.array([7], dtype=np.int64)
+            t2 = np.float32(3.5)  # rank-0
+            send_tensors(a, (t0, t1, t2), pts=123)
+            out, pts = recv_tensors(b)
+            assert pts == 123 and len(out) == 3
+            np.testing.assert_array_equal(out[0], t0)
+            np.testing.assert_array_equal(out[1], t1)
+            assert out[2].shape == () and float(out[2]) == 3.5
+        finally:
+            a.close(); b.close()
+
+    def test_error_frame_raises(self):
+        a, b = socket.socketpair()
+        try:
+            send_error(a, "backend exploded")
+            with pytest.raises(RuntimeError, match="backend exploded"):
+                recv_tensors(b)
+        finally:
+            a.close(); b.close()
+
+    def test_bad_magic_rejected(self):
+        a, b = socket.socketpair()
+        try:
+            a.sendall(b"EVIL" + b"\x00" * 12)
+            with pytest.raises(ConnectionError, match="magic"):
+                recv_tensors(b)
+        finally:
+            a.close(); b.close()
+
+
+class TestQueryPipeline:
+    def test_remote_matches_local(self):
+        frames = [np.full((4,), float(i), np.float32) for i in range(8)]
+        with QueryServer(framework="jax", model=double_model()) as srv:
+            got = []
+            p = Pipeline()
+            src = p.add(DataSrc(data=[f.copy() for f in frames]))
+            cli = p.add(TensorQueryClient(port=srv.port))
+            sink = p.add(TensorSink())
+            sink.connect("new-data",
+                         lambda f: got.append(np.asarray(f.tensor(0))))
+            p.link_chain(src, cli, sink)
+            p.run(timeout=120)
+        assert len(got) == 8
+        for i, a in enumerate(got):
+            np.testing.assert_allclose(a, 2.0 * i)
+
+    def test_pts_preserved_and_output_spec_negotiated(self):
+        model = JaxModel(
+            apply=lambda p, x: x.reshape(-1).sum()[None],
+            input_spec=TensorsSpec.of(
+                TensorSpec(dtype=np.float32, shape=(2, 3))),
+        )
+        with QueryServer(framework="jax", model=model) as srv:
+            frames = [Frame.of(np.full((2, 3), float(i), np.float32),
+                               pts=i * 100) for i in range(4)]
+            got = []
+            p = Pipeline()
+            src = p.add(DataSrc(data=frames))
+            cli = p.add(TensorQueryClient(port=srv.port))
+            sink = p.add(TensorSink())
+            sink.connect("new-data", lambda f: got.append(f))
+            p.link_chain(src, cli, sink)
+            p.run(timeout=120)
+            # negotiated output spec matched what the server returns
+            assert sink.sink_pads["sink"].spec.tensors[0].shape == (1,)
+        assert [f.pts for f in got] == [0, 100, 200, 300]
+        np.testing.assert_allclose(np.asarray(got[2].tensor(0)), [6 * 2.0])
+
+    def test_midstream_renegotiation(self):
+        """Shape drift mid-stream: the server reconfigures its backend the
+        way the in-process filter does."""
+        model = JaxModel(apply=lambda p, x: x * 3.0)  # polymorphic
+        with QueryServer(framework="jax", model=model) as srv:
+            frames = [np.full((4,), 1.0, np.float32),
+                      np.full((2, 3), 2.0, np.float32),
+                      np.full((4,), 3.0, np.float32)]
+            got = []
+            p = Pipeline()
+            src = p.add(DataSrc(data=[f.copy() for f in frames]))
+            cli = p.add(TensorQueryClient(
+                port=srv.port,
+                out_spec=TensorsSpec.of(TensorSpec(dtype=np.float32,
+                                                   shape=None)),
+            ))
+            sink = p.add(TensorSink())
+            sink.connect("new-data",
+                         lambda f: got.append(np.asarray(f.tensor(0))))
+            p.link_chain(src, cli, sink)
+            p.run(timeout=120)
+        assert [a.shape for a in got] == [(4,), (2, 3), (4,)]
+        np.testing.assert_allclose(got[1], 6.0)
+
+    def test_concurrent_clients(self):
+        """Several client pipelines share one server; each stream's
+        results stay exact (the per-connection threads + backend lock)."""
+        with QueryServer(framework="jax", model=double_model()) as srv:
+            results = {}
+
+            def run_client(k):
+                frames = [np.full((4,), float(100 * k + i), np.float32)
+                          for i in range(6)]
+                got = []
+                p = Pipeline()
+                src = p.add(DataSrc(data=frames))
+                cli = p.add(TensorQueryClient(port=srv.port))
+                sink = p.add(TensorSink())
+                sink.connect("new-data",
+                             lambda f: got.append(np.asarray(f.tensor(0))))
+                p.link_chain(src, cli, sink)
+                p.run(timeout=120)
+                results[k] = got
+
+            threads = [threading.Thread(target=run_client, args=(k,))
+                       for k in range(3)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=120)
+        for k in range(3):
+            assert len(results[k]) == 6
+            for i, a in enumerate(results[k]):
+                np.testing.assert_allclose(a, 2.0 * (100 * k + i))
+
+    def test_server_error_propagates(self):
+        """A backend failure comes back as an error frame and fails the
+        negotiation probe loudly (not a silent hang)."""
+        bad = JaxModel(
+            apply=lambda p, x: (_ for _ in ()).throw(ValueError("boom")),
+            input_spec=TensorsSpec.of(
+                TensorSpec(dtype=np.float32, shape=(4,))),
+        )
+        from nnstreamer_tpu.graph.node import NegotiationError
+
+        with QueryServer(framework="jax", model=bad) as srv:
+            p = Pipeline()
+            src = p.add(DataSrc(data=[np.zeros((4,), np.float32)]))
+            cli = p.add(TensorQueryClient(port=srv.port))
+            sink = p.add(TensorSink())
+            p.link_chain(src, cli, sink)
+            with pytest.raises(NegotiationError, match="probe"):
+                p.run(timeout=60)
+
+    def test_oversized_payload_rejected(self):
+        """Hostile framing: declared nbytes inconsistent with the declared
+        geometry must be rejected BEFORE allocation (review r4: remote
+        memory exhaustion)."""
+        import struct
+
+        from nnstreamer_tpu.elements.query import MAGIC, VERSION
+
+        a, b = socket.socketpair()
+        try:
+            evil = (MAGIC + struct.pack("<HHq", VERSION, 1, 0)
+                    + struct.pack("<H", 3) + b"<f4"
+                    + struct.pack("<H", 1) + struct.pack("<I", 2)
+                    + struct.pack("<Q", 1 << 40))  # 1 TiB for a (2,) f32
+            a.sendall(evil)
+            with pytest.raises(ConnectionError, match="payload"):
+                recv_tensors(b)
+        finally:
+            a.close(); b.close()
+
+    def test_mixed_shape_clients_no_thrash(self):
+        """Two clients with different shapes share one server: each spec
+        gets its own cached backend (review r4: interleaved specs used to
+        reconfigure the single backend on every frame)."""
+        model = JaxModel(apply=lambda p, x: x * 2.0)  # polymorphic
+        out_spec = TensorsSpec.of(TensorSpec(dtype=np.float32, shape=None))
+        with QueryServer(framework="jax", model=model) as srv:
+            results = {}
+
+            def client(k, shape):
+                frames = [np.full(shape, float(10 * k + i), np.float32)
+                          for i in range(5)]
+                got = []
+                p = Pipeline()
+                src = p.add(DataSrc(data=frames))
+                cli = p.add(TensorQueryClient(port=srv.port,
+                                              out_spec=out_spec))
+                sink = p.add(TensorSink())
+                sink.connect("new-data",
+                             lambda f: got.append(np.asarray(f.tensor(0))))
+                p.link_chain(src, cli, sink)
+                p.run(timeout=120)
+                results[k] = got
+
+            threads = [
+                threading.Thread(target=client, args=(0, (4,))),
+                threading.Thread(target=client, args=(1, (2, 3))),
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=120)
+            assert len(srv._backends) == 2  # one backend per spec, cached
+        for k, shape in ((0, (4,)), (1, (2, 3))):
+            assert len(results[k]) == 5
+            for i, a in enumerate(results[k]):
+                assert a.shape == shape
+                np.testing.assert_allclose(a, 2.0 * (10 * k + i))
+
+    def test_client_interrupt_unblocks_dead_server(self):
+        """A server that vanishes mid-stream (no FIN) must not hang the
+        pipeline: interrupt() closes the socket so the blocked recv
+        raises and stop() returns promptly (review r4)."""
+        import time
+
+        # a server that accepts, reads the negotiation probe, replies,
+        # then goes silent forever (reads but never replies again)
+        silent_ready = threading.Event()
+        srv_sock = socket.create_server(("127.0.0.1", 0))
+        port = srv_sock.getsockname()[1]
+
+        def half_server():
+            conn, _ = srv_sock.accept()
+            with conn:
+                tensors, pts = recv_tensors(conn)  # negotiation probe
+                send_tensors(conn, tensors, pts)   # answer it
+                silent_ready.set()
+                try:
+                    while True:
+                        if not conn.recv(65536):
+                            return  # client hung up
+                except OSError:
+                    return
+
+        th = threading.Thread(target=half_server, daemon=True)
+        th.start()
+        p = Pipeline()
+        src = p.add(DataSrc(
+            data=[np.zeros((4,), np.float32) for _ in range(50)]))
+        cli = p.add(TensorQueryClient(port=port))
+        sink = p.add(TensorSink())
+        p.link_chain(src, cli, sink)
+        p.start()
+        silent_ready.wait(timeout=30)
+        time.sleep(0.05)  # let a frame enter the silent recv
+        t0 = time.monotonic()
+        p.stop()
+        assert time.monotonic() - t0 < 10, "stop() hung on a dead server"
+        srv_sock.close()
+
+    def test_parse_launch_spelling(self):
+        with QueryServer(framework="jax", model=double_model()) as srv:
+            p = parse_launch(
+                f"datasrc name=s ! tensor_query_client port={srv.port} "
+                "! tensor_sink name=out collect=true"
+            )
+            p["s"].data = [np.full((4,), 5.0, np.float32)]
+            p.run(timeout=60)
+            np.testing.assert_allclose(
+                np.asarray(p["out"].frames[0].tensor(0)), 10.0
+            )
