@@ -13,6 +13,7 @@ Topology templates (drawn at random per iteration):
   renegotiation mid-stream shape changes through random chains
   valve         event-driven valve close/reopen; order + exactness held
   interrupt     pipeline.stop() from another thread mid-stream (30s bound)
+  query         TCP offload: QueryServer + 1-3 concurrent client pipelines
 
 Usage: python tools/soak_campaign.py [--minutes 10] [--seed N]
 """
@@ -341,8 +342,58 @@ def run_interrupt(rng):
     assert done.is_set(), "pipeline.stop() deadlocked (>30s)"
 
 
+def run_query(rng):
+    """TCP offload under churn: an in-process QueryServer, 1-3 client
+    pipelines (threads) with per-stream exactness; random shapes exercise
+    the per-spec backend cache."""
+    import threading
+
+    from nnstreamer_tpu import Pipeline
+    from nnstreamer_tpu.backends.jax_backend import JaxModel
+    from nnstreamer_tpu.elements.query import QueryServer, TensorQueryClient
+    from nnstreamer_tpu.elements.sink import TensorSink
+    from nnstreamer_tpu.elements.testsrc import DataSrc
+    from nnstreamer_tpu.spec import TensorSpec, TensorsSpec
+
+    n_clients = int(rng.integers(1, 4))
+    per = int(rng.integers(5, 25))
+    out_spec = TensorsSpec.of(TensorSpec(dtype=np.float32, shape=None))
+    model = JaxModel(apply=lambda p, x: x * 2.0)
+    with QueryServer(framework="jax", model=model) as srv:
+        results = {}
+
+        def client(k, shape):
+            frames = [np.full(shape, float(100 * k + i), np.float32)
+                      for i in range(per)]
+            got = []
+            p = Pipeline()
+            src = p.add(DataSrc(data=frames))
+            cli = p.add(TensorQueryClient(port=srv.port, out_spec=out_spec))
+            sink = p.add(TensorSink())
+            sink.connect("new-data",
+                         lambda f: got.append(np.asarray(f.tensor(0))))
+            p.link_chain(src, cli, sink)
+            p.run(timeout=120)
+            results[k] = got
+
+        shapes = [tuple(int(rng.integers(2, 5))
+                        for _ in range(int(rng.integers(1, 3))))
+                  for _ in range(n_clients)]
+        ts = [threading.Thread(target=client, args=(k, shapes[k]))
+              for k in range(n_clients)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=120)
+    for k in range(n_clients):
+        assert len(results.get(k, [])) == per, f"client {k} incomplete"
+        for i, a in enumerate(results[k]):
+            np.testing.assert_allclose(a, 2.0 * (100 * k + i), rtol=1e-5)
+
+
 TEMPLATES = [run_linear, run_tee, run_mux, run_repo, run_trainer,
-             run_renegotiation, run_valve_selector, run_interrupt]
+             run_renegotiation, run_valve_selector, run_interrupt,
+             run_query]
 
 
 def main():
