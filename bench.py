@@ -86,7 +86,7 @@ def probe_accelerator(retries=None):
     return None
 
 
-TPU_CACHE_PATH = os.path.join(
+TPU_CACHE_PATH = os.environ.get("BENCH_TPU_CACHE_PATH") or os.path.join(
     os.path.dirname(os.path.abspath(__file__)), "BENCH_TPU_CACHE.json"
 )
 
@@ -1371,8 +1371,10 @@ def main():
                     }
                     results, tpu_fps = child_extra, None
                     platform, on_accel = child["platform"], True
+                    # the surviving parent errors describe the CPU run,
+                    # not the adopted accelerator results — label them
                     errors = [
-                        e for e in errors
+                        f"cpu-fallback run: {e}" for e in errors
                         if not e.startswith("accelerator backend failed")
                     ]
                     if child.get("error"):

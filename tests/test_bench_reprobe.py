@@ -1,0 +1,138 @@
+"""bench.py late re-probe: the round-3 verdict's #1 mechanism.
+
+A failed startup probe pins the run to CPU; if the tunnel recovers while
+the CPU legs run, the end-of-run re-probe must adopt a subprocess's
+accelerator numbers while keeping this run's baselines.  No accelerator
+exists under test, so the probe and the child re-run are stubbed at the
+module boundary — the adoption/merge logic itself runs for real.
+"""
+
+import importlib
+import json
+import pathlib
+import types
+
+import pytest
+
+REPO = str(pathlib.Path(__file__).resolve().parents[1])
+
+
+@pytest.fixture()
+def bench_mod(monkeypatch, tmp_path):
+    monkeypatch.syspath_prepend(REPO)
+    # the stubbed 'tpu' run must write its cache into the sandbox, never
+    # over the repo's real last-chip evidence (review r4: the first run of
+    # this test clobbered BENCH_TPU_CACHE.json with stub numbers)
+    monkeypatch.setenv("BENCH_TPU_CACHE_PATH", str(tmp_path / "cache.json"))
+    import bench
+
+    importlib.reload(bench)
+    assert bench.TPU_CACHE_PATH == str(tmp_path / "cache.json")
+    # keep every leg at zero frames: this test targets orchestration only
+    for var in ("BENCH_FRAMES", "BENCH_UPLOAD_FRAMES", "BENCH_DYNBATCH_FRAMES",
+                "BENCH_QUANT_FRAMES", "BENCH_SSD_FRAMES", "BENCH_POSE_FRAMES",
+                "BENCH_CASCADE_FRAMES", "BENCH_LSTM_STEPS", "BENCH_KV_STEPS",
+                "BENCH_SEQ_WINDOWS", "BENCH_MUX_FRAMES",
+                "BENCH_BREAKDOWN_FRAMES"):
+        monkeypatch.setenv(var, "0")
+    monkeypatch.setenv("BENCH_MFU_BATCHES", "")
+    monkeypatch.setenv("BENCH_SKIP_BASELINES", "1")
+    monkeypatch.setenv("BENCH_NOTES_PATH", str(tmp_path / "notes.md"))
+    monkeypatch.setenv("BENCH_COMPILE_CACHE", "0")
+    monkeypatch.delenv("BENCH_NO_RETRY", raising=False)
+    return bench
+
+
+def run_main(bench, capsys):
+    bench.main()
+    out = capsys.readouterr().out.strip().splitlines()[-1]
+    return json.loads(out)
+
+
+def test_late_reprobe_adopts_child_accel_run(bench_mod, monkeypatch, capsys):
+    bench = bench_mod
+    calls = {"probe": 0, "child": 0}
+
+    def fake_probe(retries=None):
+        calls["probe"] += 1
+        # startup probe (retries from env) fails; the late single-retry
+        # probe finds the tunnel back
+        return "tpu" if retries == 1 else None
+
+    child_payload = {
+        "metric": "m", "value": 999.0, "unit": "fps", "platform": "tpu",
+        "extra": {
+            "config1_stream_fps": 999.0,
+            "config1_dynbatch_fps": 1500.0,
+            "wire_health_start": {"put_150k_ms": 0.3},
+        },
+    }
+
+    real_run = bench.subprocess.run
+
+    def fake_run(argv, **kw):
+        if argv[1:2] and str(argv[1]).endswith("bench.py"):
+            calls["child"] += 1
+            assert kw["env"].get("BENCH_NO_RETRY") == "1"
+            assert kw["env"].get("BENCH_SKIP_BASELINES") == "1"
+            assert "JAX_PLATFORMS" not in kw["env"]  # the CPU pin must not leak
+            return types.SimpleNamespace(
+                stdout=json.dumps(child_payload) + "\n", stderr="",
+                returncode=0,
+            )
+        return real_run(argv, **kw)
+
+    monkeypatch.setattr(bench, "probe_accelerator", fake_probe)
+    monkeypatch.setattr(bench.subprocess, "run", fake_run)
+
+    out = run_main(bench, capsys)
+    assert calls["child"] == 1
+    assert out["platform"] == "tpu"
+    # child's numbers became the primary results, best-variant headline
+    assert out["value"] == 1500.0
+    assert out["extra"]["headline_variant"] == "dynbatch"
+    assert out["extra"]["config1_stream_fps"] == 999.0
+    # the CPU fallback run is preserved as a labeled snapshot WITHOUT a
+    # duplicate baselines copy
+    assert "cpu_fallback_run" in out["extra"]
+    assert "baselines" not in out["extra"]["cpu_fallback_run"]
+
+
+def test_no_retry_env_suppresses_reprobe(bench_mod, monkeypatch, capsys):
+    bench = bench_mod
+    monkeypatch.setenv("BENCH_NO_RETRY", "1")
+    probes = []
+
+    def fake_probe(retries=None):
+        probes.append(retries)
+        return None
+
+    monkeypatch.setattr(bench, "probe_accelerator", fake_probe)
+    out = run_main(bench, capsys)
+    assert out["platform"] == "cpu-fallback"
+    # only the startup probe ran (retries=None); no late retry
+    assert probes == [None]
+
+
+def test_child_also_fallback_keeps_cpu_numbers(bench_mod, monkeypatch, capsys):
+    bench = bench_mod
+    monkeypatch.setattr(
+        bench, "probe_accelerator",
+        lambda retries=None: "tpu" if retries == 1 else None,
+    )
+    real_run = bench.subprocess.run
+
+    def fake_run(argv, **kw):
+        if argv[1:2] and str(argv[1]).endswith("bench.py"):
+            return types.SimpleNamespace(
+                stdout=json.dumps({"platform": "cpu-fallback", "extra": {}})
+                + "\n",
+                stderr="", returncode=0,
+            )
+        return real_run(argv, **kw)
+
+    monkeypatch.setattr(bench.subprocess, "run", fake_run)
+    out = run_main(bench, capsys)
+    assert out["platform"] == "cpu-fallback"
+    assert any("child also fell back" in e for e in
+               out.get("error", "").split(";"))
