@@ -1,0 +1,134 @@
+"""``tensor_if``: route frames by a condition on their tensor VALUES.
+
+Upstream GStreamer-nnstreamer grew a ``tensor_if`` element for exactly
+this (condition on compared values → pass/drop per branch); the reference
+snapshot predates it — its flow control (``valve``, selectors) switches on
+external state only, never on the data.  Typical use: run a cheap detector
+and only forward frames whose best score clears a threshold to the
+expensive classifier downstream (the cascade's streaming cousin).
+
+Supported surface (a focused subset of the upstream properties):
+
+- ``compared_value``: ``max`` | ``min`` | ``mean`` | ``abs-max`` |
+  ``element:<flat-index>`` — reduced over the selected input tensor
+  (``tensor=k``, default 0);
+- ``op``: ``>`` ``>=`` ``<`` ``<=`` ``==`` ``!=`` (string-typed, parsed
+  like every reference element property);
+- ``threshold``: float;
+- ``then`` / ``else_``: ``pass`` | ``drop`` (upstream's
+  PASSTHROUGH/SKIP).
+
+The condition is evaluated on host: for a device-resident payload that is
+one small d2h sync per frame — keep the deciding tensor tiny (scores, not
+images), which is also what the fused decode heads emit.
+
+Observability: ``passed``/``dropped`` counters, and each forwarded frame
+gets ``meta["tensor_if"] = {"value": v, "result": bool}``.
+"""
+
+from __future__ import annotations
+
+import operator
+from typing import Dict, Optional
+
+import numpy as np
+
+from ..buffer import Frame
+from ..graph.node import NegotiationError, Node, Pad
+from ..graph.registry import register_element
+from ..spec import TensorsSpec
+
+_OPS = {
+    ">": operator.gt, ">=": operator.ge, "<": operator.lt,
+    "<=": operator.le, "==": operator.eq, "!=": operator.ne,
+}
+
+
+@register_element("tensor_if")
+class TensorIf(Node):
+    def __init__(
+        self,
+        name: Optional[str] = None,
+        compared_value: str = "max",
+        op: str = ">",
+        threshold: float = 0.5,
+        then: str = "pass",
+        else_: str = "drop",
+        tensor: int = 0,
+        **aliases,
+    ):
+        super().__init__(name)
+        self.add_sink_pad("sink")
+        self.add_src_pad("src")
+        # parse_launch spells the else branch `else=...` (not a python
+        # keyword problem there); accept both spellings
+        if "else" in aliases:
+            else_ = aliases.pop("else")
+        if aliases:
+            raise TypeError(f"unknown properties {sorted(aliases)}")
+        self.compared_value = str(compared_value)
+        if op not in _OPS:
+            raise ValueError(f"unknown op {op!r}; known: {sorted(_OPS)}")
+        self.op = op
+        self.threshold = float(threshold)
+        for action, label in ((then, "then"), (else_, "else")):
+            if action not in ("pass", "drop"):
+                raise ValueError(f"{label} action must be pass|drop, got {action!r}")
+        self.then_action = then
+        self.else_action = else_
+        self.tensor = int(tensor)
+        if self.tensor < 0:
+            raise ValueError(f"tensor index must be >= 0, got {self.tensor}")
+        self.passed = 0
+        self.dropped = 0
+        self._reduce = self._make_reduce(self.compared_value)
+
+    @staticmethod
+    def _make_reduce(cv: str):
+        if cv == "max":
+            return lambda a: float(a.max())
+        if cv == "min":
+            return lambda a: float(a.min())
+        if cv == "mean":
+            return lambda a: float(a.mean())
+        if cv == "abs-max":
+            return lambda a: float(np.abs(a).max())
+        if cv.startswith("element:"):
+            idx = int(cv.split(":", 1)[1])
+            if idx < 0:
+                raise ValueError(f"element index must be >= 0, got {idx}")
+            return lambda a: float(a.reshape(-1)[idx])
+        raise ValueError(
+            f"unknown compared_value {cv!r} "
+            "(max|min|mean|abs-max|element:<i>)"
+        )
+
+    def configure(self, in_specs: Dict[str, TensorsSpec]) -> Dict[str, TensorsSpec]:
+        spec = in_specs["sink"]
+        if self.tensor >= spec.num_tensors:
+            raise NegotiationError(
+                f"{self.name}: tensor={self.tensor} but frames carry "
+                f"{spec.num_tensors}"
+            )
+        t = spec.tensors[self.tensor]
+        if self.compared_value.startswith("element:") and t.is_fixed:
+            idx = int(self.compared_value.split(":", 1)[1])
+            if idx >= t.num_elements:
+                raise NegotiationError(
+                    f"{self.name}: element:{idx} out of range for "
+                    f"{t.num_elements}-element tensor {t}"
+                )
+        return {"src": spec}
+
+    def process(self, pad: Pad, frame: Frame):
+        del pad
+        value = self._reduce(np.asarray(frame.tensors[self.tensor]))
+        result = _OPS[self.op](value, self.threshold)
+        action = self.then_action if result else self.else_action
+        if action == "drop":
+            self.dropped += 1
+            return None
+        self.passed += 1
+        meta = dict(frame.meta)
+        meta["tensor_if"] = {"value": value, "result": bool(result)}
+        return frame.with_tensors(frame.tensors, meta=meta)

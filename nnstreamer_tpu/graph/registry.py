@@ -92,6 +92,7 @@ for _el, _mod in {
     "tensor_dynunbatch": "nnstreamer_tpu.elements.dynbatch",
     "tensor_trainer": "nnstreamer_tpu.elements.trainer",
     "tensor_query_client": "nnstreamer_tpu.elements.query",
+    "tensor_if": "nnstreamer_tpu.elements.tensor_if",
     # runtime/plumbing elements (GStreamer-provided in the reference)
     "queue": "nnstreamer_tpu.elements.queue",
     "tee": "nnstreamer_tpu.elements.tee",

@@ -1,0 +1,115 @@
+"""tensor_if: value-conditional flow control (upstream nnstreamer's
+tensor_if pattern; the reference snapshot's flow control never sees the
+data).  Goldens: exact pass/drop sets on known value streams."""
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu import Pipeline, parse_launch
+from nnstreamer_tpu.elements.sink import TensorSink
+from nnstreamer_tpu.elements.tensor_if import TensorIf
+from nnstreamer_tpu.elements.testsrc import DataSrc
+
+
+def run_if(frames, **props):
+    got = []
+    p = Pipeline()
+    src = p.add(DataSrc(data=frames))
+    tif = p.add(TensorIf(**props))
+    sink = p.add(TensorSink())
+    sink.connect("new-data", lambda f: got.append(f))
+    p.link_chain(src, tif, sink)
+    p.run(timeout=60)
+    return tif, got
+
+
+class TestTensorIf:
+    def test_max_threshold_pass_drop(self):
+        frames = [np.array([0.1 * i, 0.05], np.float32) for i in range(10)]
+        tif, got = run_if(frames, compared_value="max", op=">",
+                          threshold=0.45)
+        vals = [float(np.asarray(f.tensor(0))[0]) for f in got]
+        np.testing.assert_allclose(vals, [0.5, 0.6, 0.7, 0.8, 0.9],
+                                   rtol=1e-6)
+        assert tif.passed == 5 and tif.dropped == 5
+        # forwarded frames carry the decision meta
+        assert got[0].meta["tensor_if"]["result"] is True
+        assert abs(got[0].meta["tensor_if"]["value"] - 0.5) < 1e-6
+
+    def test_inverted_actions(self):
+        """then=drop else=pass: keep only the LOW-score frames."""
+        frames = [np.array([v], np.float32) for v in (0.2, 0.9, 0.1, 0.8)]
+        tif, got = run_if(frames, compared_value="max", op=">",
+                          threshold=0.5, then="drop", else_="pass")
+        vals = [round(float(np.asarray(f.tensor(0))[0]), 2) for f in got]
+        assert vals == [0.2, 0.1]
+
+    def test_reduce_modes(self):
+        a = np.array([[-3.0, 1.0], [2.0, 0.5]], np.float32)
+        cases = {
+            "max": 2.0, "min": -3.0, "mean": 0.125, "abs-max": 3.0,
+            "element:2": 2.0,
+        }
+        for cv, want in cases.items():
+            tif, got = run_if([a.copy()], compared_value=cv, op=">=",
+                              threshold=want)
+            assert len(got) == 1, cv  # == threshold → >= passes
+            assert abs(got[0].meta["tensor_if"]["value"] - want) < 1e-6, cv
+
+    def test_second_tensor_selects(self):
+        from nnstreamer_tpu.buffer import Frame
+
+        frames = [
+            Frame.of(np.zeros((4,), np.float32),
+                     np.array([score], np.float32), pts=i)
+            for i, score in enumerate((0.9, 0.1, 0.7))
+        ]
+        tif, got = run_if(frames, compared_value="max", op=">",
+                          threshold=0.5, tensor=1)
+        assert [f.pts for f in got] == [0, 2]
+
+    def test_parse_launch_spelling_with_else(self):
+        p = parse_launch(
+            "datasrc name=s ! tensor_if name=cond compared-value=mean "
+            "op=< threshold=0.0 then=pass else=drop "
+            "! tensor_sink name=out collect=true"
+        )
+        p["s"].data = [np.array([v], np.float32) for v in (-1.0, 1.0, -2.0)]
+        p.run(timeout=60)
+        vals = [float(np.asarray(f.tensor(0))[0]) for f in p["out"].frames]
+        assert vals == [-1.0, -2.0]
+        assert p["cond"].passed == 2 and p["cond"].dropped == 1
+
+    def test_bad_props_rejected(self):
+        with pytest.raises(ValueError, match="op"):
+            TensorIf(op="~")
+        with pytest.raises(ValueError, match="compared_value"):
+            TensorIf(compared_value="median")
+        with pytest.raises(ValueError, match="then"):
+            TensorIf(then="route")
+        with pytest.raises(TypeError, match="unknown properties"):
+            TensorIf(bogus=1)
+
+    def test_bad_tensor_index_rejected_at_configure(self):
+        from nnstreamer_tpu.graph.node import NegotiationError
+        from nnstreamer_tpu.spec import TensorSpec, TensorsSpec
+
+        tif = TensorIf(tensor=2)
+        with pytest.raises(NegotiationError, match="tensor=2"):
+            tif.configure({"sink": TensorsSpec.of(
+                TensorSpec(dtype=np.float32, shape=(4,)))})
+
+    def test_negative_indices_rejected(self):
+        with pytest.raises(ValueError, match="tensor index"):
+            TensorIf(tensor=-1)
+        with pytest.raises(ValueError, match="element index"):
+            TensorIf(compared_value="element:-5")
+
+    def test_element_out_of_range_rejected_at_configure(self):
+        from nnstreamer_tpu.graph.node import NegotiationError
+        from nnstreamer_tpu.spec import TensorSpec, TensorsSpec
+
+        tif = TensorIf(compared_value="element:10")
+        with pytest.raises(NegotiationError, match="element:10"):
+            tif.configure({"sink": TensorsSpec.of(
+                TensorSpec(dtype=np.float32, shape=(4,)))})
