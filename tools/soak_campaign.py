@@ -391,9 +391,34 @@ def run_query(rng):
             np.testing.assert_allclose(a, 2.0 * (100 * k + i), rtol=1e-5)
 
 
+def run_tensor_if(rng):
+    """Value-gating under load: known value stream through tensor_if —
+    the surviving set must be exactly the frames matching the predicate."""
+    from nnstreamer_tpu import Pipeline
+    from nnstreamer_tpu.elements.sink import TensorSink
+    from nnstreamer_tpu.elements.tensor_if import TensorIf
+    from nnstreamer_tpu.elements.testsrc import DataSrc
+
+    n = int(rng.integers(20, 80))
+    thr = float(rng.uniform(0.2, 0.8))
+    vals = rng.uniform(0.0, 1.0, n).astype(np.float32)
+    got = []
+    p = Pipeline()
+    src = p.add(DataSrc(data=[np.array([v], np.float32) for v in vals]))
+    tif = p.add(TensorIf(compared_value="max", op=">", threshold=thr))
+    sink = p.add(TensorSink())
+    sink.connect("new-data",
+                 lambda f: got.append(float(np.asarray(f.tensor(0))[0])))
+    p.link_chain(src, tif, sink)
+    p.run(timeout=120)
+    want = [float(v) for v in vals if v > thr]
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+    assert tif.passed == len(want) and tif.dropped == n - len(want)
+
+
 TEMPLATES = [run_linear, run_tee, run_mux, run_repo, run_trainer,
              run_renegotiation, run_valve_selector, run_interrupt,
-             run_query]
+             run_query, run_tensor_if]
 
 
 def main():
