@@ -94,13 +94,34 @@ TPU_CACHE_PATH = os.environ.get("BENCH_TPU_CACHE_PATH") or os.path.join(
 def save_tpu_cache(out: dict) -> None:
     """Persist the last on-accelerator results: a later run that loses the
     tunnel (wedges can outlast a whole round) still carries the most recent
-    real-chip evidence, clearly labeled as cached."""
+    real-chip evidence, clearly labeled as cached.
+
+    Every accelerator run is ALSO archived append-only under BENCH_RUNS/
+    (timestamped): the tunnel's wire oscillates >100x between runs, so no
+    single run is the whole story — the archive keeps each one, with its
+    wire-health brackets, for side-by-side reading."""
+    payload = {"cached_at": time.strftime("%Y-%m-%d %H:%M:%S"), "result": out}
     try:
         with open(TPU_CACHE_PATH, "w") as f:
-            json.dump({"cached_at": time.strftime("%Y-%m-%d %H:%M:%S"),
-                       "result": out}, f)
+            json.dump(payload, f)
     except Exception as exc:
         log(f"# tpu-cache save failed: {exc!r}")
+    try:
+        runs_dir = os.environ.get("BENCH_RUNS_DIR")
+        if runs_dir is None:
+            if os.environ.get("BENCH_TPU_CACHE_PATH"):
+                # sandboxed run (tests redirect the cache exactly so stub
+                # numbers never touch the repo's evidence files) — keep the
+                # append-only archive equally clean
+                return
+            runs_dir = os.path.join(
+                os.path.dirname(os.path.abspath(__file__)), "BENCH_RUNS")
+        os.makedirs(runs_dir, exist_ok=True)
+        stamp = time.strftime("%Y%m%d_%H%M%S")
+        with open(os.path.join(runs_dir, f"bench_{stamp}.json"), "w") as f:
+            json.dump(payload, f)
+    except Exception as exc:
+        log(f"# bench-archive save failed: {exc!r}")
 
 
 def load_tpu_cache():
@@ -705,6 +726,47 @@ def measure_wire_health(n=20):
     return {"put_150k_ms": round(put_ms, 3), "dispatch_ms": round(disp_ms, 3)}
 
 
+def make_wire_gate(results, on_accel):
+    """Per-leg wire gate + stamp (the oscillating-tunnel answer).
+
+    The tunneled chip's host→device path swings 0.2 ms ↔ 30 ms per 150 KB
+    on a minutes timescale (verify-skill field notes), so a single
+    start-of-run bracket can misrepresent half the legs.  Before each
+    accelerator leg: spot-check the wire; if sick (>5 ms/150 KB), wait up
+    to BENCH_WIRE_LEG_RETRIES×30 s for the fast regime; either way stamp
+    the leg with the wire state it actually ran under
+    (``results["wire_per_leg"][label]``).  The stamp is what lets a reader
+    separate 'the code is slow' from 'the tunnel was sick during this leg'.
+    """
+    try:
+        leg_retries = max(0, int(os.environ.get("BENCH_WIRE_LEG_RETRIES", "2")))
+    except ValueError:
+        leg_retries = 2
+
+    def gate(label):
+        if not on_accel:
+            return
+        try:
+            h = measure_wire_health(n=10)
+            waited = 0
+            while h["put_150k_ms"] > 5.0 and waited < leg_retries:
+                waited += 1
+                log(f"# wire sick before {label} ({h}); waiting 30s "
+                    f"({waited}/{leg_retries})")
+                time.sleep(30)
+                h = measure_wire_health(n=10)
+            h = dict(h)
+            if waited:
+                h["waits"] = waited
+            results.setdefault("wire_per_leg", {})[label] = h
+            log(f"# wire before {label}: {h}")
+        except Exception as exc:  # a failed stamp must not cost the leg
+            results.setdefault("wire_per_leg", {})[label] = {
+                "error": repr(exc)[:120]}
+
+    return gate
+
+
 def measure_pallas():
     """Pallas kernels vs plain XLA on the active platform (VERDICT weak #3:
     these had only ever run in interpret mode before round 2)."""
@@ -870,7 +932,13 @@ def write_notes(results, platform, errors):
         "(150 KB flat put + dispatch) at both ends of the run: the tunneled "
         "chip's transfer path oscillates >100× on a timescale of minutes, "
         "so throughput numbers are only comparable against a similar "
-        "`put_150k_ms`.  Healthy ≈ 0.3-1 ms; sick ≈ 15-30 ms.",
+        "`put_150k_ms`.  Healthy ≈ 0.3-1 ms; sick ≈ 15-30 ms.  "
+        "`wire_per_leg.*` stamps the wire state each accelerator leg "
+        "actually ran under (measured immediately before the leg; sick "
+        "wire waits up to 2×30 s for the fast regime first): a leg whose "
+        "`put_150k_ms` is in the sick regime is tunnel-limited — at "
+        "~150 KB/frame the sick wire alone caps streaming at ~30-130 fps "
+        "regardless of the code under test.",
         "",
         "| measurement | value | measured on |",
         "|---|---|---|",
@@ -1004,6 +1072,8 @@ def main():
         except Exception as exc:
             errors.append(f"wire health start: {exc!r}"[:200])
 
+    wire_gate = make_wire_gate(results, on_accel)
+
     # -- config #1: streaming image-labeling pipeline (jax backend) --------
     tpu_fps = None
     jax_model = None
@@ -1012,6 +1082,8 @@ def main():
 
         jax_model = mobilenet_v2.build(num_classes=1001, image_size=224)
         n_tpu = int(os.environ.get("BENCH_FRAMES", "400"))
+        if n_tpu > 0:
+            wire_gate("config1_stream")
         if n_tpu <= 0:
             errors.append("config1 jax leg: skipped (0 frames)")
         if n_tpu > 0:
@@ -1034,6 +1106,7 @@ def main():
                                  os.environ.get("BENCH_FRAMES", "400")))
         if n_u <= 0:
             raise _Skipped("skipped (0 frames)")
+        wire_gate("config1_upload")
         u_fps = run_pipeline_fps(
             "jax", jax_model, [image_u8.copy() for _ in range(n_u)],
             upload=True,
@@ -1050,6 +1123,7 @@ def main():
                                  os.environ.get("BENCH_FRAMES", "400")))
         if n_d <= 0:
             raise _Skipped("skipped (0 frames)")
+        wire_gate("config1_dynbatch")
         d_fps, d_batches, d_frames = run_dynbatch_fps(
             [image_u8.copy() for _ in range(n_d)]
         )
@@ -1069,6 +1143,7 @@ def main():
                                   os.environ.get("BENCH_FRAMES", "400")))
         if n_du <= 0:
             raise _Skipped("skipped (0 frames)")
+        wire_gate("config1_dynupload")
         du_fps, du_batches, du_frames = run_dynbatch_fps(
             [image_u8.copy() for _ in range(n_du)], upload=True
         )
@@ -1089,6 +1164,7 @@ def main():
         if n_q <= 0:
             raise _Skipped("skipped (0 frames)")
         quant_model = mobilenet_v2.build_quantized(num_classes=1001, image_size=224)
+        wire_gate("config1_quant")
         q_fps = run_pipeline_fps(
             "jax", quant_model, [image_u8.copy() for _ in range(n_q)]
         )
@@ -1111,6 +1187,7 @@ def main():
         ssd = ssd_mobilenet.build(num_labels=91, image_size=300,
                                   fused_decode=100)
         img300 = rng.integers(0, 256, (300, 300, 3)).astype(np.uint8)
+        wire_gate("config2_ssd")
         ssd_fps = run_pipeline_fps(
             "jax", ssd, [img300.copy() for _ in range(n_ssd)],
             decoder=("bounding_boxes", {
@@ -1135,6 +1212,7 @@ def main():
             raise _Skipped("skipped (0 frames)")
         pose = posenet.build(image_size=224, fused_decode=True)
         grid = posenet.grid_size(224)
+        wire_gate("config3_pose")
         pose_fps = run_pipeline_fps(
             "jax", pose, [image_u8.copy() for _ in range(n_pose)],
             decoder=("pose_estimation", {
@@ -1162,6 +1240,7 @@ def main():
                 num_classes=1001,
             )
             img300c = rng.integers(0, 256, (300, 300, 3)).astype(np.uint8)
+            wire_gate("config2c_cascade")
             c_fps = run_pipeline_fps(
                 "jax", casc, [img300c.copy() for _ in range(n_casc)]
             )
@@ -1176,6 +1255,7 @@ def main():
         n_steps = int(os.environ.get("BENCH_LSTM_STEPS", "200"))
         if n_steps <= 0:
             raise _Skipped("skipped (0 steps)")
+        wire_gate("config4_lstm")
         lstm_fps = run_lstm_recurrence_fps(n_steps)
         results["config4_lstm_steps_per_sec"] = round(lstm_fps, 2)
         results["config4_steps"] = n_steps
@@ -1193,6 +1273,7 @@ def main():
         if n_kv > 120:  # t_max=128 cache bounds the stream (minus warmup)
             log(f"# config4c: clamping {n_kv} steps to 120 (cache t_max=128)")
             n_kv = 120
+        wire_gate("config4c_kvdecode")
         kv_fps = run_kvdecode_fps(n_kv)
         results["config4c_kvdecode_steps_per_sec"] = round(kv_fps, 2)
         results["config4c_steps"] = n_kv
@@ -1219,6 +1300,7 @@ def main():
             rng.standard_normal((seq_len, width)).astype(np.float32)
             for _ in range(n_win)
         ]
+        wire_gate("config4b_seq")
         win_fps = run_pipeline_fps("jax", seq_model, windows, normalize=False)
         results["config4b_seq_windows_per_sec"] = round(win_fps, 2)
         results["config4b_windows"] = n_win
@@ -1259,6 +1341,7 @@ def main():
                 )
                 if streams == n_streams:
                     headline_model = batched  # reused by the upload variant
+                wire_gate(f"config5_streams{streams}")
                 fps = run_mux_batched_fps(
                     batched, streams, per_stream, image_u8,
                     framework="jax-sharded",
@@ -1296,6 +1379,7 @@ def main():
 
     # -- per-frame breakdown (where the time goes, config #1) --------------
     try:
+        wire_gate("frame_breakdown")
         results["frame_breakdown"] = measure_frame_breakdown(image_u8)
         log(f"# frame breakdown: {results['frame_breakdown']}")
     except Exception as exc:
@@ -1326,10 +1410,47 @@ def main():
             errors.append(f"wire health end: {exc!r}"[:200])
 
     # -- CPU baselines: the reference stack, isolated subprocesses ---------
+    # BENCH_BASELINES_FROM=<prior bench JSON> reuses that run's isolated
+    # baselines (same host, same methodology) so a re-run during a short
+    # healthy-wire window spends its minutes on the accelerator legs; each
+    # reused row is stamped ``reused_from`` for transparency.
     baselines = {}
+    reuse_path = os.environ.get("BENCH_BASELINES_FROM")
+    if reuse_path:
+        try:
+            with open(reuse_path) as f:
+                prior = json.load(f)
+            if "result" in prior:  # BENCH_TPU_CACHE.json wrapper
+                prior = prior["result"] or {}
+            prior_b = ((prior.get("extra") or {}).get("baselines")
+                       or prior.get("baselines") or {})
+            host_cpus = os.cpu_count()
+            for which, leg in prior_b.items():
+                if not (isinstance(leg, dict) and leg.get("ok")):
+                    continue
+                if leg.get("cpu_count") != host_cpus:
+                    # a baseline from a different host shape would silently
+                    # distort every ratio (the round-1/round-2 distortion,
+                    # see BENCH_NOTES) — refuse it and measure fresh
+                    errors.append(
+                        f"baseline {which} from {reuse_path} ignored: "
+                        f"measured on a {leg.get('cpu_count')}-CPU host, "
+                        f"this host has {host_cpus}")
+                    continue
+                baselines[which] = dict(
+                    leg, reused_from=os.path.basename(reuse_path))
+            log(f"# baselines reused from {reuse_path}: {sorted(baselines)}")
+            if not baselines:
+                errors.append(
+                    f"BENCH_BASELINES_FROM={reuse_path}: no usable rows; "
+                    "measuring fresh")
+        except Exception as exc:
+            errors.append(f"BENCH_BASELINES_FROM load failed: {exc!r}"[:200])
     if os.environ.get("BENCH_SKIP_BASELINES", "") != "1":
         for which in ("config1", "config1_quant", "config2", "config2c",
                       "config3", "config4", "config4b", "config5"):
+            if which in baselines:
+                continue
             if over_budget(f"baseline {which}"):
                 continue
             try:

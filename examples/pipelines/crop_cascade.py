@@ -1,0 +1,99 @@
+"""Streaming detect→crop→classify with ``tensor_crop`` (element cascade).
+
+The sibling example ``cascade_detect_classify.py`` fuses the whole cascade
+into ONE XLA program — fastest, but the detector and classifier must be
+co-compiled.  This pipeline keeps them as independent filters joined by
+``tensor_crop`` (upstream nnstreamer's element), which is what you want
+when the two models evolve separately or the detector is not jax:
+
+            ┌► tensor_filter (detector) ─► scores→regions ─┐ (info pad)
+videotestsrc┤                                              ├ tensor_crop
+            └──────────────── raw frames ──────────────────┘ (raw pad)
+                          → (K,H,W,C) stack → tensor_filter (classifier)
+
+``tensor_crop size=W:H num=K`` emits a constant-shape crop stack, so the
+classifier compiles exactly one executable — no per-region shape churn.
+Here the "detector" is a tiny jittable stub emitting two moving boxes;
+swap in ``models/ssd_mobilenet.py`` + a region-extracting transform for
+the real thing.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+import nnstreamer_tpu as nns
+from nnstreamer_tpu.backends.jax_backend import JaxModel
+from nnstreamer_tpu.elements.crop import TensorCrop
+from nnstreamer_tpu.elements.filter import TensorFilter
+from nnstreamer_tpu.elements.sink import TensorSink
+from nnstreamer_tpu.spec import TensorSpec, TensorsSpec
+
+
+def main():
+    import jax.numpy as jnp
+
+    H = W = 64
+    K, CW, CH = 2, 16, 16
+
+    # "Detector": derives K [x, y, w, h] regions from the frame content —
+    # stands in for an SSD head; jittable, so it runs as a jax filter.
+    def detect(params, img):
+        del params
+        s = jnp.sum(img.astype(jnp.float32)) % 32
+        x0 = s.astype(jnp.int32)
+        return jnp.stack([
+            jnp.array([0, 0, CW, CH], jnp.int32)
+            + jnp.array([1, 0, 0, 0], jnp.int32) * x0,
+            jnp.array([W - CW, H - CH, CW, CH], jnp.int32),
+        ])
+
+    detector = JaxModel(
+        apply=detect, params={},
+        input_spec=TensorsSpec.of(TensorSpec(np.uint8, (H, W, 3))),
+    )
+
+    # Classifier: mean-pools each crop into 4 "logits" — stands in for
+    # MobileNet over the (K, CH, CW, 3) stack.
+    def classify(params, crops):
+        del params
+        x = crops.astype(jnp.float32) / 255.0
+        pooled = x.mean(axis=(1, 2))            # (K, 3)
+        return jnp.concatenate([pooled, pooled.max(-1, keepdims=True)], -1)
+
+    classifier = JaxModel(
+        apply=classify, params={},
+        input_spec=TensorsSpec.of(TensorSpec(np.uint8, (K, CH, CW, 3))),
+    )
+
+    p = nns.Pipeline(name="crop_cascade")
+    src = p.add(nns.make("videotestsrc", name="cam", num_buffers=6,
+                         width=W, height=H))
+    conv = p.add(nns.make("tensor_converter", name="conv"))
+    tee = p.add(nns.make("tee", name="t"))
+    det = p.add(TensorFilter(name="det", framework="jax", model=detector))
+    crop = p.add(TensorCrop(name="crop", size=f"{CW}:{CH}", num=K,
+                            sync_mode="slowest"))
+    cls = p.add(TensorFilter(name="cls", framework="jax", model=classifier))
+    sink = p.add(TensorSink(name="out", collect=True))
+
+    p.link_chain(src, conv, tee)
+    p.link("t.src_0", "crop.raw")
+    p.link("t.src_1", "det.sink")
+    p.link(det, "crop.info")
+    p.link_chain(crop, cls, sink)
+    p.run(timeout=300)
+
+    for i, frame in enumerate(sink.frames):
+        logits = np.asarray(frame.tensor(0))
+        print(f"frame {i}: {logits.shape[0]} crops, "
+              f"top logit {logits.max():.3f}")
+    assert len(sink.frames) == 6
+    print("ok")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,164 @@
+"""``tensor_crop``: crop regions out of a tensor stream, driven by a second
+stream of region tensors.
+
+Upstream GStreamer-nnstreamer grew ``tensor_crop`` (raw + info sink pads;
+the info stream carries ``[x, y, w, h]`` regions; output is the cropped
+tensors) for the detect→crop→classify pattern; the reference snapshot
+predates it, where the same topology needs host ``videocrop`` per region.
+Two pads are collected with the same time-sync engine as ``tensor_mux``
+(``tensor_common.c:1150-1266``).
+
+Two output modes, chosen by whether a static crop size is given:
+
+- **dynamic** (default): one output tensor per region, each with its own
+  ``(h, w, C)`` shape — the analog of upstream's flexible tensors.  Region
+  count and sizes vary per frame, so the negotiated output spec leaves
+  dims open; fine for sinks/decoders, not for a jitted filter.
+- **static** (``size="W:H" num=K``): always emits ONE ``(K, H, W, C)``
+  tensor — K crops of constant size, zero-padded when fewer regions
+  arrive, region ``w/h`` ignored in favor of the static size, ``x/y``
+  clamped to the frame.  Constant shape means the downstream
+  ``tensor_filter`` compiles ONE executable and every frame takes the
+  same XLA program — the TPU-first way to stream a crop cascade (the
+  fully-fused alternative is ``models/cascade.py``, which does detect+
+  crop+classify in a single program).
+
+Info tensor: ``(4,)`` or ``(N, 4)`` integer/float rows ``[x, y, w, h]``
+in pixels; raw tensor: ``(H, W, C)`` (the converter's video layout).
+
+**Empty-region sentinel**: a row with ``w <= 0`` or ``h <= 0`` means "no
+detection here" and is skipped in both modes (the spec layer forbids
+zero-sized dims, so a detector cannot emit a ``(0, 4)`` tensor; it pads
+its fixed-K output with zero-area rows instead — exactly what the fused
+SSD head's top-k emits for low-score slots).  A frame whose regions are
+all empty yields an all-zero stack in static mode and is dropped in
+dynamic mode.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import numpy as np
+
+from ..buffer import Frame
+from ..graph.node import NegotiationError
+from ..graph.registry import register_element
+from ..spec import NNS_TENSOR_SIZE_LIMIT, TensorSpec, TensorsSpec
+from .collect import CollectNode
+
+
+@register_element("tensor_crop")
+class TensorCrop(CollectNode):
+    REQUEST_SINK_PADS = False
+
+    def __init__(
+        self,
+        name: Optional[str] = None,
+        size: str = "",
+        num: int = 0,
+        sync_mode: str = "slowest",
+        sync_option: str = "",
+    ):
+        super().__init__(name, sync_mode=sync_mode, sync_option=sync_option)
+        self.add_sink_pad("raw")
+        self.add_sink_pad("info")
+        self.size = str(size)
+        self.num = int(num)
+        self._static_wh = None
+        if self.size:
+            parts = self.size.split(":")
+            if len(parts) != 2:
+                raise ValueError(f"size must be 'W:H', got {self.size!r}")
+            w, h = int(parts[0]), int(parts[1])
+            if w <= 0 or h <= 0:
+                raise ValueError(f"size must be positive, got {self.size!r}")
+            if self.num <= 0:
+                raise ValueError("static mode (size=W:H) requires num=K > 0")
+            self._static_wh = (w, h)
+        elif self.num < 0:
+            raise ValueError(f"num must be >= 0, got {self.num}")
+
+    # -- negotiation --------------------------------------------------------
+
+    def configure(self, in_specs: Dict[str, TensorsSpec]) -> Dict[str, TensorsSpec]:
+        raw = in_specs["raw"].tensors[0]
+        info = in_specs["info"].tensors[0]
+        if raw.rank is not None and raw.rank != 3:
+            raise NegotiationError(
+                f"{self.name}: raw pad expects (H, W, C) video-layout "
+                f"tensors, got {raw}"
+            )
+        if info.shape is not None:
+            last = info.shape[-1]
+            if last is not None and last != 4:
+                raise NegotiationError(
+                    f"{self.name}: info regions must be [x, y, w, h] rows, "
+                    f"got trailing dim {last}"
+                )
+        rate = in_specs["raw"].rate
+        if self._static_wh is not None:
+            w, h = self._static_wh
+            chan = raw.shape[2] if raw.shape is not None else None
+            out = TensorSpec(dtype=raw.dtype, shape=(self.num, h, w, chan))
+            return {"src": TensorsSpec.of(out, rate=rate)}
+        # dynamic mode: per-region shapes are data-dependent
+        chan = raw.shape[2] if raw.shape is not None else None
+        out = TensorSpec(dtype=raw.dtype, shape=(None, None, chan))
+        return {"src": TensorsSpec.of(out, rate=rate)}
+
+    # -- combination --------------------------------------------------------
+
+    @staticmethod
+    def _regions(info_arr: np.ndarray) -> np.ndarray:
+        r = np.asarray(info_arr)
+        if r.ndim == 1:
+            r = r.reshape(1, -1)
+        if r.ndim != 2 or r.shape[-1] != 4:
+            raise ValueError(
+                f"info tensor must be (4,) or (N, 4) [x, y, w, h], "
+                f"got shape {r.shape}"
+            )
+        return r.astype(np.int64)
+
+    def combine(self, frames: Dict[str, Frame]) -> Optional[Frame]:
+        raw_f, info_f = frames["raw"], frames["info"]
+        img = np.asarray(raw_f.tensors[0])
+        regions = self._regions(info_f.tensors[0])
+        H, W = img.shape[0], img.shape[1]
+        pts, dur = self.output_timing(frames)
+
+        if self._static_wh is not None:
+            w, h = self._static_wh
+            out = np.zeros((self.num, h, w, img.shape[2]), dtype=img.dtype)
+            filled = 0
+            for i in range(len(regions)):
+                if filled >= self.num:
+                    break
+                if regions[i, 2] <= 0 or regions[i, 3] <= 0:
+                    continue  # empty-region sentinel row
+                x, y = int(regions[i, 0]), int(regions[i, 1])
+                x = max(0, min(x, W - w)) if W >= w else 0
+                y = max(0, min(y, H - h)) if H >= h else 0
+                src = img[y:y + h, x:x + w]
+                out[filled, :src.shape[0], :src.shape[1]] = src
+                filled += 1
+            meta = dict(raw_f.meta)
+            meta["tensor_crop"] = {"regions": filled}
+            return Frame(tensors=(out,), pts=pts, duration=dur, meta=meta)
+
+        crops = []
+        limit = self.num if self.num > 0 else NNS_TENSOR_SIZE_LIMIT
+        for x, y, w, h in regions:
+            if len(crops) >= limit:
+                break
+            x0, y0 = max(0, int(x)), max(0, int(y))
+            x1, y1 = min(W, int(x) + int(w)), min(H, int(y) + int(h))
+            if x1 <= x0 or y1 <= y0:
+                continue  # empty/degenerate region (sentinel or clipped away)
+            crops.append(np.ascontiguousarray(img[y0:y1, x0:x1]))
+        if not crops:
+            return None  # no valid region: drop the round (upstream: empty)
+        meta = dict(raw_f.meta)
+        meta["tensor_crop"] = {"regions": len(crops)}
+        return Frame(tensors=tuple(crops), pts=pts, duration=dur, meta=meta)

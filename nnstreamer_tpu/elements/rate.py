@@ -1,0 +1,120 @@
+"""``tensor_rate``: adapt a tensor stream to a target frame rate.
+
+Upstream GStreamer-nnstreamer's ``tensor_rate`` (itself modeled on
+``videorate``) drops or duplicates frames so the output stream carries
+exactly ``framerate=N/D``; the reference snapshot predates it — its only
+rate control is ``tensor_sink``'s ``signal-rate`` *signal throttle*
+(``tensor_sink/README.md:24-33``), which throttles callbacks, not the
+stream.  A real rate adapter matters on TPU for the opposite reason it
+does on-device: it bounds how many frames per second cross the
+host↔device wire, the usual bottleneck.
+
+Semantics (pts-driven, no wall clock — the graph runtime is data-driven):
+
+- The output timeline is slotted at ``period = D/N`` seconds (ns
+  internally); slot k's pts is ``k * period``.
+- Each incoming frame claims every unclaimed slot up to its pts: earlier
+  slots are filled with the *previous* frame (duplication), as
+  ``videorate`` does.
+- A frame whose pts lands in an already-claimed slot is dropped.
+- With ``throttle=false`` the element only *restamps* (drops nothing,
+  duplicates nothing) — the upstream property's meaning: rate enforcement
+  off, bookkeeping on.
+- Emission is eager (a frame goes out in its own slot immediately), so
+  worst-case added latency is one frame.
+
+Counters mirror upstream's readout properties: ``in_frames``,
+``out_frames``, ``dup``, ``drop``.
+"""
+
+from __future__ import annotations
+
+from fractions import Fraction
+from typing import Dict, Optional
+
+from ..buffer import Frame, is_valid_ts
+from ..graph.node import Node, Pad
+from ..graph.registry import register_element
+from ..spec import TensorsSpec
+
+_SECOND_NS = 1_000_000_000
+
+
+@register_element("tensor_rate")
+class TensorRate(Node):
+    def __init__(
+        self,
+        name: Optional[str] = None,
+        framerate: str = "30/1",
+        throttle: bool = True,
+    ):
+        super().__init__(name)
+        self.add_sink_pad("sink")
+        self.add_src_pad("src")
+        try:
+            if "/" in str(framerate):
+                num, den = str(framerate).split("/", 1)
+                self.rate = Fraction(int(num), int(den))
+            else:
+                self.rate = Fraction(framerate)
+        except (ValueError, ZeroDivisionError) as exc:
+            raise ValueError(f"bad framerate {framerate!r}: {exc}") from None
+        if self.rate <= 0:
+            raise ValueError(f"framerate must be positive, got {framerate!r}")
+        self.throttle = bool(throttle) if not isinstance(throttle, str) \
+            else throttle.lower() in ("1", "true", "yes")
+        self._period_ns = int(_SECOND_NS * self.rate.denominator
+                              / self.rate.numerator)
+        self._next_slot = 0           # first unclaimed output slot index
+        self._pending: Optional[Frame] = None  # previous frame (duplication)
+        self.in_frames = 0
+        self.out_frames = 0
+        self.dup = 0
+        self.drop = 0
+
+    def configure(self, in_specs: Dict[str, TensorsSpec]) -> Dict[str, TensorsSpec]:
+        spec = in_specs["sink"]
+        return {"src": TensorsSpec(tensors=spec.tensors, rate=self.rate)}
+
+    # -- slotting -----------------------------------------------------------
+
+    def _slot_of(self, pts: int) -> int:
+        # a frame belongs to the nearest slot (videorate centers likewise)
+        return max(0, (pts + self._period_ns // 2) // self._period_ns)
+
+    def _emit_slot(self, frame: Frame, slot: int, duplicated: bool):
+        self.out_frames += 1
+        if duplicated:
+            self.dup += 1
+        self.src_pads["src"].push(frame.with_tensors(
+            frame.tensors,
+            pts=slot * self._period_ns,
+            duration=self._period_ns,
+        ))
+
+    def process(self, pad: Pad, frame: Frame):
+        del pad
+        self.in_frames += 1
+        if not self.throttle:
+            # restamp-only mode: pass every frame, slotted sequentially
+            self._emit_slot(frame, self._next_slot, duplicated=False)
+            self._next_slot += 1
+            return None
+        pts = frame.pts if is_valid_ts(frame.pts) \
+            else self._next_slot * self._period_ns
+        slot = self._slot_of(pts)
+        if slot < self._next_slot:
+            self.drop += 1  # this slot (and all earlier) already claimed
+            # still the most recently *received* frame: later gap slots
+            # must duplicate it, not an older one (videorate semantics)
+            self._pending = frame
+            return None
+        # gap: fill [next_slot, slot) by duplicating the previous frame,
+        # then emit this frame in its own slot (eager — one-frame latency)
+        while self._pending is not None and self._next_slot < slot:
+            self._emit_slot(self._pending, self._next_slot, duplicated=True)
+            self._next_slot += 1
+        self._emit_slot(frame, slot, duplicated=False)
+        self._next_slot = slot + 1
+        self._pending = frame
+        return None

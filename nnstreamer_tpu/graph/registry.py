@@ -93,6 +93,8 @@ for _el, _mod in {
     "tensor_trainer": "nnstreamer_tpu.elements.trainer",
     "tensor_query_client": "nnstreamer_tpu.elements.query",
     "tensor_if": "nnstreamer_tpu.elements.tensor_if",
+    "tensor_crop": "nnstreamer_tpu.elements.crop",
+    "tensor_rate": "nnstreamer_tpu.elements.rate",
     # runtime/plumbing elements (GStreamer-provided in the reference)
     "queue": "nnstreamer_tpu.elements.queue",
     "tee": "nnstreamer_tpu.elements.tee",

@@ -1,0 +1,227 @@
+"""tensor_crop (region cropping driven by a second stream) and tensor_rate
+(pts-driven frame-rate adaptation) — upstream-nnstreamer patterns the
+reference snapshot predates.  Goldens are exact numpy slices / slot maps."""
+
+from fractions import Fraction
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu import Pipeline
+from nnstreamer_tpu.buffer import Frame
+from nnstreamer_tpu.elements.crop import TensorCrop
+from nnstreamer_tpu.elements.rate import TensorRate
+from nnstreamer_tpu.elements.sink import TensorSink
+from nnstreamer_tpu.elements.testsrc import DataSrc
+from nnstreamer_tpu.graph.node import NegotiationError
+from nnstreamer_tpu.spec import TensorSpec, TensorsSpec
+
+
+def run_crop(images, regions, **props):
+    got = []
+    p = Pipeline()
+    raw = p.add(DataSrc(name="raw_src", data=images, rate=Fraction(10)))
+    info = p.add(DataSrc(name="info_src", data=regions, rate=Fraction(10)))
+    crop = p.add(TensorCrop(name="c", **props))
+    sink = p.add(TensorSink(name="out"))
+    sink.connect("new-data", got.append)
+    p.link(raw, "c.raw")
+    p.link(info, "c.info")
+    p.link(crop, sink)
+    p.run(timeout=60)
+    return got
+
+
+class TestTensorCrop:
+    def _img(self, h=8, w=8):
+        return np.arange(h * w * 3, dtype=np.uint8).reshape(h, w, 3)
+
+    def test_static_mode_stacks_constant_size(self):
+        img = self._img()
+        regions = np.array([[1, 2, 3, 2], [4, 0, 3, 2]], np.int32)
+        got = run_crop([img], [regions], size="3:2", num=2)
+        assert len(got) == 1
+        out = np.asarray(got[0].tensor(0))
+        assert out.shape == (2, 2, 3, 3)  # (K, H, W, C)
+        np.testing.assert_array_equal(out[0], img[2:4, 1:4])
+        np.testing.assert_array_equal(out[1], img[0:2, 4:7])
+        assert got[0].meta["tensor_crop"]["regions"] == 2
+
+    def test_static_mode_pads_missing_regions(self):
+        img = self._img()
+        got = run_crop([img], [np.array([[0, 0, 9, 9]], np.int32)],
+                       size="4:4", num=3)
+        out = np.asarray(got[0].tensor(0))
+        assert out.shape == (3, 4, 4, 3)
+        np.testing.assert_array_equal(out[0], img[0:4, 0:4])
+        assert not out[1].any() and not out[2].any()
+
+    def test_static_mode_clamps_out_of_range(self):
+        img = self._img()
+        # x=7 with w=4 exceeds the 8-wide frame: clamped to x=4
+        got = run_crop([img], [np.array([[7, 7, 4, 4]], np.int32)],
+                       size="4:4", num=1)
+        out = np.asarray(got[0].tensor(0))
+        np.testing.assert_array_equal(out[0], img[4:8, 4:8])
+
+    def test_dynamic_mode_variable_shapes(self):
+        img = self._img()
+        regions = np.array([[0, 0, 2, 3], [3, 3, 4, 2]], np.int32)
+        got = run_crop([img], [regions])
+        f = got[0]
+        assert len(f.tensors) == 2
+        np.testing.assert_array_equal(np.asarray(f.tensor(0)), img[0:3, 0:2])
+        np.testing.assert_array_equal(np.asarray(f.tensor(1)), img[3:5, 3:7])
+
+    def test_dynamic_mode_clips_and_drops_empty(self):
+        img = self._img()
+        regions = np.array([[6, 6, 5, 5], [9, 9, 2, 2]], np.int32)
+        got = run_crop([img], [regions])
+        f = got[0]
+        assert len(f.tensors) == 1  # the fully-outside region vanished
+        np.testing.assert_array_equal(np.asarray(f.tensor(0)), img[6:8, 6:8])
+
+    def test_region_row_vector_accepted(self):
+        img = self._img()
+        got = run_crop([img], [np.array([1, 1, 2, 2], np.int32)])
+        np.testing.assert_array_equal(
+            np.asarray(got[0].tensor(0)), img[1:3, 1:3])
+
+    def test_empty_region_sentinel_rows_skipped(self):
+        """w/h <= 0 rows mean 'no detection' (a detector cannot emit a
+        (0,4) tensor — the spec layer forbids 0-dims — so it pads with
+        zero-area rows instead); valid rows fill slots in order."""
+        img = self._img()
+        regions = np.array(
+            [[2, 2, 0, 0], [1, 1, 2, 2], [0, 0, -1, 3]], np.int32)
+        got = run_crop([img], [regions], size="2:2", num=2)
+        out = np.asarray(got[0].tensor(0))
+        np.testing.assert_array_equal(out[0], img[1:3, 1:3])
+        assert not out[1].any()
+        assert got[0].meta["tensor_crop"]["regions"] == 1
+
+    def test_all_empty_regions_drop_in_dynamic_mode(self):
+        img = self._img()
+        got = run_crop([img, img],
+                       [np.array([[0, 0, 0, 0]], np.int32),
+                        np.array([[1, 1, 2, 2]], np.int32)])
+        assert len(got) == 1  # first round dropped, second survived
+        np.testing.assert_array_equal(np.asarray(got[0].tensor(0)),
+                                      img[1:3, 1:3])
+
+    def test_bad_raw_rank_fails_negotiation(self):
+        with pytest.raises(NegotiationError):
+            run_crop([np.zeros((4, 4), np.uint8)],
+                     [np.array([[0, 0, 2, 2]], np.int32)])
+
+    def test_bad_props(self):
+        with pytest.raises(ValueError):
+            TensorCrop(size="3x2", num=1)
+        with pytest.raises(ValueError):
+            TensorCrop(size="3:2")  # static mode needs num
+        with pytest.raises(ValueError):
+            TensorCrop(size="0:2", num=1)
+
+    def test_static_spec_negotiated(self):
+        p = Pipeline()
+        raw = p.add(DataSrc(data=[self._img()], rate=Fraction(10)))
+        info = p.add(DataSrc(
+            data=[np.array([[0, 0, 4, 4]], np.int32)], rate=Fraction(10)))
+        crop = p.add(TensorCrop(name="c", size="4:4", num=2))
+        sink = p.add(TensorSink(name="out"))
+        p.link(raw, "c.raw")
+        p.link(info, "c.info")
+        p.link(crop, sink)
+        p.negotiate()
+        spec = crop.src_pads["src"].spec
+        assert spec.tensors[0] == TensorSpec(np.uint8, (2, 4, 4, 3))
+
+
+def run_rate(frames, **props):
+    got = []
+    p = Pipeline()
+    src = p.add(DataSrc(data=frames))
+    rate = p.add(TensorRate(**props))
+    sink = p.add(TensorSink())
+    sink.connect("new-data", got.append)
+    p.link_chain(src, rate, sink)
+    p.run(timeout=60)
+    return rate, got
+
+
+def _stamped(n, fps):
+    dur = 1_000_000_000 // fps
+    return [
+        Frame.of(np.array([i], np.int32), pts=i * dur, duration=dur)
+        for i in range(n)
+    ]
+
+
+class TestTensorRate:
+    def test_downsample_drops(self):
+        rate, got = run_rate(_stamped(10, 30), framerate="10/1")
+        vals = [int(np.asarray(f.tensor(0))[0]) for f in got]
+        assert vals == [0, 2, 5, 8]  # first frame landing in each slot
+        assert rate.in_frames == 10 and rate.out_frames == 4
+        assert rate.drop == 6 and rate.dup == 0
+        assert [f.pts for f in got] == [i * 100_000_000 for i in range(4)]
+        assert all(f.duration == 100_000_000 for f in got)
+
+    def test_upsample_duplicates(self):
+        rate, got = run_rate(_stamped(4, 10), framerate="30/1")
+        vals = [int(np.asarray(f.tensor(0))[0]) for f in got]
+        assert vals == [0, 0, 0, 1, 1, 1, 2, 2, 2, 3]
+        assert rate.dup == 6 and rate.drop == 0
+        period = 1_000_000_000 // 30
+        assert [f.pts for f in got] == [s * period for s in range(10)]
+
+    def test_identity_when_rates_match(self):
+        rate, got = run_rate(_stamped(5, 10), framerate="10/1")
+        assert rate.drop == 0 and rate.dup == 0 and len(got) == 5
+
+    def test_throttle_off_restamps_only(self):
+        rate, got = run_rate(_stamped(10, 30), framerate="10/1",
+                             throttle=False)
+        assert len(got) == 10 and rate.drop == 0 and rate.dup == 0
+        assert [f.pts for f in got] == [i * 100_000_000 for i in range(10)]
+
+    def test_gap_duplicates_most_recent_received(self):
+        """A dropped frame is still the newest data: later gap slots must
+        duplicate IT, not the older frame that claimed the slot
+        (videorate semantics)."""
+        ms = 1_000_000
+        frames = [
+            Frame.of(np.array([v], np.int32), pts=t * ms, duration=33 * ms)
+            for v, t in ((0, 0), (1, 40), (2, 210))
+        ]
+        rate, got = run_rate(frames, framerate="10/1")
+        vals = [int(np.asarray(f.tensor(0))[0]) for f in got]
+        # slot0=frame0, frame1 dropped (slot0 taken), slot1=dup(frame1),
+        # slot2=frame2
+        assert vals == [0, 1, 2]
+        assert rate.drop == 1 and rate.dup == 1
+
+    def test_unstamped_frames_slot_sequentially(self):
+        rate, got = run_rate([np.array([i], np.int32) for i in range(5)],
+                             framerate="10/1")
+        assert len(got) == 5 and rate.drop == 0
+
+    def test_negotiated_rate_updates(self):
+        p = Pipeline()
+        src = p.add(DataSrc(data=_stamped(3, 30), rate=Fraction(30)))
+        rate = p.add(TensorRate(framerate="15/1"))
+        sink = p.add(TensorSink())
+        p.link_chain(src, rate, sink)
+        p.negotiate()
+        assert rate.src_pads["src"].spec.rate == Fraction(15)
+
+    def test_bad_framerate(self):
+        with pytest.raises(ValueError):
+            TensorRate(framerate="0/1")
+        with pytest.raises(ValueError):
+            TensorRate(framerate="abc")
+
+    def test_parse_launch_name(self):
+        from nnstreamer_tpu.graph.registry import known_elements
+        assert "tensor_rate" in known_elements()
+        assert "tensor_crop" in known_elements()

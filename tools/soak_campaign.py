@@ -416,9 +416,104 @@ def run_tensor_if(rng):
     assert tif.passed == len(want) and tif.dropped == n - len(want)
 
 
+def run_crop(rng):
+    """tensor_crop static mode under randomized regions: every crop in the
+    (K,H,W,C) stack must equal its exact numpy slice (zero-pad beyond the
+    region count, coordinates clamped into the frame)."""
+    from fractions import Fraction
+
+    from nnstreamer_tpu import Pipeline
+    from nnstreamer_tpu.elements.crop import TensorCrop
+    from nnstreamer_tpu.elements.sink import TensorSink
+    from nnstreamer_tpu.elements.testsrc import DataSrc
+
+    n = int(rng.integers(5, 20))
+    H = W = int(rng.integers(16, 48))
+    cw, ch = int(rng.integers(4, 12)), int(rng.integers(4, 12))
+    K = int(rng.integers(1, 4))
+    imgs = [rng.integers(0, 256, (H, W, 3)).astype(np.uint8)
+            for _ in range(n)]
+    # ≥1 row (the spec layer forbids 0-sized dims); zero-area sentinel rows
+    # (w/h ≤ 0, the "no detection" encoding) are mixed in deliberately
+    regs = []
+    for _ in range(n):
+        r = rng.integers(-4, max(W, H) + 4, (int(rng.integers(1, K + 2)), 4))
+        r = r.astype(np.int32)
+        for i in range(len(r)):
+            if rng.uniform() < 0.2:
+                r[i, 2 + int(rng.integers(0, 2))] = -int(rng.integers(0, 3))
+        regs.append(r)
+    got = []
+    p = Pipeline()
+    raw = p.add(DataSrc(data=imgs, rate=Fraction(30)))
+    info = p.add(DataSrc(data=regs, rate=Fraction(30)))
+    crop = p.add(TensorCrop(name="c", size=f"{cw}:{ch}", num=K))
+    sink = p.add(TensorSink())
+    sink.connect("new-data", got.append)
+    p.link(raw, "c.raw")
+    p.link(info, "c.info")
+    p.link(crop, sink)
+    p.run(timeout=120)
+    assert len(got) == n
+    for img, r, f in zip(imgs, regs, got):
+        out = np.asarray(f.tensor(0))
+        assert out.shape == (K, ch, cw, 3)
+        valid = [row for row in r if row[2] > 0 and row[3] > 0][:K]
+        assert f.meta["tensor_crop"]["regions"] == len(valid)
+        for i, row in enumerate(valid):
+            x = int(row[0]); y = int(row[1])
+            x = max(0, min(x, W - cw)) if W >= cw else 0
+            y = max(0, min(y, H - ch)) if H >= ch else 0
+            want = np.zeros((ch, cw, 3), np.uint8)
+            src_sl = img[y:y + ch, x:x + cw]
+            want[:src_sl.shape[0], :src_sl.shape[1]] = src_sl
+            np.testing.assert_array_equal(out[i], want)
+        for i in range(len(valid), K):
+            assert not out[i].any()
+
+
+def run_rate(rng):
+    """tensor_rate invariants on a randomized in/out rate pair: the output
+    pts timeline is exactly slotted, counters balance, and the
+    down-sampling case never duplicates (nor the up-sampling case drop)."""
+    from nnstreamer_tpu import Pipeline
+    from nnstreamer_tpu.buffer import Frame
+    from nnstreamer_tpu.elements.rate import TensorRate
+    from nnstreamer_tpu.elements.sink import TensorSink
+    from nnstreamer_tpu.elements.testsrc import DataSrc
+
+    n = int(rng.integers(10, 60))
+    fin = int(rng.integers(5, 60))
+    fout = int(rng.integers(5, 60))
+    dur = 1_000_000_000 // fin
+    frames = [Frame.of(np.array([i], np.int32), pts=i * dur, duration=dur)
+              for i in range(n)]
+    got = []
+    p = Pipeline()
+    src = p.add(DataSrc(data=frames))
+    rate = p.add(TensorRate(framerate=f"{fout}/1"))
+    sink = p.add(TensorSink())
+    sink.connect("new-data", got.append)
+    p.link_chain(src, rate, sink)
+    p.run(timeout=120)
+    period = 1_000_000_000 // fout
+    slots = [f.pts // period for f in got]
+    assert slots == sorted(set(slots)), "output slots must be strictly increasing"
+    assert all(f.pts % period == 0 for f in got)
+    assert rate.in_frames == n
+    assert rate.out_frames == len(got) == rate.in_frames - rate.drop + rate.dup
+    if fout <= fin:
+        assert rate.dup == 0
+    if fout >= fin:
+        assert rate.drop == 0
+    # source values must appear in order (duplication repeats, never reorders)
+    vals = [int(np.asarray(f.tensor(0))[0]) for f in got]
+    assert vals == sorted(vals)
+
+
 TEMPLATES = [run_linear, run_tee, run_mux, run_repo, run_trainer,
              run_renegotiation, run_valve_selector, run_interrupt,
-             run_query, run_tensor_if]
+             run_query, run_tensor_if, run_crop, run_rate]
 
 
 def main():
