@@ -71,6 +71,7 @@ class TensorRate(Node):
         self.out_frames = 0
         self.dup = 0
         self.drop = 0
+        self._end_ns: Optional[int] = None  # input media end (pts+duration)
 
     def configure(self, in_specs: Dict[str, TensorsSpec]) -> Dict[str, TensorsSpec]:
         spec = in_specs["sink"]
@@ -102,6 +103,8 @@ class TensorRate(Node):
             return None
         pts = frame.pts if is_valid_ts(frame.pts) \
             else self._next_slot * self._period_ns
+        if is_valid_ts(frame.duration):
+            self._end_ns = pts + frame.duration
         slot = self._slot_of(pts)
         if slot < self._next_slot:
             self.drop += 1  # this slot (and all earlier) already claimed
@@ -117,4 +120,28 @@ class TensorRate(Node):
         self._emit_slot(frame, slot, duplicated=False)
         self._next_slot = slot + 1
         self._pending = frame
+        return None
+
+    def drain(self):
+        """EOS: fill the trailing gap slots.
+
+        Duplication otherwise only happens when a *later* frame arrives, so
+        a finite upsampled stream would end short of the input's media end
+        (e.g. 4 frames @10fps through 30/1 would emit 10 frames covering
+        0.333s instead of 12 covering the full 0.4s).  Emit duplicates of
+        the last frame for every slot whose *center* falls before the
+        input's end timestamp (last pts + duration) — the same nearest-slot
+        rounding ``_slot_of`` applies to arriving frames, so a continuing
+        input would have claimed exactly these slots.  Center-based fill
+        also guarantees a pure *down*-sample never gains an EOS duplicate
+        (it would need input duration > output period, a contradiction)."""
+        if not self.throttle or self._pending is None:
+            return None
+        end_ns = self._end_ns
+        if end_ns is None:
+            return None
+        period = self._period_ns
+        while self._next_slot * period + period // 2 < end_ns:
+            self._emit_slot(self._pending, self._next_slot, duplicated=True)
+            self._next_slot += 1
         return None

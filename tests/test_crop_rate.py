@@ -170,10 +170,25 @@ class TestTensorRate:
     def test_upsample_duplicates(self):
         rate, got = run_rate(_stamped(4, 10), framerate="30/1")
         vals = [int(np.asarray(f.tensor(0))[0]) for f in got]
-        assert vals == [0, 0, 0, 1, 1, 1, 2, 2, 2, 3]
-        assert rate.dup == 6 and rate.drop == 0
+        # 0.4 s of input media at 30 fps = 12 output slots; the last
+        # frame's 2 trailing slots are filled by the EOS drain flush
+        assert vals == [0, 0, 0, 1, 1, 1, 2, 2, 2, 3, 3, 3]
+        assert rate.dup == 8 and rate.drop == 0
         period = 1_000_000_000 // 30
-        assert [f.pts for f in got] == [s * period for s in range(10)]
+        assert [f.pts for f in got] == [s * period for s in range(12)]
+
+    def test_eos_flush_covers_media_end_exactly(self):
+        """The drain fills slots whose center precedes the media end — no
+        more (integer-ns period truncation must not add a 13th slot), and
+        none at all for a down-sample."""
+        rate, got = run_rate(_stamped(2, 5), framerate="10/1")
+        # 0.4 s of media at 10 fps = 4 slots: [f0, dup f0, f1, dup f1]
+        vals = [int(np.asarray(f.tensor(0))[0]) for f in got]
+        assert vals == [0, 0, 1, 1]
+        assert rate.dup == 2 and rate.drop == 0
+        # downsample: EOS flush adds nothing
+        rate, got = run_rate(_stamped(10, 30), framerate="10/1")
+        assert rate.dup == 0
 
     def test_identity_when_rates_match(self):
         rate, got = run_rate(_stamped(5, 10), framerate="10/1")
