@@ -264,6 +264,29 @@ def run_pipeline_fps(framework, model, frames, warmup=3, normalize=True,
     return run(len(frames))
 
 
+def dynbatch_max_for_wire(health) -> int:
+    """Pick dynbatch's batch cap from the measured wire regime.
+
+    In the slow-transfer regime (>2 ms/150 KB — the tunnel's sick phase)
+    per-dispatch latency dominates, so a larger coalesced batch amortizes
+    it: 32/(latency + 32*t) can be ~3x 8/(latency + 8*t) at the observed
+    sick-phase numbers.  On a healthy wire batch 8 keeps latency low and
+    the executable-bucket set small.  BENCH_DYNBATCH_MAX overrides."""
+    env = os.environ.get("BENCH_DYNBATCH_MAX")
+    if env:
+        try:
+            v = int(env)
+            if v >= 1:
+                return v
+            log(f"# BENCH_DYNBATCH_MAX={env!r} < 1; using wire-based default")
+        except ValueError:
+            log(f"# BENCH_DYNBATCH_MAX={env!r} not an int; using wire-based "
+                "default")
+    if health and (health.get("put_150k_ms") or 0) > 2.0:
+        return 32
+    return 8
+
+
 def run_dynbatch_fps(frames, max_batch=8, upload=False):
     """Config #1d: adaptive micro-batching on ONE stream — datasrc →
     tensor_dynbatch → jax filter (polymorphic batch, normalize fused in
@@ -832,8 +855,11 @@ def make_wire_gate(results, on_accel, budget_left=None):
         leg_retries = 2
 
     def gate(label):
+        """Gate + stamp; returns the wire-health dict (None off-accel) so a
+        leg can adapt to the regime it actually got (e.g. dynbatch sizes
+        its batches up when transfers are in the slow regime)."""
         if not on_accel:
-            return
+            return None
         try:
             h = measure_wire_health(n=10)
             waited = 0
@@ -855,9 +881,11 @@ def make_wire_gate(results, on_accel, budget_left=None):
                 h["waits"] = waited
             results.setdefault("wire_per_leg", {})[label] = h
             log(f"# wire before {label}: {h}")
+            return h
         except Exception as exc:  # a failed stamp must not cost the leg
             results.setdefault("wire_per_leg", {})[label] = {
                 "error": repr(exc)[:120]}
+            return None
 
     return gate
 
@@ -1222,11 +1250,13 @@ def main():
                                  os.environ.get("BENCH_FRAMES", "400")))
         if n_d <= 0:
             raise _Skipped("skipped (0 frames)")
-        wire_gate("config1_dynbatch")
+        h = wire_gate("config1_dynbatch")
+        maxb = dynbatch_max_for_wire(h)
         d_fps, d_batches, d_frames = run_dynbatch_fps(
-            [image_u8.copy() for _ in range(n_d)]
+            [image_u8.copy() for _ in range(n_d)], max_batch=maxb
         )
         results["config1_dynbatch_fps"] = round(d_fps, 2)
+        results["config1_dynbatch_max"] = maxb
         results["config1_dynbatch_invokes"] = d_batches
         results["config1_dynbatch_frames"] = d_frames
         log(f"# config1 dynbatch fps: {d_fps:.2f} "
@@ -1242,11 +1272,14 @@ def main():
                                   os.environ.get("BENCH_FRAMES", "400")))
         if n_du <= 0:
             raise _Skipped("skipped (0 frames)")
-        wire_gate("config1_dynupload")
+        h = wire_gate("config1_dynupload")
+        maxb = dynbatch_max_for_wire(h)
         du_fps, du_batches, du_frames = run_dynbatch_fps(
-            [image_u8.copy() for _ in range(n_du)], upload=True
+            [image_u8.copy() for _ in range(n_du)], upload=True,
+            max_batch=maxb,
         )
         results["config1_dynupload_fps"] = round(du_fps, 2)
+        results["config1_dynupload_max"] = maxb
         results["config1_dynupload_invokes"] = du_batches
         results["config1_dynupload_frames"] = du_frames
         log(f"# config1 dynbatch+upload fps: {du_fps:.2f} "
@@ -1558,18 +1591,25 @@ def main():
                 # ORIGINAL measurement stamp through every hop so a reader
                 # can see how old a row really is
                 measured_at = leg.get("measured_at")
-                if measured_at:
-                    try:
-                        age = time.time() - time.mktime(
-                            time.strptime(measured_at, "%Y-%m-%d %H:%M:%S"))
-                        if age > max_age_s:
-                            errors.append(
-                                f"baseline {which} from {reuse_path} "
-                                f"ignored: measured {measured_at}, older "
-                                f"than {max_age_s:g}s; re-measuring")
-                            continue
-                    except ValueError:
-                        pass
+                if not measured_at:
+                    # pre-provenance rows (no stamp) would chain forever —
+                    # treat as over-age and re-measure once; the fresh row
+                    # gets a stamp and reuses normally from then on
+                    errors.append(
+                        f"baseline {which} from {reuse_path} ignored: no "
+                        "measured_at provenance; re-measuring")
+                    continue
+                try:
+                    age = time.time() - time.mktime(
+                        time.strptime(measured_at, "%Y-%m-%d %H:%M:%S"))
+                except ValueError:
+                    age = max_age_s + 1  # unparseable stamp: re-measure
+                if age > max_age_s:
+                    errors.append(
+                        f"baseline {which} from {reuse_path} ignored: "
+                        f"measured {measured_at}, older than "
+                        f"{max_age_s:g}s; re-measuring")
+                    continue
                 baselines[which] = dict(
                     leg,
                     reused_from=leg.get("reused_from")
@@ -1707,7 +1747,9 @@ def main():
         cres = (cached or {}).get("result") or {}
         here = {"vs_baseline": vs_baseline,
                 "value": round(tpu_fps, 2) if tpu_fps else None}
-        if cached and run_score(cres) > run_score(here):
+        # same rule the cache itself uses (better_run): ratio-less fast
+        # runs and ratioed runs must rank consistently with save_tpu_cache
+        if cached and not better_run(here, cres):
             results["best_accelerator_run"] = {
                 "cached_at": cached.get("cached_at"),
                 "value": cres.get("value"),

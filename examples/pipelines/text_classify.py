@@ -1,0 +1,82 @@
+"""Text classification from the raw text surface.
+
+text frames (null-padded uint8 buffers, the ``text/x-raw`` contract —
+``tensor_converter.c:930-1135`` text branch) → tensor_converter
+(``input-dim`` reinterpretation, the reference's requirement for text) →
+tensor_filter (byte-level transformer, ``models/text_classifier``) →
+tensor_decoder (image_labeling — decoders are modality-agnostic: logits +
+label file → label string) → sink.
+
+Closes the text modality loop the way ``audio_classify.py`` closed audio:
+the reference converts text but has no text model.  The printed labels are
+pinned against running the model directly on the same byte buffers
+(independent golden).
+"""
+
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+import nnstreamer_tpu as nns
+from nnstreamer_tpu.buffer import Frame
+from nnstreamer_tpu.elements.filter import TensorFilter
+from nnstreamer_tpu.elements.sink import TensorSink
+from nnstreamer_tpu.elements.testsrc import DataSrc
+from nnstreamer_tpu.models import text_classifier
+
+SEQ = 64
+TEXTS = [
+    "the quick brown fox jumps over the lazy dog",
+    "colorless green ideas sleep furiously",
+    "to be or not to be, that is the question",
+    "import jax; jax.jit(lambda x: x + 1)",
+]
+
+
+def as_text_buffer(s: str, size: int = SEQ) -> np.ndarray:
+    raw = s.encode("utf-8")[:size]
+    return np.frombuffer(raw.ljust(size, b"\0"), np.uint8).copy()
+
+
+def main():
+    import jax.numpy as jnp
+
+    classes = 4
+    model = text_classifier.build(
+        num_classes=classes, seq_len=SEQ, d_model=64, n_heads=4, n_layers=2,
+        dtype=jnp.float32,
+    )
+    with tempfile.NamedTemporaryFile("w", suffix=".txt", delete=False) as f:
+        f.write("\n".join(f"topic_{i}" for i in range(classes)))
+        labels = f.name
+
+    bufs = [as_text_buffer(t) for t in TEXTS]
+    p = nns.Pipeline(name="text_classify")
+    src = p.add(DataSrc(data=[Frame.of(b) for b in bufs]))
+    conv = p.add(nns.make("tensor_converter", input_dim=str(SEQ),
+                          input_type="uint8"))
+    filt = p.add(TensorFilter(framework="jax", model=model))
+    dec = p.add(nns.make("tensor_decoder", mode="image_labeling",
+                         option1=labels))
+    sink = p.add(TensorSink(collect=True))
+    p.link_chain(src, conv, filt, dec, sink)
+    p.run(timeout=120)
+
+    ref_logits = np.asarray(text_classifier.apply(
+        model.params, jnp.asarray(np.stack(bufs)), dtype=jnp.float32))
+    ok = True
+    for i, frame in enumerate(sink.frames):
+        label = bytes(np.asarray(frame.tensor(0))).decode()
+        expect = f"topic_{int(ref_logits[i].argmax())}"
+        ok = ok and (label == expect)
+        print(f"{TEXTS[i][:40]!r:44} -> {label}")
+    print(f"golden={'OK' if ok and len(sink.frames) == len(TEXTS) else 'MISMATCH'}")
+    os.unlink(labels)
+
+
+if __name__ == "__main__":
+    main()

@@ -140,6 +140,7 @@ class TestCodegen:
         ("cascade_detect_classify.py", "cascade=OK"),
         ("decode_stream.py", "golden=OK"),
         ("audio_classify.py", "golden=OK"),
+        ("text_classify.py", "golden=OK"),
         ("train_stream.py", "train_stream OK"),
         ("offload_query.py", "offload=OK"),
     ],

@@ -418,3 +418,81 @@ class TestAudioCNN:
         assert len(got) == 2  # 8 buffers of 128 → 2 windows of 512
         assert got[0].shape == (3,)
         assert np.isfinite(got[0]).all()
+
+
+class TestTextClassifier:
+    """Byte-level transformer on the text surface (models/text_classifier)."""
+
+    @staticmethod
+    def _buf(s, size=32):
+        raw = s.encode()[:size]
+        return np.frombuffer(raw.ljust(size, b"\0"), np.uint8).copy()
+
+    def test_forward_shapes_and_batching(self):
+        import jax
+        import jax.numpy as jnp
+
+        from nnstreamer_tpu.models import text_classifier
+
+        model = text_classifier.build(num_classes=3, seq_len=32, d_model=32,
+                                      n_heads=2, n_layers=1,
+                                      dtype=jnp.float32)
+        x = self._buf("hello world")
+        y = jax.jit(lambda a: model.apply(model.params, a))(x)
+        assert y.shape == (3,)
+        xb = np.stack([x, self._buf("other text")])
+        yb = jax.jit(lambda a: model.apply(model.params, a))(xb)
+        assert yb.shape == (2, 3)
+        np.testing.assert_allclose(np.asarray(yb[0]), np.asarray(y),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_padding_mask_excludes_nulls_from_pool(self):
+        """The pooled logits read only real-text positions: changing BYTES
+        under the padding mask (position content) changes nothing, while
+        changing real text does."""
+        import jax.numpy as jnp
+
+        from nnstreamer_tpu.models import text_classifier
+
+        model = text_classifier.build(num_classes=3, seq_len=32, d_model=32,
+                                      n_heads=2, n_layers=1,
+                                      dtype=jnp.float32)
+        base = self._buf("abc")
+        y0 = np.asarray(model.apply(model.params, base))
+        changed = self._buf("abd")
+        y1 = np.asarray(model.apply(model.params, changed))
+        assert not np.allclose(y0, y1)
+        # all-padding input stays finite (degenerate denom guard)
+        y2 = np.asarray(model.apply(model.params, self._buf("")))
+        assert np.isfinite(y2).all()
+
+    def test_streams_through_converter_text_path(self):
+        """text buffers → tensor_converter input-dim reinterpretation →
+        filter → sink (tensor_converter.c:930-1135 text branch analog)."""
+        import jax.numpy as jnp
+
+        import nnstreamer_tpu as nns
+        from nnstreamer_tpu.buffer import Frame
+        from nnstreamer_tpu.elements.filter import TensorFilter
+        from nnstreamer_tpu.elements.sink import TensorSink
+        from nnstreamer_tpu.elements.testsrc import DataSrc
+        from nnstreamer_tpu.models import text_classifier
+
+        model = text_classifier.build(num_classes=2, seq_len=32, d_model=32,
+                                      n_heads=2, n_layers=1,
+                                      dtype=jnp.float32)
+        bufs = [self._buf("alpha"), self._buf("beta"), self._buf("gamma")]
+        got = []
+        p = nns.Pipeline()
+        src = p.add(DataSrc(data=[Frame.of(b) for b in bufs]))
+        conv = p.add(nns.make("tensor_converter", input_dim="32",
+                              input_type="uint8"))
+        f = p.add(TensorFilter(framework="jax", model=model))
+        sink = p.add(TensorSink())
+        sink.connect("new-data", lambda fr: got.append(np.asarray(fr.tensor(0))))
+        p.link_chain(src, conv, f, sink)
+        p.run(timeout=120)
+        assert len(got) == 3 and got[0].shape == (2,)
+        ref = np.asarray(text_classifier.apply(
+            model.params, jnp.asarray(np.stack(bufs)), dtype=jnp.float32))
+        np.testing.assert_allclose(np.stack(got), ref, rtol=1e-4, atol=1e-5)
