@@ -277,6 +277,13 @@ def dynbatch_max_for_wire(health) -> int:
         try:
             v = int(env)
             if v >= 1:
+                if v & (v - 1):  # DynBatch requires a power-of-two cap
+                    p = 1
+                    while p * 2 <= v:
+                        p *= 2
+                    log(f"# BENCH_DYNBATCH_MAX={env!r} not a power of two; "
+                        f"rounding down to {p}")
+                    v = p
                 return v
             log(f"# BENCH_DYNBATCH_MAX={env!r} < 1; using wire-based default")
         except ValueError:
@@ -626,8 +633,13 @@ def measure_mfu(batches=None, image_size=224, model_name="mobilenet_v2"):
         t0 = time.perf_counter()
         compiled(x).block_until_ready()  # warm + step estimate
         est = time.perf_counter() - t0
-        # ~2s per point: 20 iterations on a real chip, fewer on CPU smoke
+        # ~2s per point: 20 iterations on a real chip, fewer on CPU smoke.
+        # n is snapped to a fixed bucket set: it becomes the fori_loop trip
+        # count below, i.e. part of the compiled program — a continuous n
+        # would defeat the persistent compile cache across runs (every run
+        # would re-pay ~30s per point inside a live-tunnel window)
         n = max(2, min(20, int(2.0 / max(est, 1e-4))))
+        n = max(b for b in (2, 5, 10, 20) if b <= n)
         timing = "dispatch-loop"
         step = None
         try:
@@ -1287,15 +1299,18 @@ def main():
     except Exception as exc:
         leg_error(errors, "config1 dynupload leg", exc)
 
-    # -- config #1q: uint8-quantized flagship (int8 weights, on-device
-    #    dequant — the reference's flagship model is uint8-quant MobileNet)
+    # -- config #1q: uint8-quantized flagship — full-int8 path: every
+    #    ungrouped conv runs int8 x int8 → int32 on the MXU with dynamic
+    #    activation scales (the reference's flagship model is uint8-quant
+    #    MobileNet; v5e int8 peak is 2x bf16)
     try:
         from nnstreamer_tpu.models import mobilenet_v2
 
         n_q = int(os.environ.get("BENCH_QUANT_FRAMES", "200"))
         if n_q <= 0:
             raise _Skipped("skipped (0 frames)")
-        quant_model = mobilenet_v2.build_quantized(num_classes=1001, image_size=224)
+        quant_model = mobilenet_v2.build_quantized(
+            num_classes=1001, image_size=224, int8_convs=True)
         wire_gate("config1_quant")
         q_fps = run_pipeline_fps(
             "jax", quant_model, [image_u8.copy() for _ in range(n_q)]

@@ -79,10 +79,10 @@ def init_params(
     return params
 
 
-def _block_apply(block: Params, x, dtype):
+def _block_apply(block: Params, x, dtype, int8=False):
     y = x
     if "expand" in block:
-        y = conv_bn_relu6(block["expand"], y, dtype=dtype)
+        y = conv_bn_relu6(block["expand"], y, dtype=dtype, int8=int8)
     y = conv_bn_relu6(
         block["depthwise"],
         y,
@@ -90,21 +90,24 @@ def _block_apply(block: Params, x, dtype):
         groups=y.shape[-1],
         dtype=dtype,
     )
-    y = conv_bn_relu6(block["project"], y, dtype=dtype, act=False)
+    y = conv_bn_relu6(block["project"], y, dtype=dtype, act=False, int8=int8)
     if block["residual"]:
         y = y + x
     return y
 
 
-def apply(params: Params, x, dtype=jnp.bfloat16):
+def apply(params: Params, x, dtype=jnp.bfloat16, int8=False):
     """Forward: (N,H,W,3) or (H,W,3) float input → (N,classes) or (classes,)
-    float32 logits."""
+    float32 logits.  ``int8=True``: every ungrouped conv with quantized
+    weights runs int8 x int8 → int32 on the MXU (dynamic activation
+    scales); depthwise stays on the ``dtype`` path — see
+    :func:`~nnstreamer_tpu.models.layers.conv2d_int8`."""
     x, squeezed = ensure_batched(x, 4)
     y = x.astype(dtype)
-    y = conv_bn_relu6(params["stem"], y, stride=2, dtype=dtype)
+    y = conv_bn_relu6(params["stem"], y, stride=2, dtype=dtype, int8=int8)
     for block in params["blocks"]:
-        y = _block_apply(block, y, dtype)
-    y = conv_bn_relu6(params["head"], y, dtype=dtype)
+        y = _block_apply(block, y, dtype, int8=int8)
+    y = conv_bn_relu6(params["head"], y, dtype=dtype, int8=int8)
     y = y.mean(axis=(1, 2))  # global average pool
     logits = dense(params["classifier"], y, dtype=dtype).astype(jnp.float32)
     return logits[0] if squeezed else logits
@@ -133,11 +136,13 @@ def quantize_params(params: Params) -> Params:
     return walk(params)
 
 
-def apply_quantized_int8_head(params: Params, x, dtype=jnp.bfloat16):
+def apply_quantized_int8_head(params: Params, x, dtype=jnp.bfloat16,
+                              int8=False):
     """Forward pass with the classifier matmul on the int8 MXU path:
     dynamic activation quantization feeding the Pallas
     :func:`~nnstreamer_tpu.ops.pallas_kernels.int8_matmul` kernel (int8×int8
-    → int32 accumulate → fused dequant+bias)."""
+    → int32 accumulate → fused dequant+bias).  ``int8=True`` additionally
+    runs the conv trunk full-int8 (composes with ``int8_convs``)."""
     from ..ops.pallas_kernels import int8_matmul
     from ..ops.quant import QuantizedWeight, quantize_activations
 
@@ -145,10 +150,10 @@ def apply_quantized_int8_head(params: Params, x, dtype=jnp.bfloat16):
     assert isinstance(head["w"], QuantizedWeight), "quantize_params first"
     x, squeezed = ensure_batched(x, 4)
     y = x.astype(dtype)
-    y = conv_bn_relu6(params["stem"], y, stride=2, dtype=dtype)
+    y = conv_bn_relu6(params["stem"], y, stride=2, dtype=dtype, int8=int8)
     for block in params["blocks"]:
-        y = _block_apply(block, y, dtype)
-    y = conv_bn_relu6(params["head"], y, dtype=dtype)
+        y = _block_apply(block, y, dtype, int8=int8)
+    y = conv_bn_relu6(params["head"], y, dtype=dtype, int8=int8)
     y = y.mean(axis=(1, 2)).astype(jnp.float32)
     feats_q, feats_scale = quantize_activations(y)
     logits = int8_matmul(
@@ -170,12 +175,27 @@ def build_quantized(
     seed: int = 0,
     params: Optional[Params] = None,
     int8_head: bool = False,
+    int8_convs: bool = False,
 ) -> JaxModel:
-    """Quantized stream-ready model (int8 weights, on-device dequant);
-    ``int8_head=True`` additionally runs the classifier on the int8 MXU
-    kernel."""
+    """Quantized stream-ready model (int8 weights, on-device dequant).
+
+    - ``int8_convs=True``: the full-int8 path — every ungrouped conv runs
+      int8 x int8 → int32 on the MXU with dynamic activation scales (the
+      TPU-native analog of the reference's uint8-quant tflite flagship,
+      ``runTest.sh:30-38``; v5e int8 peak is 2x bf16).
+    - ``int8_head=True``: only the classifier matmul uses the Pallas int8
+      kernel (the earlier, narrower variant).
+    """
     m = build(num_classes, width_mult, image_size, batch, dtype, seed, params)
-    fwd = apply_quantized_int8_head if int8_head else apply
+    if int8_head:
+        # composes: int8_convs also moves the conv trunk to the int8 path
+        def fwd(p, x, dtype=dtype, _i8=int8_convs):
+            return apply_quantized_int8_head(p, x, dtype=dtype, int8=_i8)
+    elif int8_convs:
+        def fwd(p, x, dtype=dtype):
+            return apply(p, x, dtype=dtype, int8=True)
+    else:
+        fwd = apply
     return JaxModel(
         apply=lambda p, x: fwd(p, x, dtype=dtype),
         params=quantize_params(m.params),

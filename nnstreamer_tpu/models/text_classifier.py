@@ -65,8 +65,9 @@ def apply(params: Params, x, dtype=jnp.bfloat16):
     tok = jnp.take(params["byte_embed"], idx, axis=0)        # (B, T, d)
     mask = (idx != 0).astype(dtype)                          # null padding
     per_token = transformer.apply(params, tok, causal=False, dtype=dtype)
-    # masked mean-pool: padding contributes nothing; all-padding frames
-    # fall back to a plain mean so the output stays finite
+    # masked mean-pool: padding contributes nothing; an all-padding frame
+    # yields all-zero logits (zero numerator, denom clamped to 1) — finite,
+    # deterministic, and meaningless, as empty input should be
     w = mask[..., None]
     denom = jnp.maximum(w.sum(axis=-2), 1.0)
     logits = (per_token * w).sum(axis=-2) / denom

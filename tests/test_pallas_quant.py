@@ -137,6 +137,37 @@ class TestQuantizedMobileNet:
         err = np.abs(lf - lq).max() / (np.abs(lf).max() + 1e-9)
         assert err < 0.15, err
 
+    def test_full_int8_convs_close_and_on_int8_path(self):
+        """The full-int8 path (int8 x int8 → int32 convs, dynamic activation
+        scales): logits stay faithful to float AND the lowered program
+        really contains int8-operand/int32-accumulate convolutions —
+        guarding against a silent fall-back to the dequant float path."""
+        import re
+
+        import jax
+
+        from nnstreamer_tpu.models import mobilenet_v2
+
+        kw = dict(num_classes=16, width_mult=0.35, image_size=32,
+                  dtype=jnp.float32)
+        f = mobilenet_v2.build(**kw)
+        qc = mobilenet_v2.build_quantized(**kw, int8_convs=True,
+                                          params=f.params)
+        xs = np.random.default_rng(7).random((4, 32, 32, 3)).astype(np.float32)
+        lf = np.asarray(f.apply(f.params, xs))
+        lq = np.asarray(qc.apply(qc.params, xs))
+        corr = np.corrcoef(lf.ravel(), lq.ravel())[0, 1]
+        assert corr > 0.97, corr
+        assert (lf.argmax(1) == lq.argmax(1)).mean() >= 0.75
+        hlo = jax.jit(lambda a: qc.apply(qc.params, a)).lower(
+            jnp.asarray(xs)).as_text()
+        int8_convs = re.findall(
+            r"stablehlo\.convolution[^\n]*xi8>[^\n]*->\s*tensor<[0-9x]*xi32>",
+            hlo)
+        # every ungrouped conv (stem + expand/project + head) is int8; the
+        # depthwise convs legitimately stay float
+        assert len(int8_convs) >= 20, len(int8_convs)
+
     def test_quantized_in_pipeline(self, models):
         """build_quantized runs through the streaming filter element."""
         _, q, _ = models
