@@ -95,6 +95,8 @@ for _el, _mod in {
     "tensor_if": "nnstreamer_tpu.elements.tensor_if",
     "tensor_crop": "nnstreamer_tpu.elements.crop",
     "tensor_rate": "nnstreamer_tpu.elements.rate",
+    "tensor_sparse_enc": "nnstreamer_tpu.elements.sparse",
+    "tensor_sparse_dec": "nnstreamer_tpu.elements.sparse",
     # runtime/plumbing elements (GStreamer-provided in the reference)
     "queue": "nnstreamer_tpu.elements.queue",
     "tee": "nnstreamer_tpu.elements.tee",

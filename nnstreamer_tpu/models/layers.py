@@ -104,7 +104,9 @@ def conv2d_int8(
 
     w = params["w"]
     assert isinstance(w, QuantizedWeight), "conv2d_int8 needs quantized weights"
-    q, s = quantize_activations(x)
+    # per-SAMPLE scales: batch composition must not change a frame's
+    # numerics (an outlier frame would otherwise coarsen everyone's scale)
+    q, s = quantize_activations(x, axes=tuple(range(1, x.ndim)))
     y = jax.lax.conv_general_dilated(
         q,
         w.q,
@@ -114,8 +116,8 @@ def conv2d_int8(
         preferred_element_type=jnp.int32,
     )
     out_dtype = dtype if dtype is not None else jnp.float32
-    # w.scale is (1, 1, 1, cout) for HWIO kernels → broadcasts over NHWC
-    rescale = (s * w.scale.reshape(-1)).astype(jnp.float32)
+    # s is (N,1,1,1); w.scale is (1,1,1,cout) for HWIO → (N,1,1,cout)
+    rescale = (s * w.scale.reshape(1, 1, 1, -1)).astype(jnp.float32)
     return (y.astype(jnp.float32) * rescale).astype(out_dtype)
 
 

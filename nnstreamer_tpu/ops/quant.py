@@ -76,13 +76,22 @@ def maybe_dequantize(w, dtype=None):
     return w
 
 
-def quantize_activations(x, dtype=jnp.int8):
-    """Dynamic symmetric per-tensor activation quantization.
+def quantize_activations(x, dtype=jnp.int8, axes=None):
+    """Dynamic symmetric activation quantization.
 
     Returns ``(q, scale)`` with ``q ≈ x / scale`` in int8.  Computed on
     device; fuses into the producing XLA program.
+
+    ``axes=None``: one per-tensor scale (scalar).  ``axes=(1, 2, 3)`` on an
+    NHWC batch: one scale **per sample** (shape ``(N, 1, 1, 1)``) — in
+    batched serving a single outlier frame must not coarsen quantization
+    for the rest of the batch, and a frame's numerics must not depend on
+    which other frames it happened to be batched with.
     """
-    amax = jnp.max(jnp.abs(x))
+    if axes is None:
+        amax = jnp.max(jnp.abs(x))
+    else:
+        amax = jnp.max(jnp.abs(x), axis=axes, keepdims=True)
     scale = jnp.where(amax > 0, amax / 127.0, 1.0).astype(jnp.float32)
     q = jnp.clip(jnp.round(x / scale), -127, 127).astype(dtype)
     return q, scale

@@ -168,6 +168,23 @@ class TestQuantizedMobileNet:
         # depthwise convs legitimately stay float
         assert len(int8_convs) >= 20, len(int8_convs)
 
+    def test_full_int8_batch_composition_independence(self):
+        """Per-SAMPLE activation scales: a frame's logits must not depend
+        on which other frames it was batched with (an outlier frame in the
+        batch must not coarsen everyone's quantization)."""
+        from nnstreamer_tpu.models import mobilenet_v2
+
+        kw = dict(num_classes=8, width_mult=0.35, image_size=32,
+                  dtype=jnp.float32)
+        qc = mobilenet_v2.build_quantized(**kw, int8_convs=True)
+        rng = np.random.default_rng(11)
+        x = rng.random((1, 32, 32, 3)).astype(np.float32)
+        outlier = (rng.random((1, 32, 32, 3)).astype(np.float32) * 100.0)
+        alone = np.asarray(qc.apply(qc.params, x))[0]
+        with_outlier = np.asarray(
+            qc.apply(qc.params, np.concatenate([x, outlier])))[0]
+        np.testing.assert_allclose(with_outlier, alone, rtol=1e-4, atol=1e-4)
+
     def test_quantized_in_pipeline(self, models):
         """build_quantized runs through the streaming filter element."""
         _, q, _ = models

@@ -14,6 +14,7 @@ Topology templates (drawn at random per iteration):
   valve         event-driven valve close/reopen; order + exactness held
   interrupt     pipeline.stop() from another thread mid-stream (30s bound)
   query         TCP offload: QueryServer + 1-3 concurrent client pipelines
+  sparse        tensor_sparse_enc→dec round-trip on random shapes/densities
 
 Usage: python tools/soak_campaign.py [--minutes 10] [--seed N]
 """
@@ -511,9 +512,53 @@ def run_rate(rng):
     assert vals == sorted(vals)
 
 
+def run_sparse(rng):
+    """tensor_sparse_enc→dec round-trip exactness on randomized shapes,
+    dtypes, and densities (including all-zero and fully-dense frames),
+    with a queue between the codec halves half the time."""
+    from nnstreamer_tpu import Pipeline, make
+    from nnstreamer_tpu.buffer import Frame
+    from nnstreamer_tpu.elements.queue import Queue
+    from nnstreamer_tpu.elements.sink import TensorSink
+    from nnstreamer_tpu.elements.testsrc import DataSrc
+
+    n = int(rng.integers(5, 40))
+    rank = int(rng.integers(1, 4))
+    shape = tuple(int(rng.integers(2, 12)) for _ in range(rank))
+    dtype = rng.choice([np.float32, np.int32, np.uint8])
+    frames = []
+    for i in range(n):
+        x = np.zeros(shape, dtype)
+        density = float(rng.uniform(0.0, 1.0))
+        k = int(x.size * density)
+        if k:
+            pos = rng.choice(x.size, size=k, replace=False)
+            vals = rng.integers(1, 100, k)
+            x.reshape(-1)[pos] = vals.astype(dtype)
+        frames.append(Frame.of(x, pts=i))
+    got = []
+    p = Pipeline()
+    chain = [p.add(DataSrc(data=[f.with_tensors((f.tensor(0).copy(),))
+                                 for f in frames]))]
+    chain.append(p.add(make("tensor_sparse_enc")))
+    if rng.integers(0, 2):
+        chain.append(p.add(Queue(max_size_buffers=4)))
+    chain.append(p.add(make("tensor_sparse_dec")))
+    sink = p.add(TensorSink())
+    sink.connect("new-data", got.append)
+    chain.append(sink)
+    p.link_chain(*chain)
+    p.run(timeout=120)
+    assert len(got) == n
+    for f, out in zip(frames, got):
+        np.testing.assert_array_equal(np.asarray(out.tensor(0)),
+                                      np.asarray(f.tensor(0)))
+        assert out.pts == f.pts
+
+
 TEMPLATES = [run_linear, run_tee, run_mux, run_repo, run_trainer,
              run_renegotiation, run_valve_selector, run_interrupt,
-             run_query, run_tensor_if, run_crop, run_rate]
+             run_query, run_tensor_if, run_crop, run_rate, run_sparse]
 
 
 def main():
