@@ -1345,6 +1345,20 @@ def main():
         results["config2_ssd_fps"] = round(ssd_fps, 2)
         results["config2_frames"] = n_ssd
         log(f"# config2 ssd fps: {ssd_fps:.2f}")
+        # upload-overlap variant (same pipeline + tensor_upload/queue, the
+        # discipline that lifted config1): transfer of frame N+1 overlaps
+        # dispatch of frame N
+        wire_gate("config2_ssd_upload")
+        ssd_u_fps = run_pipeline_fps(
+            "jax", ssd, [img300.copy() for _ in range(n_ssd)],
+            decoder=("bounding_boxes", {
+                "option1": "fused-ssd", "option4": "300:300",
+                "option5": "300:300",
+            }),
+            upload=True,
+        )
+        results["config2_ssd_upload_fps"] = round(ssd_u_fps, 2)
+        log(f"# config2 ssd upload fps: {ssd_u_fps:.2f}")
     except Exception as exc:
         leg_error(errors, "config2 ssd leg", exc)
 
@@ -1369,6 +1383,16 @@ def main():
         results["config3_pose_fps"] = round(pose_fps, 2)
         results["config3_frames"] = n_pose
         log(f"# config3 pose fps: {pose_fps:.2f}")
+        wire_gate("config3_pose_upload")
+        pose_u_fps = run_pipeline_fps(
+            "jax", pose, [image_u8.copy() for _ in range(n_pose)],
+            decoder=("pose_estimation", {
+                "option1": "224:224", "option2": f"{grid}:{grid}",
+            }),
+            upload=True,
+        )
+        results["config3_pose_upload_fps"] = round(pose_u_fps, 2)
+        log(f"# config3 pose upload fps: {pose_u_fps:.2f}")
     except Exception as exc:
         leg_error(errors, "config3 pose leg", exc)
 
@@ -1713,8 +1737,10 @@ def main():
         "config1": ratio("config1_stream_fps", "config1"),
         "config1_quant": ratio("config1_quant_fps", "config1_quant"),
         "config2": ratio("config2_ssd_fps", "config2"),
+        "config2_upload": ratio("config2_ssd_upload_fps", "config2"),
         "config2c": ratio("config2c_cascade_fps", "config2c"),
         "config3": ratio("config3_pose_fps", "config3"),
+        "config3_upload": ratio("config3_pose_upload_fps", "config3"),
         "config4": ratio("config4_lstm_steps_per_sec", "config4",
                          "steps_per_sec"),
         "config4b": ratio("config4b_seq_windows_per_sec", "config4b",

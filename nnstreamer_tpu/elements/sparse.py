@@ -45,11 +45,14 @@ import numpy as np
 from ..buffer import Frame
 from ..graph.node import NegotiationError, Node, Pad
 from ..graph.registry import register_element
-from ..spec import TensorSpec, TensorsSpec
+from ..spec import TensorSpec, TensorsSpec, dtype_from_name, dtype_name
 
-# dtype wire codes (stable contract — append only)
+# dtype wire codes (stable contract — append only).  Exactly the spec
+# layer's negotiable dtypes: anything a pipeline can carry, the codec can
+# ship — including float16/bfloat16, the natural dtypes for the
+# pruned-activations use case.
 _DTYPES = ("int8", "uint8", "int16", "uint16", "int32", "uint32", "int64",
-           "uint64", "float32", "float64", "bool")
+           "uint64", "float32", "float64", "float16", "bfloat16")
 _DTYPE_CODE = {name: i for i, name in enumerate(_DTYPES)}
 
 
@@ -72,7 +75,7 @@ class SparseEnc(Node):
                 f"{spec.num_tensors} tensors/frame"
             )
         self._in_spec = spec.tensors[0]
-        if np.dtype(self._in_spec.dtype).name not in _DTYPE_CODE:
+        if dtype_name(self._in_spec.dtype) not in _DTYPE_CODE:
             raise NegotiationError(
                 f"{self.name}: unsupported dtype {self._in_spec.dtype} "
                 f"(wire codes: {_DTYPES})"
@@ -92,8 +95,7 @@ class SparseEnc(Node):
         dense = np.asarray(frame.tensor(0))
         flat = np.ascontiguousarray(dense).reshape(-1)
         # NaN is a value, not a zero: != keeps it (NaN != 0 is True)
-        (nz,) = np.nonzero(flat != 0) if flat.dtype != np.bool_ \
-            else np.nonzero(flat)
+        (nz,) = np.nonzero(flat != 0)
         empty = nz.size == 0
         if empty:  # zero-sized dims are forbidden; ship one sentinel slot
             idx = np.zeros((1,), np.int64)
@@ -102,7 +104,7 @@ class SparseEnc(Node):
             idx = nz.astype(np.int64)
             vals = flat[nz]
         header = np.asarray(
-            [int(empty), _DTYPE_CODE[np.dtype(dense.dtype).name]]
+            [int(empty), _DTYPE_CODE[dtype_name(dense.dtype)]]
             + [int(d) for d in dense.shape],
             np.int64,
         )
@@ -158,7 +160,7 @@ class SparseDec(Node):
         shape = tuple(int(d) for d in header[2:])
         if any(d <= 0 for d in shape):
             raise ValueError(f"{self.name}: bad dense shape {shape}")
-        dtype = np.dtype(_DTYPES[code])
+        dtype = dtype_from_name(_DTYPES[code])
         dense = np.zeros(int(np.prod(shape)), dtype)
         if not empty:
             idx = np.asarray(frame.tensor(1))

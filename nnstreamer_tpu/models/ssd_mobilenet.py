@@ -94,25 +94,39 @@ def init_params(key, num_labels: int = 91, width_mult: float = 1.0) -> Params:
     return params
 
 
-def apply(params: Params, x, dtype=jnp.bfloat16):
-    """(N,300,300,3) or (300,300,3) → (boxes (…,1917,4), scores (…,1917,L))."""
+def apply(params: Params, x, dtype=jnp.bfloat16, int8=False):
+    """(N,300,300,3) or (300,300,3) → (boxes (…,1917,4), scores (…,1917,L)).
+
+    ``int8=True``: ungrouped convs with quantized weights run int8 x int8
+    → int32 on the MXU (see
+    :func:`~nnstreamer_tpu.models.layers.conv2d_int8`); depthwise stays on
+    the ``dtype`` path."""
+    from ..ops.quant import QuantizedWeight
+    from .layers import conv2d_int8
+
     x, squeezed = ensure_batched(x, 4)
     y = x.astype(dtype)
-    y = conv_bn_relu6(params["stem"], y, stride=2, dtype=dtype)
+    y = conv_bn_relu6(params["stem"], y, stride=2, dtype=dtype, int8=int8)
     features: List[jnp.ndarray] = []
     for i, block in enumerate(params["blocks"]):
-        y = mobilenet_v2._block_apply(block, y, dtype)
+        y = mobilenet_v2._block_apply(block, y, dtype, int8=int8)
         if i == 12:  # end of the 96-channel stage: 19×19
             features.append(y)
     features.append(y)  # 10×10, 320 channels
     for extra in params["extras"]:
-        y = conv_bn_relu6(extra, y, stride=2, dtype=dtype)
+        y = conv_bn_relu6(extra, y, stride=2, dtype=dtype, int8=int8)
         features.append(y)
+
+    def head_conv(hp, feat):
+        if int8 and isinstance(hp["w"], QuantizedWeight):
+            return conv2d_int8(hp, feat, dtype=dtype)
+        return conv2d(hp, feat, dtype=dtype)
+
     num_labels = params["num_labels"]
     boxes, scores = [], []
     for feat, bh, ch in zip(features, params["box_heads"], params["cls_heads"]):
-        b = conv2d(bh, feat, dtype=dtype)
-        c = conv2d(ch, feat, dtype=dtype)
+        b = head_conv(bh, feat)
+        c = head_conv(ch, feat)
         n = feat.shape[0]
         boxes.append(b.reshape(n, -1, 4))
         scores.append(c.reshape(n, -1, num_labels))
@@ -204,11 +218,13 @@ def build(
     seed: int = 0,
     params: Optional[Params] = None,
     fused_decode: Optional[int] = None,
+    int8: bool = False,
 ) -> JaxModel:
     """``fused_decode=K`` appends :func:`decode_topk` to the program: the
     model then emits one small ``(K, 6)`` detection tensor (the
     ``fused-ssd`` decoder sub-mode consumes it) instead of raw
-    boxes+scores."""
+    boxes+scores.  ``int8=True`` routes quantized-weight convs through the
+    MXU int8 path (pass quantized params, or use :func:`build_quantized`)."""
     if params is None:
         params = init_params(jax.random.PRNGKey(seed), num_labels)
     shape: Tuple[Optional[int], ...] = (image_size, image_size, 3)
@@ -218,16 +234,42 @@ def build(
         priors = generate_priors(image_size)
 
         def fwd(p, x):
-            boxes, scores = apply(p, x, dtype=dtype)
+            boxes, scores = apply(p, x, dtype=dtype, int8=int8)
             return decode_topk(boxes, scores, priors, k=fused_decode)
 
     else:
         def fwd(p, x):
-            return apply(p, x, dtype=dtype)
+            return apply(p, x, dtype=dtype, int8=int8)
 
     return JaxModel(
         apply=fwd,
         params=params,
         input_spec=TensorsSpec.of(TensorSpec(dtype=np.float32, shape=shape)),
-        name="ssd_mobilenet_v2",
+        name="ssd_mobilenet_v2_q8" if int8 else "ssd_mobilenet_v2",
+    )
+
+
+def build_quantized(
+    num_labels: int = 91,
+    image_size: int = 300,
+    batch: Optional[int] = None,
+    dtype=jnp.bfloat16,
+    seed: int = 0,
+    params: Optional[Params] = None,
+    fused_decode: Optional[int] = None,
+) -> JaxModel:
+    """Full-int8 detector: every ungrouped conv (stem, expand/project,
+    extras, box/cls heads) runs int8 x int8 → int32 on the MXU with
+    dynamic per-sample activation scales — the same tier as
+    ``mobilenet_v2.build_quantized(int8_convs=True)``, for the two-model
+    cascade topologies (SURVEY §4's bounding-box suite)."""
+    from .mobilenet_v2 import quantize_params
+
+    m = build(num_labels, image_size, batch, dtype, seed, params,
+              fused_decode=fused_decode, int8=True)
+    return JaxModel(
+        apply=m.apply,
+        params=quantize_params(m.params),
+        input_spec=m.input_spec,
+        name=m.name,
     )

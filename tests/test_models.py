@@ -496,3 +496,46 @@ class TestTextClassifier:
         ref = np.asarray(text_classifier.apply(
             model.params, jnp.asarray(np.stack(bufs)), dtype=jnp.float32))
         np.testing.assert_allclose(np.stack(got), ref, rtol=1e-4, atol=1e-5)
+
+
+class TestSSDQuantized:
+    """Full-int8 SSD detector (models/ssd_mobilenet.build_quantized)."""
+
+    def test_int8_close_to_float_and_on_int8_path(self):
+        import re
+
+        import jax
+        import jax.numpy as jnp
+
+        from nnstreamer_tpu.models import ssd_mobilenet
+
+        f = ssd_mobilenet.build(num_labels=7, image_size=96,
+                                dtype=jnp.float32)
+        q = ssd_mobilenet.build_quantized(num_labels=7, image_size=96,
+                                          dtype=jnp.float32, params=f.params)
+        x = np.random.default_rng(2).random((96, 96, 3)).astype(np.float32)
+        bf, sf = f.apply(f.params, x)
+        bq, sq = q.apply(q.params, x)
+        for a, b in ((bf, bq), (sf, sq)):
+            a, b = np.asarray(a), np.asarray(b)
+            assert a.shape == b.shape
+            corr = np.corrcoef(a.ravel(), b.ravel())[0, 1]
+            assert corr > 0.97, corr
+        hlo = jax.jit(lambda a: q.apply(q.params, a)).lower(
+            jnp.asarray(x)).as_text()
+        int8_convs = re.findall(
+            r"stablehlo\.convolution[^\n]*xi8>[^\n]*->\s*tensor<[0-9x]*xi32>",
+            hlo)
+        assert len(int8_convs) >= 20, len(int8_convs)
+
+    def test_int8_fused_decode_emits_k6(self):
+        import jax.numpy as jnp
+
+        from nnstreamer_tpu.models import ssd_mobilenet
+
+        q = ssd_mobilenet.build_quantized(num_labels=7, image_size=96,
+                                          dtype=jnp.float32, fused_decode=10)
+        x = np.random.default_rng(3).random((96, 96, 3)).astype(np.float32)
+        det = np.asarray(q.apply(q.params, x))
+        assert det.shape == (10, 6)
+        assert np.isfinite(det).all()

@@ -56,6 +56,20 @@ class TestSparseRoundtrip:
         assert np.isnan(out[1, 2])
         assert out[3, 3] == 0
 
+    def test_bfloat16_roundtrip(self):
+        """bfloat16 — the repo's TPU-first dtype and the natural carrier
+        for pruned activations — must survive the wire codes."""
+        import ml_dtypes
+
+        bf16 = np.dtype(ml_dtypes.bfloat16)
+        x = np.zeros((8, 8), bf16)
+        x[2, 3] = bf16.type(1.5)
+        x[7, 0] = bf16.type(-2.25)
+        _, _, got = roundtrip([x.copy()])
+        out = np.asarray(got[0].tensor(0))
+        assert out.dtype == bf16
+        np.testing.assert_array_equal(out, x)
+
     def test_uint8_mask_roundtrip_and_compression_counters(self):
         x = np.zeros((32, 32), np.uint8)
         x[:2] = 255  # 1/16 dense segmentation-style mask

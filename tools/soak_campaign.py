@@ -529,8 +529,12 @@ def run_sparse(rng):
     frames = []
     for i in range(n):
         x = np.zeros(shape, dtype)
-        density = float(rng.uniform(0.0, 1.0))
-        k = int(x.size * density)
+        # 1-in-5 frames hit an exact extreme so the empty-sentinel and
+        # fully-dense encoder paths really run (a uniform draw almost
+        # never produces either)
+        r = int(rng.integers(0, 5))
+        density = 0.0 if r == 0 else 1.0 if r == 1 else float(rng.uniform(0, 1))
+        k = int(round(x.size * density))
         if k:
             pos = rng.choice(x.size, size=k, replace=False)
             vals = rng.integers(1, 100, k)
