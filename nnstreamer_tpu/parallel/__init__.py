@@ -1,4 +1,10 @@
-from .mesh import batch_sharding, init_distributed, make_mesh, replicated  # noqa: F401
+from .mesh import (  # noqa: F401
+    batch_sharding,
+    init_distributed,
+    init_from_env,
+    make_mesh,
+    replicated,
+)
 from .ring_attention import (  # noqa: F401
     full_attention,
     ring_attention,

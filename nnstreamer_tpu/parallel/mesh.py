@@ -65,6 +65,44 @@ def init_distributed(
     return jax.process_count()
 
 
+def init_from_env() -> int:
+    """Join the multi-host job described by ``NNS_MULTIHOST_*`` env vars.
+
+    The contract ``tools/launch_multihost.py`` (the torchrun/mpirun analog
+    the reference never needed) exports to every worker it spawns:
+
+    - ``NNS_MULTIHOST_COORD``  — ``host:port`` of process 0's coordinator
+    - ``NNS_MULTIHOST_NPROCS`` — total process count
+    - ``NNS_MULTIHOST_PROC_ID`` — this process's rank
+
+    With none of them set, falls back to :func:`init_distributed`'s
+    auto-discovery (TPU pods find the coordinator via the metadata
+    server).  Returns the process count."""
+    import os
+
+    # empty string == missing: a wrapper exporting an unset shell var must
+    # get the contextual error, not a bare int('') ValueError
+    coord = os.environ.get("NNS_MULTIHOST_COORD") or None
+    nprocs = os.environ.get("NNS_MULTIHOST_NPROCS") or None
+    pid = os.environ.get("NNS_MULTIHOST_PROC_ID") or None
+    if coord is None and nprocs is None and pid is None:
+        return init_distributed()
+    if coord is None or nprocs is None or pid is None:
+        raise ValueError(
+            "incomplete NNS_MULTIHOST_* env: need COORD, NPROCS and "
+            f"PROC_ID together (got coord={coord!r}, nprocs={nprocs!r}, "
+            f"proc_id={pid!r})"
+        )
+    try:
+        n, p = int(nprocs), int(pid)
+    except ValueError:
+        raise ValueError(
+            f"NNS_MULTIHOST_NPROCS={nprocs!r} / PROC_ID={pid!r} must be "
+            "integers"
+        ) from None
+    return init_distributed(coord, n, p)
+
+
 def batch_sharding(mesh: Mesh, rank: int, axis: str = "dp") -> NamedSharding:
     """Shard the leading (batch) dim over ``axis``, replicate the rest."""
     return NamedSharding(mesh, P(axis, *([None] * (rank - 1))))

@@ -1,0 +1,65 @@
+"""Worker for the launcher test (tools/launch_multihost.py).
+
+Joins via the NNS_MULTIHOST_* env contract (parallel.mesh.init_from_env),
+then runs a dp-sharded TRAINING step over the global cross-process mesh:
+each process holds different rows of the batch, the gradient psum crosses
+the DCN-analog transport, and every process must end with bit-identical
+updated params — the invariant that makes multi-host data-parallel
+training correct.
+"""
+
+import os
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from nnstreamer_tpu.parallel.mesh import init_from_env
+
+
+def main() -> None:
+    n = init_from_env()
+    pid = jax.process_index()
+    assert jax.process_count() == n
+    devs = jax.devices()
+    mesh = Mesh(np.array(devs), ("dp",))
+    rows = NamedSharding(mesh, P("dp", None))
+    repl = NamedSharding(mesh, P())
+
+    d, classes = 8, 3
+    rng = np.random.default_rng(0)  # same data recipe on every process
+    w_true = rng.standard_normal((d, classes)).astype(np.float32)
+    x_all = rng.standard_normal((len(devs), d)).astype(np.float32)
+    y_all = x_all @ w_true
+
+    # each process contributes only ITS rows; the global array spans all
+    x = jax.make_array_from_callback(
+        x_all.shape, rows, lambda idx: x_all[idx])
+    y = jax.make_array_from_callback(
+        y_all.shape, rows, lambda idx: y_all[idx])
+    w = jax.device_put(np.zeros((d, classes), np.float32), repl)
+
+    @jax.jit
+    def step(w, x, y):
+        def loss(w):
+            return ((x @ w - y) ** 2).mean()
+        g = jax.grad(loss)(w)  # XLA inserts the cross-process psum
+        return w - 0.1 * g
+
+    for _ in range(5):
+        w = step(w, x, y)
+    w_local = np.asarray(jax.device_get(w))
+    # identical params on every process = the data-parallel invariant
+    digest = float(np.abs(w_local).sum())
+    print(f"proc {pid}: MULTIHOST_TRAIN_OK digest={digest:.6f}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -50,3 +50,49 @@ def test_two_process_job_runs_collectives():
     for pid, (rc, out, err) in enumerate(outs):
         assert rc == 0, f"proc {pid} rc={rc}\n{err[-2000:]}"
         assert f"proc {pid}: MULTIHOST_OK" in out
+
+
+def test_launcher_runs_two_process_training_job():
+    """tools/launch_multihost.py (the torchrun/mpirun analog): spawns the
+    workers, wires the NNS_MULTIHOST_* contract, streams output, exits 0
+    only when every rank does.  The worker trains dp-sharded across the
+    two processes and both ranks must report the same param digest."""
+    import re
+
+    launcher = os.path.join(os.path.dirname(os.path.dirname(__file__)),
+                            "tools", "launch_multihost.py")
+    worker = os.path.join(os.path.dirname(__file__), "fixtures",
+                          "multihost_env_worker.py")
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, launcher, "--nprocs", "2",
+         "--devices-per-proc", "2", worker],
+        capture_output=True, text=True, timeout=240, env=env,
+    )
+    assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-2000:]
+    digests = re.findall(r"MULTIHOST_TRAIN_OK digest=([0-9.]+)", proc.stdout)
+    assert len(digests) == 2, proc.stdout
+    assert digests[0] == digests[1], digests
+
+
+def test_launcher_kills_survivors_on_rank_failure(tmp_path):
+    """mpirun discipline: one failed rank must take the job down (a
+    half-dead collective otherwise hangs in the next psum)."""
+    launcher = os.path.join(os.path.dirname(os.path.dirname(__file__)),
+                            "tools", "launch_multihost.py")
+    bad = tmp_path / "bad_worker.py"
+    bad.write_text(
+        "import os, sys, time\n"
+        "if os.environ['NNS_MULTIHOST_PROC_ID'] == '1':\n"
+        "    sys.exit(3)\n"
+        "time.sleep(60)\n"
+    )
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, launcher, "--nprocs", "2", str(bad)],
+        capture_output=True, text=True, timeout=60, env=env,
+    )
+    assert proc.returncode == 3, (proc.returncode, proc.stderr[-500:])
