@@ -1,0 +1,38 @@
+"""``protobuf`` decoder: tensor frames → serialized protobuf bytes.
+
+Analog of upstream 2.x's ``tensordec-protobuf.cc`` (the reference snapshot
+predates it): the whole frame — every tensor, dtype/shape self-described,
+pts/duration — becomes ONE ``TensorFrame`` message
+(``proto/tensor_frame.proto``), emitted as a flat uint8 tensor.  The
+inverse direction is ``tensor_converter input_format=protobuf``.
+
+Typical topology: ``... ! tensor_decoder mode=protobuf ! filesink`` (or a
+queue/TCP hop), then ``filesrc ! tensor_converter input_format=protobuf !
+...`` in the consuming pipeline — cross-process and cross-language tensor
+exchange with a stable schema.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..buffer import Frame
+from ..elements.decoder import DecoderPlugin, register_decoder
+from ..interop import encode_frame
+from ..spec import TensorSpec, TensorsSpec
+
+
+@register_decoder("protobuf")
+class ProtobufEncode(DecoderPlugin):
+    def out_spec(self, in_spec: TensorsSpec) -> TensorsSpec:
+        # message length varies per frame: dtype-only spec
+        return TensorsSpec(
+            tensors=(TensorSpec(dtype=np.uint8, shape=None),),
+            rate=in_spec.rate,
+        )
+
+    def decode(self, frame: Frame, in_spec: TensorsSpec) -> Frame:
+        del in_spec
+        payload = np.frombuffer(encode_frame(frame), np.uint8)
+        return Frame(tensors=(payload,), pts=frame.pts,
+                     duration=frame.duration, meta=dict(frame.meta))

@@ -36,6 +36,8 @@ class TensorConverter(Node):
         frames_per_tensor: int = 1,
         input_dim: str = "",
         input_type: str = "",
+        input_format: str = "",
+        num_tensors: int = 1,
     ):
         super().__init__(name)
         self.add_sink_pad("sink")
@@ -43,8 +45,31 @@ class TensorConverter(Node):
         self.frames_per_tensor = int(frames_per_tensor)
         if self.frames_per_tensor < 1:
             raise ValueError("frames-per-tensor must be >= 1")
+        # input_format="protobuf": each incoming byte buffer is one
+        # self-describing TensorFrame message (the upstream-2.x protobuf
+        # converter subplugin's job; inverse of tensor_decoder
+        # mode=protobuf).  num_tensors declares the per-frame tensor count
+        # for negotiation (shapes/dtypes ride in each message).
+        self.input_format = str(input_format or "").lower()
+        if self.input_format not in ("", "protobuf"):
+            raise ValueError(
+                f"unknown input-format {input_format!r} (know: protobuf)"
+            )
+        if self.input_format and self.frames_per_tensor != 1:
+            raise ValueError(
+                "frames-per-tensor does not apply to input-format=protobuf "
+                "(each message is one self-describing frame)"
+            )
+        self.num_tensors = int(num_tensors)
+        if self.num_tensors < 1:
+            raise ValueError("num-tensors must be >= 1")
         self.input_spec: Optional[TensorSpec] = None
         if input_dim:
+            if self.input_format:
+                raise ValueError(
+                    "input-dim and input-format are mutually exclusive "
+                    "(protobuf messages are self-describing)"
+                )
             self.input_spec = TensorSpec.from_dims_string(
                 input_dim, input_type or "uint8"
             )
@@ -61,6 +86,19 @@ class TensorConverter(Node):
         in_spec = in_specs["sink"]
         media = in_spec.tensors[0].name  # unused; media rides in frame meta
         del media
+        if self.input_format == "protobuf":
+            if in_spec.num_tensors != 1:
+                raise NegotiationError(
+                    f"{self.name}: protobuf input must be a single byte "
+                    f"buffer per frame, got {in_spec.num_tensors} tensors"
+                )
+            # shapes/dtypes are per-message; declare count only
+            self._out_rate = in_spec.rate
+            self._in_rate = in_spec.rate
+            return {"src": TensorsSpec(
+                tensors=tuple(TensorSpec() for _ in range(self.num_tensors)),
+                rate=in_spec.rate,
+            )}
         # The upstream spec describes the raw layout; the media kind arrives
         # via the source's declared media (meta).  When the upstream is an
         # octet/byte stream, input-dim/input-type must reinterpret it.
@@ -146,6 +184,31 @@ class TensorConverter(Node):
     def process(self, pad: Pad, frame: Frame):
         del pad
         arr = np.asarray(frame.tensor(0))
+        if self.input_format == "protobuf":
+            from ..interop import decode_frame
+
+            decoded = decode_frame(np.ascontiguousarray(arr).tobytes())
+            if len(decoded.tensors) != self.num_tensors:
+                # the out pad negotiated num_tensors open specs; pushing a
+                # different count would violate the caps contract far from
+                # the cause (the out spec is unfixed, so Pad.push cannot
+                # catch it)
+                raise ValueError(
+                    f"{self.name}: protobuf message carries "
+                    f"{len(decoded.tensors)} tensors, negotiated "
+                    f"num-tensors={self.num_tensors}"
+                )
+            # the incoming transport frame's timing wins when valid (a
+            # live stream restamps); otherwise the serialized timing is
+            # the original capture's
+            pts = frame.pts if is_valid_ts(frame.pts) else decoded.pts
+            dur = frame.duration if is_valid_ts(frame.duration) \
+                else decoded.duration
+            self.src_pads["src"].push(Frame(
+                tensors=decoded.tensors, pts=pts, duration=dur,
+                meta=dict(frame.meta),
+            ))
+            return None
         media = frame.meta.get("media")
         if isinstance(media, VideoSpec):
             arr = self._strip_stride(arr, frame)

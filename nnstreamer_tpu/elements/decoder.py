@@ -24,6 +24,7 @@ _BUILTIN = {
     "image_labeling": "nnstreamer_tpu.decoders.image_label",
     "bounding_boxes": "nnstreamer_tpu.decoders.bounding_boxes",
     "pose_estimation": "nnstreamer_tpu.decoders.pose",
+    "protobuf": "nnstreamer_tpu.decoders.proto",
 }
 
 

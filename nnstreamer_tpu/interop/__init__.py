@@ -1,0 +1,10 @@
+"""Interop codecs: tensor frames ⇄ standardized wire formats.
+
+Upstream GStreamer-nnstreamer 2.x ships protobuf/flatbuf converter+decoder
+subplugins for cross-process and cross-language tensor exchange; the
+reference snapshot predates them.  Here the protobuf codec
+(:mod:`.protobuf_codec`) backs ``tensor_decoder mode=protobuf`` and
+``tensor_converter input_format=protobuf``.
+"""
+
+from .protobuf_codec import decode_frame, encode_frame  # noqa: F401

@@ -1,0 +1,64 @@
+"""Frame ⇄ protobuf bytes (schema: ``proto/tensor_frame.proto``).
+
+Regenerate the vendored ``tensor_frame_pb2.py`` after schema changes with
+``tools/gen_proto.sh``.  Payloads are C-contiguous **little-endian**;
+dtypes are spec-layer names, so everything a pipeline can negotiate
+round-trips (including bfloat16 via ml_dtypes, whose dtype objects don't
+support ``newbyteorder`` — endianness is handled by byteswapping on
+big-endian hosts instead).
+"""
+
+from __future__ import annotations
+
+import sys
+
+import numpy as np
+
+from ..buffer import NONE_TS, Frame
+from ..spec import dtype_from_name, dtype_name
+from . import tensor_frame_pb2 as pb
+
+_LITTLE = sys.byteorder == "little"
+
+
+def encode_frame(frame: Frame) -> bytes:
+    """Serialize every tensor + timing into one ``TensorFrame`` message."""
+    msg = pb.TensorFrame()
+    msg.pts = frame.pts if frame.pts is not None else NONE_TS
+    msg.duration = frame.duration if frame.duration is not None else NONE_TS
+    for t in frame.tensors:
+        # NOT ascontiguousarray unconditionally: it promotes 0-d scalars
+        # to 1-d (the query-protocol gotcha, see the verify skill notes)
+        arr = np.asarray(t)
+        if not arr.flags.c_contiguous:
+            arr = np.ascontiguousarray(arr)
+        if not _LITTLE and arr.dtype.itemsize > 1:  # pragma: no cover
+            arr = arr.byteswap()
+        entry = msg.tensors.add()
+        entry.dtype = dtype_name(arr.dtype)
+        entry.shape.extend(int(d) for d in arr.shape)
+        entry.data = arr.tobytes()
+    return msg.SerializeToString()
+
+
+def decode_frame(data: bytes) -> Frame:
+    """Parse a ``TensorFrame`` message back into a Frame."""
+    msg = pb.TensorFrame()
+    msg.ParseFromString(bytes(data))
+    tensors = []
+    for entry in msg.tensors:
+        dtype = dtype_from_name(entry.dtype)
+        shape = tuple(int(d) for d in entry.shape)
+        n = 1
+        for d in shape:
+            n *= d
+        if len(entry.data) != n * dtype.itemsize:
+            raise ValueError(
+                f"protobuf tensor payload is {len(entry.data)}B, expected "
+                f"{n * dtype.itemsize}B for {entry.dtype}{shape}"
+            )
+        arr = np.frombuffer(entry.data, dtype=dtype, count=n)
+        if not _LITTLE and dtype.itemsize > 1:  # pragma: no cover
+            arr = arr.byteswap()
+        tensors.append(arr.copy().reshape(shape))
+    return Frame(tensors=tuple(tensors), pts=msg.pts, duration=msg.duration)

@@ -1,0 +1,126 @@
+"""Protobuf tensor interop: tensor_decoder mode=protobuf ⇄
+tensor_converter input_format=protobuf (upstream 2.x's protobuf
+converter/decoder subplugins; see proto/tensor_frame.proto).
+"""
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu import Pipeline, make, parse_launch
+from nnstreamer_tpu.buffer import Frame
+from nnstreamer_tpu.elements.sink import TensorSink
+from nnstreamer_tpu.elements.testsrc import DataSrc
+from nnstreamer_tpu.interop import decode_frame, encode_frame
+
+
+class TestCodec:
+    def test_roundtrip_multi_tensor_and_timing(self, rng):
+        f = Frame(
+            tensors=(rng.standard_normal((2, 3)).astype(np.float32),
+                     np.arange(4, dtype=np.int64)),
+            pts=123, duration=456,
+        )
+        g = decode_frame(encode_frame(f))
+        assert g.pts == 123 and g.duration == 456
+        for a, b in zip(f.tensors, g.tensors):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+            assert np.asarray(a).dtype == np.asarray(b).dtype
+
+    def test_bfloat16_roundtrip(self):
+        import ml_dtypes
+
+        bf16 = np.dtype(ml_dtypes.bfloat16)
+        x = np.array([1.5, -2.25, 0.0], bf16)
+        g = decode_frame(encode_frame(Frame(tensors=(x,))))
+        assert np.asarray(g.tensor(0)).dtype == bf16
+        np.testing.assert_array_equal(np.asarray(g.tensor(0)), x)
+
+    def test_scalar_and_empty_meta(self):
+        g = decode_frame(encode_frame(Frame(tensors=(np.float32(7.5),))))
+        assert np.asarray(g.tensor(0)).shape == ()
+        assert float(np.asarray(g.tensor(0))) == 7.5
+
+    def test_truncated_payload_rejected(self):
+        f = Frame(tensors=(np.zeros((4,), np.float32),))
+        import nnstreamer_tpu.interop.tensor_frame_pb2 as pb
+
+        msg = pb.TensorFrame()
+        msg.ParseFromString(encode_frame(f))
+        msg.tensors[0].data = msg.tensors[0].data[:-2]
+        with pytest.raises(ValueError, match="payload"):
+            decode_frame(msg.SerializeToString())
+
+
+class TestPipelineRoundtrip:
+    def test_decoder_converter_pair(self, rng):
+        frames = [
+            Frame(tensors=(rng.standard_normal((3, 4)).astype(np.float32),
+                           np.array([i], np.int32)), pts=i * 10)
+            for i in range(5)
+        ]
+        got = []
+        p = Pipeline()
+        src = p.add(DataSrc(data=frames))
+        enc = p.add(make("tensor_decoder", mode="protobuf"))
+        dec = p.add(make("tensor_converter", input_format="protobuf",
+                         num_tensors=2))
+        sink = p.add(TensorSink())
+        sink.connect("new-data", got.append)
+        p.link_chain(src, enc, dec, sink)
+        p.run(timeout=60)
+        assert len(got) == 5
+        for f, out in zip(frames, got):
+            assert out.pts == f.pts
+            assert out.num_tensors == 2
+            np.testing.assert_array_equal(np.asarray(out.tensor(0)),
+                                          np.asarray(f.tensor(0)))
+            np.testing.assert_array_equal(np.asarray(out.tensor(1)),
+                                          np.asarray(f.tensor(1)))
+
+    def test_through_file(self, rng, tmp_path):
+        """Produce in one pipeline, consume in another — the storage
+        topology the codec exists for."""
+        x = rng.standard_normal((4, 4)).astype(np.float32)
+        path = str(tmp_path / "frame.pb")
+        p1 = parse_launch(
+            f"tensor_decoder mode=protobuf name=e ! "
+            f"filesink location={path}"
+        )
+        src = p1.add(DataSrc(data=[x.copy()]))
+        p1.link(src, p1.nodes["e"])
+        p1.run(timeout=60)
+
+        p2 = parse_launch(
+            f"filesrc location={path} ! "
+            "tensor_converter input_format=protobuf ! "
+            "tensor_sink name=out collect=true"
+        )
+        p2.run(timeout=60)
+        out = p2.nodes["out"].frames
+        assert len(out) == 1
+        np.testing.assert_array_equal(np.asarray(out[0].tensor(0)), x)
+
+    def test_parse_launch_grammar_and_bad_format(self):
+        with pytest.raises(ValueError, match="input-format"):
+            make("tensor_converter", input_format="msgpack")
+        with pytest.raises(ValueError, match="mutually exclusive"):
+            make("tensor_converter", input_format="protobuf", input_dim="4")
+        with pytest.raises(ValueError, match="frames-per-tensor"):
+            make("tensor_converter", input_format="protobuf",
+                 frames_per_tensor=4)
+
+    def test_tensor_count_mismatch_rejected(self, rng):
+        """The reader's negotiated num_tensors is a contract: a message
+        carrying a different count must fail AT the converter, not
+        downstream (the open out-spec means Pad.push cannot catch it)."""
+        frames = [Frame(tensors=(np.zeros((2,), np.float32),
+                                 np.zeros((2,), np.float32)))]
+        p = Pipeline()
+        src = p.add(DataSrc(data=frames))
+        enc = p.add(make("tensor_decoder", mode="protobuf"))
+        dec = p.add(make("tensor_converter", input_format="protobuf",
+                         num_tensors=3))
+        sink = p.add(TensorSink())
+        p.link_chain(src, enc, dec, sink)
+        with pytest.raises(Exception, match="carries 2 tensors"):
+            p.run(timeout=30)

@@ -1,0 +1,6 @@
+#!/bin/sh
+# Regenerate the vendored protobuf codec module from proto/tensor_frame.proto.
+set -e
+cd "$(dirname "$0")/.."
+protoc --python_out=nnstreamer_tpu/interop --proto_path=proto proto/tensor_frame.proto
+echo "regenerated nnstreamer_tpu/interop/tensor_frame_pb2.py"

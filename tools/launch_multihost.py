@@ -101,16 +101,19 @@ def main() -> int:
         t.start()
 
     def terminate(survivors, grace_s=10.0):
-        """mpirun discipline, two-step: TERM, then KILL after a grace
-        period — a worker whose SIGTERM handler blocks (checkpoint
-        cleanup, stuck collective) must not hang the launcher forever."""
+        """mpirun discipline, two-step: TERM, then KILL after ONE shared
+        grace period — a worker whose SIGTERM handler blocks (checkpoint
+        cleanup, stuck collective) must not hang the launcher forever,
+        and N stuck ranks must not stack N grace periods."""
+        import time
+
         for j in survivors:
             if procs[j].poll() is None:
                 procs[j].send_signal(signal.SIGTERM)
-        deadline = grace_s
+        deadline = time.monotonic() + grace_s
         for j in survivors:
             try:
-                procs[j].wait(timeout=max(0.1, deadline))
+                procs[j].wait(timeout=max(0.1, deadline - time.monotonic()))
             except subprocess.TimeoutExpired:
                 sys.stderr.write(
                     f"[launcher] rank {args.rank_offset + j} ignored "
