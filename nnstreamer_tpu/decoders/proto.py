@@ -10,9 +10,19 @@ Typical topology: ``... ! tensor_decoder mode=protobuf ! filesink`` (or a
 queue/TCP hop), then ``filesrc ! tensor_converter input_format=protobuf !
 ...`` in the consuming pipeline — cross-process and cross-language tensor
 exchange with a stable schema.
+
+**Framing**: each message is prefixed with its length as 8 little-endian
+bytes (the standard delimited-stream discipline).  Bare proto3 messages
+concatenate ambiguously — ``ParseFromString`` on two appended frames
+silently *merges* them (repeated fields append, scalars take the last
+value) — so a multi-frame ``filesink`` capture would otherwise decode as
+one corrupted frame.  The converter side splits on the prefixes and
+emits one frame per message.
 """
 
 from __future__ import annotations
+
+import struct
 
 import numpy as np
 
@@ -20,6 +30,8 @@ from ..buffer import Frame
 from ..elements.decoder import DecoderPlugin, register_decoder
 from ..interop import encode_frame
 from ..spec import TensorSpec, TensorsSpec
+
+LEN_PREFIX = struct.Struct("<Q")
 
 
 @register_decoder("protobuf")
@@ -33,6 +45,7 @@ class ProtobufEncode(DecoderPlugin):
 
     def decode(self, frame: Frame, in_spec: TensorsSpec) -> Frame:
         del in_spec
-        payload = np.frombuffer(encode_frame(frame), np.uint8)
+        msg = encode_frame(frame)
+        payload = np.frombuffer(LEN_PREFIX.pack(len(msg)) + msg, np.uint8)
         return Frame(tensors=(payload,), pts=frame.pts,
                      duration=frame.duration, meta=dict(frame.meta))

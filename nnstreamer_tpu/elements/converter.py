@@ -60,9 +60,18 @@ class TensorConverter(Node):
                 "frames-per-tensor does not apply to input-format=protobuf "
                 "(each message is one self-describing frame)"
             )
+        if self.input_format and input_type:
+            raise ValueError(
+                "input-type does not apply to input-format=protobuf "
+                "(dtypes ride in each message)"
+            )
         self.num_tensors = int(num_tensors)
         if self.num_tensors < 1:
             raise ValueError("num-tensors must be >= 1")
+        if not self.input_format and self.num_tensors != 1:
+            raise ValueError(
+                "num-tensors only applies with input-format=protobuf"
+            )
         self.input_spec: Optional[TensorSpec] = None
         if input_dim:
             if self.input_format:
@@ -185,29 +194,49 @@ class TensorConverter(Node):
         del pad
         arr = np.asarray(frame.tensor(0))
         if self.input_format == "protobuf":
+            from ..decoders.proto import LEN_PREFIX
             from ..interop import decode_frame
 
-            decoded = decode_frame(np.ascontiguousarray(arr).tobytes())
-            if len(decoded.tensors) != self.num_tensors:
-                # the out pad negotiated num_tensors open specs; pushing a
-                # different count would violate the caps contract far from
-                # the cause (the out spec is unfixed, so Pad.push cannot
-                # catch it)
-                raise ValueError(
-                    f"{self.name}: protobuf message carries "
-                    f"{len(decoded.tensors)} tensors, negotiated "
-                    f"num-tensors={self.num_tensors}"
-                )
-            # the incoming transport frame's timing wins when valid (a
-            # live stream restamps); otherwise the serialized timing is
-            # the original capture's
-            pts = frame.pts if is_valid_ts(frame.pts) else decoded.pts
-            dur = frame.duration if is_valid_ts(frame.duration) \
-                else decoded.duration
-            self.src_pads["src"].push(Frame(
-                tensors=decoded.tensors, pts=pts, duration=dur,
-                meta=dict(frame.meta),
-            ))
+            # length-delimited stream: one incoming buffer may hold many
+            # messages (a filesink capture of a whole stream) — split on
+            # the 8-byte prefixes and emit one frame per message
+            buf = np.ascontiguousarray(arr).tobytes()
+            off = 0
+            while off < len(buf):
+                if off + LEN_PREFIX.size > len(buf):
+                    raise ValueError(
+                        f"{self.name}: truncated length prefix at byte "
+                        f"{off}/{len(buf)}"
+                    )
+                (mlen,) = LEN_PREFIX.unpack_from(buf, off)
+                off += LEN_PREFIX.size
+                if off + mlen > len(buf):
+                    raise ValueError(
+                        f"{self.name}: truncated protobuf message "
+                        f"({mlen}B declared, {len(buf) - off}B left)"
+                    )
+                decoded = decode_frame(buf[off:off + mlen])
+                off += mlen
+                if len(decoded.tensors) != self.num_tensors:
+                    # the out pad negotiated num_tensors open specs;
+                    # pushing a different count would violate the caps
+                    # contract far from the cause (the out spec is
+                    # unfixed, so Pad.push cannot catch it)
+                    raise ValueError(
+                        f"{self.name}: protobuf message carries "
+                        f"{len(decoded.tensors)} tensors, negotiated "
+                        f"num-tensors={self.num_tensors}"
+                    )
+                # the incoming transport frame's timing wins when valid (a
+                # live stream restamps); otherwise the serialized timing
+                # is the original capture's
+                pts = frame.pts if is_valid_ts(frame.pts) else decoded.pts
+                dur = frame.duration if is_valid_ts(frame.duration) \
+                    else decoded.duration
+                self.src_pads["src"].push(Frame(
+                    tensors=decoded.tensors, pts=pts, duration=dur,
+                    meta=dict(frame.meta),
+                ))
             return None
         media = frame.meta.get("media")
         if isinstance(media, VideoSpec):

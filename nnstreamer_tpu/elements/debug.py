@@ -1,0 +1,134 @@
+"""``tensor_debug``: in-line stream inspection (pass-through).
+
+Upstream GStreamer-nnstreamer 2.x grew ``tensor_debug`` (the reference
+snapshot predates it; its debugging story is GST_DEBUG log categories +
+dot dumps, survey §5).  A pass-through tap that records what actually
+flows — the first tool to reach for when a pipeline produces wrong
+numbers and the question is "which hop corrupted them":
+
+- per-frame capture of shapes/dtypes/pts (``ring`` holds the last
+  ``capacity`` records; negligible overhead — no tensor copies);
+- optional ``checksum=True`` adds a uint64 byte-sum per tensor (catches
+  silent corruption across transports — the sparse/protobuf/query hops);
+- optional ``console=True`` prints one line per frame (the GST_DEBUG
+  analog, off by default);
+- counters: ``frames``, ``bytes``; ``stats()`` summarizes (count, fps
+  from pts span, per-tensor spec string).
+
+Everything is observable from the object; nothing perturbs the stream
+(frames pass through untouched, same object identity).
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+from typing import Dict, Optional
+
+import numpy as np
+
+from ..buffer import Frame, is_valid_ts
+from ..graph.node import Node, Pad
+from ..graph.registry import register_element
+from ..spec import TensorsSpec, dtype_name
+from ..utils.props import parse_bool
+
+
+def _tensor_nbytes(t) -> int:
+    """Byte size without materializing: ndarray/jax Arrays have .nbytes;
+    WireTensor exposes shape/dtype only."""
+    nb = getattr(t, "nbytes", None)
+    if nb is not None:
+        return int(nb)
+    n = 1
+    for d in t.shape:
+        n *= int(d)
+    return n * np.dtype(t.dtype).itemsize
+
+
+@register_element("tensor_debug")
+class TensorDebug(Node):
+    def __init__(
+        self,
+        name: Optional[str] = None,
+        capacity: int = 16,
+        checksum: bool = False,
+        console: bool = False,
+    ):
+        super().__init__(name)
+        self.add_sink_pad("sink")
+        self.add_src_pad("src")
+        self.capacity = int(capacity)
+        if self.capacity < 1:
+            raise ValueError("capacity must be >= 1")
+        self.checksum = parse_bool(checksum, name="checksum")
+        self.console = parse_bool(console, name="console")
+        self.ring = collections.deque(maxlen=self.capacity)
+        self.frames = 0
+        self.bytes = 0
+        self._stamped = 0  # frames carrying a valid pts
+        self._first_pts = None
+        self._last_pts = None
+        # NOT self._lock: Node._dispatch already holds that around
+        # process(), so re-acquiring it here would self-deadlock
+        self._stats_lock = threading.Lock()
+
+    def configure(self, in_specs: Dict[str, TensorsSpec]) -> Dict[str, TensorsSpec]:
+        return {"src": in_specs["sink"]}  # pure pass-through
+
+    def process(self, pad: Pad, frame: Frame):
+        del pad
+        # shape/dtype/nbytes come from the tensor objects directly — a
+        # device-resident jax Array must NOT be pulled to host just to be
+        # described (only the checksum option materializes bytes)
+        rec = {
+            "pts": frame.pts,
+            "tensors": tuple(
+                f"{dtype_name(t.dtype)}{tuple(t.shape)}"
+                for t in frame.tensors
+            ),
+        }
+        nbytes = sum(_tensor_nbytes(t) for t in frame.tensors)
+        if self.checksum:
+            rec["checksum"] = tuple(
+                int(np.ascontiguousarray(np.asarray(t)).view(np.uint8)
+                    .sum(dtype=np.uint64))
+                for t in frame.tensors
+            )
+        with self._stats_lock:
+            self.frames += 1
+            rec["n"] = self.frames
+            self.bytes += nbytes
+            self.ring.append(rec)
+            if is_valid_ts(frame.pts):
+                self._stamped += 1
+                if self._first_pts is None:
+                    self._first_pts = frame.pts
+                self._last_pts = frame.pts
+            n = self.frames
+        if self.console:
+            print(f"[{self.name}] #{n} pts={frame.pts} "
+                  f"{' '.join(rec['tensors'])}"
+                  + (f" sum={rec['checksum']}" if self.checksum else ""),
+                  flush=True)
+        self.src_pads["src"].push(frame)
+        return None
+
+    def stats(self) -> Dict[str, object]:
+        """Summary of everything seen (the readout properties analog).
+        Safe to call while the pipeline runs (snapshot under the stats
+        lock)."""
+        with self._stats_lock:
+            out: Dict[str, object] = {
+                "frames": self.frames,
+                "bytes": self.bytes,
+                "last": list(self.ring),
+            }
+            first, last, stamped = self._first_pts, self._last_pts, self._stamped
+        if (first is not None and last is not None and last > first
+                and stamped > 1):
+            span_s = (last - first) / 1e9
+            # fps over the frames that actually carry timestamps — a
+            # mixed stream must not divide ALL frames by the stamped span
+            out["fps_from_pts"] = round((stamped - 1) / span_s, 3)
+        return out

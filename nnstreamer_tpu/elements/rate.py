@@ -36,6 +36,7 @@ from ..buffer import Frame, is_valid_ts
 from ..graph.node import Node, Pad
 from ..graph.registry import register_element
 from ..spec import TensorsSpec
+from ..utils.props import parse_bool
 
 _SECOND_NS = 1_000_000_000
 
@@ -61,8 +62,7 @@ class TensorRate(Node):
             raise ValueError(f"bad framerate {framerate!r}: {exc}") from None
         if self.rate <= 0:
             raise ValueError(f"framerate must be positive, got {framerate!r}")
-        self.throttle = bool(throttle) if not isinstance(throttle, str) \
-            else throttle.lower() in ("1", "true", "yes")
+        self.throttle = parse_bool(throttle, name="throttle")
         self._period_ns = int(_SECOND_NS * self.rate.denominator
                               / self.rate.numerator)
         self._next_slot = 0           # first unclaimed output slot index

@@ -97,6 +97,7 @@ for _el, _mod in {
     "tensor_rate": "nnstreamer_tpu.elements.rate",
     "tensor_sparse_enc": "nnstreamer_tpu.elements.sparse",
     "tensor_sparse_dec": "nnstreamer_tpu.elements.sparse",
+    "tensor_debug": "nnstreamer_tpu.elements.debug",
     # runtime/plumbing elements (GStreamer-provided in the reference)
     "queue": "nnstreamer_tpu.elements.queue",
     "tee": "nnstreamer_tpu.elements.tee",

@@ -14,7 +14,7 @@ import sys
 
 import numpy as np
 
-from ..buffer import NONE_TS, Frame
+from ..buffer import NONE_TS, Frame, is_valid_ts
 from ..spec import dtype_from_name, dtype_name
 from . import tensor_frame_pb2 as pb
 
@@ -22,10 +22,16 @@ _LITTLE = sys.byteorder == "little"
 
 
 def encode_frame(frame: Frame) -> bytes:
-    """Serialize every tensor + timing into one ``TensorFrame`` message."""
+    """Serialize every tensor + timing into one ``TensorFrame`` message.
+
+    Timing uses proto3 *optional presence*: an unstamped frame leaves the
+    fields absent, so a cross-language producer that never sets pts (the
+    proto3 default) round-trips as "no timestamp" — NOT as t=0."""
     msg = pb.TensorFrame()
-    msg.pts = frame.pts if frame.pts is not None else NONE_TS
-    msg.duration = frame.duration if frame.duration is not None else NONE_TS
+    if frame.pts is not None and is_valid_ts(frame.pts):
+        msg.pts = frame.pts
+    if frame.duration is not None and is_valid_ts(frame.duration):
+        msg.duration = frame.duration
     for t in frame.tensors:
         # NOT ascontiguousarray unconditionally: it promotes 0-d scalars
         # to 1-d (the query-protocol gotcha, see the verify skill notes)
@@ -61,4 +67,8 @@ def decode_frame(data: bytes) -> Frame:
         if not _LITTLE and dtype.itemsize > 1:  # pragma: no cover
             arr = arr.byteswap()
         tensors.append(arr.copy().reshape(shape))
-    return Frame(tensors=tuple(tensors), pts=msg.pts, duration=msg.duration)
+    return Frame(
+        tensors=tuple(tensors),
+        pts=msg.pts if msg.HasField("pts") else NONE_TS,
+        duration=msg.duration if msg.HasField("duration") else NONE_TS,
+    )

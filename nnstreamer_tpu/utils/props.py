@@ -1,0 +1,26 @@
+"""Property-value parsing shared by elements.
+
+``parse_launch`` delivers every property as a string; elements accept the
+same constructor argument programmatically as a real bool.  One helper
+keeps the accepted spellings identical across elements (three hand-rolled
+copies had already grown in rate/debug — the drift this file exists to
+stop).  The accepted true-spellings match the conf layer's (``conf._TRUE``).
+"""
+
+from __future__ import annotations
+
+_TRUE = {"1", "true", "yes", "on"}
+_FALSE = {"0", "false", "no", "off", ""}
+
+
+def parse_bool(value, *, name: str = "property") -> bool:
+    """Bool or string property → bool; unknown spellings are errors (a
+    typo'd ``throtle=ture`` must not silently mean False)."""
+    if isinstance(value, str):
+        low = value.strip().lower()
+        if low in _TRUE:
+            return True
+        if low in _FALSE:
+            return False
+        raise ValueError(f"bad boolean for {name}: {value!r}")
+    return bool(value)

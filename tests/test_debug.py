@@ -1,0 +1,99 @@
+"""tensor_debug: pass-through stream inspection (upstream 2.x element)."""
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu import Pipeline, make, parse_launch
+from nnstreamer_tpu.buffer import Frame
+from nnstreamer_tpu.elements.sink import TensorSink
+from nnstreamer_tpu.elements.testsrc import DataSrc
+
+
+def run_debug(frames, **props):
+    got = []
+    p = Pipeline()
+    src = p.add(DataSrc(data=frames))
+    dbg = p.add(make("tensor_debug", **props))
+    sink = p.add(TensorSink())
+    sink.connect("new-data", got.append)
+    p.link_chain(src, dbg, sink)
+    p.run(timeout=60)
+    return dbg, got
+
+
+class TestTensorDebug:
+    def test_passthrough_untouched(self, rng):
+        frames = [Frame.of(rng.standard_normal((3, 4)).astype(np.float32),
+                           pts=i * 100_000_000, duration=100_000_000)
+                  for i in range(5)]
+        dbg, got = run_debug([f.with_tensors(f.tensors) for f in frames])
+        assert len(got) == 5 and dbg.frames == 5
+        for f, out in zip(frames, got):
+            np.testing.assert_array_equal(np.asarray(out.tensor(0)),
+                                          np.asarray(f.tensor(0)))
+            assert out.pts == f.pts
+        st = dbg.stats()
+        assert st["frames"] == 5
+        assert st["bytes"] == 5 * 3 * 4 * 4
+        assert st["fps_from_pts"] == 10.0
+        assert st["last"][0]["tensors"] == ("float32(3, 4)",)
+
+    def test_ring_capacity_and_checksum(self, rng):
+        frames = [np.full((4,), i, np.uint8) for i in range(10)]
+        dbg, _ = run_debug(frames, capacity=3, checksum=True)
+        st = dbg.stats()
+        assert len(st["last"]) == 3
+        assert [r["n"] for r in st["last"]] == [8, 9, 10]
+        # byte-sum of np.full((4,), 9) = 36
+        assert st["last"][-1]["checksum"] == (36,)
+
+    def test_console_mode_prints(self, rng, capfd):
+        run_debug([np.zeros((2,), np.float32)], console=True, checksum=True)
+        out = capfd.readouterr().out
+        assert "#1" in out and "float32(2,)" in out and "sum=" in out
+
+    def test_parse_launch(self):
+        p = parse_launch(
+            "tensor_debug name=d checksum=true ! tensor_sink name=out collect=true")
+        src = p.add(DataSrc(data=[np.ones((2, 2), np.float32)]))
+        p.link(src, p.nodes["d"])
+        p.run(timeout=60)
+        assert p.nodes["out"].num_frames == 1
+        assert p.nodes["d"].stats()["frames"] == 1
+
+    def test_bad_capacity(self):
+        with pytest.raises(ValueError, match="capacity"):
+            make("tensor_debug", capacity=0)
+
+    def test_mixed_pts_fps_counts_only_stamped_frames(self):
+        frames = [Frame.of(np.zeros((1,), np.float32), pts=0, duration=1),
+                  Frame.of(np.zeros((1,), np.float32), pts=100_000_000,
+                           duration=1)]
+        frames += [Frame.of(np.zeros((1,), np.float32)) for _ in range(8)]
+        dbg, _ = run_debug(frames)
+        st = dbg.stats()
+        assert st["frames"] == 10
+        # 2 stamped frames spanning 0.1s -> 10 fps, NOT (10-1)/0.1 = 90
+        assert st["fps_from_pts"] == 10.0
+
+    def test_device_resident_frames_not_materialized(self):
+        """jax Array payloads are described from metadata only (no
+        device->host copy on the tap's hot path)."""
+        import jax.numpy as jnp
+        import nnstreamer_tpu.elements.debug as dbg_mod
+
+        calls = {"n": 0}
+        orig = np.asarray
+
+        def counting_asarray(*a, **k):
+            calls["n"] += 1
+            return orig(*a, **k)
+
+        frames = [Frame.of(jnp.ones((4, 4), jnp.float32))]
+        dbg_mod.np.asarray = counting_asarray
+        try:
+            dbg, _ = run_debug(frames)
+        finally:
+            dbg_mod.np.asarray = orig
+        assert dbg.stats()["last"][0]["tensors"] == ("float32(4, 4)",)
+        assert calls["n"] == 0, "tap must not np.asarray device payloads"

@@ -100,6 +100,64 @@ class TestPipelineRoundtrip:
         assert len(out) == 1
         np.testing.assert_array_equal(np.asarray(out[0].tensor(0)), x)
 
+    def test_multi_frame_file_capture_splits_exactly(self, rng, tmp_path):
+        """A whole-stream filesink capture holds MANY length-prefixed
+        messages in one byte buffer; the converter must split them back
+        into distinct frames (bare proto3 concatenation would silently
+        merge them into one corrupted frame)."""
+        frames = [Frame(tensors=(np.full((3,), i, np.float32),),
+                        pts=i * 10, duration=10) for i in range(4)]
+        path = str(tmp_path / "stream.pb")
+        p1 = parse_launch(
+            f"tensor_decoder mode=protobuf name=e ! "
+            f"filesink location={path}")
+        src = p1.add(DataSrc(data=frames))
+        p1.link(src, p1.nodes["e"])
+        p1.run(timeout=60)
+
+        p2 = parse_launch(
+            f"filesrc location={path} ! "
+            "tensor_converter input_format=protobuf ! "
+            "tensor_sink name=out collect=true")
+        p2.run(timeout=60)
+        out = p2.nodes["out"].frames
+        assert len(out) == 4
+        for i, f in enumerate(out):
+            np.testing.assert_array_equal(
+                np.asarray(f.tensor(0)), np.full((3,), i, np.float32))
+            assert f.pts == i * 10  # serialized timing restored per frame
+
+    def test_unset_pts_stays_unset(self):
+        """proto3 optional presence: a producer that never sets pts must
+        round-trip as 'no timestamp', not as t=0."""
+        from nnstreamer_tpu.buffer import NONE_TS, is_valid_ts
+
+        g = decode_frame(encode_frame(Frame(tensors=(np.zeros(2, np.float32),))))
+        assert g.pts == NONE_TS and not is_valid_ts(g.pts)
+        # and a legitimately-zero pts survives as zero
+        g0 = decode_frame(encode_frame(
+            Frame(tensors=(np.zeros(2, np.float32),), pts=0)))
+        assert g0.pts == 0 and is_valid_ts(g0.pts)
+
+    def test_truncated_stream_rejected(self, rng):
+        frames = [Frame(tensors=(np.zeros((4,), np.float32),))]
+        p = Pipeline()
+        src = p.add(DataSrc(data=frames))
+        enc = p.add(make("tensor_decoder", mode="protobuf"))
+        sink = p.add(TensorSink(collect=True))
+        p.link_chain(src, enc, sink)
+        p.run(timeout=30)
+        payload = np.asarray(p.nodes[sink.name].frames[0].tensor(0))
+        clipped = payload[:-3]  # cut into the message body
+
+        p2 = Pipeline()
+        src2 = p2.add(DataSrc(data=[clipped]))
+        dec = p2.add(make("tensor_converter", input_format="protobuf"))
+        sink2 = p2.add(TensorSink())
+        p2.link_chain(src2, dec, sink2)
+        with pytest.raises(Exception, match="truncated"):
+            p2.run(timeout=30)
+
     def test_parse_launch_grammar_and_bad_format(self):
         with pytest.raises(ValueError, match="input-format"):
             make("tensor_converter", input_format="msgpack")
@@ -108,6 +166,11 @@ class TestPipelineRoundtrip:
         with pytest.raises(ValueError, match="frames-per-tensor"):
             make("tensor_converter", input_format="protobuf",
                  frames_per_tensor=4)
+        with pytest.raises(ValueError, match="input-type"):
+            make("tensor_converter", input_format="protobuf",
+                 input_type="float32")
+        with pytest.raises(ValueError, match="num-tensors"):
+            make("tensor_converter", num_tensors=2)
 
     def test_tensor_count_mismatch_rejected(self, rng):
         """The reader's negotiated num_tensors is a contract: a message
