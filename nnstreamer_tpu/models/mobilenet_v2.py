@@ -114,26 +114,14 @@ def apply(params: Params, x, dtype=jnp.bfloat16, int8=False):
 
 
 def quantize_params(params: Params) -> Params:
-    """Weight-only int8 quantization of every conv/dense kernel (per output
-    channel).  The TPU-native analog of the reference's uint8-quantized
-    tflite flagship (survey §7f): weights live in HBM at 1 byte/element and
-    dequantize inside the fused XLA program; BN/bias stay float."""
-    from ..ops.quant import quantize_weight
+    """Int8 quantization of every conv/dense kernel (per output channel).
+    The TPU-native analog of the reference's uint8-quantized tflite
+    flagship (survey §7f): weights live in HBM at 1 byte/element; BN/bias
+    stay float.  (Generic walk — re-exported from
+    :func:`nnstreamer_tpu.ops.quant.quantize_params`.)"""
+    from ..ops.quant import quantize_params as _qp
 
-    def walk(node):
-        if isinstance(node, dict):
-            out = {}
-            for k, v in node.items():
-                if k == "w" and hasattr(v, "ndim") and v.ndim >= 2:
-                    out[k] = quantize_weight(v, axis=-1)
-                else:
-                    out[k] = walk(v)
-            return out
-        if isinstance(node, list):
-            return [walk(v) for v in node]
-        return node
-
-    return walk(params)
+    return _qp(params)
 
 
 def apply_quantized_int8_head(params: Params, x, dtype=jnp.bfloat16,

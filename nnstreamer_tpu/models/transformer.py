@@ -29,6 +29,22 @@ from ..spec import TensorSpec, TensorsSpec
 from .layers import Params, dense_init, ensure_batched
 
 
+def _proj(p: Params, x, dtype):
+    """``x @ w + b`` with the weight leaf deciding the path: an int8
+    :class:`~nnstreamer_tpu.ops.quant.QuantizedWeight` (from
+    ``quantize_params``) runs the W8A8 MXU matmul with per-token dynamic
+    scales (:func:`~nnstreamer_tpu.ops.quant.matmul_int8`); a float leaf
+    takes the plain ``dtype`` matmul.  Weight-only dequant is pointless
+    for transformer matmuls on TPU (same bf16 compute) — quantized params
+    mean W8A8 here."""
+    from ..ops.quant import QuantizedWeight, matmul_int8
+
+    w = p["w"]
+    if isinstance(w, QuantizedWeight):
+        return matmul_int8(x, w, dtype) + p["b"].astype(dtype)
+    return x @ w.astype(dtype) + p["b"].astype(dtype)
+
+
 def _layernorm(p: Params, x, eps: float = 1e-5):
     mean = x.mean(-1, keepdims=True)
     var = ((x - mean) ** 2).mean(-1, keepdims=True)
@@ -98,11 +114,11 @@ def _block_apply(
     """One pre-LN encoder block (attention + FFN/MoE with residuals)."""
     b, t, d = y.shape
     z = _layernorm(blk["ln1"], y)
-    qkv = z @ blk["qkv"]["w"].astype(dtype) + blk["qkv"]["b"].astype(dtype)
+    qkv = _proj(blk["qkv"], z, dtype)
     q, k, v = jnp.split(qkv, 3, axis=-1)
     q, k, v = (a.reshape(b, t, h, d // h) for a in (q, k, v))
     o = _attention(q, k, v, attn, mesh, axis, causal).reshape(b, t, d)
-    y = y + o @ blk["proj"]["w"].astype(dtype) + blk["proj"]["b"].astype(dtype)
+    y = y + _proj(blk["proj"], o, dtype)
     return _ffn_residual(blk, y, dtype, moe_mesh, moe_axis)
 
 
@@ -116,8 +132,8 @@ def _ffn_residual(blk: Params, y, dtype, moe_mesh=None, moe_axis: str = "ep"):
 
         return y + moe_ffn(blk["moe"], z, mesh=moe_mesh, axis=moe_axis,
                            dtype=dtype)
-    z = jax.nn.gelu(z @ blk["ff1"]["w"].astype(dtype) + blk["ff1"]["b"].astype(dtype))
-    return y + z @ blk["ff2"]["w"].astype(dtype) + blk["ff2"]["b"].astype(dtype)
+    z = jax.nn.gelu(_proj(blk["ff1"], z, dtype))
+    return y + _proj(blk["ff2"], z, dtype)
 
 
 def _attention(q, k, v, attn: str, mesh, axis: str, causal: bool):
@@ -150,8 +166,7 @@ def apply(
     """(B, T, d_in) or (T, d_in) features → (B, T, n_out) / (T, n_out)."""
     x, squeezed = ensure_batched(x, 3)
     h = params["n_heads"]
-    y = (x.astype(dtype) @ params["embed"]["w"].astype(dtype)
-         + params["embed"]["b"].astype(dtype))
+    y = _proj(params["embed"], x.astype(dtype), dtype)
     pe = params.get("pos_embed")
     if pe is not None:  # learned positional embeddings (ViT-style callers)
         y = y + pe.astype(dtype)
@@ -161,8 +176,7 @@ def apply(
             moe_mesh=moe_mesh, moe_axis=moe_axis,
         )
     y = _layernorm(params["ln_f"], y)
-    out = (y @ params["head"]["w"].astype(dtype)
-           + params["head"]["b"].astype(dtype)).astype(jnp.float32)
+    out = _proj(params["head"], y, dtype).astype(jnp.float32)
     return out[0] if squeezed else out
 
 
@@ -206,6 +220,34 @@ def build(
     )
 
 
+def build_quantized(**kwargs) -> JaxModel:
+    """W8A8 encoder: every matmul (embed, qkv, proj, ffn, head) runs
+    int8 x int8 → int32 on the MXU with per-token dynamic activation
+    scales (:func:`~nnstreamer_tpu.ops.quant.matmul_int8`) — the LLM-era
+    serving quantization, same tier as
+    ``mobilenet_v2.build_quantized(int8_convs=True)``.  Attention itself
+    stays in the compute dtype.  Takes :func:`build`'s kwargs; the decode
+    cell inherits the quantized leaves automatically (``_proj`` dispatches
+    on the leaf type), so stepwise==full equivalence holds under int8
+    too."""
+    from ..ops.quant import quantize_params
+
+    if kwargs.get("moe_experts", 0):
+        raise NotImplementedError(
+            "build_quantized does not cover MoE blocks: the expert weights "
+            "(w1/w2, expert-stacked 3-D) need expert-level scale handling "
+            "and only the gate would quantize — use the dense-FFN encoder "
+            "for W8A8"
+        )
+    m = build(**kwargs)
+    return JaxModel(
+        apply=m.apply,
+        params=quantize_params(m.params),
+        input_spec=m.input_spec,
+        name=m.name + "_q8",
+    )
+
+
 def decode_step(params: Params, x_t, cache, pos, dtype=jnp.float32):
     """One autoregressive step with a KV cache.
 
@@ -235,8 +277,7 @@ def decode_step(params: Params, x_t, cache, pos, dtype=jnp.float32):
     h = params["n_heads"]
     t_max = cache.shape[2]
     p_idx = pos[0]
-    y = (x_t[None].astype(dtype) @ params["embed"]["w"].astype(dtype)
-         + params["embed"]["b"].astype(dtype))  # (1, d)
+    y = _proj(params["embed"], x_t[None].astype(dtype), dtype)  # (1, d)
     pe = params.get("pos_embed")
     if pe is not None:
         y = y + jax.lax.dynamic_slice_in_dim(pe, p_idx, 1, 0).astype(dtype)
@@ -244,7 +285,7 @@ def decode_step(params: Params, x_t, cache, pos, dtype=jnp.float32):
     new_cache = []
     for li, blk in enumerate(params["blocks"]):
         z = _layernorm(blk["ln1"], y[None])[0]
-        qkv = z @ blk["qkv"]["w"].astype(dtype) + blk["qkv"]["b"].astype(dtype)
+        qkv = _proj(blk["qkv"], z, dtype)
         q, k, v = jnp.split(qkv, 3, axis=-1)  # (1, d) each
         ck = jax.lax.dynamic_update_slice_in_dim(
             cache[li, 0].astype(dtype), k, p_idx, 0
@@ -262,11 +303,10 @@ def decode_step(params: Params, x_t, cache, pos, dtype=jnp.float32):
         s = jnp.where(live[None, None, :], s, -jnp.inf)
         w = jax.nn.softmax(s, axis=-1)
         o = jnp.einsum("hqk,khd->qhd", w, vh).reshape(1, d)
-        y = y + o @ blk["proj"]["w"].astype(dtype) + blk["proj"]["b"].astype(dtype)
+        y = y + _proj(blk["proj"], o, dtype)
         y = _ffn_residual(blk, y[None], dtype)[0]
     y = _layernorm(params["ln_f"], y[None])[0]
-    out = (y @ params["head"]["w"].astype(dtype)
-           + params["head"]["b"].astype(dtype)).astype(jnp.float32)
+    out = _proj(params["head"], y, dtype).astype(jnp.float32)
     # overflow: a step past the cache capacity would clamp the write slot
     # and attend over stale state — saturate to NaN so the caller notices
     out = jnp.where(p_idx < t_max, out, jnp.nan)
@@ -364,14 +404,12 @@ def build_pipelined(
 
     def pipelined_apply(p, x):
         outer_p, stacked = p
-        y = (x.astype(dtype) @ outer_p["embed"]["w"].astype(dtype)
-             + outer_p["embed"]["b"].astype(dtype))
+        y = _proj(outer_p["embed"], x.astype(dtype), dtype)
         y = gpipe_apply(
             stage_fn, stacked, y, mesh, axis=axis, microbatches=microbatches
         )
         y = _layernorm(outer_p["ln_f"], y)
-        return (y @ outer_p["head"]["w"].astype(dtype)
-                + outer_p["head"]["b"].astype(dtype)).astype(jnp.float32)
+        return _proj(outer_p["head"], y, dtype).astype(jnp.float32)
 
     return JaxModel(
         apply=pipelined_apply,

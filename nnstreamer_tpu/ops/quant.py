@@ -76,6 +76,51 @@ def maybe_dequantize(w, dtype=None):
     return w
 
 
+def quantize_params(params):
+    """Walk a pytree-of-dicts/lists quantizing every ``"w"`` leaf with
+    ndim >= 2 (conv kernels, dense/matmul weights) to per-output-channel
+    int8; biases, norms, embeddings-by-name and scalars stay float.  Works
+    on any zoo model's params (mobilenet/SSD convs, transformer matmuls)."""
+
+    def walk(node):
+        if isinstance(node, dict):
+            out = {}
+            for k, v in node.items():
+                if k == "w" and hasattr(v, "ndim") and v.ndim >= 2:
+                    out[k] = quantize_weight(v, axis=-1)
+                else:
+                    out[k] = walk(v)
+            return out
+        if isinstance(node, list):
+            return [walk(v) for v in node]
+        return node
+
+    return walk(params)
+
+
+def matmul_int8(x, qw: QuantizedWeight, dtype=jnp.float32):
+    """W8A8 matmul on the MXU: ``(..., d) @ (d, dout)`` with int8 operands
+    and int32 accumulation.
+
+    Activations quantize dynamically with **per-row** scales (one scale
+    per token/sample — ``axes=(-1,)``), the finer-grained sibling of
+    :func:`~nnstreamer_tpu.models.layers.conv2d_int8`'s per-sample scales:
+    a transformer batch mixes tokens of very different magnitude, and one
+    outlier token must not coarsen the whole batch.  The int32 result
+    rescales by ``row_scale * per-channel weight scale`` in the epilogue.
+    v5e executes int8 at 2x the bf16 rate."""
+    import jax
+
+    q, s = quantize_activations(x, axes=(-1,))          # s: (..., 1)
+    y = jax.lax.dot_general(
+        q, qw.q,
+        (((x.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32,
+    )
+    rescale = (s * qw.scale.reshape(-1)).astype(jnp.float32)  # (..., dout)
+    return (y.astype(jnp.float32) * rescale).astype(dtype)
+
+
 def quantize_activations(x, dtype=jnp.int8, axes=None):
     """Dynamic symmetric activation quantization.
 

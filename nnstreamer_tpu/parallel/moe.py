@@ -79,7 +79,13 @@ def moe_ffn(
     e = params["w1"].shape[0]
     cap = max(1, int(np.ceil(t * capacity_factor / e)))
 
-    logits = xt @ params["gate"]["w"].astype(dtype) + params["gate"]["b"].astype(dtype)
+    # maybe_dequantize: a generic ops.quant.quantize_params walk turns the
+    # gate's 2-D "w" leaf into a QuantizedWeight (which has no .astype) —
+    # routing logits are tiny, so dequant-to-float is the right path
+    from ..ops.quant import maybe_dequantize
+
+    logits = (xt @ maybe_dequantize(params["gate"]["w"], dtype)
+              + params["gate"]["b"].astype(dtype))
     probs = jax.nn.softmax(logits, axis=-1)  # (t, e)
     expert = jnp.argmax(probs, axis=-1)  # (t,)
     gate_w = jnp.max(probs, axis=-1)  # (t,)

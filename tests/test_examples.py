@@ -141,6 +141,7 @@ class TestCodegen:
         ("decode_stream.py", "golden=OK"),
         ("audio_classify.py", "golden=OK"),
         ("text_classify.py", "golden=OK"),
+        ("capture_replay.py", "capture_replay=OK"),
         ("train_stream.py", "train_stream OK"),
         ("offload_query.py", "offload=OK"),
     ],

@@ -245,3 +245,60 @@ class TestDecodeCell:
             transformer.decode_step(
                 params, jnp.ones((4,)), cache, jnp.zeros((1,), jnp.int32)
             )
+
+
+class TestQuantizedTransformer:
+    """W8A8 encoder (transformer.build_quantized): every matmul int8 x
+    int8 -> int32 on the MXU, per-token dynamic scales."""
+
+    def test_quantized_close_to_float_and_on_int8_path(self):
+        import re
+
+        import jax
+        import jax.numpy as jnp
+
+        from nnstreamer_tpu.models import transformer
+
+        m = transformer.build(seq_len=12, d_in=8, n_out=6, d_model=32,
+                              n_heads=2, n_layers=2)
+        q = transformer.build_quantized(seq_len=12, d_in=8, n_out=6,
+                                        d_model=32, n_heads=2, n_layers=2)
+        # same init seed -> same float weights under the quantization
+        xs = np.random.default_rng(4).standard_normal((2, 12, 8)).astype(np.float32)
+        lf = np.asarray(m.apply(m.params, xs))
+        lq = np.asarray(q.apply(q.params, xs))
+        assert lf.shape == lq.shape
+        corr = np.corrcoef(lf.ravel(), lq.ravel())[0, 1]
+        assert corr > 0.98, corr
+        hlo = jax.jit(lambda a: q.apply(q.params, a)).lower(
+            jnp.asarray(xs)).as_text()
+        int8_dots = re.findall(
+            r"stablehlo\.dot_general[^\n]*xi8>[^\n]*->\s*tensor<[0-9x]*xi32>",
+            hlo)
+        # embed + per-block (qkv, proj, ff1, ff2) x2 + head = 10
+        assert len(int8_dots) >= 10, len(int8_dots)
+
+    def test_stepwise_equals_full_under_int8(self):
+        """decode_step inherits the quantized leaves through _proj, so the
+        stepwise==full equivalence must survive quantization (per-token
+        scales are computed identically on both paths)."""
+        import jax
+        import jax.numpy as jnp
+
+        from nnstreamer_tpu.models import transformer
+        from nnstreamer_tpu.ops.quant import quantize_params
+
+        t, d_in, n_out, d_model = 6, 6, 5, 16
+        params = quantize_params(transformer.init_params(
+            jax.random.PRNGKey(2), d_model, 2, 2, 32, d_in, n_out))
+        xs = np.random.default_rng(3).standard_normal((t, d_in)).astype(np.float32)
+        full = np.asarray(transformer.apply(params, jnp.asarray(xs), causal=True))
+
+        step = jax.jit(lambda x, c, p: transformer.decode_step(params, x, c, p))
+        cache = transformer.init_decode_cache(2, d_model, t)
+        pos = jnp.zeros((1,), jnp.int32)
+        for i in range(t):
+            y, cache, pos = step(jnp.asarray(xs[i]), cache, pos)
+            np.testing.assert_allclose(
+                np.asarray(y), full[i], rtol=5e-3, atol=5e-3
+            )
