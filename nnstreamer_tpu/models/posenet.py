@@ -36,14 +36,24 @@ def init_params(key, width_mult: float = 1.0) -> Params:
     }
 
 
-def apply(params: Params, x, dtype=jnp.bfloat16):
-    """(N,H,W,3) or (H,W,3) → (N,H/16,W/16,14) or (H/16,W/16,14) heatmaps."""
+def apply(params: Params, x, dtype=jnp.bfloat16, int8=False):
+    """(N,H,W,3) or (H,W,3) → (N,H/16,W/16,14) or (H/16,W/16,14) heatmaps.
+
+    ``int8=True``: ungrouped convs with quantized weights run on the MXU
+    int8 path (:func:`~nnstreamer_tpu.models.layers.conv2d_int8`)."""
+    from ..ops.quant import QuantizedWeight
+    from .layers import conv2d_int8
+
     x, squeezed = ensure_batched(x, 4)
     y = x.astype(dtype)
-    y = conv_bn_relu6(params["stem"], y, stride=2, dtype=dtype)
+    y = conv_bn_relu6(params["stem"], y, stride=2, dtype=dtype, int8=int8)
     for block in params["blocks"]:
-        y = mobilenet_v2._block_apply(block, y, dtype)
-    hm = jax.nn.sigmoid(conv2d(params["head"], y, dtype=dtype)).astype(jnp.float32)
+        y = mobilenet_v2._block_apply(block, y, dtype, int8=int8)
+    if int8 and isinstance(params["head"]["w"], QuantizedWeight):
+        hm_lin = conv2d_int8(params["head"], y, dtype=dtype)
+    else:
+        hm_lin = conv2d(params["head"], y, dtype=dtype)
+    hm = jax.nn.sigmoid(hm_lin).astype(jnp.float32)
     return hm[0] if squeezed else hm
 
 
@@ -73,10 +83,13 @@ def build(
     seed: int = 0,
     params: Optional[Params] = None,
     fused_decode: bool = False,
+    int8: bool = False,
 ) -> JaxModel:
     """``fused_decode=True`` appends :func:`decode_keypoints`: the model
     then emits ``(14, 3)`` keypoints (grid coords) that the
-    ``pose_estimation`` decoder consumes directly."""
+    ``pose_estimation`` decoder consumes directly.  ``int8=True`` routes
+    quantized-weight convs through the MXU int8 path (pass quantized
+    params, or use :func:`build_quantized`)."""
     if params is None:
         params = init_params(jax.random.PRNGKey(seed))
     shape: Tuple[Optional[int], ...] = (image_size, image_size, 3)
@@ -84,16 +97,33 @@ def build(
         shape = (batch,) + shape
     if fused_decode:
         def fwd(p, x):
-            return decode_keypoints(apply(p, x, dtype=dtype))
+            return decode_keypoints(apply(p, x, dtype=dtype, int8=int8))
     else:
         def fwd(p, x):
-            return apply(p, x, dtype=dtype)
+            return apply(p, x, dtype=dtype, int8=int8)
     return JaxModel(
         apply=fwd,
         params=params,
         input_spec=TensorsSpec.of(TensorSpec(dtype=np.float32, shape=shape)),
         name="posenet_mobilenet_v2",
     )
+
+
+def build_quantized(
+    image_size: int = 224,
+    batch: Optional[int] = None,
+    dtype=jnp.bfloat16,
+    seed: int = 0,
+    params: Optional[Params] = None,
+    fused_decode: bool = False,
+) -> JaxModel:
+    """Full-int8 pose net (same tier as the other zoo families): every
+    ungrouped conv — stem, expand/project, heatmap head — on the MXU int8
+    path with dynamic per-sample activation scales."""
+    from ..ops.quant import quantize_model
+
+    return quantize_model(build(image_size, batch, dtype, seed, params,
+                                fused_decode=fused_decode, int8=True))
 
 
 def grid_size(image_size: int = 224) -> int:

@@ -245,7 +245,7 @@ def build(
         apply=fwd,
         params=params,
         input_spec=TensorsSpec.of(TensorSpec(dtype=np.float32, shape=shape)),
-        name="ssd_mobilenet_v2_q8" if int8 else "ssd_mobilenet_v2",
+        name="ssd_mobilenet_v2",
     )
 
 
@@ -263,13 +263,7 @@ def build_quantized(
     dynamic per-sample activation scales — the same tier as
     ``mobilenet_v2.build_quantized(int8_convs=True)``, for the two-model
     cascade topologies (SURVEY §4's bounding-box suite)."""
-    from .mobilenet_v2 import quantize_params
+    from ..ops.quant import quantize_model
 
-    m = build(num_labels, image_size, batch, dtype, seed, params,
-              fused_decode=fused_decode, int8=True)
-    return JaxModel(
-        apply=m.apply,
-        params=quantize_params(m.params),
-        input_spec=m.input_spec,
-        name=m.name,
-    )
+    return quantize_model(build(num_labels, image_size, batch, dtype, seed,
+                                params, fused_decode=fused_decode, int8=True))

@@ -230,7 +230,7 @@ def build_quantized(**kwargs) -> JaxModel:
     cell inherits the quantized leaves automatically (``_proj`` dispatches
     on the leaf type), so stepwise==full equivalence holds under int8
     too."""
-    from ..ops.quant import quantize_params
+    from ..ops.quant import quantize_model
 
     if kwargs.get("moe_experts", 0):
         raise NotImplementedError(
@@ -239,13 +239,7 @@ def build_quantized(**kwargs) -> JaxModel:
             "and only the gate would quantize — use the dense-FFN encoder "
             "for W8A8"
         )
-    m = build(**kwargs)
-    return JaxModel(
-        apply=m.apply,
-        params=quantize_params(m.params),
-        input_spec=m.input_spec,
-        name=m.name + "_q8",
-    )
+    return quantize_model(build(**kwargs))
 
 
 def decode_step(params: Params, x_t, cache, pos, dtype=jnp.float32):

@@ -88,3 +88,14 @@ def build(
         input_spec=TensorsSpec.of(TensorSpec(dtype=np.float32, shape=shape)),
         name=f"vit_{attn}_p{patch}_{d_model}x{n_layers}",
     )
+
+
+def build_quantized(**kwargs) -> JaxModel:
+    """W8A8 ViT: the transformer trunk's matmuls (embed/qkv/proj/ffn/head)
+    all run int8 x int8 → int32 with per-token dynamic scales — the trunk
+    dispatches on the quantized leaves
+    (:func:`~nnstreamer_tpu.models.transformer._proj`); patchify is a
+    reshape and stays free.  Takes :func:`build`'s kwargs."""
+    from ..ops.quant import quantize_model
+
+    return quantize_model(build(**kwargs))

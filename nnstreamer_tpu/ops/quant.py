@@ -98,6 +98,22 @@ def quantize_params(params):
     return walk(params)
 
 
+def quantize_model(m, name_suffix: str = "_q8"):
+    """Quantize a built ``JaxModel``'s params in place of a float build:
+    same apply/spec, int8 ``"w"`` leaves, ``name + suffix``.  The one
+    shared implementation behind every zoo family's ``build_quantized``
+    (the forward must already dispatch on the leaf type — ``int8=`` conv
+    flags or ``transformer._proj``)."""
+    from ..backends.jax_backend import JaxModel
+
+    return JaxModel(
+        apply=m.apply,
+        params=quantize_params(m.params),
+        input_spec=m.input_spec,
+        name=m.name + name_suffix,
+    )
+
+
 def matmul_int8(x, qw: QuantizedWeight, dtype=jnp.float32):
     """W8A8 matmul on the MXU: ``(..., d) @ (d, dout)`` with int8 operands
     and int32 accumulation.

@@ -539,3 +539,54 @@ class TestSSDQuantized:
         det = np.asarray(q.apply(q.params, x))
         assert det.shape == (10, 6)
         assert np.isfinite(det).all()
+
+
+class TestZooQuantizedVariants:
+    """The five big zoo families offer the int8 MXU tier (posenet/vit
+    join mobilenet/SSD/transformer)."""
+
+    def test_posenet_quantized_close_and_int8(self):
+        import re
+
+        import jax
+        import jax.numpy as jnp
+
+        from nnstreamer_tpu.models import posenet
+
+        f = posenet.build(image_size=64, dtype=jnp.float32)
+        q = posenet.build_quantized(image_size=64, dtype=jnp.float32,
+                                    params=f.params)
+        x = np.random.default_rng(8).random((64, 64, 3)).astype(np.float32)
+        hf = np.asarray(f.apply(f.params, x))
+        hq = np.asarray(q.apply(q.params, x))
+        assert hf.shape == hq.shape
+        corr = np.corrcoef(hf.ravel(), hq.ravel())[0, 1]
+        assert corr > 0.97, corr
+        hlo = jax.jit(lambda a: q.apply(q.params, a)).lower(
+            jnp.asarray(x)).as_text()
+        assert len(re.findall(
+            r"stablehlo\.convolution[^\n]*xi8>[^\n]*->\s*tensor<[0-9x]*xi32>",
+            hlo)) >= 10
+
+    def test_vit_quantized_close_and_int8(self):
+        import re
+
+        import jax
+        import jax.numpy as jnp
+
+        from nnstreamer_tpu.models import vit
+
+        kw = dict(num_classes=5, image_size=32, patch=8, d_model=32,
+                  n_heads=2, n_layers=1, dtype=jnp.float32)
+        f = vit.build(**kw)
+        q = vit.build_quantized(**kw)
+        x = np.random.default_rng(9).random((32, 32, 3)).astype(np.float32)
+        lf = np.asarray(f.apply(f.params, x))
+        lq = np.asarray(q.apply(q.params, x))
+        corr = np.corrcoef(lf.ravel(), lq.ravel())[0, 1]
+        assert corr > 0.97, corr
+        hlo = jax.jit(lambda a: q.apply(q.params, a)).lower(
+            jnp.asarray(x)).as_text()
+        assert len(re.findall(
+            r"stablehlo\.dot_general[^\n]*xi8>[^\n]*->\s*tensor<[0-9x]*xi32>",
+            hlo)) >= 5
