@@ -242,7 +242,8 @@ def build_quantized(**kwargs) -> JaxModel:
     return quantize_model(build(**kwargs))
 
 
-def decode_step(params: Params, x_t, cache, pos, dtype=jnp.float32):
+def decode_step(params: Params, x_t, cache, pos, dtype=jnp.float32,
+                window: bool = False):
     """One autoregressive step with a KV cache.
 
     The reference's streaming recurrence is the LSTM cell cycled through
@@ -253,47 +254,73 @@ def decode_step(params: Params, x_t, cache, pos, dtype=jnp.float32):
     - ``x_t``: (d_in,) — one step's features;
     - ``cache``: (L, 2, T_max, d_model) — per-layer K and V, concatenated
       head-merged (static shape; position ``pos`` indexes the write slot);
-    - ``pos``: (1,) int32 — current step index (< T_max).
+    - ``pos``: (1,) int32 — current step index (< T_max unless ``window``).
 
     Returns ``(y_t (n_out,), cache', pos+1)``.  Equivalent to running the
     full causal :func:`apply` over the whole prefix and taking the last
-    token's output — pinned by tests.  Past ``T_max`` the output saturates
-    to NaN (loudly wrong beats silently-stale attention; size the cache
-    for the stream or reset the slots).  MoE blocks are rejected: switch
-    capacity is a sequence-level quantity, so a per-token step cannot
-    reproduce the full pass's drop semantics.
+    token's output — pinned by tests.
+
+    Two capacity disciplines:
+
+    - ``window=False`` (default): past ``T_max`` the output saturates to
+      NaN (loudly wrong beats silently-stale attention; size the cache for
+      the stream or reset the slots).
+    - ``window=True``: the cache is a **ring** — token ``a`` writes slot
+      ``a % T_max`` and attention sees exactly the last ``T_max`` tokens
+      (sliding-window attention).  The stream can run forever at constant
+      memory — the TPU-native infinite-decode discipline.  Requires
+      ``pos_embed``-free params (the default encoder): absolute learned
+      positions cannot wrap.
+
+    MoE blocks are rejected: switch capacity is a sequence-level quantity,
+    so a per-token step cannot reproduce the full pass's drop semantics.
     """
     if any("moe" in blk for blk in params["blocks"]):
         raise NotImplementedError(
             "decode_step does not support MoE blocks (capacity semantics "
             "are sequence-level); use the dense-FFN encoder for decode"
         )
+    pe = params.get("pos_embed")
+    if window and pe is not None:
+        raise ValueError(
+            "window=True needs pos_embed-free params: absolute learned "
+            "positions cannot wrap a ring cache"
+        )
     h = params["n_heads"]
     t_max = cache.shape[2]
     p_idx = pos[0]
+    slot = p_idx % t_max if window else p_idx
     y = _proj(params["embed"], x_t[None].astype(dtype), dtype)  # (1, d)
-    pe = params.get("pos_embed")
     if pe is not None:
         y = y + jax.lax.dynamic_slice_in_dim(pe, p_idx, 1, 0).astype(dtype)
     d = y.shape[-1]
+    idx = jnp.arange(t_max)
+    if window:
+        # slot s holds absolute token (p_idx - (p_idx - s) mod T_max):
+        # live iff that token exists (dist <= p_idx); dist < T_max always,
+        # so after warm-up every slot is live — a full sliding window
+        live = (p_idx - idx) % t_max <= p_idx
+    else:
+        live = idx <= p_idx
     new_cache = []
     for li, blk in enumerate(params["blocks"]):
         z = _layernorm(blk["ln1"], y[None])[0]
         qkv = _proj(blk["qkv"], z, dtype)
         q, k, v = jnp.split(qkv, 3, axis=-1)  # (1, d) each
         ck = jax.lax.dynamic_update_slice_in_dim(
-            cache[li, 0].astype(dtype), k, p_idx, 0
+            cache[li, 0].astype(dtype), k, slot, 0
         )
         cv = jax.lax.dynamic_update_slice_in_dim(
-            cache[li, 1].astype(dtype), v, p_idx, 0
+            cache[li, 1].astype(dtype), v, slot, 0
         )
         new_cache.append(jnp.stack([ck, cv]))
         # causal attention of the single query against the cached prefix
+        # (ring mode: attention is permutation-invariant over the cached
+        # set, so slot order does not matter once the mask is right)
         qh = q.reshape(1, h, d // h)
         kh = ck.reshape(t_max, h, d // h)
         vh = cv.reshape(t_max, h, d // h)
         s = jnp.einsum("qhd,khd->hqk", qh, kh) * (d // h) ** -0.5
-        live = jnp.arange(t_max) <= p_idx
         s = jnp.where(live[None, None, :], s, -jnp.inf)
         w = jax.nn.softmax(s, axis=-1)
         o = jnp.einsum("hqk,khd->qhd", w, vh).reshape(1, d)
@@ -301,10 +328,18 @@ def decode_step(params: Params, x_t, cache, pos, dtype=jnp.float32):
         y = _ffn_residual(blk, y[None], dtype)[0]
     y = _layernorm(params["ln_f"], y[None])[0]
     out = _proj(params["head"], y, dtype).astype(jnp.float32)
-    # overflow: a step past the cache capacity would clamp the write slot
-    # and attend over stale state — saturate to NaN so the caller notices
-    out = jnp.where(p_idx < t_max, out, jnp.nan)
-    return out[0], jnp.stack(new_cache).astype(cache.dtype), pos + 1
+    if not window:
+        # overflow: a step past the cache capacity would clamp the write
+        # slot and attend over stale state — saturate to NaN so the
+        # caller notices
+        out = jnp.where(p_idx < t_max, out, jnp.nan)
+        return out[0], jnp.stack(new_cache).astype(cache.dtype), pos + 1
+    # ring mode runs FOREVER: keep pos bounded in [0, 2*T_max) so the
+    # int32 counter can never overflow at step 2**31 (the wrap preserves
+    # slot ≡ pos mod T_max and the mask is all-live past warm-up anyway)
+    nxt = pos + 1
+    nxt = jnp.where(nxt >= 2 * t_max, nxt - t_max, nxt)
+    return out[0], jnp.stack(new_cache).astype(cache.dtype), nxt
 
 
 def init_decode_cache(n_layers: int, d_model: int, t_max: int,
@@ -323,10 +358,13 @@ def build_decode_cell(
     dtype=jnp.float32,
     seed: int = 0,
     params: Optional[Params] = None,
+    window: bool = False,
 ) -> JaxModel:
     """Stream-ready decode cell: inputs ``(x_t, cache, pos)`` → outputs
     ``(y_t, cache', pos')`` — cycle cache/pos through repo slots exactly
-    like the LSTM cell's (h, c)."""
+    like the LSTM cell's (h, c).  ``window=True``: ring cache / sliding
+    -window attention — the stream runs forever at constant memory
+    (see :func:`decode_step`)."""
     if params is None:
         params = init_params(
             jax.random.PRNGKey(seed), d_model, n_heads, n_layers,
@@ -334,7 +372,7 @@ def build_decode_cell(
         )
     return JaxModel(
         apply=lambda p, x_t, cache, pos: decode_step(
-            p, x_t, cache, pos, dtype=dtype
+            p, x_t, cache, pos, dtype=dtype, window=window
         ),
         params=params,
         input_spec=TensorsSpec(tensors=(
@@ -343,7 +381,8 @@ def build_decode_cell(
                        shape=(n_layers, 2, t_max, d_model)),
             TensorSpec(dtype=np.int32, shape=(1,)),
         )),
-        name=f"transformer_decode_{d_model}x{n_layers}",
+        name=f"transformer_decode_{d_model}x{n_layers}"
+             + ("_win" if window else ""),
     )
 
 

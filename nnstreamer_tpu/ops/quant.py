@@ -100,10 +100,12 @@ def quantize_params(params):
 
 def quantize_model(m, name_suffix: str = "_q8"):
     """Quantize a built ``JaxModel``'s params in place of a float build:
-    same apply/spec, int8 ``"w"`` leaves, ``name + suffix``.  The one
-    shared implementation behind every zoo family's ``build_quantized``
-    (the forward must already dispatch on the leaf type — ``int8=`` conv
-    flags or ``transformer._proj``)."""
+    same apply/spec, int8 ``"w"`` leaves, ``name + suffix``.  The shared
+    implementation behind the SSD/posenet/transformer/ViT
+    ``build_quantized`` delegates (mobilenet_v2 keeps its own multi-tier
+    builder — int8_convs/int8_head combinations).  The forward must
+    already dispatch on the leaf type (``int8=`` conv flags or
+    ``transformer._proj``)."""
     from ..backends.jax_backend import JaxModel
 
     return JaxModel(
