@@ -68,8 +68,18 @@ def conv2d(
     padding: str = "SAME",
     groups: int = 1,
     dtype=None,
+    int8: bool = False,
 ) -> jnp.ndarray:
-    # int8 QuantizedWeight leaves dequantize here, fusing into the conv
+    """``int8=True`` + an ungrouped quantized weight → the MXU int8 path
+    (:func:`conv2d_int8`); otherwise QuantizedWeight leaves dequantize
+    here, fusing into the conv.  Keeping the dispatch HERE (the one shared
+    conv) spares every caller — conv_bn_relu6, the SSD box/cls heads, the
+    posenet heatmap head — its own leaf-type special case."""
+    from ..ops.quant import QuantizedWeight
+
+    if int8 and groups == 1 and isinstance(params["w"], QuantizedWeight):
+        return conv2d_int8(params, x, stride=stride, padding=padding,
+                           dtype=dtype)
     w = maybe_dequantize(params["w"], dtype)
     return jax.lax.conv_general_dilated(
         x,
@@ -148,15 +158,12 @@ def conv_bn_relu6(
     params: Params, x, stride=1, groups=1, dtype=None, act=True, int8=False
 ) -> jnp.ndarray:
     """``int8=True`` routes ungrouped convs with quantized weights through
-    :func:`conv2d_int8` (MXU int8 mode); depthwise and float-weight convs
-    take the standard path either way.  BN + relu6 are elementwise — XLA
-    fuses them into the conv epilogue on both paths."""
-    from ..ops.quant import QuantizedWeight
-
-    if int8 and groups == 1 and isinstance(params["conv"]["w"], QuantizedWeight):
-        y = conv2d_int8(params["conv"], x, stride=stride, dtype=dtype)
-    else:
-        y = conv2d(params["conv"], x, stride=stride, groups=groups, dtype=dtype)
+    :func:`conv2d_int8` (MXU int8 mode — dispatched inside :func:`conv2d`);
+    depthwise and float-weight convs take the standard path either way.
+    BN + relu6 are elementwise — XLA fuses them into the conv epilogue on
+    both paths."""
+    y = conv2d(params["conv"], x, stride=stride, groups=groups, dtype=dtype,
+               int8=int8)
     y = batch_norm(params["bn"], y)
     return relu6(y) if act else y
 

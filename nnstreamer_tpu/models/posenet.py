@@ -41,19 +41,13 @@ def apply(params: Params, x, dtype=jnp.bfloat16, int8=False):
 
     ``int8=True``: ungrouped convs with quantized weights run on the MXU
     int8 path (:func:`~nnstreamer_tpu.models.layers.conv2d_int8`)."""
-    from ..ops.quant import QuantizedWeight
-    from .layers import conv2d_int8
-
     x, squeezed = ensure_batched(x, 4)
     y = x.astype(dtype)
     y = conv_bn_relu6(params["stem"], y, stride=2, dtype=dtype, int8=int8)
     for block in params["blocks"]:
         y = mobilenet_v2._block_apply(block, y, dtype, int8=int8)
-    if int8 and isinstance(params["head"]["w"], QuantizedWeight):
-        hm_lin = conv2d_int8(params["head"], y, dtype=dtype)
-    else:
-        hm_lin = conv2d(params["head"], y, dtype=dtype)
-    hm = jax.nn.sigmoid(hm_lin).astype(jnp.float32)
+    hm = jax.nn.sigmoid(
+        conv2d(params["head"], y, dtype=dtype, int8=int8)).astype(jnp.float32)
     return hm[0] if squeezed else hm
 
 

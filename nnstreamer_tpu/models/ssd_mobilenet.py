@@ -101,9 +101,6 @@ def apply(params: Params, x, dtype=jnp.bfloat16, int8=False):
     → int32 on the MXU (see
     :func:`~nnstreamer_tpu.models.layers.conv2d_int8`); depthwise stays on
     the ``dtype`` path."""
-    from ..ops.quant import QuantizedWeight
-    from .layers import conv2d_int8
-
     x, squeezed = ensure_batched(x, 4)
     y = x.astype(dtype)
     y = conv_bn_relu6(params["stem"], y, stride=2, dtype=dtype, int8=int8)
@@ -117,16 +114,11 @@ def apply(params: Params, x, dtype=jnp.bfloat16, int8=False):
         y = conv_bn_relu6(extra, y, stride=2, dtype=dtype, int8=int8)
         features.append(y)
 
-    def head_conv(hp, feat):
-        if int8 and isinstance(hp["w"], QuantizedWeight):
-            return conv2d_int8(hp, feat, dtype=dtype)
-        return conv2d(hp, feat, dtype=dtype)
-
     num_labels = params["num_labels"]
     boxes, scores = [], []
     for feat, bh, ch in zip(features, params["box_heads"], params["cls_heads"]):
-        b = head_conv(bh, feat)
-        c = head_conv(ch, feat)
+        b = conv2d(bh, feat, dtype=dtype, int8=int8)
+        c = conv2d(ch, feat, dtype=dtype, int8=int8)
         n = feat.shape[0]
         boxes.append(b.reshape(n, -1, 4))
         scores.append(c.reshape(n, -1, num_labels))
