@@ -40,6 +40,18 @@ class TestCodec:
         assert np.asarray(g.tensor(0)).shape == ()
         assert float(np.asarray(g.tensor(0))) == 7.5
 
+    def test_unknown_fields_are_forward_compatible(self):
+        """The schema contract is append-only: a message from a FUTURE
+        producer (extra fields) must decode cleanly today — proto3 skips
+        unknown field numbers."""
+        raw = encode_frame(
+            Frame(tensors=(np.arange(3, dtype=np.float32),), pts=5))
+        # splice an unknown field (number 15, varint 7) onto the message
+        g = decode_frame(raw + bytes([15 << 3 | 0, 7]))
+        np.testing.assert_array_equal(
+            np.asarray(g.tensor(0)), np.arange(3, dtype=np.float32))
+        assert g.pts == 5
+
     def test_truncated_payload_rejected(self):
         f = Frame(tensors=(np.zeros((4,), np.float32),))
         import nnstreamer_tpu.interop.tensor_frame_pb2 as pb
@@ -187,3 +199,4 @@ class TestPipelineRoundtrip:
         p.link_chain(src, enc, dec, sink)
         with pytest.raises(Exception, match="carries 2 tensors"):
             p.run(timeout=30)
+

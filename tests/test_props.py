@@ -1,0 +1,29 @@
+"""utils.props.parse_bool: the one shared property-bool parser."""
+
+import pytest
+
+from nnstreamer_tpu.utils.props import parse_bool
+
+
+def test_true_spellings():
+    for v in ("1", "true", "Yes", " ON ", True, 2):
+        assert parse_bool(v) is True
+
+
+def test_false_spellings():
+    for v in ("0", "false", "No", "off", "", False, 0, None):
+        assert parse_bool(v) is False
+
+
+def test_typo_is_an_error_not_false():
+    with pytest.raises(ValueError, match="throttle"):
+        parse_bool("ture", name="throttle")
+
+
+def test_element_constructors_reject_typos():
+    from nnstreamer_tpu import make
+
+    with pytest.raises(ValueError, match="checksum"):
+        make("tensor_debug", checksum="ture")
+    with pytest.raises(ValueError, match="throttle"):
+        make("tensor_rate", throttle="yep!")
