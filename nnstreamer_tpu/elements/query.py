@@ -22,17 +22,21 @@ across hosts (same discipline as ``utils/checkpoint.py``).
 The server executes any ``FilterBackend`` (framework + model, the same
 pair ``tensor_filter`` takes); per-connection threads share a bounded
 per-input-spec backend cache under a lock (concurrent clients with
-different shapes never thrash one backend's reconfigure) — batching
-across clients is the mux/dynbatch elements' job upstream of the
-filter, not the transport's.
+different shapes never thrash one backend's reconfigure).  With
+``batch=K`` the server additionally coalesces same-geometry requests
+from concurrent connections into one bucketed batched invoke — the
+mux→batch discipline applied at the transport (needs a
+batch-polymorphic model; see ``QueryServer.__init__``).
 """
 
 from __future__ import annotations
 
+import queue
 import socket
 import struct
 import threading
-from typing import Dict, Optional, Tuple
+import time
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -137,7 +141,19 @@ class QueryServer:
         custom: str = "",
         host: str = "127.0.0.1",
         port: int = 0,
+        batch: int = 0,
+        batch_window_ms: float = 2.0,
     ):
+        """``batch=K`` (K ≥ 2) turns on **cross-client batching**: requests
+        from concurrent connections with the same tensor geometry coalesce
+        into one batched invoke — the mux→batch north star extended to the
+        TCP offload surface (one process owns the chip; edge clients get
+        batched onto the MXU automatically).  Requires a model with a
+        polymorphic leading batch dim (the ``tensor_dynbatch`` contract);
+        the dispatcher waits up to ``batch_window_ms`` for stragglers, so
+        a lone client pays at most that much extra latency.  Each
+        connection has at most one request in flight (the client protocol
+        is synchronous), so per-client ordering is inherent."""
         self._framework = framework
         self._model = model
         self._custom = custom
@@ -151,6 +167,14 @@ class QueryServer:
         self._srv: Optional[socket.socket] = None
         self._accept_thread: Optional[threading.Thread] = None
         self._running = False
+        self.batch = int(batch)
+        if self.batch == 1 or self.batch < 0:
+            raise ValueError("batch must be 0 (off) or >= 2")
+        self.batch_window_s = float(batch_window_ms) / 1e3
+        self._rq: "Optional[queue.Queue]" = None
+        self._dispatch_thread: Optional[threading.Thread] = None
+        self.batched_invokes = 0   # observability
+        self.batched_frames = 0
 
     def _backend_for(self, spec: TensorsSpec):
         """Backend configured for ``spec`` (caller holds the lock)."""
@@ -171,6 +195,13 @@ class QueryServer:
         self._srv = socket.create_server((self.host, self.port))
         self.port = self._srv.getsockname()[1]
         self._running = True
+        if self.batch:
+            self._rq = queue.Queue()
+            self._dispatch_thread = threading.Thread(
+                target=self._dispatch_loop, daemon=True,
+                name="query-server-batcher",
+            )
+            self._dispatch_thread.start()
         self._accept_thread = threading.Thread(
             target=self._accept_loop, daemon=True, name="query-server-accept"
         )
@@ -196,11 +227,14 @@ class QueryServer:
                 except (ConnectionError, OSError):
                     return
                 try:
-                    with self._lock:
-                        if not self._running:
-                            return  # stop() raced us: backend is closing
-                        spec = TensorsSpec.from_arrays(tensors)
-                        outs = self._backend_for(spec).invoke(tensors)
+                    if self.batch:
+                        outs = self._invoke_batched(tensors)
+                    else:
+                        with self._lock:
+                            if not self._running:
+                                return  # stop() raced us: backend closing
+                            spec = TensorsSpec.from_arrays(tensors)
+                            outs = self._backend_for(spec).invoke(tensors)
                     send_tensors(conn, outs, pts)
                 except Exception as exc:  # noqa: BLE001 — report, keep serving
                     try:
@@ -208,10 +242,137 @@ class QueryServer:
                     except OSError:
                         return
 
+    # -- cross-client batching ---------------------------------------------
+
+    class _Pending:
+        __slots__ = ("spec", "tensors", "event", "outs", "error")
+
+        def __init__(self, spec, tensors):
+            self.spec = spec
+            self.tensors = tensors
+            self.event = threading.Event()
+            self.outs = None
+            self.error = None
+
+    def _invoke_batched(self, tensors):
+        """Enqueue for the dispatcher; block until this request's slice of
+        the batched result arrives.  The wait polls ``_running`` so a
+        request racing ``stop()`` (enqueued after the final queue drain)
+        errors out instead of hanging its connection thread forever."""
+        if not self._running:
+            raise RuntimeError("query server stopped")
+        req = self._Pending(TensorsSpec.from_arrays(tensors), tensors)
+        self._rq.put(req)
+        while not req.event.wait(0.5):
+            if not self._running:
+                raise RuntimeError("query server stopped")
+        if req.error is not None:
+            raise req.error
+        return req.outs
+
+    def _dispatch_loop(self) -> None:
+        while self._running:
+            try:
+                first = self._rq.get(timeout=0.1)
+            except queue.Empty:
+                continue
+            group: List[QueryServer._Pending] = [first]
+            bounced: List[QueryServer._Pending] = []
+            deadline = time.monotonic() + self.batch_window_s
+            while len(group) < self.batch:
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    break
+                try:
+                    nxt = self._rq.get(timeout=left)
+                except queue.Empty:
+                    break
+                if nxt.spec != first.spec:
+                    # different geometry: set aside and KEEP scanning —
+                    # same-spec requests behind it must still coalesce
+                    # (safe to reorder across connections: each has at
+                    # most one request in flight)
+                    bounced.append(nxt)
+                    continue
+                group.append(nxt)
+            for g in bounced:
+                self._rq.put(g)
+            self._dispatch_group(group)
+
+    def _dispatch_group(self, group) -> None:
+        n_tensors = len(group[0].tensors)
+        try:
+            # requests already carry the batch dim ((k_i, ...) frames — the
+            # polymorphic-model contract): coalesce by CONCATENATING along
+            # axis 0 and split the result back by row offsets.  Total rows
+            # pad up to a power of two (repeating the last row) so the
+            # backend compiles one executable per bucket, exactly the
+            # tensor_dynbatch discipline.
+            rows = []
+            for g in group:
+                r = None
+                for t in g.tensors:
+                    t = np.asarray(t)
+                    if t.ndim < 1:
+                        raise ValueError(
+                            "batched query serving needs frames with a "
+                            "leading batch dim (got a rank-0 tensor)"
+                        )
+                    if r is None:
+                        r = t.shape[0]
+                    elif t.shape[0] != r:
+                        # offsets are computed from tensor 0 — a differing
+                        # secondary leading dim would mis-slice EVERY
+                        # client's reply
+                        raise ValueError(
+                            "batched query serving needs every tensor in a "
+                            f"frame to share the leading batch dim (got "
+                            f"{t.shape[0]} vs {r})"
+                        )
+                rows.append(r)
+            total = sum(rows)
+            # same power-of-two bucket discipline as tensor_dynbatch
+            from .dynbatch import _bucket
+
+            b = _bucket(total, 1 << 30)
+            cat = []
+            for i in range(n_tensors):
+                parts = [np.asarray(g.tensors[i]) for g in group]
+                pad = b - total
+                if pad:
+                    parts.append(np.repeat(parts[-1][-1:], pad, axis=0))
+                cat.append(np.concatenate(parts, axis=0))
+            with self._lock:
+                if not self._running:
+                    raise RuntimeError("server stopping")
+                spec = TensorsSpec.from_arrays(cat)
+                outs = self._backend_for(spec).invoke(cat)
+            self.batched_invokes += 1
+            self.batched_frames += total
+            off = 0
+            for g, r in zip(group, rows):
+                g.outs = [np.asarray(o)[off:off + r] for o in outs]
+                g.event.set()
+                off += r
+        except Exception as exc:  # noqa: BLE001 — every waiter must wake
+            for g in group:
+                g.error = exc
+                g.event.set()
+
     def stop(self) -> None:
         self._running = False
         if self._srv is not None:
             self._srv.close()
+        if self._rq is not None:
+            # wake every queued waiter: connection threads block on their
+            # event and would otherwise hang past the dispatcher's exit
+            while True:
+                try:
+                    g = self._rq.get_nowait()
+                except queue.Empty:
+                    break
+                g.error = RuntimeError("query server stopped")
+                g.event.set()
         with self._lock:  # never close a backend under an in-flight invoke
             for be in self._backends.values():
                 be.close()

@@ -360,7 +360,11 @@ def run_query(rng):
     per = int(rng.integers(5, 25))
     out_spec = TensorsSpec.of(TensorSpec(dtype=np.float32, shape=None))
     model = JaxModel(apply=lambda p, x: x * 2.0)
-    with QueryServer(framework="jax", model=model) as srv:
+    # half the runs turn on cross-client batching (requires batch-dim
+    # frames, which these (d0, ...) fills satisfy: rank >= 1)
+    batch = int(rng.choice([0, 2, 4]))
+    with QueryServer(framework="jax", model=model, batch=batch,
+                     batch_window_ms=float(rng.uniform(0.5, 10.0))) as srv:
         results = {}
 
         def client(k, shape):
