@@ -1,0 +1,105 @@
+"""``python -m nnstreamer_tpu "<pipeline>"`` — the gst-launch analog.
+
+The reference's primary UX is ``gst-launch-1.0 videotestsrc ! ... !
+tensor_sink``; this is the same one-liner surface for the TPU-native
+stack:
+
+    python -m nnstreamer_tpu "videotestsrc num-buffers=16 width=224 \\
+        height=224 ! tensor_converter ! tensor_transform \\
+        mode=arithmetic option=typecast:float32,div:255.0 ! \\
+        tensor_sink name=out"
+
+Every named ``tensor_sink`` gets a per-frame one-line report (shapes,
+pts — the ``-v`` habit); ``--quiet`` silences it.  ``--dot FILE`` dumps
+the negotiated graph (GST_DEBUG_DUMP_DOT_DIR analog), ``--stats``
+prints per-node invoke latencies after EOS (gst-instruments analog),
+``--platform cpu`` pins jax before any backend initializes.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m nnstreamer_tpu",
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    ap.add_argument("pipeline", help="pipeline description (parse_launch grammar)")
+    ap.add_argument("--timeout", type=float, default=300.0,
+                    help="max seconds to run (default 300)")
+    ap.add_argument("--quiet", action="store_true",
+                    help="no per-frame sink reports")
+    ap.add_argument("--dot", metavar="FILE", default=None,
+                    help="write the negotiated pipeline graph (Graphviz)")
+    ap.add_argument("--stats", action="store_true",
+                    help="print per-node invoke latencies after EOS")
+    ap.add_argument("--platform", default=None, metavar="NAME",
+                    help="pin the jax platform (e.g. cpu) before backends "
+                         "initialize")
+    args = ap.parse_args(argv)
+
+    if args.platform:
+        import jax
+
+        jax.config.update("jax_platforms", args.platform)
+
+    import nnstreamer_tpu as nns
+    from nnstreamer_tpu.elements.sink import TensorSink
+
+    if args.stats:
+        from nnstreamer_tpu.utils import profiling
+
+        profiling.enable(True)
+
+    try:
+        p = nns.parse_launch(args.pipeline)
+    except Exception as exc:  # noqa: BLE001 — CLI surface: message, rc 2
+        print(f"parse error: {exc}", file=sys.stderr)
+        return 2
+
+    counts = {}
+    if not args.quiet:
+        def reporter(name):
+            def cb(frame):
+                counts[name] = counts.get(name, 0) + 1
+                shapes = " ".join(
+                    f"{t.dtype}{tuple(t.shape)}" for t in frame.tensors
+                )
+                print(f"{name}: frame {counts[name]} pts={frame.pts} {shapes}")
+            return cb
+
+        for name, node in p.nodes.items():
+            if isinstance(node, TensorSink):
+                node.connect("new-data", reporter(name))
+
+    t0 = time.perf_counter()
+    try:
+        p.run(timeout=args.timeout)
+    except Exception as exc:  # noqa: BLE001
+        print(f"pipeline error: {exc}", file=sys.stderr)
+        return 1
+    wall = time.perf_counter() - t0
+    total = sum(counts.values())
+    if not args.quiet:
+        print(f"EOS after {wall:.2f}s"
+              + (f"; {total} sink frames" if total else ""))
+
+    if args.dot:
+        with open(args.dot, "w") as f:
+            f.write(p.to_dot())
+        print(f"pipeline graph -> {args.dot}")
+    if args.stats:
+        from nnstreamer_tpu.utils import profiling
+
+        for name, st in sorted(profiling.stats().items()):
+            print(f"{name}: {st}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

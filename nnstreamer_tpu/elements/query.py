@@ -186,8 +186,11 @@ class QueryServer:
             be.open(self._model, custom=self._custom)
             be.reconfigure(spec)
             if len(self._backends) >= self.MAX_SPEC_BACKENDS:
-                _, old = self._backends.popitem()  # drop an arbitrary cold one
-                old.close()
+                # true LRU: re-insertion-on-hit makes dict order =
+                # recency, so the COLDEST entry is the first key
+                # (popitem() would evict the hottest)
+                cold = next(iter(self._backends))
+                self._backends.pop(cold).close()
         self._backends[spec] = be  # (re-)insert as most recent
         return be
 
@@ -271,33 +274,40 @@ class QueryServer:
         return req.outs
 
     def _dispatch_loop(self) -> None:
+        """One pending group PER SPEC, each with its own window deadline:
+        mixed-geometry traffic progresses independently (a lone spec
+        flushes after its own window; no spec serializes behind another's
+        wait).  Safe to group across connections in any order — each has
+        at most one request in flight."""
+        pending: Dict[TensorsSpec, list] = {}  # spec -> [deadline, group]
         while self._running:
+            timeout = 0.1
+            if pending:
+                nearest = min(d for d, _ in pending.values())
+                timeout = min(timeout, max(0.001, nearest - time.monotonic()))
             try:
-                first = self._rq.get(timeout=0.1)
+                req = self._rq.get(timeout=timeout)
             except queue.Empty:
-                continue
-            group: List[QueryServer._Pending] = [first]
-            bounced: List[QueryServer._Pending] = []
-            deadline = time.monotonic() + self.batch_window_s
-            while len(group) < self.batch:
-                left = deadline - time.monotonic()
-                if left <= 0:
-                    break
-                try:
-                    nxt = self._rq.get(timeout=left)
-                except queue.Empty:
-                    break
-                if nxt.spec != first.spec:
-                    # different geometry: set aside and KEEP scanning —
-                    # same-spec requests behind it must still coalesce
-                    # (safe to reorder across connections: each has at
-                    # most one request in flight)
-                    bounced.append(nxt)
-                    continue
-                group.append(nxt)
-            for g in bounced:
-                self._rq.put(g)
-            self._dispatch_group(group)
+                req = None
+            if req is not None:
+                entry = pending.get(req.spec)
+                if entry is None:
+                    pending[req.spec] = [
+                        time.monotonic() + self.batch_window_s, [req]]
+                else:
+                    entry[1].append(req)
+                    if len(entry[1]) >= self.batch:
+                        del pending[req.spec]
+                        self._dispatch_group(entry[1])
+            now = time.monotonic()
+            for spec in [s for s, (d, _) in pending.items() if d <= now]:
+                self._dispatch_group(pending.pop(spec)[1])
+        # exit: every still-pending waiter must wake (stop() drains only
+        # the queue, not groups already collected here)
+        for _, group in pending.values():
+            for g in group:
+                g.error = RuntimeError("query server stopped")
+                g.event.set()
 
     def _dispatch_group(self, group) -> None:
         n_tensors = len(group[0].tensors)

@@ -1,0 +1,43 @@
+"""python -m nnstreamer_tpu: the gst-launch analog CLI."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+
+def run_cli(args, timeout=120):
+    from conftest import cpu_subprocess_env
+
+    return subprocess.run(
+        [sys.executable, "-m", "nnstreamer_tpu", *args],
+        capture_output=True, text=True, timeout=timeout,
+        env=cpu_subprocess_env(),
+    )
+
+
+PIPE = ("videotestsrc num-buffers=3 width=16 height=16 ! "
+        "tensor_converter ! tensor_sink name=out")
+
+
+def test_runs_pipeline_and_reports_frames():
+    r = run_cli(["--platform", "cpu", PIPE])
+    assert r.returncode == 0, r.stderr[-500:]
+    assert "out: frame 3" in r.stdout
+    assert "EOS" in r.stdout and "3 sink frames" in r.stdout
+
+
+def test_quiet_and_dot(tmp_path):
+    dot = str(tmp_path / "g.dot")
+    r = run_cli(["--platform", "cpu", "--quiet", "--dot", dot, PIPE])
+    assert r.returncode == 0, r.stderr[-500:]
+    assert "out: frame" not in r.stdout
+    assert os.path.exists(dot)
+    assert "digraph" in open(dot).read()
+
+
+def test_parse_error_is_rc2():
+    r = run_cli(["--platform", "cpu", "no_such_element ! tensor_sink"])
+    assert r.returncode == 2
+    assert "parse error" in r.stderr

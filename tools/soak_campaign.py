@@ -362,7 +362,7 @@ def run_query(rng):
     model = JaxModel(apply=lambda p, x: x * 2.0)
     # half the runs turn on cross-client batching (requires batch-dim
     # frames, which these (d0, ...) fills satisfy: rank >= 1)
-    batch = int(rng.choice([0, 2, 4]))
+    batch = int(rng.choice([0, 0, 2, 4]))
     with QueryServer(framework="jax", model=model, batch=batch,
                      batch_window_ms=float(rng.uniform(0.5, 10.0))) as srv:
         results = {}
