@@ -70,6 +70,40 @@ def main():
     print(f"offload: {len(remote)} frames served over TCP, "
           f"labels match local filter — offload=OK")
 
+    # -- cross-client batching: concurrent edge pipelines coalesce onto
+    #    one batched invoke (QueryServer(batch=K); model must take a
+    #    polymorphic leading batch dim)
+    import threading
+
+    import jax
+    import jax.numpy as jnp
+
+    wb = jax.random.normal(jax.random.PRNGKey(1), (48, 10), jnp.float32)
+    poly = JaxModel(
+        apply=lambda p, x: x.astype(jnp.float32) @ p, params=wb,
+        input_spec=TensorsSpec.of(TensorSpec(dtype=np.float32,
+                                             shape=(None, 48))),
+    )
+    with QueryServer(framework="jax", model=poly, batch=4,
+                     batch_window_ms=20.0) as srv:
+        results = {}
+
+        def edge(k):
+            data = [np.full((1, 48), float(k + i), np.float32)
+                    for i in range(8)]
+            results[k] = run(data, lambda: TensorQueryClient(port=srv.port))
+
+        ts = [threading.Thread(target=edge, args=(k,)) for k in range(3)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(120)
+            assert not t.is_alive(), "edge pipeline hung"
+        inv, fr = srv.batched_invokes, srv.batched_frames
+    assert all(len(results[k]) == 8 for k in range(3))
+    print(f"batched serving: {fr} frames in {inv} invokes "
+          f"({fr / max(inv, 1):.1f} frames/invoke) — batching=OK")
+
 
 if __name__ == "__main__":
     main()

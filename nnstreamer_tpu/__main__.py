@@ -77,27 +77,34 @@ def main(argv=None) -> int:
             if isinstance(node, TensorSink):
                 node.connect("new-data", reporter(name))
 
+    def dump_debug():
+        # runs on success AND on pipeline error — a failing run is exactly
+        # when the graph dump and latencies are needed (the reference's
+        # dot-dump fires on error states too)
+        if args.dot:
+            try:
+                with open(args.dot, "w") as f:
+                    f.write(p.to_dot())
+                print(f"pipeline graph -> {args.dot}")
+            except Exception as exc:  # noqa: BLE001
+                print(f"dot dump failed: {exc}", file=sys.stderr)
+        if args.stats:
+            for name, st in sorted(p.stats().items()):
+                print(f"{name}: {st}")
+
     t0 = time.perf_counter()
     try:
         p.run(timeout=args.timeout)
     except Exception as exc:  # noqa: BLE001
         print(f"pipeline error: {exc}", file=sys.stderr)
+        dump_debug()
         return 1
     wall = time.perf_counter() - t0
     total = sum(counts.values())
     if not args.quiet:
         print(f"EOS after {wall:.2f}s"
               + (f"; {total} sink frames" if total else ""))
-
-    if args.dot:
-        with open(args.dot, "w") as f:
-            f.write(p.to_dot())
-        print(f"pipeline graph -> {args.dot}")
-    if args.stats:
-        from nnstreamer_tpu.utils import profiling
-
-        for name, st in sorted(profiling.stats().items()):
-            print(f"{name}: {st}")
+    dump_debug()
     return 0
 
 

@@ -143,7 +143,7 @@ class TestCodegen:
         ("text_classify.py", "golden=OK"),
         ("capture_replay.py", "capture_replay=OK"),
         ("train_stream.py", "train_stream OK"),
-        ("offload_query.py", "offload=OK"),
+        ("offload_query.py", "batching=OK"),
     ],
 )
 def test_pipeline_demo_runs(script, expect):
