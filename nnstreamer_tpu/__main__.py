@@ -77,10 +77,13 @@ def main(argv=None) -> int:
             if isinstance(node, TensorSink):
                 node.connect("new-data", reporter(name))
 
-    def dump_debug():
-        # runs on success AND on pipeline error — a failing run is exactly
-        # when the graph dump and latencies are needed (the reference's
-        # dot-dump fires on error states too)
+    def dump_debug() -> bool:
+        """Runs on success AND on pipeline error — a failing run is exactly
+        when the graph dump and latencies are needed (the reference's
+        dot-dump fires on error states too).  Returns False if a requested
+        artifact could not be produced (the success path must then exit
+        nonzero; the error path already does)."""
+        ok = True
         if args.dot:
             try:
                 with open(args.dot, "w") as f:
@@ -88,9 +91,11 @@ def main(argv=None) -> int:
                 print(f"pipeline graph -> {args.dot}")
             except Exception as exc:  # noqa: BLE001
                 print(f"dot dump failed: {exc}", file=sys.stderr)
+                ok = False
         if args.stats:
             for name, st in sorted(p.stats().items()):
                 print(f"{name}: {st}")
+        return ok
 
     t0 = time.perf_counter()
     try:
@@ -104,8 +109,7 @@ def main(argv=None) -> int:
     if not args.quiet:
         print(f"EOS after {wall:.2f}s"
               + (f"; {total} sink frames" if total else ""))
-    dump_debug()
-    return 0
+    return 0 if dump_debug() else 1
 
 
 if __name__ == "__main__":
