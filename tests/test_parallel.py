@@ -13,6 +13,7 @@ import jax
 import jax.numpy as jnp
 
 from nnstreamer_tpu import Frame, NegotiationError, Pipeline
+from nnstreamer_tpu.parallel.mesh import batch_sharding, make_mesh, replicated
 from nnstreamer_tpu.backends.jax_backend import JaxModel
 from nnstreamer_tpu.elements.batch import TensorBatch, TensorUnbatch
 from nnstreamer_tpu.elements.demux import TensorDemux
@@ -303,3 +304,37 @@ class TestUnbatchResidency:
             None, Frame.of(jnp.ones((4, 16), jnp.float32))
         )
         assert all(isinstance(t, jax.Array) for t in probe.tensors)
+
+
+class TestMeshHelpers:
+    def test_make_mesh_default_1d(self):
+        mesh = make_mesh()
+        assert mesh.axis_names == ("dp",)
+        assert mesh.devices.size == len(jax.devices())
+
+    def test_make_mesh_2d_and_too_big(self):
+        devs = jax.devices()
+        if len(devs) < 8:
+            pytest.skip("needs the 8-device CPU mesh")
+        mesh = make_mesh((4, 2), ("dp", "tp"))
+        assert mesh.devices.shape == (4, 2)
+        with pytest.raises(ValueError, match="needs"):
+            make_mesh((len(devs) + 1,))
+
+    def test_batch_sharding_and_replicated_specs(self):
+        mesh = make_mesh()
+        sh = batch_sharding(mesh, rank=3)
+        assert sh.spec[0] == "dp" and sh.spec[1] is None and sh.spec[2] is None
+        assert all(s is None for s in replicated(mesh).spec)
+
+    def test_init_from_env_validation(self, monkeypatch):
+        from nnstreamer_tpu.parallel import init_from_env
+
+        monkeypatch.setenv("NNS_MULTIHOST_COORD", "h:1")
+        monkeypatch.setenv("NNS_MULTIHOST_NPROCS", "")
+        monkeypatch.setenv("NNS_MULTIHOST_PROC_ID", "0")
+        with pytest.raises(ValueError, match="incomplete NNS_MULTIHOST"):
+            init_from_env()
+        monkeypatch.setenv("NNS_MULTIHOST_NPROCS", "two")
+        with pytest.raises(ValueError, match="must be .*integers"):
+            init_from_env()
