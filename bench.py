@@ -5,15 +5,29 @@ North-star metric (BASELINE.md / BASELINE.json): frames/sec/chip through the
 ``tensor_filter`` invoke path on the image-labeling pipeline, with tflite-CPU
 (the reference's flagship backend) as ``vs_baseline``.  Target ≥4×.
 
-Robustness contract (this file must never lose the round's perf evidence):
+Robustness contract (this file must never lose the round's perf evidence —
+round 4's official artifact was rc=124/parsed:null because the driver's
+external timeout killed the run before the single end-of-run JSON line):
 - the accelerator backend is probed in a short-timeout *subprocess* first
   (a sick PJRT plugin can hang or die mid-run — seen in round 1); on probe
   failure the probe retries once, then the run pins itself to CPU and still
   reports numbers, with an ``"error"`` field explaining the downgrade;
 - every leg (TPU pipeline, tflite baseline, batched-mux config, MFU, Pallas
   kernels) is individually guarded — one failed leg never zeroes the rest;
-- exactly ONE JSON line goes to stdout; everything else goes to stderr;
-  exit code is 0 even on failure (the JSON carries the diagnostics).
+- legs run in VALUE ORDER (config1 variants → config5 → quant → the rest)
+  under a global time budget (BENCH_BUDGET_S, default 480 s); on budget
+  exhaustion the remaining legs are skipped and the run exits 0 with
+  partial results + the cached ``best_accelerator_run`` pointer;
+- after EVERY leg a complete JSON snapshot (marked ``"partial": true``) is
+  printed to stdout and atomically written to ``BENCH_PARTIAL.json`` — the
+  LAST stdout line is the result, and killing the process at any moment
+  leaves the previous snapshot as valid evidence;
+- standalone runs install SIGTERM/SIGINT handlers (finalize + exit 0, so
+  ``timeout`` never yields rc 124) and a hard watchdog thread that emits
+  the final snapshot and ``os._exit(0)``s even if a wedged PJRT call has
+  the main thread stuck past the budget;
+- everything else goes to stderr; exit code is 0 even on failure (the JSON
+  carries the diagnostics).
 
 Also measured (recorded in BENCH_NOTES.md + the JSON "extra" field):
 - config #5: mux(4 streams) → batch → jax filter → unbatch → demux;
@@ -25,6 +39,7 @@ import json
 import os
 import subprocess
 import sys
+import threading
 import time
 import traceback
 
@@ -60,9 +75,11 @@ def probe_accelerator(retries=None):
     briefly-sick tunnel (seen round 3: wedges can last minutes to hours).
     """
     if retries is None:
-        retries = int(os.environ.get("BENCH_PROBE_RETRIES", "3"))
+        retries = int(os.environ.get("BENCH_PROBE_RETRIES", "2"))
     retries = max(1, retries)
-    pause = float(os.environ.get("BENCH_PROBE_PAUSE_S", "30"))
+    # defaults sized against BENCH_BUDGET_S: worst-case probing (all
+    # retries timing out) must stay well under half the default budget
+    pause = float(os.environ.get("BENCH_PROBE_PAUSE_S", "15"))
     # pin_cpu() exports JAX_PLATFORMS=cpu into OUR environ; the probe child
     # must not inherit it or a post-pin re-probe can only ever see 'cpu'
     env = {k: v for k, v in os.environ.items() if k != "JAX_PLATFORMS"}
@@ -95,8 +112,11 @@ def run_score(out: dict) -> tuple:
     """Orderable goodness of an accelerator bench result.
 
     vs_baseline first (the judged number), raw fps as tie-break.  Runs that
-    errored out before producing a value sort below everything."""
-    return (out.get("vs_baseline") or 0.0, out.get("value") or 0.0)
+    errored out before producing a value sort below everything — a MEASURED
+    0.0 must still outrank a missing (None) value, so None maps to -1, not
+    0 (advisor r4)."""
+    vs, val = out.get("vs_baseline"), out.get("value")
+    return (-1.0 if vs is None else vs, -1.0 if val is None else val)
 
 
 def better_run(new: dict, old: dict) -> bool:
@@ -228,6 +248,7 @@ def run_pipeline_fps(framework, model, frames, warmup=3, normalize=True,
         if normalize:
             chain.append(p.add(TensorTransform(mode="arithmetic", option=NORMALIZE,
                                                acceleration=accel)))
+        fcustom = custom
         if upload:
             # transfer/dispatch overlap: the source thread device_puts wire
             # bytes, the queue worker only dispatches (docs/performance.md)
@@ -236,8 +257,10 @@ def run_pipeline_fps(framework, model, frames, warmup=3, normalize=True,
 
             chain.append(p.add(TensorUpload()))
             chain.append(p.add(Queue(max_size_buffers=16)))
+            # linear chain: the uploaded buffer is single-use → donate it
+            fcustom = f"{custom},donate=1" if custom else "donate=1"
         chain.append(p.add(TensorFilter(framework=framework, model=model,
-                                        custom=custom)))
+                                        custom=fcustom)))
         if decoder is not None:
             from nnstreamer_tpu.elements.queue import Queue
 
@@ -333,7 +356,8 @@ def run_dynbatch_fps(frames, max_batch=8, upload=False):
         ),
     )
     backend = get_backend("jax")
-    backend.open(poly)
+    # linear dynbatch chain: coalesced upload buffers are single-use
+    backend.open(poly, custom="donate=1" if upload else "")
     b = 1
     while b <= max_batch:  # prime every bucket's executable (LRU-cached)
         backend.reconfigure(TensorsSpec.of(
@@ -412,13 +436,16 @@ def run_mux_batched_fps(model, n_streams, frames_per_stream, image_u8,
         norm = p.add(TensorTransform(mode="arithmetic", option=NORMALIZE,
                                      acceleration=accel))
         mids = [batch, norm]
+        fcustom = custom
         if upload:
             from nnstreamer_tpu.elements.queue import Queue
             from nnstreamer_tpu.elements.upload import TensorUpload
 
             mids.append(p.add(TensorUpload()))
             mids.append(p.add(Queue(max_size_buffers=8)))
-        filt = p.add(TensorFilter(framework=framework, model=model, custom=custom))
+            # linear mux→batch→filter chain: uploaded buffer is single-use
+            fcustom = f"{custom},donate=1" if custom else "donate=1"
+        filt = p.add(TensorFilter(framework=framework, model=model, custom=fcustom))
         unbatch = p.add(TensorUnbatch())
         demux = p.add(TensorDemux())
         p.link_chain(mux, *mids, filt, unbatch, demux)
@@ -639,37 +666,76 @@ def measure_mfu(batches=None, image_size=224, model_name="mobilenet_v2"):
         # would defeat the persistent compile cache across runs (every run
         # would re-pay ~30s per point inside a live-tunnel window)
         n = max(2, min(20, int(2.0 / max(est, 1e-4))))
-        n = max(b for b in (2, 5, 10, 20) if b <= n)
+        # Two trip counts from a FIXED bucket set (they become fori_loop
+        # trip counts, i.e. part of the compiled program — a continuous n
+        # would defeat the persistent compile cache across runs)
+        n1 = max(b for b in (2, 5, 10) if b <= max(2, n))
+        n2 = n1 * 2
         timing = "dispatch-loop"
-        step = None
+        step = overhead_ms = None
+        # Round 4's "tunnel-immune" single-n chained timing swung 49 ms →
+        # 38,104 ms/step between windows: any PER-CALL constant (dispatch
+        # enqueue + scalar readback over a catastrophically sick wire, a
+        # compile-cache miss inside the timed rep, device clock throttling
+        # between warm and rep) divides by n and masquerades as step time.
+        # Guard: if even one compiled call is this slow, the chained pair
+        # below would eat minutes of budget for a number the overhead
+        # subtraction already tells us is wire-dominated — keep the cheap
+        # dispatch-loop estimate and flag it.
+        chain_ok = est * (n1 + n2) * 3 < float(
+            os.environ.get("BENCH_MFU_POINT_CAP_S", "90"))
+        if not chain_ok:
+            timing = f"dispatch-loop(est {est*1e3:.0f} ms/call too slow " \
+                     "for chained timing)"
         try:
-            # Tunnel-immune timing: chain n steps inside ONE jitted
-            # fori_loop (single dispatch, single scalar readback).  Each
-            # per-call dispatch crosses the tunnel, whose enqueue latency
-            # oscillates 0.03–60 ms; a chained loop pays it once, so the
-            # measured time is the chip's, not the wire's.  The scalar
-            # carry fed back into the input forces a data dependency so
-            # XLA cannot collapse or reorder the iterations.
+            if not chain_ok:
+                raise _Skipped("slow est")
+            # Tunnel-immune timing, round-5 revision: run the chain at TWO
+            # trip counts and DIFFERENCE them.  step = (t(n2) - t(n1)) /
+            # (n2 - n1) cancels every per-call constant exactly — dispatch
+            # latency, scalar readback, fixed loop setup — no matter how
+            # sick the wire is; the residual t(n1) - n1*step is reported as
+            # overhead_ms so the wire's per-call cost is visible instead of
+            # leaking into the step time (VERDICT r4 weak #3).  The scalar
+            # carry fed back into the input forces a data dependency so XLA
+            # cannot collapse or reorder the iterations.
             from jax import lax
 
-            def chain(a):
-                def body(i, c):
-                    y = model.apply(
-                        model.params,
-                        (a.astype(jnp.float32) - 127.5) / 127.5 + c,
-                    )
-                    return jnp.mean(y).astype(jnp.float32) * 1e-9
-                return lax.fori_loop(0, n, body, jnp.float32(0.0))
+            def build_chain(trips):
+                def chain(a):
+                    def body(i, c):
+                        y = model.apply(
+                            model.params,
+                            (a.astype(jnp.float32) - 127.5) / 127.5 + c,
+                        )
+                        return jnp.mean(y).astype(jnp.float32) * 1e-9
+                    return lax.fori_loop(0, trips, body, jnp.float32(0.0))
+                return jax.jit(chain).lower(x).compile()
 
-            chain_c = jax.jit(chain).lower(x).compile()
-            jax.block_until_ready(chain_c(x))  # warm
-            reps = []
+            c1, c2 = build_chain(n1), build_chain(n2)
+            jax.block_until_ready(c1(x))  # warm (compile outside timing)
+            jax.block_until_ready(c2(x))
+            t1s, t2s = [], []
             for _ in range(2):
                 t0 = time.perf_counter()
-                jax.block_until_ready(chain_c(x))
-                reps.append(time.perf_counter() - t0)
-            step = min(reps) / n
-            timing = f"chained-fori(n={n})"
+                jax.block_until_ready(c1(x))
+                t1s.append(time.perf_counter() - t0)
+                t0 = time.perf_counter()
+                jax.block_until_ready(c2(x))
+                t2s.append(time.perf_counter() - t0)
+            t1, t2 = min(t1s), min(t2s)
+            if t2 > t1:
+                step = (t2 - t1) / (n2 - n1)
+                overhead_ms = round(max(0.0, t1 - n1 * step) * 1e3, 3)
+                timing = f"chained-fori-diff(n={n1},{n2})"
+            else:
+                # differencing degenerate (noise floor): the larger chain's
+                # per-trip time is the best upper bound we have
+                step = t2 / n2
+                timing = (f"chained-fori(n={n2}; diff degenerate "
+                          f"t1={t1*1e3:.1f}>=t2={t2*1e3:.1f} ms)")
+        except _Skipped:
+            pass
         except Exception as exc:
             log(f"# mfu chained timing failed ({exc!r}); dispatch-loop")
         if step is None:
@@ -679,7 +745,7 @@ def measure_mfu(batches=None, image_size=224, model_name="mobilenet_v2"):
             res.block_until_ready()
             step = (time.perf_counter() - t0) / n
         mfu = (flops / step / (peak_tflops * 1e12)) if flops else None
-        return {
+        row = {
             "batch": batch,
             "step_ms": round(step * 1e3, 3),
             "fps": round(batch / step, 1),
@@ -687,6 +753,9 @@ def measure_mfu(batches=None, image_size=224, model_name="mobilenet_v2"):
             "mfu": round(mfu, 4) if mfu else None,
             "timing": timing,
         }
+        if overhead_ms is not None:
+            row["per_call_overhead_ms"] = overhead_ms
+        return row
 
     sweep = []
     for batch in batches:
@@ -919,28 +988,14 @@ def measure_pallas():
         out.block_until_ready()
         return (time.perf_counter() - t0) / n
 
-    try:
-        from nnstreamer_tpu.ops.pallas_kernels import fused_arith
-
-        # device-resident input: measure the KERNELS, not the host->device
-        # relayout both legs would otherwise pay per call
-        x = jax.device_put(
-            rng.integers(0, 256, (8, 224, 224, 3)).astype(np.uint8)
-        )
-        x.block_until_ready()
-        ops = (("typecast", np.float32), ("add", -127.5), ("div", 127.5))
-        pal = jax.jit(lambda a: fused_arith(a, ops))
-
-        def xla(a):
-            return (a.astype(jnp.float32) + -127.5) / 127.5
-
-        xla_j = jax.jit(xla)
-        t_pal, t_xla = timeit(pal, x), timeit(xla_j, x)
-        res["fused_arith_ms"] = round(t_pal * 1e3, 4)
-        res["xla_arith_ms"] = round(t_xla * 1e3, 4)
-        res["fused_arith_speedup"] = round(t_xla / t_pal, 3)
-    except Exception as exc:
-        res["fused_arith_error"] = repr(exc)[:300]
+    # The hand-written fused-arith VPU kernel is no longer benched or used
+    # on the acceleration path: its only real-hardware measurement (r4) lost
+    # to plain XLA fusion 0.775x (2.52 ms vs 1.95 ms for the normalize
+    # chain), so the Orc-analog acceleration story is XLA's automatic
+    # elementwise fusion via graph/optimize.py + jit — the honest and
+    # faster path (VERDICT r4 weak #5).  The kernel survives in
+    # ops/pallas_kernels.py for the custom-kernel extension point only.
+    res["fused_arith"] = "retired: XLA fusion beat the hand kernel on chip"
 
     try:
         from nnstreamer_tpu.ops.pallas_kernels import int8_matmul
@@ -1155,18 +1210,381 @@ def enable_compile_cache():
         log(f"# compile cache unavailable: {exc!r}")
 
 
-def main():
-    errors = []
-    results = {}
-    t_start = time.perf_counter()
-    budget_s = float(os.environ.get("BENCH_BUDGET_S", "2700"))
-    enable_compile_cache()
+BUDGET_DEFAULT_S = 480.0
 
-    def over_budget(label):
-        if time.perf_counter() - t_start > budget_s:
-            errors.append(f"{label}: skipped (BENCH_BUDGET_S={budget_s:g} spent)")
+
+class Reporter:
+    """Incremental evidence writer (VERDICT r4 'next' #1).
+
+    After every leg the current best view of the whole run — ratios,
+    headline variant, cached best_accelerator_run pointer included — is
+    (a) written atomically to ``BENCH_PARTIAL.json`` and (b) printed to
+    stdout as a complete JSON snapshot line marked ``"partial": true``.
+    Killing the process at ANY moment therefore leaves the previous
+    snapshot as valid, parseable evidence; round 4's official artifact was
+    ``rc: 124, parsed: null`` precisely because the only JSON line printed
+    at the very end.  ``finalize()`` is idempotent and reachable from the
+    normal end of :func:`main`, the SIGTERM/SIGINT handlers, and the hard
+    watchdog thread (which ``os._exit(0)``s even a wedged PJRT call)."""
+
+    def __init__(self, budget_s: float):
+        self.t_start = time.perf_counter()
+        self.budget_s = budget_s
+        self.results = {}
+        self.errors = []
+        self.baselines = {}
+        self.platform = None
+        self.current_leg = "startup"
+        self.last_out = None
+        self.done = False
+        self._final_emitted = False
+        # RLock: a SIGTERM can land while the main thread holds the lock
+        # inside snapshot(); the handler runs on the same thread and calls
+        # finalize() — a plain Lock would deadlock the very path built to
+        # guarantee output (review r5)
+        self._lock = threading.RLock()
+        self.partial_path = os.environ.get("BENCH_PARTIAL_PATH") or os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "BENCH_PARTIAL.json")
+
+    # -- budget ------------------------------------------------------------
+
+    def spent(self) -> float:
+        return time.perf_counter() - self.t_start
+
+    def remaining(self) -> float:
+        return self.budget_s - self.spent()
+
+    def over_budget(self, label: str) -> bool:
+        if self.remaining() < 0:
+            self.errors.append(
+                f"{label}: skipped (BENCH_BUDGET_S={self.budget_s:g} spent)")
             return True
         return False
+
+    # -- result assembly ---------------------------------------------------
+
+    def build_out(self, partial: bool = False) -> dict:
+        """The final-JSON dict, recomputed from whatever has been measured
+        so far: per-config ratios, best-config1-variant headline, and the
+        best-accelerator-run pointer.  Safe to call repeatedly."""
+        results, baselines = self.results, self.baselines
+        platform = self.platform
+        results["baselines"] = baselines
+
+        def ratio(tpu_key, base_key, base_field="fps"):
+            tpu_v = results.get(tpu_key)
+            base = baselines.get(base_key) or {}
+            base_v = base.get(base_field) if base.get("ok") else None
+            if tpu_v and base_v:
+                return round(tpu_v / base_v, 2)
+            return None
+
+        vs = {
+            "config1": ratio("config1_stream_fps", "config1"),
+            "config1_quant": ratio("config1_quant_fps", "config1_quant"),
+            "config2": ratio("config2_ssd_fps", "config2"),
+            "config2_upload": ratio("config2_ssd_upload_fps", "config2"),
+            "config2c": ratio("config2c_cascade_fps", "config2c"),
+            "config2c_upload": ratio("config2c_cascade_upload_fps", "config2c"),
+            "config3": ratio("config3_pose_fps", "config3"),
+            "config3_upload": ratio("config3_pose_upload_fps", "config3"),
+            "config4": ratio("config4_lstm_steps_per_sec", "config4",
+                             "steps_per_sec"),
+            "config4b": ratio("config4b_seq_windows_per_sec", "config4b",
+                              "windows_per_sec"),
+            "config5": ratio("config5_mux_batched_fps", "config5"),
+            "config5_upload": ratio("config5_mux_upload_fps", "config5"),
+        }
+        results["vs_baseline_per_config"] = vs
+        cpu_fps = (baselines.get("config1") or {}).get("fps") \
+            if (baselines.get("config1") or {}).get("ok") else None
+        if cpu_fps:
+            results["tflite_cpu_fps"] = round(cpu_fps, 2)
+
+        # Headline = the best config1 variant (plain stream / upload-
+        # overlap / dynbatch).  All are the SAME streaming pipeline +
+        # semantics — upload overlaps the h2d transfer with dispatch,
+        # dynbatch coalesces a pile-up adaptively; the reference pipelines
+        # the same way with queues.
+        variants = {
+            "stream": results.get("config1_stream_fps"),
+            "upload": results.get("config1_upload_fps"),
+            "dynbatch": results.get("config1_dynbatch_fps"),
+            "dynbatch+upload": results.get("config1_dynupload_fps"),
+        }
+        best_variant, best_fps = None, None
+        for name, v in variants.items():
+            if v is not None and (best_fps is None or v > best_fps):
+                best_variant, best_fps = name, v
+        vs_baseline = vs["config1"]
+        tpu_fps = None
+        if best_fps is not None:
+            tpu_fps = best_fps
+            results["headline_variant"] = best_variant
+            if cpu_fps:
+                # keep vs['config1'] the matched stream-vs-stream ratio; the
+                # best-of-variants headline gets its own labeled key
+                vs["config1_best"] = round(best_fps / cpu_fps, 2)
+                vs_baseline = vs["config1_best"]
+
+        results.pop("best_accelerator_run", None)
+        if platform not in (None, "cpu"):
+            # on-accel but possibly under a sick wire: if a better
+            # accelerator run is cached (best-of, see save_tpu_cache),
+            # point at it so the final JSON never hides the round's best
+            # chip evidence behind one unlucky wire phase
+            cached = load_tpu_cache()
+            cres = (cached or {}).get("result") or {}
+            here = {"vs_baseline": vs_baseline,
+                    "value": round(tpu_fps, 2) if tpu_fps else None}
+            if cached and not better_run(here, cres):
+                results["best_accelerator_run"] = {
+                    "cached_at": cached.get("cached_at"),
+                    "value": cres.get("value"),
+                    "vs_baseline": cres.get("vs_baseline"),
+                    "platform": cres.get("platform"),
+                    "note": "a prior run this round scored higher (see "
+                            "BENCH_TPU_CACHE.json / BENCH_RUNS/); this "
+                            "run's wire was likely sicker — compare "
+                            "wire_health brackets",
+                }
+        else:
+            cached = load_tpu_cache()
+            if cached is not None:
+                # no accelerator this run: carry the best real-chip numbers
+                # on file alongside (NOT replacing) the CPU measurements
+                carry = {
+                    "cached_at": cached.get("cached_at"),
+                    "value": (cached.get("result") or {}).get("value"),
+                    "vs_baseline": (cached.get("result") or {}).get("vs_baseline"),
+                    "platform": (cached.get("result") or {}).get("platform"),
+                }
+                cached_extra = (cached.get("result") or {}).get("extra") or {}
+                if "baselines" not in cached_extra:
+                    # a cached run without the isolated-subprocess baselines
+                    # computed its ratio against an in-process denominator —
+                    # the discredited methodology — drop the ratio rather
+                    # than let it be cited again
+                    carry["vs_baseline"] = None
+                    carry["note"] = (
+                        "cached ratio dropped: its baseline denominator was "
+                        "measured in-process beside a live PJRT client and "
+                        "is invalid; compare value against "
+                        "baselines.config1.fps"
+                    )
+                results["best_accelerator_run"] = carry
+
+        results["measured_on"] = platform or "cpu-fallback"
+        variant_note = (
+            f", best variant: {results['headline_variant']}"
+            if results.get("headline_variant") else ""
+        )
+        out = {
+            "metric": "mobilenet_v2_224 image-labeling pipeline throughput "
+                      f"(tensor_filter invoke, streaming{variant_note})",
+            "value": round(tpu_fps, 2) if tpu_fps else None,
+            "unit": "frames/sec/chip",
+            "vs_baseline": vs_baseline,
+            "platform": platform or "cpu-fallback",
+            "extra": results,
+        }
+        if self.errors:
+            out["error"] = "; ".join(self.errors)
+        if partial:
+            out["partial"] = True
+            out["snapshot_after"] = self.current_leg
+            out["budget"] = {"spent_s": round(self.spent(), 1),
+                            "budget_s": self.budget_s}
+        return out
+
+    def snapshot(self) -> None:
+        """Persist + print the current state; never raises."""
+        try:
+            with self._lock:
+                if self._final_emitted:
+                    return
+                out = self.build_out(partial=True)
+                self.last_out = out
+                tmp = self.partial_path + ".tmp"
+                with open(tmp, "w") as f:
+                    json.dump(out, f)
+                os.replace(tmp, self.partial_path)
+                print(json.dumps(out), flush=True)
+        except Exception as exc:  # noqa: BLE001 — evidence plumbing only
+            log(f"# snapshot failed: {exc!r}")
+
+    def finalize(self, async_ctx: bool = False):
+        """Emit the final JSON exactly once (notes + cache + stdout).
+
+        ``async_ctx=True`` (signal handler / watchdog thread) reuses the
+        last CONSISTENT snapshot instead of recomputing from a results dict
+        the main thread may be mutating mid-leg.  The async path acquires
+        with a timeout: if the lock is somehow held forever (a thread died
+        mid-snapshot), emitting slightly-racy JSON beats hanging the
+        process the watchdog exists to end."""
+        got = self._lock.acquire(timeout=5.0) if async_ctx \
+            else self._lock.acquire()
+        try:
+            if self._final_emitted:
+                return None
+            self._final_emitted = True
+            if async_ctx:
+                out = dict(self.last_out) if self.last_out else {
+                    "metric": "mobilenet_v2_224 image-labeling pipeline "
+                              "throughput",
+                    "value": None, "unit": "frames/sec/chip",
+                    "vs_baseline": None,
+                    "platform": self.platform or "cpu-fallback",
+                }
+                out.pop("partial", None)
+                out.pop("snapshot_after", None)
+                note = (f"run interrupted during leg {self.current_leg!r} "
+                        f"after {self.spent():.0f}s; result is the last "
+                        "completed snapshot")
+                out["error"] = (f"{out['error']}; {note}"
+                                if out.get("error") else note)
+            else:
+                out = self.build_out(partial=False)
+        finally:
+            if got:
+                self._lock.release()
+        try:
+            write_notes(self.results, self.platform, self.errors)
+        except Exception as exc:
+            log(f"# notes write failed: {exc!r}")
+        try:
+            tmp = self.partial_path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(out, f)
+            os.replace(tmp, self.partial_path)
+        except Exception as exc:
+            log(f"# partial-file finalize failed: {exc!r}")
+        if self.platform not in (None, "cpu"):
+            save_tpu_cache(out)
+        print(json.dumps(out), flush=True)
+        return out
+
+
+def install_signal_handlers(reporter: Reporter) -> None:
+    """SIGTERM/SIGINT → finalize + exit 0: an external ``timeout`` kill
+    yields the full evidence JSON and rc 0 instead of rc 124/no output."""
+    import signal
+
+    def handler(signum, frame):
+        del frame
+        log(f"# signal {signum} during {reporter.current_leg!r}; "
+            "emitting final snapshot")
+        reporter.finalize(async_ctx=True)
+        sys.stdout.flush()
+        sys.stderr.flush()
+        os._exit(0)
+
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        try:
+            signal.signal(sig, handler)
+        except (ValueError, OSError) as exc:
+            log(f"# cannot install handler for signal {sig}: {exc!r}")
+
+
+def arm_watchdog(reporter: Reporter, hard_s: float) -> threading.Thread:
+    """A daemon thread that force-finishes the run at ``hard_s`` seconds:
+    signal handlers only run between Python bytecodes, so a PJRT call
+    wedged inside C would otherwise hold the process until the driver's
+    SIGKILL — ``os._exit`` from this thread works regardless."""
+
+    def run():
+        while not reporter.done:
+            if reporter.spent() > hard_s:
+                log(f"# WATCHDOG: {hard_s:g}s hard limit hit during "
+                    f"{reporter.current_leg!r}; emitting final snapshot")
+                reporter.finalize(async_ctx=True)
+                sys.stdout.flush()
+                sys.stderr.flush()
+                os._exit(0)
+            time.sleep(1.0)
+
+    t = threading.Thread(target=run, daemon=True, name="bench-watchdog")
+    t.start()
+    return t
+
+
+def load_reused_baselines(rep: Reporter) -> None:
+    """Adopt prior isolated-subprocess baselines (same host, bounded age)
+    so a short healthy-wire window is spent on accelerator legs.  Reuse is
+    now the DEFAULT — BENCH_BASELINES_FROM overrides the source, and
+    setting it to an empty string forces fresh measurement."""
+    reuse_path = os.environ.get("BENCH_BASELINES_FROM")
+    if reuse_path is None and os.path.exists(TPU_CACHE_PATH):
+        reuse_path = TPU_CACHE_PATH
+        log(f"# default baseline reuse from {reuse_path} "
+            "(set BENCH_BASELINES_FROM= to disable)")
+    if not reuse_path:
+        return
+    baselines, errors = rep.baselines, rep.errors
+    try:
+        with open(reuse_path) as f:
+            prior = json.load(f)
+        if "result" in prior:  # BENCH_TPU_CACHE.json wrapper
+            prior = prior["result"] or {}
+        prior_b = ((prior.get("extra") or {}).get("baselines")
+                   or prior.get("baselines") or {})
+        host_cpus = os.cpu_count()
+        max_age_s = float(os.environ.get(
+            "BENCH_BASELINE_MAX_AGE_S", str(7 * 24 * 3600)))
+        for which, leg in prior_b.items():
+            if not (isinstance(leg, dict) and leg.get("ok")):
+                continue
+            if leg.get("cpu_count") != host_cpus:
+                # a baseline from a different host shape would silently
+                # distort every ratio — refuse it and measure fresh
+                errors.append(
+                    f"baseline {which} from {reuse_path} ignored: "
+                    f"measured on a {leg.get('cpu_count')}-CPU host, "
+                    f"this host has {host_cpus}")
+                continue
+            # reuse can chain run→cache→run indefinitely: bound the age so
+            # rows measured long ago get re-measured, and keep the ORIGINAL
+            # measurement stamp through every hop
+            measured_at = leg.get("measured_at")
+            if not measured_at:
+                errors.append(
+                    f"baseline {which} from {reuse_path} ignored: no "
+                    "measured_at provenance; re-measuring")
+                continue
+            try:
+                age = time.time() - time.mktime(
+                    time.strptime(measured_at, "%Y-%m-%d %H:%M:%S"))
+            except ValueError:
+                age = max_age_s + 1  # unparseable stamp: re-measure
+            if age > max_age_s:
+                errors.append(
+                    f"baseline {which} from {reuse_path} ignored: "
+                    f"measured {measured_at}, older than "
+                    f"{max_age_s:g}s; re-measuring")
+                continue
+            baselines[which] = dict(
+                leg,
+                reused_from=leg.get("reused_from")
+                or os.path.basename(reuse_path))
+        log(f"# baselines reused from {reuse_path}: {sorted(baselines)}")
+        if not baselines:
+            errors.append(
+                f"baselines from {reuse_path}: no usable rows; "
+                "measuring fresh")
+    except Exception as exc:
+        errors.append(f"baseline reuse load failed: {exc!r}"[:200])
+
+
+def main(standalone=False):
+    budget_s = float(os.environ.get("BENCH_BUDGET_S", str(BUDGET_DEFAULT_S)))
+    rep = Reporter(budget_s)
+    if standalone:
+        install_signal_handlers(rep)
+        grace = float(os.environ.get("BENCH_WATCHDOG_GRACE_S", "120"))
+        arm_watchdog(rep, budget_s + grace)
+    enable_compile_cache()
+    errors, results = rep.errors, rep.results
+    rep.snapshot()  # evidence exists from second zero (cached pointer incl.)
 
     platform = probe_accelerator()
     if platform is None:
@@ -1178,7 +1596,13 @@ def main():
         platform = None
     elif platform == "cpu":
         errors.append("no accelerator registered; CPU-only measurements")
+    rep.platform = platform
     log(f"# jax platform: {platform or 'cpu-fallback'}")
+
+    # Baselines first (reused rows cost nothing) so every snapshot from the
+    # first leg on carries real vs_baseline ratios.
+    load_reused_baselines(rep)
+    rep.snapshot()
 
     rng = np.random.default_rng(0)
     image_u8 = rng.integers(0, 256, (224, 224, 3)).astype(np.uint8)
@@ -1197,6 +1621,7 @@ def main():
             history = [measure_wire_health()]
             while (
                 history[-1]["put_150k_ms"] > 5.0 and len(history) <= waits
+                and rep.remaining() > 120
             ):
                 log(f"# wire sick ({history[-1]}); waiting 60s "
                     f"({len(history)}/{waits})")
@@ -1209,55 +1634,53 @@ def main():
         except Exception as exc:
             errors.append(f"wire health start: {exc!r}"[:200])
 
-    wire_gate = make_wire_gate(
-        results, on_accel,
-        budget_left=lambda: budget_s - (time.perf_counter() - t_start),
-    )
+    wire_gate = make_wire_gate(results, on_accel, budget_left=rep.remaining)
+
+    # ---- legs, in VALUE order: config1 variants (the headline) first, then
+    # config5 (the north-star architecture), quant, everything else.  Each
+    # leg is a closure run by the budget-checking loop at the bottom; a
+    # snapshot lands after every one.
+
+    share = {"model": None}
+
+    def get_model():
+        if share["model"] is None:
+            from nnstreamer_tpu.models import mobilenet_v2
+
+            share["model"] = mobilenet_v2.build(num_classes=1001,
+                                                image_size=224)
+        return share["model"]
 
     # -- config #1: streaming image-labeling pipeline (jax backend) --------
-    tpu_fps = None
-    jax_model = None
-    try:
-        from nnstreamer_tpu.models import mobilenet_v2
-
-        jax_model = mobilenet_v2.build(num_classes=1001, image_size=224)
+    def leg_config1_stream():
         n_tpu = int(os.environ.get("BENCH_FRAMES", "400"))
         if n_tpu <= 0:
-            errors.append("config1 jax leg: skipped (0 frames)")
-        else:
-            wire_gate("config1_stream")
-            tpu_frames = [image_u8.copy() for _ in range(n_tpu)]
-            tpu_fps = run_pipeline_fps("jax", jax_model, tpu_frames)
-            results["config1_stream_fps"] = round(tpu_fps, 2)
-            results["config1_frames"] = n_tpu
-            log(f"# config1 jax streaming fps: {tpu_fps:.2f}")
-    except Exception as exc:
-        leg_error(errors, "config1 jax leg", exc)
+            raise _Skipped("skipped (0 frames)")
+        wire_gate("config1_stream")
+        fps = run_pipeline_fps("jax", get_model(),
+                               [image_u8.copy() for _ in range(n_tpu)])
+        results["config1_stream_fps"] = round(fps, 2)
+        results["config1_frames"] = n_tpu
+        log(f"# config1 jax streaming fps: {fps:.2f}")
 
     # -- config #1u: same pipeline with tensor_upload + queue — transfer of
     #    frame N+1 (source thread) overlaps dispatch of frame N (worker)
-    try:
-        if jax_model is None:
-            from nnstreamer_tpu.models import mobilenet_v2
-
-            jax_model = mobilenet_v2.build(num_classes=1001, image_size=224)
+    def leg_config1_upload():
         n_u = int(os.environ.get("BENCH_UPLOAD_FRAMES",
                                  os.environ.get("BENCH_FRAMES", "400")))
         if n_u <= 0:
             raise _Skipped("skipped (0 frames)")
         wire_gate("config1_upload")
         u_fps = run_pipeline_fps(
-            "jax", jax_model, [image_u8.copy() for _ in range(n_u)],
+            "jax", get_model(), [image_u8.copy() for _ in range(n_u)],
             upload=True,
         )
         results["config1_upload_fps"] = round(u_fps, 2)
         results["config1_upload_frames"] = n_u
         log(f"# config1 upload-overlap fps: {u_fps:.2f}")
-    except Exception as exc:
-        leg_error(errors, "config1 upload leg", exc)
 
     # -- config #1d: adaptive micro-batching (tensor_dynbatch) -------------
-    try:
+    def leg_config1_dynbatch():
         n_d = int(os.environ.get("BENCH_DYNBATCH_FRAMES",
                                  os.environ.get("BENCH_FRAMES", "400")))
         if n_d <= 0:
@@ -1273,13 +1696,11 @@ def main():
         results["config1_dynbatch_frames"] = d_frames
         log(f"# config1 dynbatch fps: {d_fps:.2f} "
             f"({d_batches} invokes / {d_frames} frames)")
-    except Exception as exc:
-        leg_error(errors, "config1 dynbatch leg", exc)
 
     # -- config #1du: dynbatch + upload overlap — coalesced batches cross
     #    the wire in the dynbatch worker while the queue worker dispatches
     #    the previous batch (amortization AND overlap stacked)
-    try:
+    def leg_config1_dynupload():
         n_du = int(os.environ.get("BENCH_DYNBATCH_FRAMES",
                                   os.environ.get("BENCH_FRAMES", "400")))
         if n_du <= 0:
@@ -1296,21 +1717,21 @@ def main():
         results["config1_dynupload_frames"] = du_frames
         log(f"# config1 dynbatch+upload fps: {du_fps:.2f} "
             f"({du_batches} invokes / {du_frames} frames)")
-    except Exception as exc:
-        leg_error(errors, "config1 dynupload leg", exc)
 
     # -- config #1q: uint8-quantized flagship — full-int8 path: every
-    #    ungrouped conv runs int8 x int8 → int32 on the MXU with dynamic
-    #    activation scales (the reference's flagship model is uint8-quant
-    #    MobileNet; v5e int8 peak is 2x bf16)
-    try:
+    #    ungrouped conv runs int8 x int8 → int32 on the MXU with STATIC
+    #    activation scales calibrated at build time (round-5: the per-sample
+    #    dynamic scales cost extra passes and lost to float on chip; the
+    #    reference's uint8 flagship uses fixed scales the same way)
+    def leg_config1_quant():
         from nnstreamer_tpu.models import mobilenet_v2
 
         n_q = int(os.environ.get("BENCH_QUANT_FRAMES", "200"))
         if n_q <= 0:
             raise _Skipped("skipped (0 frames)")
         quant_model = mobilenet_v2.build_quantized(
-            num_classes=1001, image_size=224, int8_convs=True)
+            num_classes=1001, image_size=224, int8_convs=True,
+            static_scales=True)
         wire_gate("config1_quant")
         q_fps = run_pipeline_fps(
             "jax", quant_model, [image_u8.copy() for _ in range(n_q)]
@@ -1318,14 +1739,12 @@ def main():
         results["config1_quant_fps"] = round(q_fps, 2)
         results["config1_quant_frames"] = n_q
         log(f"# config1 quantized fps: {q_fps:.2f}")
-    except Exception as exc:
-        leg_error(errors, "config1 quant leg", exc)
 
     # -- config #2: SSD-MobileNet bounding-box pipeline --------------------
     # fused on-device decode head (lax.top_k inside the model's program) +
     # the fused-ssd decoder: the benched pipeline now includes the FULL
     # detection path (decode + overlay), unlike round 2's model-only leg
-    try:
+    def leg_config2():
         from nnstreamer_tpu.models import ssd_mobilenet
 
         n_ssd = int(os.environ.get("BENCH_SSD_FRAMES", "100"))
@@ -1359,13 +1778,11 @@ def main():
         )
         results["config2_ssd_upload_fps"] = round(ssd_u_fps, 2)
         log(f"# config2 ssd upload fps: {ssd_u_fps:.2f}")
-    except Exception as exc:
-        leg_error(errors, "config2 ssd leg", exc)
 
     # -- config #3: PoseNet pose-estimation pipeline -----------------------
     # fused on-device keypoint decode (heatmap argmax in the model's XLA
     # program) + skeleton overlay: the full pose path, both legs symmetric
-    try:
+    def leg_config3():
         from nnstreamer_tpu.models import posenet
 
         n_pose = int(os.environ.get("BENCH_POSE_FRAMES", "100"))
@@ -1393,36 +1810,41 @@ def main():
         )
         results["config3_pose_upload_fps"] = round(pose_u_fps, 2)
         log(f"# config3 pose upload fps: {pose_u_fps:.2f}")
-    except Exception as exc:
-        leg_error(errors, "config3 pose leg", exc)
 
     # -- config #2c: fused detect→crop→classify cascade --------------------
     # the reference runs this as detector → host decode → videocrop×K →
-    # scaler → second filter; here the whole cascade is ONE program/frame
-    try:
+    # scaler → second filter; here the whole cascade is ONE program/frame.
+    # Round 5 adds the upload-overlap variant (the treatment that took
+    # config2 to 2.47x): the 300x300 frame crosses the wire in the source
+    # thread while the queue worker dispatches the previous cascade.
+    def leg_config2c():
         from nnstreamer_tpu.models import cascade as cascade_mod
 
         n_casc = int(os.environ.get("BENCH_CASCADE_FRAMES", "50"))
         if n_casc <= 0:
-            errors.append("config2c cascade leg: skipped (0 frames)")
-        if n_casc > 0 and not over_budget("config2c cascade"):
-            casc = cascade_mod.build_detect_classify(
-                num_labels=91, det_size=300, k=16, crop_size=96,
-                num_classes=1001,
-            )
-            img300c = rng.integers(0, 256, (300, 300, 3)).astype(np.uint8)
-            wire_gate("config2c_cascade")
-            c_fps = run_pipeline_fps(
-                "jax", casc, [img300c.copy() for _ in range(n_casc)]
-            )
-            results["config2c_cascade_fps"] = round(c_fps, 2)
-            results["config2c_frames"] = n_casc
-            log(f"# config2c cascade (detect+crop+classify x16) fps: {c_fps:.2f}")
-    except Exception as exc:
-        leg_error(errors, "config2c cascade leg", exc)
+            raise _Skipped("skipped (0 frames)")
+        casc = cascade_mod.build_detect_classify(
+            num_labels=91, det_size=300, k=16, crop_size=96,
+            num_classes=1001,
+        )
+        img300c = rng.integers(0, 256, (300, 300, 3)).astype(np.uint8)
+        wire_gate("config2c_cascade")
+        c_fps = run_pipeline_fps(
+            "jax", casc, [img300c.copy() for _ in range(n_casc)]
+        )
+        results["config2c_cascade_fps"] = round(c_fps, 2)
+        results["config2c_frames"] = n_casc
+        log(f"# config2c cascade (detect+crop+classify x16) fps: {c_fps:.2f}")
+        wire_gate("config2c_cascade_upload")
+        cu_fps = run_pipeline_fps(
+            "jax", casc, [img300c.copy() for _ in range(n_casc)],
+            upload=True,
+        )
+        results["config2c_cascade_upload_fps"] = round(cu_fps, 2)
+        log(f"# config2c cascade upload fps: {cu_fps:.2f}")
 
     # -- config #4: LSTM recurrence through repo slots ---------------------
-    try:
+    def leg_config4():
         n_steps = int(os.environ.get("BENCH_LSTM_STEPS", "200"))
         if n_steps <= 0:
             raise _Skipped("skipped (0 steps)")
@@ -1431,12 +1853,10 @@ def main():
         results["config4_lstm_steps_per_sec"] = round(lstm_fps, 2)
         results["config4_steps"] = n_steps
         log(f"# config4 lstm recurrence steps/sec: {lstm_fps:.2f}")
-    except Exception as exc:
-        leg_error(errors, "config4 lstm leg", exc)
 
     # -- config #4c: transformer KV-cache decode through repo slots --------
     # device-resident state: the (L,2,T,d) cache never leaves the chip
-    try:
+    def leg_config4c():
         n_kv = int(os.environ.get("BENCH_KV_STEPS",
                                   os.environ.get("BENCH_LSTM_STEPS", "200")))
         if n_kv <= 0:
@@ -1449,15 +1869,13 @@ def main():
         results["config4c_kvdecode_steps_per_sec"] = round(kv_fps, 2)
         results["config4c_steps"] = n_kv
         log(f"# config4c kv-cache decode steps/sec: {kv_fps:.2f}")
-    except Exception as exc:
-        leg_error(errors, "config4c kvdecode leg", exc)
 
     # -- config #4b: windowed sequence LSTM (lax.scan) ----------------------
     # The TPU-native recurrence: tensor_aggregator windows → ONE compiled
     # program scans the whole sequence on device.  Config #4 (per-step
     # repo-slot cycles) is round-trip-latency-bound by design — this is the
     # shape a TPU deployment actually uses for throughput.
-    try:
+    def leg_config4b():
         from nnstreamer_tpu.models import lstm as lstm_mod
 
         n_win = int(os.environ.get("BENCH_SEQ_WINDOWS", "100"))
@@ -1478,13 +1896,11 @@ def main():
         results["config4b_seq_steps_per_sec"] = round(win_fps * seq_len, 1)
         log(f"# config4b sequence-lstm windows/sec: {win_fps:.2f} "
             f"({win_fps * seq_len:.0f} steps/s)")
-    except Exception as exc:
-        leg_error(errors, "config4b seq leg", exc)
 
     # -- config #5: mux → batched classifier, with a stream-scaling sweep --
     # (jax-sharded: the batch dim shards over however many chips exist; on
     # one chip it is an ordinary batched invoke through the sharding path)
-    try:
+    def leg_config5():
         import jax as _jax
 
         from nnstreamer_tpu.models import mobilenet_v2
@@ -1504,7 +1920,8 @@ def main():
         results["config5_frames_per_stream"] = per_stream
         headline_model = None
         for streams in sweep:
-            if streams != n_streams and over_budget(f"config5 sweep {streams}"):
+            if streams != n_streams and rep.over_budget(
+                    f"config5 sweep {streams}"):
                 continue
             try:  # a failed sweep point must not discard measured ones
                 batched = mobilenet_v2.build(
@@ -1525,339 +1942,195 @@ def main():
                 if not isinstance(exc, _Skipped):
                     log(traceback.format_exc())
         results["config5_mux_batched_fps"] = scaling.get(n_streams)
+        rep.snapshot()
         # upload-overlap variant at the headline stream count: the batched
         # wire transfer rides the mux worker while the queue worker
         # dispatches the previous round (round-2's chip loss was serial
         # transfer+dispatch in this exact topology)
-        if not over_budget("config5 upload variant"):
-            try:
-                if headline_model is None:
-                    headline_model = mobilenet_v2.build(
-                        num_classes=1001, image_size=224, batch=n_streams
-                    )
-                u_fps = run_mux_batched_fps(
-                    headline_model, n_streams, per_stream, image_u8,
-                    framework="jax-sharded",
-                    custom=f"devices={min(n_dev, n_streams)},axis=dp",
-                    upload=True,
+        if not rep.over_budget("config5 upload variant"):
+            if headline_model is None:
+                headline_model = mobilenet_v2.build(
+                    num_classes=1001, image_size=224, batch=n_streams
                 )
-                results["config5_mux_upload_fps"] = round(u_fps, 2)
-                log(f"# config5 mux+upload fps ({n_streams} streams): {u_fps:.2f}")
-            except Exception as exc:
-                leg_error(errors, "config5 upload leg", exc)
-    except Exception as exc:
-        leg_error(errors, "config5 mux leg", exc)
+            u_fps = run_mux_batched_fps(
+                headline_model, n_streams, per_stream, image_u8,
+                framework="jax-sharded",
+                custom=f"devices={min(n_dev, n_streams)},axis=dp",
+                upload=True,
+            )
+            results["config5_mux_upload_fps"] = round(u_fps, 2)
+            log(f"# config5 mux+upload fps ({n_streams} streams): {u_fps:.2f}")
 
     # -- per-frame breakdown (where the time goes, config #1) --------------
-    try:
+    def leg_breakdown():
         wire_gate("frame_breakdown")
         results["frame_breakdown"] = measure_frame_breakdown(image_u8)
         log(f"# frame breakdown: {results['frame_breakdown']}")
-    except Exception as exc:
-        errors.append(f"breakdown: {exc!r}"[:400])
 
     # -- MFU + Pallas (diagnostics; only meaningful on the real chip) ------
-    # budget-gated like the config legs: blowing past BENCH_BUDGET_S here
-    # would hit chip_watch's hard subprocess timeout and lose the whole
-    # run's evidence (final JSON + save_tpu_cache both happen after this)
-    if not over_budget("mfu sweep"):
-        try:
-            results["mfu"] = measure_mfu()
-            log(f"# mfu: {results['mfu']}")
-        except Exception as exc:
-            errors.append(f"mfu: {exc!r}"[:400])
-    if (on_accel or os.environ.get("BENCH_MFU_VIT_BATCHES")) \
-            and not over_budget("mfu_vit sweep"):
+    def leg_mfu():
+        wire_gate("mfu")
+        results["mfu"] = measure_mfu()
+        log(f"# mfu: {results['mfu']}")
+
+    def leg_mfu_vit():
         # framework-ceiling sweep: ViT-B/16 is matmul-dominated, so its MFU
         # shows what the framework+XLA path achieves when the model is
         # MXU-friendly (MobileNet's depthwise convs cap the sweep above)
-        try:
-            results["mfu_vit"] = measure_mfu(model_name="vit_b16")
-            log(f"# mfu_vit: {results['mfu_vit']}")
-        except Exception as exc:
-            errors.append(f"mfu_vit: {exc!r}"[:400])
-    if not on_accel:
-        # CPU-interpreter Pallas numbers are noise (r3: 22x "slowdown", 7x
-        # "autotune win" — both artifacts); skip rather than report them
-        results["pallas"] = {"skipped": "pallas/autotune legs run on the "
-                                        "accelerator only (r3 verdict weak #4)"}
-    elif not over_budget("pallas legs"):
-        try:
-            results["pallas"] = measure_pallas()
-            log(f"# pallas: {results['pallas']}")
-        except Exception as exc:
-            errors.append(f"pallas: {exc!r}"[:400])
-    if on_accel:
-        try:
-            results["wire_health_end"] = measure_wire_health()
-            log(f"# wire health (end): {results['wire_health_end']}")
-        except Exception as exc:
-            errors.append(f"wire health end: {exc!r}"[:200])
+        if not (on_accel or os.environ.get("BENCH_MFU_VIT_BATCHES")):
+            raise _Skipped("accelerator only")
+        wire_gate("mfu_vit")
+        results["mfu_vit"] = measure_mfu(model_name="vit_b16")
+        log(f"# mfu_vit: {results['mfu_vit']}")
+
+    def leg_pallas():
+        if not on_accel:
+            # CPU-interpreter Pallas numbers are noise (r3: 22x "slowdown",
+            # 7x "autotune win" — both artifacts); skip, don't report them
+            results["pallas"] = {
+                "skipped": "pallas/autotune legs run on the accelerator "
+                           "only (r3 verdict weak #4)"}
+            raise _Skipped("accelerator only")
+        results["pallas"] = measure_pallas()
+        log(f"# pallas: {results['pallas']}")
+
+    def leg_wire_end():
+        if not on_accel:
+            raise _Skipped("accelerator only")
+        results["wire_health_end"] = measure_wire_health()
+        log(f"# wire health (end): {results['wire_health_end']}")
 
     # -- CPU baselines: the reference stack, isolated subprocesses ---------
-    # BENCH_BASELINES_FROM=<prior bench JSON> reuses that run's isolated
-    # baselines (same host, same methodology) so a re-run during a short
-    # healthy-wire window spends its minutes on the accelerator legs; each
-    # reused row is stamped ``reused_from`` for transparency.
-    baselines = {}
-    reuse_path = os.environ.get("BENCH_BASELINES_FROM")
-    if reuse_path:
-        try:
-            with open(reuse_path) as f:
-                prior = json.load(f)
-            if "result" in prior:  # BENCH_TPU_CACHE.json wrapper
-                prior = prior["result"] or {}
-            prior_b = ((prior.get("extra") or {}).get("baselines")
-                       or prior.get("baselines") or {})
-            host_cpus = os.cpu_count()
-            max_age_s = float(os.environ.get(
-                "BENCH_BASELINE_MAX_AGE_S", str(7 * 24 * 3600)))
-            for which, leg in prior_b.items():
-                if not (isinstance(leg, dict) and leg.get("ok")):
-                    continue
-                if leg.get("cpu_count") != host_cpus:
-                    # a baseline from a different host shape would silently
-                    # distort every ratio (the round-1/round-2 distortion,
-                    # see BENCH_NOTES) — refuse it and measure fresh
-                    errors.append(
-                        f"baseline {which} from {reuse_path} ignored: "
-                        f"measured on a {leg.get('cpu_count')}-CPU host, "
-                        f"this host has {host_cpus}")
-                    continue
-                # reuse can chain run→cache→run indefinitely (chip_watch
-                # feeds the cache back in every bench): bound the age so
-                # rows measured long ago get re-measured, and keep the
-                # ORIGINAL measurement stamp through every hop so a reader
-                # can see how old a row really is
-                measured_at = leg.get("measured_at")
-                if not measured_at:
-                    # pre-provenance rows (no stamp) would chain forever —
-                    # treat as over-age and re-measure once; the fresh row
-                    # gets a stamp and reuses normally from then on
-                    errors.append(
-                        f"baseline {which} from {reuse_path} ignored: no "
-                        "measured_at provenance; re-measuring")
-                    continue
-                try:
-                    age = time.time() - time.mktime(
-                        time.strptime(measured_at, "%Y-%m-%d %H:%M:%S"))
-                except ValueError:
-                    age = max_age_s + 1  # unparseable stamp: re-measure
-                if age > max_age_s:
-                    errors.append(
-                        f"baseline {which} from {reuse_path} ignored: "
-                        f"measured {measured_at}, older than "
-                        f"{max_age_s:g}s; re-measuring")
-                    continue
-                baselines[which] = dict(
-                    leg,
-                    reused_from=leg.get("reused_from")
-                    or os.path.basename(reuse_path))
-            log(f"# baselines reused from {reuse_path}: {sorted(baselines)}")
-            if not baselines:
-                errors.append(
-                    f"BENCH_BASELINES_FROM={reuse_path}: no usable rows; "
-                    "measuring fresh")
-        except Exception as exc:
-            errors.append(f"BENCH_BASELINES_FROM load failed: {exc!r}"[:200])
-    if os.environ.get("BENCH_SKIP_BASELINES", "") != "1":
+    # (reused rows were loaded up front; only the missing ones cost time)
+    def leg_baselines():
+        if os.environ.get("BENCH_SKIP_BASELINES", "") == "1":
+            raise _Skipped("BENCH_SKIP_BASELINES=1")
         for which in ("config1", "config1_quant", "config2", "config2c",
                       "config3", "config4", "config4b", "config5"):
-            if which in baselines:
+            if which in rep.baselines:
                 continue
-            if over_budget(f"baseline {which}"):
+            if rep.over_budget(f"baseline {which}"):
                 continue
             try:
-                leg = run_baseline_leg(which)
-                baselines[which] = leg
+                timeout = max(60.0, rep.remaining() + 60.0)
+                leg = run_baseline_leg(which, timeout=timeout)
+                rep.baselines[which] = leg
                 log(f"# baseline {which}: {leg}")
                 if not leg.get("ok"):
                     errors.append(f"baseline {which}: {leg.get('error')}"[:300])
             except Exception as exc:
                 errors.append(f"baseline {which}: {exc!r}"[:300])
-    results["baselines"] = baselines
+            rep.snapshot()  # each baseline improves the ratios
 
     # -- late re-probe: round 3 lost every accel number because one failed
     #    probe pinned the WHOLE session to CPU.  If the tunnel came back
-    #    while the CPU legs + baselines ran (~20 min), grab it now: re-run
-    #    the accel legs in a fresh subprocess (this process is already
-    #    pinned) and adopt its numbers, keeping our baselines.
-    if platform in (None, "cpu") and os.environ.get("BENCH_NO_RETRY") != "1":
+    #    while the CPU legs + baselines ran, grab it now: re-run the accel
+    #    legs in a fresh subprocess (this process is already pinned) and
+    #    adopt its numbers, keeping our baselines.
+    def leg_late_reprobe():
+        if rep.platform not in (None, "cpu"):
+            raise _Skipped("already on accelerator")
+        if os.environ.get("BENCH_NO_RETRY") == "1":
+            raise _Skipped("BENCH_NO_RETRY=1")
         late = probe_accelerator(retries=1)
-        if late not in (None, "cpu"):
-            log("# accelerator reachable again — re-running accel legs")
-            try:
-                env = {k: v for k, v in os.environ.items()
-                       if k != "JAX_PLATFORMS"}  # don't inherit the CPU pin
-                env.update(BENCH_NO_RETRY="1", BENCH_SKIP_BASELINES="1",
-                           BENCH_PROBE_RETRIES="1")
-                proc = subprocess.run(
-                    [sys.executable, os.path.abspath(__file__)],
-                    capture_output=True, text=True, timeout=3600, env=env,
-                )
-                child = json.loads(proc.stdout.strip().splitlines()[-1])
-                if child.get("platform") not in (None, "cpu", "cpu-fallback"):
-                    child_extra = child.get("extra") or {}
-                    child_extra["baselines"] = baselines
-                    # snapshot of the fallback run, minus its baselines copy
-                    # (those rows are already present with the right stamp)
-                    child_extra["cpu_fallback_run"] = {
-                        k: v for k, v in results.items() if k != "baselines"
-                    }
-                    results, tpu_fps = child_extra, None
-                    platform, on_accel = child["platform"], True
-                    # the surviving parent errors describe the CPU run,
-                    # not the adopted accelerator results — label them
-                    errors = [
-                        f"cpu-fallback run: {e}" for e in errors
-                        if not e.startswith("accelerator backend failed")
-                    ]
-                    if child.get("error"):
-                        errors.append(
-                            f"late-accel rerun: {child['error']}"[:400])
-                else:
-                    errors.append(
-                        "late-accel rerun attempted but the child also fell "
-                        f"back (platform={child.get('platform')}); keeping "
-                        "the CPU numbers"
-                    )
-            except Exception as exc:
-                errors.append(f"late accel rerun failed: {exc!r}"[:300])
-
-    # -- vs_baseline per config --------------------------------------------
-    def ratio(tpu_key, base_key, base_field="fps"):
-        tpu_v = results.get(tpu_key)
-        base = baselines.get(base_key) or {}
-        base_v = base.get(base_field) if base.get("ok") else None
-        if tpu_v and base_v:
-            return round(tpu_v / base_v, 2)
-        return None
-
-    vs = {
-        "config1": ratio("config1_stream_fps", "config1"),
-        "config1_quant": ratio("config1_quant_fps", "config1_quant"),
-        "config2": ratio("config2_ssd_fps", "config2"),
-        "config2_upload": ratio("config2_ssd_upload_fps", "config2"),
-        "config2c": ratio("config2c_cascade_fps", "config2c"),
-        "config3": ratio("config3_pose_fps", "config3"),
-        "config3_upload": ratio("config3_pose_upload_fps", "config3"),
-        "config4": ratio("config4_lstm_steps_per_sec", "config4",
-                         "steps_per_sec"),
-        "config4b": ratio("config4b_seq_windows_per_sec", "config4b",
-                          "windows_per_sec"),
-        "config5": ratio("config5_mux_batched_fps", "config5"),
-        "config5_upload": ratio("config5_mux_upload_fps", "config5"),
-    }
-    results["vs_baseline_per_config"] = vs
-    cpu_fps = (baselines.get("config1") or {}).get("fps") \
-        if (baselines.get("config1") or {}).get("ok") else None
-    if cpu_fps:
-        results["tflite_cpu_fps"] = round(cpu_fps, 2)
-
-    # Headline = the best config1 variant (plain stream / upload-overlap /
-    # dynbatch).  All three are the SAME streaming pipeline + semantics —
-    # upload overlaps the h2d transfer with dispatch, dynbatch coalesces a
-    # pile-up adaptively; the reference pipelines the same way with queues
-    # (r3 verdict #2: "drive the benched config through upload+dynbatch").
-    variants = {
-        "stream": results.get("config1_stream_fps"),
-        "upload": results.get("config1_upload_fps"),
-        "dynbatch": results.get("config1_dynbatch_fps"),
-        "dynbatch+upload": results.get("config1_dynupload_fps"),
-    }
-    best_variant, best_fps = None, None
-    for name, v in variants.items():
-        if v is not None and (best_fps is None or v > best_fps):
-            best_variant, best_fps = name, v
-    vs_baseline = vs["config1"]
-    if best_fps is not None:
-        tpu_fps = best_fps
-        results["headline_variant"] = best_variant
-        if cpu_fps:
-            # keep vs['config1'] the matched stream-vs-stream ratio; the
-            # best-of-variants headline gets its own labeled key
-            vs["config1_best"] = round(best_fps / cpu_fps, 2)
-            vs_baseline = vs["config1_best"]
-
-    if platform not in (None, "cpu"):
-        # on-accel but possibly under a sick wire: if a better accelerator
-        # run is cached (best-of, see save_tpu_cache), point at it so the
-        # final JSON the driver records never hides the round's best chip
-        # evidence behind one unlucky wire phase
-        cached = load_tpu_cache()
-        cres = (cached or {}).get("result") or {}
-        here = {"vs_baseline": vs_baseline,
-                "value": round(tpu_fps, 2) if tpu_fps else None}
-        # same rule the cache itself uses (better_run): ratio-less fast
-        # runs and ratioed runs must rank consistently with save_tpu_cache
-        if cached and not better_run(here, cres):
-            results["best_accelerator_run"] = {
-                "cached_at": cached.get("cached_at"),
-                "value": cres.get("value"),
-                "vs_baseline": cres.get("vs_baseline"),
-                "platform": cres.get("platform"),
-                "note": "a prior run this round scored higher (see "
-                        "BENCH_TPU_CACHE.json / BENCH_RUNS/); this run's "
-                        "wire was likely sicker — compare wire_health "
-                        "brackets",
+        if late in (None, "cpu"):
+            raise _Skipped("still no accelerator")
+        log("# accelerator reachable again — re-running accel legs")
+        env = {k: v for k, v in os.environ.items()
+               if k != "JAX_PLATFORMS"}  # don't inherit the CPU pin
+        child_budget = max(120.0, rep.remaining() - 30.0)
+        env.update(BENCH_NO_RETRY="1", BENCH_SKIP_BASELINES="1",
+                   BENCH_PROBE_RETRIES="1",
+                   BENCH_BUDGET_S=str(child_budget))
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)],
+            capture_output=True, text=True, timeout=child_budget + 480,
+            env=env,
+        )
+        child = json.loads(proc.stdout.strip().splitlines()[-1])
+        if child.get("platform") not in (None, "cpu", "cpu-fallback"):
+            child_extra = child.get("extra") or {}
+            # snapshot of the fallback run, minus its baselines copy
+            # (those rows are already present with the right stamp)
+            child_extra["cpu_fallback_run"] = {
+                k: v for k, v in results.items() if k != "baselines"
             }
-    if platform in (None, "cpu"):
-        cached = load_tpu_cache()
-        if cached is not None:
-            # current run had no accelerator: carry the best real-chip
-            # numbers on file (best-of cache, see save_tpu_cache) alongside
-            # (NOT replacing) this run's CPU measurements — added before
-            # write_notes so the evidence document shows it
-            carry = {
-                "cached_at": cached.get("cached_at"),
-                "value": (cached.get("result") or {}).get("value"),
-                "vs_baseline": (cached.get("result") or {}).get("vs_baseline"),
-                "platform": (cached.get("result") or {}).get("platform"),
-            }
-            cached_extra = (cached.get("result") or {}).get("extra") or {}
-            if "baselines" not in cached_extra:
-                # a cached run without the isolated-subprocess baselines
-                # computed its ratio against an in-process denominator —
-                # the discredited methodology (r3 verdict: round-2's
-                # 12.17x divided by an invalid 13.68 fps) — drop the ratio
-                # rather than let it be cited again
-                carry["vs_baseline"] = None
-                carry["note"] = (
-                    "cached ratio dropped: its baseline denominator was "
-                    "measured in-process beside a live PJRT client and is "
-                    "invalid; compare value against baselines.config1.fps"
-                )
-            results["best_accelerator_run"] = carry
+            rep.results = child_extra
+            rep.platform = child["platform"]
+            # the surviving parent errors describe the CPU run, not the
+            # adopted accelerator results — label them
+            rep.errors[:] = [
+                f"cpu-fallback run: {e}" for e in rep.errors
+                if not e.startswith("accelerator backend failed")
+            ]
+            if child.get("error"):
+                rep.errors.append(
+                    f"late-accel rerun: {child['error']}"[:400])
+        else:
+            errors.append(
+                "late-accel rerun attempted but the child also fell "
+                f"back (platform={child.get('platform')}); keeping "
+                "the CPU numbers"
+            )
 
-    try:
-        write_notes(results, platform, errors)
-    except Exception as exc:
-        errors.append(f"notes: {exc!r}"[:200])
-
-    results["measured_on"] = platform or "cpu-fallback"
-    variant_note = (
-        f", best variant: {results['headline_variant']}"
-        if results.get("headline_variant") else ""
-    )
-    out = {
-        "metric": "mobilenet_v2_224 image-labeling pipeline throughput "
-                  f"(tensor_filter invoke, streaming{variant_note})",
-        "value": round(tpu_fps, 2) if tpu_fps else None,
-        "unit": "frames/sec/chip",
-        "vs_baseline": vs_baseline,
-        "platform": platform or "cpu-fallback",
-        "extra": results,
+    # ---- the runner: value order, budget gates, snapshot after every leg.
+    # min_s is a rough floor — a leg isn't STARTED with less budget than
+    # that left (the watchdog covers overshoot mid-leg).
+    legs = [
+        ("config1 jax leg", leg_config1_stream, 0.0),
+        ("config1 upload leg", leg_config1_upload, 20.0),
+        ("config1 dynbatch leg", leg_config1_dynbatch, 20.0),
+        ("config1 dynupload leg", leg_config1_dynupload, 20.0),
+        ("config5 mux leg", leg_config5, 30.0),
+        ("config1 quant leg", leg_config1_quant, 20.0),
+        ("config2 ssd leg", leg_config2, 30.0),
+        ("config2c cascade leg", leg_config2c, 30.0),
+        ("config3 pose leg", leg_config3, 30.0),
+        ("config4 lstm leg", leg_config4, 15.0),
+        ("config4b seq leg", leg_config4b, 20.0),
+        ("config4c kvdecode leg", leg_config4c, 15.0),
+        # baselines BEFORE the diagnostics: on a fresh host (no cache to
+        # reuse) the judged vs_baseline ratio must outrank breakdown/MFU/
+        # pallas when the budget runs short (review r5)
+        ("baselines", leg_baselines, 15.0),
+        ("breakdown", leg_breakdown, 15.0),
+        ("mfu", leg_mfu, 30.0),
+        ("mfu_vit", leg_mfu_vit, 30.0),
+        ("pallas", leg_pallas, 15.0),
+        ("wire health end", leg_wire_end, 0.0),
+        ("late accel rerun", leg_late_reprobe, 60.0),
+    ]
+    legs_filter = {
+        v.strip() for v in os.environ.get("BENCH_LEGS", "").split(",")
+        if v.strip()
     }
-    if errors:
-        out["error"] = "; ".join(errors)
-    if platform not in (None, "cpu"):
-        save_tpu_cache(out)
-    print(json.dumps(out))
+    for label, fn, min_s in legs:
+        if legs_filter and label not in legs_filter:
+            log(f"# {label}: not in BENCH_LEGS filter; skipped")
+            continue
+        if rep.over_budget(label):
+            continue
+        if min_s and rep.remaining() < min_s:
+            errors.append(
+                f"{label}: skipped ({rep.remaining():.0f}s budget left, "
+                f"needs ~{min_s:g}s)")
+            continue
+        rep.current_leg = label
+        try:
+            fn()
+        except Exception as exc:
+            leg_error(errors, label, exc)
+        rep.snapshot()
+
+    rep.current_leg = "finalize"
+    out = rep.finalize()
+    rep.done = True
+    return out
 
 
 if __name__ == "__main__":
     try:
-        main()
+        main(standalone=True)
     except Exception as exc:  # never lose the round's evidence to an rc!=0
         print(json.dumps({
             "metric": "mobilenet_v2_224 image-labeling pipeline throughput",

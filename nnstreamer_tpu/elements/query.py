@@ -143,6 +143,7 @@ class QueryServer:
         port: int = 0,
         batch: int = 0,
         batch_window_ms: float = 2.0,
+        max_batch: int = 64,
     ):
         """``batch=K`` (K ≥ 2) turns on **cross-client batching**: requests
         from concurrent connections with the same tensor geometry coalesce
@@ -153,7 +154,21 @@ class QueryServer:
         the dispatcher waits up to ``batch_window_ms`` for stragglers, so
         a lone client pays at most that much extra latency.  Each
         connection has at most one request in flight (the client protocol
-        is synchronous), so per-client ordering is inherent."""
+        is synchronous), so per-client ordering is inherent.
+
+        ``max_batch`` caps the power-of-two padding bucket (the
+        ``tensor_dynbatch`` discipline): without it, requests already
+        carrying large leading dims could nearly double their rows in
+        padding waste (advisor r4).  A group whose total rows exceed the
+        cap dispatches unpadded at its exact size (one extra executable,
+        no waste).
+
+        Known limitation (advisor r4): groups dispatch inline on the single
+        dispatcher thread, so while one group's (possibly first-compile)
+        invoke runs, other specs' groups can sit past their
+        ``batch_window_ms`` deadline — a latency/fairness wart under
+        mixed-geometry load, not a correctness bug (ordering and replies
+        are per-connection regardless)."""
         self._framework = framework
         self._model = model
         self._custom = custom
@@ -170,6 +185,9 @@ class QueryServer:
         self.batch = int(batch)
         if self.batch == 1 or self.batch < 0:
             raise ValueError("batch must be 0 (off) or >= 2")
+        self.max_batch = int(max_batch)
+        if self.max_batch < 1:
+            raise ValueError("max_batch must be >= 1")
         self.batch_window_s = float(batch_window_ms) / 1e3
         self._rq: "Optional[queue.Queue]" = None
         self._dispatch_thread: Optional[threading.Thread] = None
@@ -341,10 +359,15 @@ class QueryServer:
                         )
                 rows.append(r)
             total = sum(rows)
-            # same power-of-two bucket discipline as tensor_dynbatch
+            # same power-of-two bucket discipline as tensor_dynbatch; a
+            # group past the cap dispatches at its exact size instead of
+            # padding toward the next power of two (advisor r4: an uncapped
+            # bucket can nearly double large requests in padding waste)
             from .dynbatch import _bucket
 
-            b = _bucket(total, 1 << 30)
+            b = _bucket(total, self.max_batch)
+            if b < total:
+                b = total
             cat = []
             for i in range(n_tensors):
                 parts = [np.asarray(g.tensors[i]) for g in group]

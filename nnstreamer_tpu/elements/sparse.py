@@ -165,6 +165,11 @@ class SparseDec(Node):
         if not empty:
             idx = np.asarray(frame.tensor(1))
             vals = np.asarray(frame.tensor(2))
+            if idx.size != vals.size:
+                raise ValueError(
+                    f"{self.name}: sparse frame has {idx.size} indices but "
+                    f"{vals.size} values (corrupt or truncated transport)"
+                )
             if idx.size and (idx.min() < 0 or idx.max() >= dense.size):
                 raise ValueError(
                     f"{self.name}: sparse indices out of range for shape "

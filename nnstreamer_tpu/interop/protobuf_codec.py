@@ -21,18 +21,26 @@ from . import tensor_frame_pb2 as pb
 _LITTLE = sys.byteorder == "little"
 
 
-def encode_frame(frame: Frame) -> bytes:
+def encode_frame(frame: Frame, names=None) -> bytes:
     """Serialize every tensor + timing into one ``TensorFrame`` message.
 
     Timing uses proto3 *optional presence*: an unstamped frame leaves the
     fields absent, so a cross-language producer that never sets pts (the
-    proto3 default) round-trips as "no timestamp" — NOT as t=0."""
+    proto3 default) round-trips as "no timestamp" — NOT as t=0.
+
+    Per-tensor names (the GstTensorInfo name analog) come from ``names``
+    (a sequence aligned with ``frame.tensors``) or, absent that, from
+    ``frame.meta["tensor_names"]`` — the key :func:`decode_frame` restores
+    them under, so names survive an encode→decode round trip (advisor r4:
+    the field existed in the schema but was silently dropped)."""
+    if names is None:
+        names = frame.meta.get("tensor_names") or ()
     msg = pb.TensorFrame()
     if frame.pts is not None and is_valid_ts(frame.pts):
         msg.pts = frame.pts
     if frame.duration is not None and is_valid_ts(frame.duration):
         msg.duration = frame.duration
-    for t in frame.tensors:
+    for i, t in enumerate(frame.tensors):
         # NOT ascontiguousarray unconditionally: it promotes 0-d scalars
         # to 1-d (the query-protocol gotcha, see the verify skill notes)
         arr = np.asarray(t)
@@ -41,6 +49,8 @@ def encode_frame(frame: Frame) -> bytes:
         if not _LITTLE and arr.dtype.itemsize > 1:  # pragma: no cover
             arr = arr.byteswap()
         entry = msg.tensors.add()
+        if i < len(names) and names[i]:
+            entry.name = str(names[i])
         entry.dtype = dtype_name(arr.dtype)
         entry.shape.extend(int(d) for d in arr.shape)
         entry.data = arr.tobytes()
@@ -67,8 +77,12 @@ def decode_frame(data: bytes) -> Frame:
         if not _LITTLE and dtype.itemsize > 1:  # pragma: no cover
             arr = arr.byteswap()
         tensors.append(arr.copy().reshape(shape))
+    meta = {}
+    if any(e.name for e in msg.tensors):
+        meta["tensor_names"] = tuple(e.name for e in msg.tensors)
     return Frame(
         tensors=tuple(tensors),
         pts=msg.pts if msg.HasField("pts") else NONE_TS,
         duration=msg.duration if msg.HasField("duration") else NONE_TS,
+        meta=meta,
     )

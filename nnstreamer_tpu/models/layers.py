@@ -109,14 +109,40 @@ def conv2d_int8(
     leaves; the int32 result rescales by ``act_scale * w_scale`` in the
     conv epilogue.  Grouped (depthwise) convs stay on the float path —
     they are bandwidth-bound (one MAC per weight) and gain nothing from
-    the MXU's int8 mode."""
+    the MXU's int8 mode.
+
+    When the param dict carries a calibrated ``act_scale`` (see
+    :func:`~nnstreamer_tpu.ops.quant.calibrate_static_scales`), the
+    quantize uses that FIXED scale instead: no max-reduce, purely
+    elementwise, fuses into the previous conv's epilogue — the round-5 fix
+    for the dynamic path's extra per-conv HBM passes that made int8 lose
+    to float end-to-end on chip.  A static per-tensor scale is batch-
+    composition-independent by construction."""
+    from ..ops import quant as quant_ops
     from ..ops.quant import QuantizedWeight, quantize_activations
 
     w = params["w"]
     assert isinstance(w, QuantizedWeight), "conv2d_int8 needs quantized weights"
-    # per-SAMPLE scales: batch composition must not change a frame's
-    # numerics (an outlier frame would otherwise coarsen everyone's scale)
-    q, s = quantize_activations(x, axes=tuple(range(1, x.ndim)))
+    act_scale = params.get("act_scale")
+    if quant_ops.is_calibrating():
+        # eager calibration pass: record the running max activation scale
+        # into the param dict (a float leaf), then fall through to the
+        # dynamic path so the forward still produces real outputs
+        amax = float(jnp.max(jnp.abs(x)))
+        prev = float(act_scale) if act_scale is not None else 0.0
+        params["act_scale"] = max(prev, amax / 127.0) or 1.0
+        act_scale = None
+    if act_scale is not None:
+        s = jnp.asarray(act_scale, jnp.float32)
+        q = quant_ops.quantize_static(x, s)
+        # s scalar; w.scale is (1,1,1,cout) for HWIO → (1,1,1,cout)
+        rescale = (s * w.scale.reshape(1, 1, 1, -1)).astype(jnp.float32)
+    else:
+        # per-SAMPLE scales: batch composition must not change a frame's
+        # numerics (an outlier frame would coarsen everyone's scale)
+        q, s = quantize_activations(x, axes=tuple(range(1, x.ndim)))
+        # s is (N,1,1,1); w.scale is (1,1,1,cout) for HWIO → (N,1,1,cout)
+        rescale = (s * w.scale.reshape(1, 1, 1, -1)).astype(jnp.float32)
     y = jax.lax.conv_general_dilated(
         q,
         w.q,
@@ -126,8 +152,6 @@ def conv2d_int8(
         preferred_element_type=jnp.int32,
     )
     out_dtype = dtype if dtype is not None else jnp.float32
-    # s is (N,1,1,1); w.scale is (1,1,1,cout) for HWIO → (N,1,1,cout)
-    rescale = (s * w.scale.reshape(1, 1, 1, -1)).astype(jnp.float32)
     return (y.astype(jnp.float32) * rescale).astype(out_dtype)
 
 

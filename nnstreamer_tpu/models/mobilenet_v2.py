@@ -164,13 +164,28 @@ def build_quantized(
     params: Optional[Params] = None,
     int8_head: bool = False,
     int8_convs: bool = False,
+    static_scales: bool = False,
+    calib_samples: int = 4,
+    calib_data=None,
 ) -> JaxModel:
     """Quantized stream-ready model (int8 weights, on-device dequant).
 
     - ``int8_convs=True``: the full-int8 path — every ungrouped conv runs
-      int8 x int8 → int32 on the MXU with dynamic activation scales (the
-      TPU-native analog of the reference's uint8-quant tflite flagship,
-      ``runTest.sh:30-38``; v5e int8 peak is 2x bf16).
+      int8 x int8 → int32 on the MXU (the TPU-native analog of the
+      reference's uint8-quant tflite flagship, ``runTest.sh:30-38``; v5e
+      int8 peak is 2x bf16).
+    - ``static_scales=True`` (with ``int8_convs``): activation scales are
+      CALIBRATED once at build time (eager forward on the CPU backend) and
+      baked as fixed per-conv scalars — the quantize becomes purely
+      elementwise and fuses into the previous conv's epilogue instead of
+      paying a per-conv max-reduce pass per frame (round-4's measured
+      reason int8 lost to float on chip; the reference's tflite flagship
+      bakes activation ranges at conversion time the same way).
+      ``calib_data`` supplies representative NORMALIZED input frames (an
+      iterable of ``(H, W, 3)`` float arrays) — with trained weights,
+      calibrate on real data: the default ``calib_samples`` uniform-noise
+      frames only bound the activations noise induces, and real-image
+      activations past the recorded max hard-clip at ±127·scale.
     - ``int8_head=True``: only the classifier matmul uses the Pallas int8
       kernel (the earlier, narrower variant).
     """
@@ -184,9 +199,28 @@ def build_quantized(
             return apply(p, x, dtype=dtype, int8=True)
     else:
         fwd = apply
+    qparams = quantize_params(m.params)
+    if static_scales and (int8_convs or int8_head):
+        from ..ops.quant import calibrate_static_scales
+
+        if calib_data is not None:
+            samples = [np.asarray(x, np.float32) for x in calib_data]
+            if not samples:
+                raise ValueError("calib_data is empty")
+        else:
+            rng = np.random.default_rng(seed + 1)
+            samples = [
+                rng.uniform(-1.0, 1.0, (image_size, image_size, 3))
+                .astype(np.float32)
+                for _ in range(max(1, calib_samples))
+            ]
+        calibrate_static_scales(
+            lambda p, x: apply(p, x, dtype=dtype, int8=True), qparams,
+            samples,
+        )
     return JaxModel(
         apply=lambda p, x: fwd(p, x, dtype=dtype),
-        params=quantize_params(m.params),
+        params=qparams,
         input_spec=m.input_spec,
         name=f"mobilenet_v2_q8_{width_mult}_{image_size}",
     )

@@ -15,6 +15,7 @@ The TPU-native equivalent implemented here:
 
 from __future__ import annotations
 
+from contextlib import contextmanager
 from dataclasses import dataclass
 from typing import Any
 
@@ -158,3 +159,67 @@ def quantize_activations(x, dtype=jnp.int8, axes=None):
     scale = jnp.where(amax > 0, amax / 127.0, 1.0).astype(jnp.float32)
     q = jnp.clip(jnp.round(x / scale), -127, 127).astype(dtype)
     return q, scale
+
+
+def quantize_static(x, scale, dtype=jnp.int8):
+    """Quantize with a FIXED (calibrated) scale.
+
+    Unlike :func:`quantize_activations`, there is no ``max(|x|)``
+    reduction: the op is purely elementwise, so XLA fuses it into the
+    producing conv's epilogue — zero extra HBM passes.  The dynamic
+    per-sample reduce was the measured reason the full-int8 tier lost to
+    float end-to-end on chip in round 4 (0.6x) despite the int8 kernels
+    themselves winning 3.56x: ~35 convs × (max-reduce pass + quantize
+    pass) of activation traffic per frame."""
+    return jnp.clip(jnp.round(x / scale), -127, 127).astype(dtype)
+
+
+# -- static-scale calibration (the reference's uint8 flagship uses fixed
+# scales the same way: tflite bakes activation ranges at conversion time,
+# ``tests/nnstreamer_filter_tensorflow_lite/runTest.sh:30-38``) -----------
+
+_CALIBRATING = False
+
+
+def is_calibrating() -> bool:
+    return _CALIBRATING
+
+
+@contextmanager
+def calibration():
+    """While active, int8 convs run their dynamic path EAGERLY and record
+    ``max(|activation|)/127`` into their own param dict as a float
+    ``act_scale`` leaf (max over all samples seen)."""
+    global _CALIBRATING
+    _CALIBRATING = True
+    try:
+        yield
+    finally:
+        _CALIBRATING = False
+
+
+def calibrate_static_scales(apply_fn, params, samples, device=None):
+    """Run ``apply_fn(params, x)`` eagerly over calibration ``samples``;
+    every int8 conv annotates its param dict with a static ``act_scale``.
+
+    Must run OUTSIDE jit (recording is a Python side effect).  Runs on the
+    CPU backend by default: eager per-op dispatch over a sick TPU tunnel
+    would cost minutes, and the recorded scales are values, not timings —
+    platform-independent."""
+    import jax
+
+    if device is None:
+        try:
+            device = jax.devices("cpu")[0]
+        except RuntimeError:
+            device = None  # no cpu backend registered: use the default
+    ctx = jax.default_device(device) if device is not None else None
+    with calibration():
+        if ctx is not None:
+            with ctx:
+                for x in samples:
+                    apply_fn(params, jnp.asarray(x))
+        else:
+            for x in samples:
+                apply_fn(params, jnp.asarray(x))
+    return params

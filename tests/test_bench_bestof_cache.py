@@ -97,4 +97,7 @@ def test_run_score_ordering(bench_mod):
     rs = bench_mod.run_score
     assert rs({"vs_baseline": 4.4, "value": 1.0}) > rs({"vs_baseline": 0.2, "value": 9e9})
     assert rs({"vs_baseline": None, "value": 5.0}) > rs({"vs_baseline": None, "value": 1.0})
-    assert rs({}) == (0.0, 0.0)
+    # a MEASURED zero outranks a missing value (advisor r4: None vs 0.0
+    # were conflated, misranking a genuinely-zero run against an errored one)
+    assert rs({"vs_baseline": 0.0, "value": 0.0}) > rs({})
+    assert rs({}) == (-1.0, -1.0)

@@ -97,3 +97,56 @@ def test_bench_failure_is_a_log_row_not_a_crash(watch, monkeypatch):
     result = cw.run_bench(budget_s=5)
     assert "error" in result
     assert log_records(tmp)[-1]["kind"] == "bench_ran"
+
+
+def test_quick_stage_passes_legs_filter(watch, monkeypatch):
+    """Stage 1 of the two-stage fire (r4 verdict 'next' #2): the quick
+    bench must restrict itself to the high-value legs via BENCH_LEGS."""
+    cw, tmp = watch
+    (tmp / "bench.py").write_text(
+        "import json, os\n"
+        "print(json.dumps({'platform': 'tpu', 'value': 1.0,"
+        " 'legs': os.environ.get('BENCH_LEGS', ''),"
+        " 'budget': os.environ.get('BENCH_BUDGET_S')}))\n")
+    monkeypatch.setattr(cw, "REPO", str(tmp))
+    quick = cw.run_bench(cw.QUICK_BUDGET_S, quick=True)
+    assert "config1 jax leg" in quick["legs"]
+    assert "config5 mux leg" in quick["legs"]
+    assert float(quick["budget"]) == cw.QUICK_BUDGET_S
+    full = cw.run_bench(budget_s=5)
+    assert full["legs"] == ""  # full run: no filter
+    recs = log_records(tmp)
+    stages = [r.get("stage") for r in recs if r["kind"] == "bench_ran"]
+    assert stages == ["quick", "full"]
+
+
+def test_run_bench_takes_last_parseable_line(watch, monkeypatch):
+    """bench.py streams partial snapshots; a kill mid-print leaves a
+    truncated tail line — the parser must fall back to the last COMPLETE
+    JSON line instead of failing the whole run."""
+    cw, tmp = watch
+    (tmp / "bench.py").write_text(
+        "import json\n"
+        "print(json.dumps({'platform': 'tpu', 'value': 7.0, 'partial': True}))\n"
+        "print('{\"platform\": \"tpu\", \"val')\n"  # truncated mid-write
+    )
+    monkeypatch.setattr(cw, "REPO", str(tmp))
+    result = cw.run_bench(budget_s=5)
+    assert result["value"] == 7.0
+
+
+def test_run_soak_logs_platform_and_summary(watch, monkeypatch):
+    cw, tmp = watch
+    (tmp / "tools").mkdir()
+    (tmp / "tools" / "soak_campaign.py").write_text(
+        "print('jax platform: tpu')\n"
+        "print('[0] run_linear seed=1 OK')\n"
+        "print('campaign done: 17 iterations, 0 failures')\n")
+    monkeypatch.setattr(cw, "REPO", str(tmp))
+    rec = cw.run_soak(minutes=0.01)
+    assert rec["platform"] == "tpu"
+    assert rec["summary"] == "campaign done: 17 iterations, 0 failures"
+    assert rec["rc"] == 0
+    assert (tmp / "SOAK_TPU_r05.log").exists()
+    kinds = [r["kind"] for r in log_records(tmp)]
+    assert kinds[-2:] == ["soak_started", "soak_ran"]

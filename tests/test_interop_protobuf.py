@@ -200,3 +200,25 @@ class TestPipelineRoundtrip:
         with pytest.raises(Exception, match="carries 2 tensors"):
             p.run(timeout=30)
 
+
+
+class TestTensorNames:
+    def test_names_roundtrip_via_meta(self, rng):
+        f = Frame(
+            tensors=(rng.standard_normal((2, 3)).astype(np.float32),
+                     np.arange(4, dtype=np.int64)),
+            meta={"tensor_names": ("boxes", "scores")},
+        )
+        g = decode_frame(encode_frame(f))
+        # advisor r4: Tensor.name existed in the schema but encode never
+        # wrote it and decode dropped it
+        assert g.meta["tensor_names"] == ("boxes", "scores")
+
+    def test_explicit_names_param_wins(self, rng):
+        f = Frame(tensors=(np.zeros((2,), np.float32),))
+        g = decode_frame(encode_frame(f, names=("logits",)))
+        assert g.meta["tensor_names"] == ("logits",)
+
+    def test_unnamed_frames_stay_unnamed(self):
+        g = decode_frame(encode_frame(Frame(tensors=(np.zeros(2, np.float32),))))
+        assert "tensor_names" not in g.meta

@@ -340,3 +340,104 @@ class TestTransformPallas:
             got = np.asarray(sink.frames[0].tensor(0))
             assert got.dtype == np.float32, accel
             np.testing.assert_allclose(got, x / 2.0)
+
+
+class TestStaticScales:
+    """Calibrated static activation scales (round-5: the fix for the
+    dynamic per-conv max-reduce that made int8 lose to float on chip)."""
+
+    @staticmethod
+    def _builds():
+        from nnstreamer_tpu.models import mobilenet_v2
+
+        kw = dict(num_classes=16, width_mult=0.35, image_size=32,
+                  dtype=jnp.float32)
+        f = mobilenet_v2.build(**kw)
+        qs = mobilenet_v2.build_quantized(**kw, int8_convs=True,
+                                          static_scales=True,
+                                          params=f.params)
+        return f, qs
+
+    def test_calibration_annotates_every_int8_conv(self):
+        _, qs = self._builds()
+        n = []
+
+        def walk(node):
+            if isinstance(node, dict):
+                if "act_scale" in node:
+                    n.append(node["act_scale"])
+                for v in node.values():
+                    walk(v)
+            elif isinstance(node, list):
+                for v in node:
+                    walk(v)
+
+        walk(qs.params)
+        # stem + every expand/project + head = all 35 ungrouped convs at
+        # width 0.35 (depthwise stays float, records nothing)
+        assert len(n) == 35
+        assert all(isinstance(s, float) and s > 0 for s in n)
+
+    def test_static_matches_float_and_kills_the_reduces(self):
+        import re
+
+        import jax
+
+        f, qs = self._builds()
+        x = np.random.default_rng(7).uniform(
+            -1, 1, (4, 32, 32, 3)).astype(np.float32)
+        lf = np.asarray(f.apply(f.params, x))
+        ls = np.asarray(qs.apply(qs.params, x))
+        corr = np.corrcoef(lf.ravel(), ls.ravel())[0, 1]
+        assert corr > 0.97, corr
+        assert (lf.argmax(1) == ls.argmax(1)).mean() >= 0.75
+        hlo = jax.jit(lambda a: qs.apply(qs.params, a)).lower(
+            jnp.asarray(x)).as_text()
+        # still genuinely int8 on the MXU...
+        int8_convs = re.findall(
+            r"stablehlo\.convolution[^\n]*xi8>[^\n]*->\s*tensor<[0-9x]*xi32>",
+            hlo)
+        assert len(int8_convs) >= 20, len(int8_convs)
+        # ...but with the per-conv max-reduces GONE: the only reduction
+        # left in the whole program is the global average pool (the
+        # dynamic path lowers 36 = 35 amax + 1 pool)
+        reduces = re.findall(r"stablehlo\.reduce\b", hlo)
+        assert len(reduces) <= 2, len(reduces)
+
+    def test_static_scale_is_batch_composition_independent(self):
+        """A fixed per-tensor scale cannot depend on batch peers — pin it
+        anyway (the property the dynamic path bought with per-sample
+        scales must survive the static swap)."""
+        _, qs = self._builds()
+        rng = np.random.default_rng(11)
+        x = rng.random((1, 32, 32, 3)).astype(np.float32)
+        outlier = rng.random((1, 32, 32, 3)).astype(np.float32) * 100.0
+        alone = np.asarray(qs.apply(qs.params, x))[0]
+        paired = np.asarray(
+            qs.apply(qs.params, np.concatenate([x, outlier])))[0]
+        np.testing.assert_allclose(paired, alone, rtol=1e-4, atol=1e-4)
+
+    def test_calib_data_drives_the_scales(self):
+        """Representative calibration data must actually set the recorded
+        scales (review r5: noise-only calibration under-bounds real
+        activations)."""
+        from nnstreamer_tpu.models import mobilenet_v2
+
+        kw = dict(num_classes=8, width_mult=0.35, image_size=32,
+                  dtype=jnp.float32)
+        f = mobilenet_v2.build(**kw)
+        big = [np.full((32, 32, 3), 50.0, np.float32)]
+        qs_small = mobilenet_v2.build_quantized(
+            **kw, int8_convs=True, static_scales=True, params=f.params)
+        qs_big = mobilenet_v2.build_quantized(
+            **kw, int8_convs=True, static_scales=True, params=f.params,
+            calib_data=big)
+        # the stem conv sees the raw input: its recorded scale must track
+        # the calibration data's magnitude (50 vs <=1)
+        s_small = qs_small.params["stem"]["conv"]["act_scale"]
+        s_big = qs_big.params["stem"]["conv"]["act_scale"]
+        assert s_big > s_small * 10
+        with pytest.raises(ValueError, match="empty"):
+            mobilenet_v2.build_quantized(
+                **kw, int8_convs=True, static_scales=True, params=f.params,
+                calib_data=[])

@@ -366,3 +366,39 @@ class TestCrossClientBatching:
     def test_batch_one_rejected(self):
         with pytest.raises(ValueError, match="batch"):
             QueryServer(framework="jax", model=self._poly_model(), batch=1)
+
+
+class TestBatchCap:
+    def test_oversize_group_dispatches_exact_and_stays_correct(self):
+        """max_batch caps the power-of-two padding bucket (advisor r4): a
+        request past the cap must dispatch at its exact size — still
+        correct, no near-double padding."""
+        model = JaxModel(
+            apply=lambda p, x: x * 2.0,
+            input_spec=TensorsSpec.of(
+                TensorSpec(dtype=np.float32, shape=(None, 4))),
+        )
+        with QueryServer(framework="jax", model=model, batch=2,
+                         batch_window_ms=1.0, max_batch=4) as srv:
+            got = []
+            frames = [np.arange(24, dtype=np.float32).reshape(6, 4) + i
+                      for i in range(3)]
+            p = Pipeline()
+            src = p.add(DataSrc(data=frames))
+            cli = p.add(TensorQueryClient(port=srv.port))
+            sink = p.add(TensorSink())
+            sink.connect("new-data",
+                         lambda f: got.append(np.asarray(f.tensor(0))))
+            p.link_chain(src, cli, sink)
+            p.run(timeout=120)
+        assert len(got) == 3
+        for i, a in enumerate(got):
+            np.testing.assert_allclose(
+                a, 2.0 * (np.arange(24, dtype=np.float32).reshape(6, 4) + i))
+
+    def test_max_batch_validation(self):
+        model = JaxModel(apply=lambda p, x: x,
+                         input_spec=TensorsSpec.of(
+                             TensorSpec(dtype=np.float32, shape=(None, 4))))
+        with pytest.raises(ValueError, match="max_batch"):
+            QueryServer(framework="jax", model=model, batch=2, max_batch=0)

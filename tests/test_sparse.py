@@ -155,3 +155,21 @@ class TestSparseRoundtrip:
         p.link_chain(src, enc, sink)
         with pytest.raises(Exception, match="per-tensor"):
             p.run(timeout=30)
+
+    def test_dec_rejects_index_value_length_mismatch(self):
+        """Advisor r4: a frame with len(indices) != len(values) must fail
+        with the element's contextual error, not a raw numpy broadcast
+        error from ``dense[idx] = vals``."""
+        from nnstreamer_tpu.elements.sparse import _DTYPE_CODE
+
+        header = np.array([0, _DTYPE_CODE["float32"], 6], np.int64)
+        bad = Frame(tensors=(header,
+                             np.array([0, 2], np.int64),        # 2 indices
+                             np.array([1.0], np.float32)))      # 1 value
+        p = Pipeline()
+        src = p.add(DataSrc(data=[bad]))
+        dec = p.add(make("tensor_sparse_dec"))
+        sink = p.add(TensorSink())
+        p.link_chain(src, dec, sink)
+        with pytest.raises(Exception, match="2 indices but 1 values"):
+            p.run(timeout=30)

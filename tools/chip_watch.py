@@ -27,7 +27,7 @@ import sys
 import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-LOG_PATH = os.path.join(REPO, "PROBE_LOG_r04.jsonl")
+LOG_PATH = os.path.join(REPO, "PROBE_LOG_r05.jsonl")
 DOCTOR = os.path.join(REPO, "tools", "tunnel_doctor.py")
 
 
@@ -53,19 +53,34 @@ def probe(timeout: float = 120.0) -> dict:
     return info
 
 
-BENCH_BUDGET_S = 2400.0  # bench.py budget; subprocess hard-timeout adds 600s
+BENCH_BUDGET_S = 1500.0  # full-bench budget; subprocess hard-timeout pads
+QUICK_BUDGET_S = 240.0   # stage-1 high-value bench on a fresh window
+SOAK_MINUTES = 8.0       # stage-3 on-chip soak (VERDICT r4 'next' #8)
+
+# Stage 1 of the two-stage fire (VERDICT r4 'next' #2): when a window
+# opens, land the HIGH-VALUE legs first — config1 variants (the ≥4x
+# headline), config5 (the north-star architecture), quant — in a short
+# budget-bound run, so even a minutes-long healthy phase yields the
+# headline before the full sweep risks eating the window.
+QUICK_LEGS = ",".join([
+    "config1 jax leg", "config1 upload leg", "config1 dynbatch leg",
+    "config1 dynupload leg", "config5 mux leg", "config1 quant leg",
+])
 
 
-def run_bench(budget_s: float = BENCH_BUDGET_S) -> dict:
-    """Full bench.py run; bench.py persists BENCH_TPU_CACHE.json itself when
+def run_bench(budget_s: float = BENCH_BUDGET_S, quick: bool = False) -> dict:
+    """One bench.py run; bench.py persists BENCH_TPU_CACHE.json itself when
     it lands on an accelerator (best-of: a sick-wire run cannot clobber a
-    healthy-wire result).  Baselines are reused from the cache when present
-    (same-host guard inside bench.py) so a short healthy-wire window is
-    spent on the accelerator legs, not on re-measuring the CPU stack.
-    Returns the parsed JSON line (or an error record); either way the probe
-    log records that a bench was attempted."""
-    append_log({"kind": "bench_started"})
+    healthy-wire result) and snapshots partial evidence after every leg.
+    Baselines are reused from the cache when present (same-host guard
+    inside bench.py) so a short healthy-wire window is spent on the
+    accelerator legs, not on re-measuring the CPU stack.  Returns the
+    parsed JSON line (or an error record); either way the probe log
+    records that a bench was attempted."""
+    append_log({"kind": "bench_started", "stage": "quick" if quick else "full"})
     env = {**os.environ, "BENCH_BUDGET_S": str(budget_s)}
+    if quick:
+        env["BENCH_LEGS"] = QUICK_LEGS
     cache = (os.environ.get("BENCH_TPU_CACHE_PATH")
              or os.path.join(REPO, "BENCH_TPU_CACHE.json"))
     if os.path.exists(cache):
@@ -73,22 +88,63 @@ def run_bench(budget_s: float = BENCH_BUDGET_S) -> dict:
     try:
         proc = subprocess.run(
             [sys.executable, os.path.join(REPO, "bench.py")],
-            capture_output=True, text=True, timeout=budget_s + 600,
+            capture_output=True, text=True, timeout=budget_s + 300,
             env=env,
             cwd=REPO,
         )
-        line = proc.stdout.strip().splitlines()[-1]
-        result = json.loads(line)
+        # last PARSEABLE line wins: bench.py streams partial snapshots and
+        # ends with the final result; a kill mid-print must not lose the run
+        result = None
+        for line in reversed(proc.stdout.strip().splitlines()):
+            try:
+                result = json.loads(line)
+                break
+            except ValueError:
+                continue
+        if result is None:
+            raise RuntimeError(f"no JSON in bench stdout (rc={proc.returncode})")
     except Exception as exc:  # noqa: BLE001
         result = {"error": f"bench run failed: {exc!r}"[:300]}
     append_log({
         "kind": "bench_ran",
+        "stage": "quick" if quick else "full",
         "platform": result.get("platform"),
         "value": result.get("value"),
         "vs_baseline": result.get("vs_baseline"),
         "error": (result.get("error") or "")[:200],
     })
     return result
+
+
+def run_soak(minutes: float = SOAK_MINUTES) -> dict:
+    """On-chip soak (stage 3): randomized pipeline campaign on the live
+    accelerator — the first hardware evidence that the *runtime* (not just
+    the kernels) behaves under PJRT.  CPU soak stands at ~312k iterations;
+    TPU soak had zero before round 5."""
+    append_log({"kind": "soak_started", "minutes": minutes})
+    rec = {"kind": "soak_ran"}
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "soak_campaign.py"),
+             "--minutes", str(minutes)],
+            capture_output=True, text=True, timeout=minutes * 60 + 600,
+            env=dict(os.environ), cwd=REPO,
+        )
+        out = proc.stdout
+        rec["rc"] = proc.returncode
+        for line in out.splitlines():
+            if line.startswith("jax platform:"):
+                rec["platform"] = line.split(":", 1)[1].strip()
+            if line.startswith("campaign done:"):
+                rec["summary"] = line.strip()
+        with open(os.path.join(REPO, "SOAK_TPU_r05.log"), "a") as f:
+            f.write(out)
+            if proc.stderr:
+                f.write("\n--- stderr ---\n" + proc.stderr[-20000:])
+    except Exception as exc:  # noqa: BLE001
+        rec["error"] = f"soak run failed: {exc!r}"[:300]
+    append_log(rec)
+    return rec
 
 
 def main() -> int:
@@ -121,13 +177,20 @@ def main() -> int:
             info = probe()
             print(json.dumps(info), flush=True)
             if info.get("state") in bench_states and args.bench:
-                # a bench holds the chip for up to ~budget+600s; don't start
-                # one that would run past the deadline (the whole point of
-                # the deadline is to leave the tunnel free after it)
-                if t_end and time.time() + BENCH_BUDGET_S + 600 > t_end:
+                # two-stage fire + soak; don't start work that would run
+                # past the deadline (the whole point of the deadline is to
+                # leave the tunnel free after it)
+                def fits(need_s):
+                    return not t_end or time.time() + need_s <= t_end
+                if not fits(QUICK_BUDGET_S + 300):
                     append_log({"kind": "bench_skipped_near_deadline"})
                 else:
-                    print(json.dumps(run_bench()), flush=True)
+                    print(json.dumps(run_bench(QUICK_BUDGET_S, quick=True)),
+                          flush=True)
+                    if fits(BENCH_BUDGET_S + 300):
+                        print(json.dumps(run_bench()), flush=True)
+                    if fits(SOAK_MINUTES * 60 + 600):
+                        print(json.dumps(run_soak()), flush=True)
             time.sleep(args.watch * 60)
 
     info = probe()

@@ -36,7 +36,88 @@ def rate(fn, n, drain=None):
     return n / dt, dt / n * 1e3
 
 
+def tiny_main(n=1000):
+    """Framework-overhead view: a near-zero-compute model makes the loop
+    time ≈ pure framework cost (graph hops + backend.invoke + dispatch),
+    the number VERDICT r4 'next' #3 bounds at ≤0.5 ms/frame.  Compute and
+    transfer are ~0 here, so every millisecond is ours."""
+    import numpy as np
+
+    from nnstreamer_tpu.backends.jax_backend import JaxBackend, JaxModel
+    from nnstreamer_tpu.spec import TensorSpec, TensorsSpec
+
+    model = JaxModel(
+        apply=lambda p, x: x.reshape(-1)[:8].astype(jnp.float32),
+        input_spec=TensorsSpec.of(
+            TensorSpec(dtype=np.uint8, shape=(224, 224, 3))),
+    )
+    img = np.random.default_rng(0).integers(0, 256, (224, 224, 3)).astype(np.uint8)
+    frames = [img.copy() for _ in range(n)]
+
+    fn = jax.jit(lambda x: x.reshape(-1)[:8].astype(jnp.float32))
+    fn(img.reshape(-1)).block_until_ready()
+    it = iter(frames)
+    fps, ms = rate(lambda: fn(next(it).reshape(-1)), n,
+                   drain=lambda o: o.block_until_ready())
+    print(f"t0) raw jit dispatch:       {ms:8.4f} ms  ({fps:8.1f}/s)")
+
+    be = JaxBackend()
+    be.open(model)
+    be.reconfigure(TensorsSpec.from_arrays((img,)))
+    be.invoke((img,))
+    it = iter(frames)
+    fps, ms = rate(lambda: be.invoke((next(it),)), n,
+                   drain=lambda o: o[0].block_until_ready())
+    print(f"t1) backend.invoke loop:    {ms:8.4f} ms  ({fps:8.1f}/s)")
+
+    import nnstreamer_tpu as nns
+    from nnstreamer_tpu.elements.filter import TensorFilter
+    from nnstreamer_tpu.elements.sink import TensorSink
+    from nnstreamer_tpu.elements.testsrc import DataSrc
+
+    state = {"first": None, "count": 0}
+
+    def cb(frame):
+        state["count"] += 1
+        if state["first"] is None:
+            state["first"] = time.perf_counter()
+
+    best = None
+    for _ in range(3):  # warm + take the best of three runs
+        state.update(first=None, count=0)
+        p = nns.Pipeline()
+        src = p.add(DataSrc(data=frames))
+        filt = p.add(TensorFilter(framework="jax", model=model))
+        sink = p.add(TensorSink(callback=cb))
+        p.link_chain(src, filt, sink)
+        p.run(timeout=300)
+        dt = (time.perf_counter() - state["first"]) / (state["count"] - 1) * 1e3
+        best = dt if best is None else min(best, dt)
+    print(f"t2) full pipeline/frame:    {best:8.4f} ms  ({1e3 / best:8.1f}/s)")
+    verdict = "PASS" if best <= 0.5 else "FAIL"
+    print(f"t3) framework overhead budget (<=0.5 ms/frame): {verdict}")
+
+    pr = cProfile.Profile()
+    state.update(first=None, count=0)
+    p = nns.Pipeline()
+    src = p.add(DataSrc(data=frames))
+    filt = p.add(TensorFilter(framework="jax", model=model))
+    sink = p.add(TensorSink(callback=cb))
+    p.link_chain(src, filt, sink)
+    pr.enable()
+    p.run(timeout=300)
+    pr.disable()
+    s = io.StringIO()
+    st = pstats.Stats(pr, stream=s)
+    st.sort_stats("tottime").print_stats(18)
+    print(s.getvalue())
+
+
 def main():
+    if "--tiny" in sys.argv:
+        args = [a for a in sys.argv[1:] if a != "--tiny"]
+        tiny_main(int(args[0]) if args else 1000)
+        return
     n = int(sys.argv[1]) if len(sys.argv) > 1 else 200
     from nnstreamer_tpu.models import mobilenet_v2
 

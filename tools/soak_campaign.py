@@ -583,6 +583,13 @@ def main():
         print("OK")
         return 0
 
+    try:  # stamp the platform: a TPU soak log must be provably TPU
+        import jax
+
+        print(f"jax platform: {jax.devices()[0].platform}", flush=True)
+    except Exception as exc:  # noqa: BLE001 — the soak itself still counts
+        print(f"jax platform: unavailable ({exc!r})", flush=True)
+
     t_end = time.time() + args.minutes * 60
     i = fails = 0
     base = int(time.time())
