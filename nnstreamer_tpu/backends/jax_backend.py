@@ -586,4 +586,10 @@ class JaxShardedBackend(JaxBackend):
         in_shardings = tuple(
             batch_sharding(self._mesh, r, axis) for r in ranks
         )
-        return jax.jit(fn, in_shardings=in_shardings)
+        kwargs = {}
+        if wire and self._donate_wire and jax.default_backend() != "cpu":
+            # same opt-in wire-input donation as the base backend (review
+            # r5: the override silently dropped it on the sharded path the
+            # bench enables it on)
+            kwargs["donate_argnums"] = tuple(range(len(ranks)))
+        return jax.jit(fn, in_shardings=in_shardings, **kwargs)

@@ -1,0 +1,30 @@
+#!/usr/bin/env python
+"""Validate a bench.py stdout capture against the driver contract.
+
+bench.py streams a partial JSON snapshot after every leg; the LAST stdout
+line must be the final (non-partial) result carrying the driver-contract
+keys.  Shared by tools/run_ci.sh and .github/workflows/ci.yml so the two
+CI surfaces cannot drift (review r5).
+
+Usage: python tools/check_bench_final.py <bench_stdout_file>
+"""
+
+import json
+import sys
+
+
+def check(path: str) -> dict:
+    with open(path) as f:
+        lines = [ln for ln in f.read().splitlines() if ln.strip()]
+    if not lines:
+        raise AssertionError("bench produced no stdout")
+    final = json.loads(lines[-1])
+    assert "partial" not in final, "last line must be the final result"
+    for key in ("metric", "value", "unit", "vs_baseline"):
+        assert key in final, f"missing driver-contract key {key!r}"
+    return final
+
+
+if __name__ == "__main__":
+    final = check(sys.argv[1])
+    print("bench smoke ok:", final["value"], final.get("vs_baseline"))

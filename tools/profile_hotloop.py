@@ -23,6 +23,13 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import numpy as np
 
 import jax
+
+if os.environ.get("JAX_PLATFORMS") == "cpu":
+    # under the axon sitecustomize the env var alone does NOT stop the
+    # accelerator plugin from dialing a (possibly wedged) tunnel at first
+    # backend use; only the config API pins CPU reliably
+    jax.config.update("jax_platforms", "cpu")
+
 import jax.numpy as jnp
 
 
@@ -91,6 +98,10 @@ def tiny_main(n=1000):
         sink = p.add(TensorSink(callback=cb))
         p.link_chain(src, filt, sink)
         p.run(timeout=300)
+        if state["first"] is None or state["count"] < 2:
+            raise RuntimeError(
+                f"pipeline delivered {state['count']} frames (need >= 2 "
+                "for a rate) — stalled, or run with a larger n")
         dt = (time.perf_counter() - state["first"]) / (state["count"] - 1) * 1e3
         best = dt if best is None else min(best, dt)
     print(f"t2) full pipeline/frame:    {best:8.4f} ms  ({1e3 / best:8.1f}/s)")

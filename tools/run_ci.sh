@@ -61,12 +61,16 @@ g.dryrun_multichip(8)
 print('dryrun OK')
 "
 
-run_step "Bench smoke (one JSON line, rc=0)" \
-  env BENCH_FRAMES=10 BENCH_QUANT_FRAMES=4 BENCH_BASELINE_FRAMES=3 \
-      BENCH_MUX_FRAMES=3 BENCH_MUX_STREAMS=2 BENCH_MUX_SWEEP=2 \
-      BENCH_SSD_FRAMES=3 BENCH_POSE_FRAMES=3 BENCH_LSTM_STEPS=10 \
-      BENCH_SEQ_WINDOWS=3 BENCH_MFU_BATCHES=8 BENCH_BREAKDOWN_FRAMES=6 \
-      BENCH_CASCADE_FRAMES=2 BENCH_PROBE_TIMEOUT=10 BENCH_NOTES_PATH=/tmp/ci_bench_notes.md \
-  python bench.py
+run_step "Bench smoke (final JSON line parses, rc=0)" \
+  bash -c '
+    env BENCH_FRAMES=10 BENCH_QUANT_FRAMES=4 BENCH_BASELINE_FRAMES=3 \
+        BENCH_MUX_FRAMES=3 BENCH_MUX_STREAMS=2 BENCH_MUX_SWEEP=2 \
+        BENCH_SSD_FRAMES=3 BENCH_POSE_FRAMES=3 BENCH_LSTM_STEPS=10 \
+        BENCH_SEQ_WINDOWS=3 BENCH_MFU_BATCHES=8 BENCH_BREAKDOWN_FRAMES=6 \
+        BENCH_CASCADE_FRAMES=2 BENCH_PROBE_TIMEOUT=10 BENCH_BUDGET_S=1200 \
+        BENCH_NOTES_PATH=/tmp/ci_bench_notes.md \
+        BENCH_PARTIAL_PATH=/tmp/ci_bench_partial.json \
+    python bench.py > /tmp/ci_bench_smoke.out \
+    && python tools/check_bench_final.py /tmp/ci_bench_smoke.out'
 
 echo "=== CI RESULT: PASS ===" | tee -a "$LOG"
