@@ -144,6 +144,7 @@ class TestCodegen:
         ("capture_replay.py", "capture_replay=OK"),
         ("train_stream.py", "train_stream OK"),
         ("offload_query.py", "batching=OK"),
+        ("continuous_batching.py", "continuous_batching=OK"),
     ],
 )
 def test_pipeline_demo_runs(script, expect):

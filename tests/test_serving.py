@@ -1,0 +1,256 @@
+"""Continuous-batching decode engine (`nnstreamer_tpu.serving`).
+
+Exactness is the whole contract: a stream served through the shared
+fixed-capacity batch — joining late, starving, sharing ticks with other
+streams — must produce the same tokens as running the single-stream
+decode cell alone (the config4c / repo-slot path)."""
+
+import threading
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from nnstreamer_tpu.models import transformer
+from nnstreamer_tpu.serving import ContinuousBatcher
+
+KW = dict(t_max=16, d_in=8, n_out=4, d_model=32, n_heads=4, n_layers=2)
+
+
+def single_stream_outputs(params, xs, window=False):
+    """Reference: the plain single-sequence decode_step loop."""
+    cache = transformer.init_decode_cache(
+        len(params["blocks"]), params["ln_f"]["scale"].shape[-1], KW["t_max"])
+    pos = jnp.zeros((1,), jnp.int32)
+    outs = []
+    for x in xs:
+        y, cache, pos = transformer.decode_step(
+            params, jnp.asarray(x), cache, pos, window=window)
+        outs.append(np.asarray(y))
+    return outs
+
+
+def stream_inputs(seed, n):
+    rng = np.random.default_rng(seed)
+    return [rng.standard_normal(KW["d_in"]).astype(np.float32)
+            for _ in range(n)]
+
+
+class TestExactness:
+    def test_staggered_joins_match_single_stream(self):
+        with ContinuousBatcher(capacity=3, **KW) as eng:
+            lengths = {0: 6, 1: 4, 2: 5}
+            streams = {k: stream_inputs(k, n) for k, n in lengths.items()}
+            got = {k: [] for k in streams}
+
+            s0 = eng.open_session()
+            for x in streams[0][:2]:      # stream 0 runs alone first
+                s0.feed(x)
+                got[0].append(s0.get(timeout=30))
+            s1 = eng.open_session()       # stream 1 joins mid-flight
+            for i in range(4):
+                if 2 + i < lengths[0]:
+                    s0.feed(streams[0][2 + i])
+                s1.feed(streams[1][i])
+                if 2 + i < lengths[0]:
+                    got[0].append(s0.get(timeout=30))
+                got[1].append(s1.get(timeout=30))
+            s2 = eng.open_session()       # stream 2 joins after 1 finished
+            s1.close()
+            for x in streams[2]:
+                s2.feed(x)
+                got[2].append(s2.get(timeout=30))
+            s0.close(), s2.close()
+            params = eng.params
+        for k, xs in streams.items():
+            want = single_stream_outputs(params, xs)
+            assert len(got[k]) == len(want)
+            for a, b in zip(got[k], want):
+                np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-5)
+
+    def test_starved_slot_state_is_untouched(self):
+        """A slot with no input this tick flows through the compiled step
+        but its cache/pos must come out unchanged (the gate select)."""
+        with ContinuousBatcher(capacity=2, **KW) as eng:
+            a, b = eng.open_session(), eng.open_session()
+            xa = stream_inputs(10, 5)
+            xb = stream_inputs(11, 2)
+            b.feed(xb[0])
+            got_b = [b.get(timeout=30)]
+            for x in xa:                  # b starves while a streams
+                a.feed(x)
+                a.get(timeout=30)
+            b.feed(xb[1])
+            got_b.append(b.get(timeout=30))
+            params = eng.params
+        want = single_stream_outputs(params, xb)
+        for g, w in zip(got_b, want):
+            np.testing.assert_allclose(g, w, rtol=1e-5, atol=1e-5)
+
+    def test_slot_reuse_has_no_state_leak(self):
+        with ContinuousBatcher(capacity=1, **KW) as eng:
+            first = eng.open_session()
+            for x in stream_inputs(20, 7):
+                first.feed(x)
+                first.get(timeout=30)
+            first.close()
+            second = eng.open_session()   # same slot, fresh stream
+            xs = stream_inputs(21, 4)
+            got = []
+            for x in xs:
+                second.feed(x)
+                got.append(second.get(timeout=30))
+            params = eng.params
+        want = single_stream_outputs(params, xs)
+        for g, w in zip(got, want):
+            np.testing.assert_allclose(g, w, rtol=1e-5, atol=1e-5)
+
+    def test_ring_window_streams_past_t_max(self):
+        with ContinuousBatcher(capacity=1, window=True, **KW) as eng:
+            s = eng.open_session()
+            xs = stream_inputs(30, KW["t_max"] + 5)
+            got = []
+            for x in xs:
+                s.feed(x)
+                got.append(s.get(timeout=30))
+            params = eng.params
+        assert all(np.isfinite(g).all() for g in got)
+        want = single_stream_outputs(params, xs, window=True)
+        np.testing.assert_allclose(got[-1], want[-1], rtol=1e-5, atol=1e-5)
+
+    def test_shares_params_with_the_cell_builder(self):
+        """One checkpoint serves both the repo-slot pipeline cell and the
+        batcher: passing build_decode_cell's params must reproduce it."""
+        cell = transformer.build_decode_cell(
+            t_max=KW["t_max"], d_in=KW["d_in"], n_out=KW["n_out"],
+            d_model=KW["d_model"], n_heads=KW["n_heads"],
+            n_layers=KW["n_layers"], seed=7,
+        )
+        with ContinuousBatcher(capacity=2, params=cell.params, **KW) as eng:
+            s = eng.open_session()
+            xs = stream_inputs(31, 3)
+            got = [s.get(timeout=30) for x in xs if s.feed(x) is None]
+        want = single_stream_outputs(cell.params, xs)
+        for g, w in zip(got, want):
+            np.testing.assert_allclose(g, w, rtol=1e-5, atol=1e-5)
+
+
+class TestLifecycle:
+    def test_capacity_blocks_then_frees(self):
+        with ContinuousBatcher(capacity=1, **KW) as eng:
+            a = eng.open_session()
+            with pytest.raises(TimeoutError, match="capacity 1"):
+                eng.open_session(timeout=0.05)
+            a.close()
+            b = eng.open_session(timeout=5)
+            assert b.slot == a.slot
+
+    def test_feed_validation(self):
+        with ContinuousBatcher(capacity=1, **KW) as eng:
+            s = eng.open_session()
+            with pytest.raises(ValueError, match="feed expects shape"):
+                s.feed(np.zeros(3, np.float32))
+            s.close()
+            with pytest.raises(RuntimeError, match="closed"):
+                s.feed(np.zeros(KW["d_in"], np.float32))
+
+    def test_stop_unblocks_waiters(self):
+        eng = ContinuousBatcher(capacity=1, **KW)
+        eng.open_session()
+        err = {}
+
+        def waiter():
+            try:
+                eng.open_session(timeout=10)
+            except Exception as exc:  # noqa: BLE001
+                err["e"] = exc
+
+        t = threading.Thread(target=waiter)
+        t.start()
+        eng.stop()
+        t.join(timeout=10)
+        assert isinstance(err.get("e"), RuntimeError)
+
+    def test_tick_batching_counters(self):
+        """Feeding every stream up front lets ticks coalesce: total steps
+        served is exact, and ticks must not exceed steps (batching can
+        only reduce dispatches)."""
+        with ContinuousBatcher(capacity=4, **KW) as eng:
+            sessions = [eng.open_session() for _ in range(4)]
+            n = 5
+            for k, s in enumerate(sessions):
+                for x in stream_inputs(40 + k, n):
+                    s.feed(x)
+            for s in sessions:
+                for _ in range(n):
+                    s.get(timeout=30)
+            assert eng.steps_total == 4 * n
+            assert eng.ticks <= eng.steps_total
+
+
+class TestRobustness:
+    def test_feed_copies_the_callers_buffer(self):
+        """A client legally reuses one buffer across feeds (feed returns
+        immediately); queued inputs must snapshot the value at feed time
+        (review r5: asarray aliased an already-float32 array)."""
+        with ContinuousBatcher(capacity=1, **KW) as eng:
+            s = eng.open_session()
+            buf = np.zeros(KW["d_in"], np.float32)
+            vals = []
+            for i in range(4):
+                buf[:] = float(i + 1)
+                vals.append(buf.copy())
+                s.feed(buf)          # same buffer object every time
+            got = [s.get(timeout=30) for _ in range(4)]
+            params = eng.params
+        want = single_stream_outputs(params, vals)
+        for g, w in zip(got, want):
+            np.testing.assert_allclose(g, w, rtol=1e-5, atol=1e-5)
+
+    def test_engine_failure_surfaces_to_clients(self):
+        eng = ContinuousBatcher(capacity=1, **KW)
+        try:
+            s = eng.open_session()
+
+            def boom(*a, **k):
+                raise RuntimeError("step exploded")
+
+            eng._step = boom
+            s.feed(np.zeros(KW["d_in"], np.float32))
+            with pytest.raises(RuntimeError, match="step exploded"):
+                s.get(timeout=30)
+            # subsequent feeds refuse loudly instead of queueing forever
+            with pytest.raises(RuntimeError, match="engine stopped"):
+                s.feed(np.zeros(KW["d_in"], np.float32))
+        finally:
+            eng.stop()
+
+    def test_stop_wakes_a_blocked_get(self):
+        eng = ContinuousBatcher(capacity=1, **KW)
+        s = eng.open_session()
+        err = {}
+
+        def waiter():
+            try:
+                s.get(timeout=60)    # nothing was fed: blocks on the queue
+            except Exception as exc:  # noqa: BLE001
+                err["e"] = exc
+
+        t = threading.Thread(target=waiter)
+        t.start()
+        import time
+
+        time.sleep(0.2)
+        eng.stop()
+        t.join(timeout=10)
+        assert isinstance(err.get("e"), RuntimeError)
+
+    def test_mismatched_checkpoint_rejected_at_build(self):
+        params = transformer.init_params(
+            __import__("jax").random.PRNGKey(0), KW["d_model"],
+            KW["n_heads"], KW["n_layers"], 4 * KW["d_model"],
+            KW["d_in"] // 2, KW["n_out"],
+        )
+        with pytest.raises(ValueError, match="params expect d_in"):
+            ContinuousBatcher(capacity=1, params=params, **KW)
