@@ -65,9 +65,32 @@ def main():
         print(f"stream {k}: {len(xs)} tokens exact")
 
     served, ticks = eng.steps_total, eng.ticks
-    eng.stop()
     print(f"served {served} steps in {ticks} compiled ticks "
           f"(batching ratio {served / max(1, ticks):.2f}x)")
+
+    # -- the same engine as a NETWORK service: one TCP connection = one
+    # decode session, speaking the stock tensor_query wire protocol, so a
+    # pipeline offloads its decode stream with the ordinary client element
+    from nnstreamer_tpu import Pipeline
+    from nnstreamer_tpu.elements.query import TensorQueryClient
+    from nnstreamer_tpu.elements.sink import TensorSink
+    from nnstreamer_tpu.elements.testsrc import DataSrc
+    from nnstreamer_tpu.serving import DecodeServer
+
+    with DecodeServer(eng) as srv:
+        got_tcp = []
+        p = Pipeline()
+        src = p.add(DataSrc(data=streams[0]))
+        cli = p.add(TensorQueryClient(port=srv.port))  # negotiates via probe
+        sink = p.add(TensorSink())
+        sink.connect("new-data",
+                     lambda f: got_tcp.append(np.asarray(f.tensor(0))))
+        p.link_chain(src, cli, sink)
+        p.run(timeout=300)
+    for a, b in zip(got_tcp, got[0]):
+        np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-5)
+    print(f"tcp offload: {len(got_tcp)} tokens exact")
+    eng.stop()
     print("continuous_batching=OK")
 
 

@@ -48,6 +48,11 @@ from ..spec import TensorSpec, TensorsSpec
 MAGIC = b"NNSQ"
 VERSION = 1
 ERR_SENTINEL = 0xFFFF
+# pts of the client's negotiation probe frame.  DISTINCT from NONE_TS (-1):
+# unstamped stream frames are legitimate, and a stateful server (the
+# serving.DecodeServer) must answer a probe without advancing its session —
+# it can only do that if probes are unambiguous on the wire.
+PROBE_PTS = -2
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
@@ -474,7 +479,7 @@ class TensorQueryClient(Node):
             zeros = tuple(
                 np.zeros(t.shape, t.dtype) for t in spec.tensors
             )
-            send_tensors(sock, zeros, -1)
+            send_tensors(sock, zeros, PROBE_PTS)
             outs, _ = recv_tensors(sock)
         except (OSError, RuntimeError) as exc:
             raise NegotiationError(

@@ -44,6 +44,7 @@ device state); a single session's ``feed``/``get`` pairs are ordered.
 from __future__ import annotations
 
 import queue
+import socket
 import threading
 from typing import Dict, Optional
 
@@ -86,20 +87,45 @@ class DecodeSession:
     def get(self, timeout: Optional[float] = None) -> np.ndarray:
         """Next output ((n_out,) float32), blocking up to ``timeout``.
         Raises RuntimeError (with the engine's failure attached, if any)
-        when the engine stops while this stream still waits."""
-        try:
-            out = self._q_out.get(timeout=timeout)
-        except queue.Empty:
-            raise TimeoutError(
-                f"no decode output within {timeout}s (stream starved?)"
-            ) from None
-        if out is _STOPPED:
-            err = self._engine._error
-            raise RuntimeError(
-                "engine stopped while this stream was waiting"
-                + (f" (engine failure: {err!r})" if err else "")
-            )
-        return out
+        when the engine stops — including for gets issued, or still
+        blocked, after the stop (liveness is re-checked while waiting, so
+        no waiter outlives the engine; review r5)."""
+        import time as _time
+
+        deadline = None if timeout is None else _time.monotonic() + timeout
+        while True:
+            try:
+                out = self._q_out.get_nowait()
+            except queue.Empty:
+                # already-computed outputs drain first (they precede the
+                # sentinel in the queue); only an EMPTY queue on a dead
+                # engine means nothing can ever arrive
+                if not self._engine._running:
+                    err = self._engine._error
+                    raise RuntimeError(
+                        "engine stopped"
+                        + (f" (engine failure: {err!r})" if err else "")
+                    ) from None
+                if deadline is None:
+                    wait = 0.1
+                else:
+                    wait = min(0.1, deadline - _time.monotonic())
+                    if wait <= 0:
+                        raise TimeoutError(
+                            f"no decode output within {timeout}s "
+                            "(stream starved?)") from None
+                try:
+                    out = self._q_out.get(timeout=wait)
+                except queue.Empty:
+                    continue  # re-check liveness/deadline (≤100 ms lag)
+            if out is _STOPPED:
+                self._q_out.put(_STOPPED)  # keep later gets loud too
+                err = self._engine._error
+                raise RuntimeError(
+                    "engine stopped while this stream was waiting"
+                    + (f" (engine failure: {err!r})" if err else "")
+                )
+            return out
 
     def close(self) -> None:
         """Release the slot (reusable by the next :meth:`ContinuousBatcher.
@@ -187,6 +213,16 @@ class ContinuousBatcher:
         self._caches = jnp.zeros(
             (self.capacity, n_layers_p, 2, t_max, d_model_p), dtype)
         self._poss = jnp.zeros((self.capacity, 1), jnp.int32)
+        # pay the XLA compile HERE, not on the first client's step: an
+        # all-gates-false tick touches no state (the where reselects) but
+        # builds the executable, so client-side step timeouts never race a
+        # multi-second first compile
+        ys, self._caches, self._poss = self._step(
+            jnp.zeros((self.capacity, d_in), jnp.float32),
+            self._caches, self._poss,
+            jnp.zeros((self.capacity,), bool),
+        )
+        jax.block_until_ready(ys)
 
         self._cv = threading.Condition()
         self._active: Dict[int, DecodeSession] = {}
@@ -321,3 +357,150 @@ class ContinuousBatcher:
                     sess._q_out.put(ys_np[slot].copy())
         except BaseException as exc:  # noqa: BLE001 — wake the waiters
             self._fail(exc)
+
+
+class DecodeServer:
+    """Continuous batching over TCP: **one connection = one decode
+    session** on a shared :class:`ContinuousBatcher`.
+
+    The wire protocol is the ``tensor_query`` framing
+    (:mod:`nnstreamer_tpu.elements.query` — raw endian-explicit bytes, no
+    pickle), so a pipeline offloads a decode stream with the stock client
+    element::
+
+        tensor_query_client host=... port=...   # out_spec=(n_out,) f32
+
+    Each connection streams synchronously (send one ``(d_in,)`` step,
+    receive one ``(n_out,)`` output — per-stream ordering is inherent);
+    CONCURRENT connections are what the engine coalesces into batched
+    ticks, so aggregate throughput scales with the number of live streams
+    up to ``capacity`` — continuous batching as a network service.
+
+    Negotiation: the stock client probes with a zero frame stamped
+    ``PROBE_PTS`` (a dedicated wire sentinel, distinct from the ``-1`` of
+    an unstamped stream frame).  Probes are answered with the output
+    geometry WITHOUT advancing decode state — any number of them (mid-
+    stream renegotiation included) is safe; every other frame, stamped or
+    not, is one decode step.  Passing ``out_spec=`` to the client skips
+    the probe entirely.
+    """
+
+    def __init__(self, engine: ContinuousBatcher, host: str = "127.0.0.1",
+                 port: int = 0, session_timeout: float = 30.0):
+        self.engine = engine
+        self.host, self.port = host, int(port)
+        self.session_timeout = float(session_timeout)
+        self._srv: Optional[socket.socket] = None
+        self._accept: Optional[threading.Thread] = None
+        self._running = False
+        self.connections = 0  # observability
+        # live client sockets: stop() must shut these down too — an idle
+        # client's _serve thread is parked in recv, and only unblocking it
+        # releases the session's capacity slot (review r5)
+        self._conns: set = set()
+        self._conns_lock = threading.Lock()
+
+    def start(self) -> "DecodeServer":
+        self._srv = socket.create_server((self.host, self.port))
+        self.port = self._srv.getsockname()[1]
+        self._running = True
+        self._accept = threading.Thread(
+            target=self._accept_loop, daemon=True, name="decode-server")
+        self._accept.start()
+        return self
+
+    def stop(self) -> None:
+        self._running = False
+        if self._srv is not None:
+            try:
+                # close() alone does not wake a blocked accept/recv
+                self._srv.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            self._srv.close()
+        with self._conns_lock:
+            conns = list(self._conns)
+        for conn in conns:
+            try:
+                conn.shutdown(socket.SHUT_RDWR)  # wakes the recv → finally
+            except OSError:
+                pass
+        if self._accept is not None:
+            self._accept.join(timeout=10)
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    def _accept_loop(self) -> None:
+        while self._running:
+            try:
+                conn, _ = self._srv.accept()
+            except OSError:
+                return  # stop() closed the listener
+            self.connections += 1
+            with self._conns_lock:
+                self._conns.add(conn)
+            threading.Thread(target=self._serve, args=(conn,),
+                             daemon=True).start()
+
+    def _serve(self, conn: socket.socket) -> None:
+        from .elements.query import (
+            PROBE_PTS,
+            recv_tensors,
+            send_error,
+            send_tensors,
+        )
+
+        sess: Optional[DecodeSession] = None
+        try:
+            while self._running:
+                try:
+                    tensors, pts = recv_tensors(conn)
+                except (ConnectionError, OSError):
+                    return  # client left: free the slot in finally
+                try:
+                    if len(tensors) != 1:
+                        raise ValueError(
+                            f"decode step takes 1 tensor, got {len(tensors)}")
+                    if pts == PROBE_PTS:
+                        # the stock client's negotiation probe: answer the
+                        # output geometry WITHOUT advancing decode state.
+                        # Validate the PROBE's geometry so a mismatched
+                        # client fails at configure time with a clear
+                        # message, not mid-stream (review r5).
+                        if tuple(tensors[0].shape) != (self.engine.d_in,):
+                            raise ValueError(
+                                f"decode server expects ({self.engine.d_in},)"
+                                f" float32 steps, got {tensors[0].shape}")
+                        send_tensors(
+                            conn,
+                            (np.zeros((self.engine.n_out,), np.float32),),
+                            pts)
+                        continue
+                    if sess is None:
+                        # lazy join: a probe-only connection never holds a
+                        # capacity slot
+                        sess = self.engine.open_session(
+                            timeout=self.session_timeout)
+                    sess.feed(tensors[0])
+                    y = sess.get(timeout=self.session_timeout)
+                    send_tensors(conn, (y,), pts)
+                except (ValueError, RuntimeError, TimeoutError) as exc:
+                    try:
+                        send_error(conn, f"decode server: {exc}")
+                    except OSError:
+                        return
+                    if isinstance(exc, (RuntimeError, TimeoutError)):
+                        return  # engine stopped / capacity timeout: drop
+        finally:
+            if sess is not None:
+                sess.close()
+            with self._conns_lock:
+                self._conns.discard(conn)
+            try:
+                conn.close()
+            except OSError:
+                pass

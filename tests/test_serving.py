@@ -254,3 +254,214 @@ class TestRobustness:
         )
         with pytest.raises(ValueError, match="params expect d_in"):
             ContinuousBatcher(capacity=1, params=params, **KW)
+
+
+class TestDecodeServer:
+    """TCP surface: one connection = one decode session; the stock
+    tensor_query_client element offloads a stream to it."""
+
+    @staticmethod
+    def _engine():
+        return ContinuousBatcher(capacity=2, **KW)
+
+    def test_pipeline_offload_matches_single_stream(self):
+        from nnstreamer_tpu import Pipeline
+        from nnstreamer_tpu.elements.query import TensorQueryClient
+        from nnstreamer_tpu.elements.sink import TensorSink
+        from nnstreamer_tpu.elements.testsrc import DataSrc
+        from nnstreamer_tpu.serving import DecodeServer
+        from nnstreamer_tpu.spec import TensorSpec, TensorsSpec
+
+        xs = stream_inputs(50, 5)
+        out_spec = TensorsSpec.of(
+            TensorSpec(dtype=np.float32, shape=(KW["n_out"],)))
+        with self._engine() as eng, DecodeServer(eng) as srv:
+            got = []
+            p = Pipeline()
+            src = p.add(DataSrc(data=xs))
+            cli = p.add(TensorQueryClient(port=srv.port, out_spec=out_spec))
+            sink = p.add(TensorSink())
+            sink.connect("new-data",
+                         lambda f: got.append(np.asarray(f.tensor(0))))
+            p.link_chain(src, cli, sink)
+            p.run(timeout=120)
+            params = eng.params
+        want = single_stream_outputs(params, xs)
+        assert len(got) == len(want)
+        for g, w in zip(got, want):
+            np.testing.assert_allclose(g, w, rtol=1e-5, atol=1e-5)
+
+    def test_probe_negotiation_does_not_step(self):
+        """Without out_spec the client probes with an unstamped zero frame:
+        the server must answer the geometry and NOT advance the session."""
+        from nnstreamer_tpu import Pipeline
+        from nnstreamer_tpu.elements.query import TensorQueryClient
+        from nnstreamer_tpu.elements.sink import TensorSink
+        from nnstreamer_tpu.elements.testsrc import DataSrc
+        from nnstreamer_tpu.serving import DecodeServer
+
+        xs = stream_inputs(51, 4)
+        with self._engine() as eng, DecodeServer(eng) as srv:
+            got = []
+            p = Pipeline()
+            src = p.add(DataSrc(data=xs))
+            cli = p.add(TensorQueryClient(port=srv.port))  # probes
+            sink = p.add(TensorSink())
+            sink.connect("new-data",
+                         lambda f: got.append(np.asarray(f.tensor(0))))
+            p.link_chain(src, cli, sink)
+            p.run(timeout=120)
+            params = eng.params
+        want = single_stream_outputs(params, xs)
+        for g, w in zip(got, want):
+            np.testing.assert_allclose(g, w, rtol=1e-5, atol=1e-5)
+
+    def test_concurrent_connections_share_the_batch(self):
+        from nnstreamer_tpu.elements.query import recv_tensors, send_tensors
+        from nnstreamer_tpu.serving import DecodeServer
+        import socket as socket_mod
+
+        with self._engine() as eng, DecodeServer(eng) as srv:
+            streams = {k: stream_inputs(60 + k, 6) for k in range(2)}
+            got = {k: [] for k in streams}
+
+            def client(k):
+                s = socket_mod.create_connection(("127.0.0.1", srv.port))
+                try:
+                    for i, x in enumerate(streams[k]):
+                        send_tensors(s, (x,), i)
+                        outs, pts = recv_tensors(s)
+                        assert pts == i
+                        got[k].append(outs[0])
+                finally:
+                    s.close()
+
+            ts = [threading.Thread(target=client, args=(k,)) for k in streams]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join(timeout=120)
+            params = eng.params
+        for k, xs in streams.items():
+            want = single_stream_outputs(params, xs)
+            for g, w in zip(got[k], want):
+                np.testing.assert_allclose(g, w, rtol=1e-5, atol=1e-5)
+
+    def test_probes_are_stateless_and_unstamped_frames_step(self):
+        """PROBE_PTS frames answer geometry without advancing; ordinary
+        unstamped (pts=-1) frames are real decode steps — the sentinel
+        keeps the two unambiguous on the wire."""
+        import socket as socket_mod
+
+        from nnstreamer_tpu.elements.query import (
+            PROBE_PTS,
+            recv_tensors,
+            send_tensors,
+        )
+        from nnstreamer_tpu.serving import DecodeServer
+
+        xs = stream_inputs(55, 3)
+        with self._engine() as eng, DecodeServer(eng) as srv:
+            s = socket_mod.create_connection(("127.0.0.1", srv.port))
+            try:
+                zero = np.zeros(KW["d_in"], np.float32)
+                send_tensors(s, (zero,), PROBE_PTS)   # probe
+                outs, _ = recv_tensors(s)
+                assert outs[0].shape == (KW["n_out"],)
+                got = []
+                for i, x in enumerate(xs):
+                    if i == 1:  # mid-stream re-probe must not step either
+                        send_tensors(s, (zero,), PROBE_PTS)
+                        recv_tensors(s)
+                    send_tensors(s, (x,), -1)          # unstamped = a step
+                    outs, _ = recv_tensors(s)
+                    got.append(outs[0])
+            finally:
+                s.close()
+            params = eng.params
+        want = single_stream_outputs(params, xs)
+        for g, w in zip(got, want):
+            np.testing.assert_allclose(g, w, rtol=1e-5, atol=1e-5)
+
+    def test_capacity_exhaustion_surfaces_as_protocol_error(self):
+        from nnstreamer_tpu.elements.query import recv_tensors, send_tensors
+        from nnstreamer_tpu.serving import DecodeServer
+        import socket as socket_mod
+
+        with ContinuousBatcher(capacity=1, **KW) as eng, \
+                DecodeServer(eng, session_timeout=0.2) as srv:
+            a = socket_mod.create_connection(("127.0.0.1", srv.port))
+            b = socket_mod.create_connection(("127.0.0.1", srv.port))
+            try:
+                x = np.zeros(KW["d_in"], np.float32)
+                send_tensors(a, (x,), 0)      # a holds the only slot
+                recv_tensors(a)
+                send_tensors(b, (x,), 0)
+                with pytest.raises(RuntimeError, match="no free slot"):
+                    recv_tensors(b)
+            finally:
+                a.close(), b.close()
+
+    def test_server_stop_releases_idle_clients_slots(self):
+        """An idle connection's serve thread parks in recv holding a slot;
+        stop() must shut the socket down so the slot frees (review r5)."""
+        import socket as socket_mod
+
+        from nnstreamer_tpu.elements.query import recv_tensors, send_tensors
+        from nnstreamer_tpu.serving import DecodeServer
+
+        eng = ContinuousBatcher(capacity=1, **KW)
+        try:
+            srv = DecodeServer(eng).start()
+            c = socket_mod.create_connection(("127.0.0.1", srv.port))
+            send_tensors(c, (np.zeros(KW["d_in"], np.float32),), 0)
+            recv_tensors(c)               # c now holds the only slot, idle
+            assert not eng._free
+            srv.stop()                    # must unblock c's serve thread
+            import time
+
+            deadline = time.time() + 10
+            while not eng._free and time.time() < deadline:
+                time.sleep(0.05)
+            assert eng._free, "slot not released by server stop"
+            c.close()
+        finally:
+            eng.stop()
+
+    def test_mismatched_client_fails_at_negotiation(self):
+        from nnstreamer_tpu import Pipeline
+        from nnstreamer_tpu.elements.query import TensorQueryClient
+        from nnstreamer_tpu.elements.sink import TensorSink
+        from nnstreamer_tpu.elements.testsrc import DataSrc
+        from nnstreamer_tpu.graph.pipeline import PipelineError
+        from nnstreamer_tpu.serving import DecodeServer
+
+        wrong = [np.zeros(KW["d_in"] * 2, np.float32)]
+        with self._engine() as eng, DecodeServer(eng) as srv:
+            p = Pipeline()
+            src = p.add(DataSrc(data=wrong))
+            cli = p.add(TensorQueryClient(port=srv.port))
+            sink = p.add(TensorSink())
+            p.link_chain(src, cli, sink)
+            with pytest.raises(Exception, match="expects \\(8,\\)"):
+                p.run(timeout=60)
+
+
+class TestStopDrain:
+    def test_gets_after_stop_raise_and_queued_outputs_drain(self):
+        """Pipelined feeds + stop: outputs computed before the stop drain
+        in order, then EVERY later get raises (not just the first —
+        review r5: a single sentinel used to strand the second waiter)."""
+        eng = ContinuousBatcher(capacity=1, **KW)
+        s = eng.open_session()
+        xs = stream_inputs(70, 3)
+        for x in xs:
+            s.feed(x)
+        got = [s.get(timeout=30) for _ in range(3)]  # all served
+        eng.stop()
+        for _ in range(3):  # every post-stop get is loud, forever
+            with pytest.raises(RuntimeError, match="engine stopped"):
+                s.get(timeout=5)
+        want = single_stream_outputs(eng.params, xs)
+        for g, w in zip(got, want):
+            np.testing.assert_allclose(g, w, rtol=1e-5, atol=1e-5)
