@@ -148,6 +148,13 @@ class ContinuousBatcher:
     build_decode_cell`; ``params`` overrides the random init (same pytree
     as the single-stream cell, so a checkpoint serves both).  ``window=True``
     gives every slot a ring cache (infinite streams at constant memory).
+
+    ``devices=N`` shards the SLOT axis over an ``N``-device mesh
+    (``jax.sharding``): each chip holds ``capacity/N`` slots' caches and
+    runs their steps; params replicate by closure; XLA places any
+    collectives on ICI.  Continuous batching across chips with the same
+    exactness contract — membership stays a gate vector, the per-tick
+    host traffic stays ``(S, d_in)`` in / ``(S, n_out)`` out.
     """
 
     def __init__(
@@ -163,6 +170,8 @@ class ContinuousBatcher:
         seed: int = 0,
         params=None,
         window: bool = False,
+        devices: Optional[int] = None,
+        axis: str = "dp",
     ):
         from .models import transformer
 
@@ -209,7 +218,30 @@ class ContinuousBatcher:
             )
 
         donate = (1,) if jax.default_backend() != "cpu" else ()
-        self._step = jax.jit(batched, donate_argnums=donate)
+        self.mesh = None
+        jit_kwargs = {}
+        if devices is not None:
+            from .parallel.mesh import batch_sharding, make_mesh
+
+            devices = int(devices)
+            if devices < 1:
+                raise ValueError(f"devices must be >= 1, got {devices}")
+            if self.capacity % devices:
+                raise ValueError(
+                    f"capacity {self.capacity} must divide evenly over "
+                    f"{devices} devices")
+            self.mesh = make_mesh((devices,), (axis,))
+            # slot axis sharded on every step operand; params replicate
+            # via closure capture.  The warmup call below places the
+            # zero-initialized state onto the mesh — no separate
+            # device_put needed.
+            jit_kwargs["in_shardings"] = (
+                batch_sharding(self.mesh, 2, axis),   # xs (S, d_in)
+                batch_sharding(self.mesh, 5, axis),   # caches (S, L, 2, T, d)
+                batch_sharding(self.mesh, 2, axis),   # poss (S, 1)
+                batch_sharding(self.mesh, 1, axis),   # gates (S,)
+            )
+        self._step = jax.jit(batched, donate_argnums=donate, **jit_kwargs)
         self._caches = jnp.zeros(
             (self.capacity, n_layers_p, 2, t_max, d_model_p), dtype)
         self._poss = jnp.zeros((self.capacity, 1), jnp.int32)

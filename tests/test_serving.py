@@ -465,3 +465,43 @@ class TestStopDrain:
         want = single_stream_outputs(eng.params, xs)
         for g, w in zip(got, want):
             np.testing.assert_allclose(g, w, rtol=1e-5, atol=1e-5)
+
+
+class TestShardedEngine:
+    """devices=N shards the slot axis over a mesh (virtual 8-dev CPU mesh
+    via conftest): exactness is unchanged and the cache batch really
+    carries the mesh sharding."""
+
+    def test_sharded_matches_single_stream(self):
+        import jax
+
+        if len(jax.devices()) < 8:
+            pytest.skip("needs the 8-device CPU mesh")
+        with ContinuousBatcher(capacity=8, devices=8, **KW) as eng:
+            from jax.sharding import NamedSharding
+
+            assert isinstance(eng._caches.sharding, NamedSharding)
+            assert eng._caches.sharding.mesh.shape["dp"] == 8
+            sessions = [eng.open_session() for _ in range(3)]
+            streams = [stream_inputs(80 + k, 4) for k in range(3)]
+            got = [[] for _ in streams]
+            for i in range(4):
+                for k, s in enumerate(sessions):
+                    s.feed(streams[k][i])
+                for k, s in enumerate(sessions):
+                    got[k].append(s.get(timeout=60))
+            params = eng.params
+        for k, xs in enumerate(streams):
+            want = single_stream_outputs(params, xs)
+            for g, w in zip(got[k], want):
+                np.testing.assert_allclose(g, w, rtol=1e-5, atol=1e-5)
+
+    def test_capacity_must_divide_devices(self):
+        with pytest.raises(ValueError, match="divide evenly"):
+            ContinuousBatcher(capacity=3, devices=2, **KW)
+
+    def test_devices_must_be_positive(self):
+        with pytest.raises(ValueError, match="devices must be >= 1"):
+            ContinuousBatcher(capacity=4, devices=0, **KW)
+        with pytest.raises(ValueError, match="devices must be >= 1"):
+            ContinuousBatcher(capacity=4, devices=-2, **KW)

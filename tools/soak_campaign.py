@@ -564,9 +564,62 @@ def run_sparse(rng):
         assert out.pts == f.pts
 
 
+def run_continuous_batching(rng):
+    """serving.ContinuousBatcher under randomized membership churn:
+    random capacity, random stream lengths, staggered joins/leaves/
+    starvation, occasional slot reuse — every stream's outputs must
+    match the single-sequence decode loop exactly."""
+    import jax.numpy as jnp
+
+    from nnstreamer_tpu.models import transformer
+    from nnstreamer_tpu.serving import ContinuousBatcher
+
+    kw = dict(t_max=12, d_in=4, n_out=3, d_model=16, n_heads=2, n_layers=1)
+    capacity = int(rng.integers(1, 5))
+    n_streams = int(rng.integers(1, capacity + 3))  # more streams than slots
+    lengths = [int(rng.integers(1, 9)) for _ in range(n_streams)]
+    streams = [
+        [rng.standard_normal(kw["d_in"]).astype(np.float32)
+         for _ in range(n)]
+        for n in lengths
+    ]
+    got = [[] for _ in streams]
+    with ContinuousBatcher(capacity=capacity, seed=int(rng.integers(4)),
+                           **kw) as eng:
+        pending = list(range(n_streams))
+        live = {}  # stream idx -> (session, iterator position)
+        while pending or live:
+            if pending and len(live) < capacity and rng.random() < 0.7:
+                k = pending.pop(0)
+                live[k] = (eng.open_session(timeout=30), 0)
+            if not live:
+                continue
+            # random live stream advances one step; others starve
+            k = list(live)[int(rng.integers(0, len(live)))]
+            sess, i = live[k]
+            sess.feed(streams[k][i])
+            got[k].append(sess.get(timeout=60))
+            if i + 1 >= lengths[k]:
+                sess.close()
+                del live[k]
+            else:
+                live[k] = (sess, i + 1)
+        params = eng.params
+    for k, xs in enumerate(streams):
+        cache = transformer.init_decode_cache(
+            kw["n_layers"], kw["d_model"], kw["t_max"])
+        pos = jnp.zeros((1,), np.int32)
+        for i, x in enumerate(xs):
+            y, cache, pos = transformer.decode_step(
+                params, jnp.asarray(x), cache, pos)
+            np.testing.assert_allclose(
+                got[k][i], np.asarray(y), rtol=1e-4, atol=1e-4)
+
+
 TEMPLATES = [run_linear, run_tee, run_mux, run_repo, run_trainer,
              run_renegotiation, run_valve_selector, run_interrupt,
-             run_query, run_tensor_if, run_crop, run_rate, run_sparse]
+             run_query, run_tensor_if, run_crop, run_rate, run_sparse,
+             run_continuous_batching]
 
 
 def main():
