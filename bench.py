@@ -362,11 +362,8 @@ def run_dynbatch_fps(frames, max_batch=8, upload=False, poly_model=None,
     and the warm backend is injected into the filter — which pile-ups
     occur mid-run is timing-dependent, and an in-run XLA compile would
     otherwise skew the measurement."""
-    import jax.numpy as jnp
-
     from nnstreamer_tpu import Pipeline
     from nnstreamer_tpu.backends.base import get_backend
-    from nnstreamer_tpu.backends.jax_backend import JaxModel
     from nnstreamer_tpu.elements.dynbatch import DynBatch, DynUnbatch
     from nnstreamer_tpu.elements.filter import TensorFilter
     from nnstreamer_tpu.elements.sink import TensorSink

@@ -1,0 +1,85 @@
+"""Frame / WireTensor API surface (`nnstreamer_tpu.buffer`) — the
+GstBuffer/GstMemory analog: payload tuple + timing + meta, plus the
+device-resident wire-layout wrapper's ndarray duck-typing."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from nnstreamer_tpu.buffer import NONE_TS, SECOND, Frame, WireTensor, is_valid_ts
+
+
+class TestFrame:
+    def test_of_and_accessors(self):
+        a, b = np.zeros((2, 3), np.float32), np.arange(4)
+        f = Frame.of(a, b, pts=5, duration=2, camera="left")
+        assert f.num_tensors == 2
+        assert f.tensor() is a and f.tensor(1) is b
+        assert f.meta == {"camera": "left"}
+        assert f.end_ts == 7
+
+    def test_end_ts_requires_both_stamps(self):
+        assert Frame.of(np.zeros(1), pts=5).end_ts == NONE_TS
+        assert Frame.of(np.zeros(1), duration=5).end_ts == NONE_TS
+
+    def test_list_tensors_coerce_to_tuple(self):
+        f = Frame(tensors=[np.zeros(1), np.ones(1)])
+        assert isinstance(f.tensors, tuple)
+
+    def test_with_tensors_preserves_then_overrides(self):
+        f = Frame.of(np.zeros(2), pts=10, duration=3, tag="x")
+        g = f.with_tensors((np.ones(2),))
+        assert g.pts == 10 and g.duration == 3 and g.meta == {"tag": "x"}
+        h = f.with_tensors((np.ones(2),), pts=99, meta={"tag": "y"})
+        assert h.pts == 99 and h.meta == {"tag": "y"}
+        # meta is copied, never shared
+        g.meta["tag"] = "mutated"
+        assert f.meta["tag"] == "x"
+
+    def test_to_host_materializes_device_arrays(self):
+        f = Frame.of(jnp.arange(6).reshape(2, 3))
+        g = f.to_host()
+        assert isinstance(g.tensor(0), np.ndarray)
+        np.testing.assert_array_equal(g.tensor(0), np.arange(6).reshape(2, 3))
+
+    def test_repr_shows_shapes_and_pts(self):
+        r = repr(Frame.of(np.zeros((2, 3), np.float32), pts=7))
+        assert "float32(2, 3)" in r and "pts=7" in r
+
+    def test_ts_helpers(self):
+        assert is_valid_ts(0) and is_valid_ts(SECOND)
+        assert not is_valid_ts(NONE_TS) and not is_valid_ts(None)
+
+
+class TestWireTensorDuckTyping:
+    @staticmethod
+    def _wt():
+        data = jnp.arange(12, dtype=jnp.float32)  # wire layout: flat
+        return WireTensor(data, shape=(3, 4), dtype=np.float32)
+
+    def test_geometry(self):
+        wt = self._wt()
+        assert wt.ndim == 2 and wt.size == 12 and len(wt) == 3
+        assert wt.nbytes == 48
+        assert repr(wt) == "WireTensor(float32(3, 4))"
+
+    def test_len_of_scalar_raises(self):
+        wt = WireTensor(jnp.zeros((1,)), shape=(), dtype=np.float32)
+        with pytest.raises(TypeError, match="unsized"):
+            len(wt)
+
+    def test_getitem_materializes_logical_layout(self):
+        wt = self._wt()
+        np.testing.assert_array_equal(
+            wt[1], np.arange(12, dtype=np.float32).reshape(3, 4)[1])
+
+    def test_array_copy_false_refuses(self):
+        with pytest.raises(ValueError, match="without a copy"):
+            np.asarray(self._wt(), copy=False)
+
+    def test_array_dtype_conversion(self):
+        out = np.asarray(self._wt()).astype(np.int32)
+        assert out.dtype == np.int32
+        out2 = self._wt().__array__(dtype=np.int32)
+        assert out2.dtype == np.int32

@@ -41,3 +41,51 @@ def test_parse_error_is_rc2():
     r = run_cli(["--platform", "cpu", "no_such_element ! tensor_sink"])
     assert r.returncode == 2
     assert "parse error" in r.stderr
+
+
+class TestInProcess:
+    """Same CLI surface driven in-process (main(argv)): behavior identical
+    to the subprocess tests above, and the suite's coverage actually sees
+    it (the module measured 0% because subprocesses are untraced)."""
+
+    def test_run_reports_frames_and_eos(self, capsys):
+        from nnstreamer_tpu.__main__ import main
+
+        pipe = ("videotestsrc num-buffers=4 width=16 height=16 ! "
+                "tensor_converter ! tensor_transform mode=arithmetic "
+                "option=typecast:float32,div:255.0 ! tensor_sink name=out")
+        assert main([pipe]) == 0
+        out = capsys.readouterr().out
+        assert "out: frame 4" in out
+        assert "EOS after" in out and "4 sink frames" in out
+
+    def test_quiet_suppresses_reports(self, capsys):
+        from nnstreamer_tpu.__main__ import main
+
+        assert main([PIPE, "--quiet"]) == 0
+        out = capsys.readouterr().out
+        assert "frame" not in out and "EOS" not in out
+
+    def test_parse_error_rc2(self, capsys):
+        from nnstreamer_tpu.__main__ import main
+
+        assert main(["no_such_element ! tensor_sink"]) == 2
+        assert "parse error" in capsys.readouterr().err
+
+    def test_dot_and_stats_on_success(self, tmp_path, capsys):
+        from nnstreamer_tpu.__main__ import main
+
+        dot = tmp_path / "g.dot"
+        assert main([PIPE, "--dot", str(dot), "--stats", "--quiet"]) == 0
+        out = capsys.readouterr().out
+        assert dot.exists()
+        assert "videotestsrc" in dot.read_text()
+        assert f"pipeline graph -> {dot}" in out
+
+    def test_unwritable_dot_fails_loud(self, tmp_path, capsys):
+        from nnstreamer_tpu.__main__ import main
+
+        rc = main([PIPE, "--quiet",
+                   "--dot", str(tmp_path / "nodir" / "g.dot")])
+        assert rc == 1
+        assert "dot dump failed" in capsys.readouterr().err

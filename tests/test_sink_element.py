@@ -1,0 +1,66 @@
+"""tensor_sink signal machinery: signal-rate throttling, stream-start/eos
+signals, collect mode, fakesink — the reference's app-facing sink contract
+(`tensor_sink/README.md:13-37`)."""
+
+import numpy as np
+
+import nnstreamer_tpu as nns
+from nnstreamer_tpu.elements.sink import TensorSink
+from nnstreamer_tpu.elements.testsrc import DataSrc
+
+
+def run_pipe(sink, n=10):
+    p = nns.Pipeline()
+    src = p.add(DataSrc(data=[np.full((4,), i, np.float32)
+                              for i in range(n)]))
+    p.add(sink)
+    p.link_chain(src, sink)
+    p.run(timeout=60)
+    return p
+
+
+def test_signal_rate_throttles_but_counts_all():
+    got = []
+    sink = TensorSink(signal_rate=1)  # 1 signal/sec: a burst emits ~1
+    sink.connect("new-data", lambda f: got.append(f))
+    run_pipe(sink, n=20)
+    assert sink.num_frames == 20       # every frame counted...
+    assert 1 <= len(got) < 20          # ...but signals throttled
+
+    unthrottled = []
+    sink2 = TensorSink(signal_rate=0)
+    sink2.connect("new-data", lambda f: unthrottled.append(f))
+    run_pipe(sink2, n=20)
+    assert len(unthrottled) == 20      # 0 = emit all (reference default)
+
+
+def test_eos_signal_and_wait():
+    fired = []
+    sink = TensorSink()
+    sink.connect("eos", lambda: fired.append(True))
+    run_pipe(sink, n=3)
+    assert fired == [True]
+    assert sink.wait_eos(timeout=5)
+
+
+def test_collect_mode_and_start_resets():
+    sink = TensorSink(collect=True)
+    run_pipe(sink, n=5)
+    assert sink.num_frames == 5 and len(sink.frames) == 5
+    assert float(np.asarray(sink.frames[3].tensor(0))[0]) == 3.0
+    assert sink.wait_eos(timeout=5)
+    # start() resets the collected state for a fresh run (the restart
+    # contract pipelines rely on)
+    sink.start()
+    assert sink.num_frames == 0 and sink.frames == []
+    assert not sink.wait_eos(timeout=0.01)
+
+
+def test_fakesink_counts_and_discards():
+    p = nns.Pipeline()
+    src = p.add(DataSrc(data=[np.zeros((2,), np.float32)] * 7))
+    sink = p.add(nns.make("fakesink"))
+    p.link_chain(src, sink)
+    p.run(timeout=60)
+    assert sink.num_frames == 7
+    assert not hasattr(sink, "frames") or not getattr(sink, "frames", [])
