@@ -68,6 +68,18 @@ def main():
     print(f"served {served} steps in {ticks} compiled ticks "
           f"(batching ratio {served / max(1, ticks):.2f}x)")
 
+    # -- prefill/decode split: a whole prompt in ONE compiled pass, then
+    # decode continues from its KV state — identical to stepping it
+    with eng.open_session() as sess:
+        sess.prefill(np.stack(streams[0][:3]))
+        y_prompt = sess.get(timeout=120)
+        np.testing.assert_allclose(y_prompt, got[0][2], rtol=1e-5, atol=1e-5)
+        sess.feed(streams[0][3])
+        np.testing.assert_allclose(sess.get(timeout=120), got[0][3],
+                                   rtol=1e-5, atol=1e-5)
+    print(f"prefill: 3-token prompt in one pass "
+          f"({eng.prefill_tokens} prompt tokens absorbed), continuation exact")
+
     # -- the same engine as a NETWORK service: one TCP connection = one
     # decode session, speaking the stock tensor_query wire protocol, so a
     # pipeline offloads its decode stream with the ordinary client element

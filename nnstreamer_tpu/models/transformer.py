@@ -348,6 +348,79 @@ def init_decode_cache(n_layers: int, d_model: int, t_max: int,
     return jnp.zeros((n_layers, 2, t_max, d_model), dtype)
 
 
+def prefill(params: Params, xs, t_max: int, n_valid=None,
+            dtype=jnp.float32):
+    """Process a whole ``(T, d_in)`` prompt in ONE causal pass and return
+    ``(y_last, cache, pos)`` — continuation state for :func:`decode_step`.
+
+    The serving-engine prefill/decode split (Orca/vLLM discipline): a
+    T-token prompt costs one compiled program instead of T per-token
+    ticks, and the matmuls run at sequence arithmetic intensity instead
+    of batch-1.  Numerically equivalent to stepping :func:`decode_step`
+    over the prompt — pinned by tests.
+
+    ``n_valid`` (int32 scalar, default T) supports LENGTH BUCKETING: pad
+    the prompt to a bucketed T, pass the real length, and compile once
+    per bucket instead of once per length.  Rows past ``n_valid`` are
+    masked out of the attention AND zeroed in the returned cache, and
+    ``y_last``/``pos`` come from the real length, so padding is
+    invisible to the continuation.
+
+    Same restrictions as :func:`decode_step`: no MoE blocks; T must be
+    ≤ ``t_max`` (the ring-window case is covered because positions
+    0..T-1 map to slots 0..T-1 while T ≤ t_max).
+    """
+    if any("moe" in blk for blk in params["blocks"]):
+        raise NotImplementedError(
+            "prefill does not support MoE blocks (capacity semantics are "
+            "sequence-level relative to the FULL batch); use the dense-FFN "
+            "encoder for decode"
+        )
+    t = xs.shape[0]
+    if t > t_max:
+        raise ValueError(f"prompt length {t} exceeds cache t_max {t_max}")
+    if n_valid is None:
+        n_valid = t
+    n_valid = jnp.asarray(n_valid, jnp.int32)
+    h = params["n_heads"]
+    y = _proj(params["embed"], xs.astype(dtype), dtype)  # (T, d)
+    pe = params.get("pos_embed")
+    if pe is not None:
+        y = y + pe[:t].astype(dtype)
+    d = y.shape[-1]
+    tok = jnp.arange(t)
+    valid = tok < n_valid                                 # (T,)
+    new_cache = []
+    for blk in params["blocks"]:
+        z = _layernorm(blk["ln1"], y[None])[0]
+        qkv = _proj(blk["qkv"], z, dtype)
+        q, k, v = jnp.split(qkv, 3, axis=-1)              # (T, d) each
+        # padded rows must be invisible to the continuation: zero them in
+        # the cache (decode_step's live mask only excludes idx > pos, and
+        # pos == n_valid overwrites exactly one of them)
+        kz = jnp.where(valid[:, None], k, 0.0)
+        vz = jnp.where(valid[:, None], v, 0.0)
+        ck = jnp.zeros((t_max, d), dtype).at[:t].set(kz)
+        cv = jnp.zeros((t_max, d), dtype).at[:t].set(vz)
+        new_cache.append(jnp.stack([ck, cv]))
+        qh = q.reshape(t, h, d // h)
+        kh = k.reshape(t, h, d // h)
+        vh = v.reshape(t, h, d // h)
+        s = jnp.einsum("qhd,khd->hqk", qh, kh) * (d // h) ** -0.5
+        causal = tok[None, :, None] >= tok[None, None, :]  # q >= k
+        mask = causal & valid[None, None, :]
+        s = jnp.where(mask, s, -jnp.inf)
+        w = jax.nn.softmax(s, axis=-1)
+        o = jnp.einsum("hqk,khd->qhd", w, vh).reshape(t, d)
+        y = y + _proj(blk["proj"], o, dtype)
+        y = _ffn_residual(blk, y[None], dtype)[0]
+    y = _layernorm(params["ln_f"], y[None])[0]
+    out = _proj(params["head"], y, dtype).astype(jnp.float32)  # (T, n_out)
+    y_last = jnp.take(out, n_valid - 1, axis=0)
+    cache = jnp.stack(new_cache)
+    return y_last, cache, n_valid.reshape(1)
+
+
 def build_decode_cell(
     t_max: int = 128,
     d_in: int = 64,

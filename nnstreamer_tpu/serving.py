@@ -84,6 +84,37 @@ class DecodeSession:
         self._q_in.put(x)
         self._engine._kick()
 
+    def prefill(self, xs) -> None:
+        """Queue a whole ``(T, d_in)`` prompt as ONE compiled causal pass
+        (the Orca/vLLM prefill/decode split): the slot's cache/pos are
+        REPLACED by the prompt's continuation state, so call it first —
+        or mid-stream to restart the context.  Exactly one output (the
+        last prompt token's) arrives via :meth:`get`; subsequent
+        :meth:`feed` steps continue from position T.  Prompt lengths pad
+        to power-of-two buckets (compile once per bucket; padding is
+        masked out of attention and cache)."""
+        if self.closed:
+            raise RuntimeError("session closed")
+        self._engine._check_alive()
+        xs = np.array(xs, np.float32)
+        eng = self._engine
+        if xs.ndim != 2 or xs.shape[1] != eng.d_in or xs.shape[0] < 1:
+            raise ValueError(
+                f"prefill expects shape (T, {eng.d_in}) with T >= 1, "
+                f"got {xs.shape}")
+        if xs.shape[0] > eng.t_max:
+            raise ValueError(
+                f"prompt length {xs.shape[0]} exceeds cache t_max "
+                f"{eng.t_max}")
+        tb = 1
+        while tb < xs.shape[0]:
+            tb <<= 1
+        tb = min(tb, eng.t_max)
+        padded = np.zeros((tb, eng.d_in), np.float32)
+        padded[:xs.shape[0]] = xs
+        self._q_in.put(("prefill", padded, int(xs.shape[0])))
+        eng._kick()
+
     def get(self, timeout: Optional[float] = None) -> np.ndarray:
         """Next output ((n_out,) float32), blocking up to ``timeout``.
         Raises RuntimeError (with the engine's failure attached, if any)
@@ -256,6 +287,8 @@ class ContinuousBatcher:
         )
         jax.block_until_ready(ys)
 
+        self._dtype = dtype
+        self._prefill_fns: Dict[int, object] = {}  # bucket T -> jitted
         self._cv = threading.Condition()
         self._active: Dict[int, DecodeSession] = {}
         self._free = list(range(self.capacity - 1, -1, -1))  # pop() -> slot 0 first
@@ -264,6 +297,7 @@ class ContinuousBatcher:
         self._error: Optional[BaseException] = None
         self.ticks = 0          # compiled steps dispatched
         self.steps_total = 0    # per-stream steps served
+        self.prefill_tokens = 0  # prompt tokens absorbed via prefill
         self._thread = threading.Thread(
             target=self._loop, daemon=True, name="continuous-batcher")
         self._thread.start()
@@ -337,10 +371,25 @@ class ContinuousBatcher:
                 self._free.append(sess.slot)
                 self._cv.notify_all()
 
+    def _prefill_fn(self, tb: int):
+        """Jitted prefill for bucket length ``tb`` (compiled once)."""
+        fn = self._prefill_fns.get(tb)
+        if fn is None:
+            from .models import transformer
+
+            params, t_max, dtype = self.params, self.t_max, self._dtype
+
+            def run(xp, n):
+                return transformer.prefill(params, xp, t_max, n, dtype=dtype)
+
+            fn = jax.jit(run)
+            self._prefill_fns[tb] = fn
+        return fn
+
     def _gather(self):
         """Under the lock: apply pending slot resets, collect at most one
-        queued input per active session.  Returns (xs, gates, fed) or None
-        when idle."""
+        queued item per active session (a decode step or a prefill
+        marker).  Returns (xs, gates, fed, prefills) or None when idle."""
         for slot in self._resets:
             # join-time state reset, serialized with stepping (no cross-
             # thread mutation of the device arrays)
@@ -349,20 +398,24 @@ class ContinuousBatcher:
         self._resets.clear()
         xs = gates = None
         fed = {}
+        prefills = []
         for slot, sess in self._active.items():
             try:
-                x = sess._q_in.get_nowait()
+                item = sess._q_in.get_nowait()
             except queue.Empty:
+                continue
+            if isinstance(item, tuple) and item[0] == "prefill":
+                prefills.append((slot, sess, item[1], item[2]))
                 continue
             if xs is None:
                 xs = np.zeros((self.capacity, self.d_in), np.float32)
                 gates = np.zeros((self.capacity,), bool)
-            xs[slot] = x
+            xs[slot] = item
             gates[slot] = True
             fed[slot] = sess
-        if not fed:
+        if not fed and not prefills:
             return None
-        return xs, gates, fed
+        return xs, gates, fed, prefills
 
     def _loop(self) -> None:
         try:
@@ -376,17 +429,52 @@ class ContinuousBatcher:
                         batch = self._gather()
                     if batch is None and not self._running:
                         return
-                    xs, gates, fed = batch
+                    xs, gates, fed, prefills = batch
+                # Dispatches (and any first-bucket prefill COMPILE) run
+                # OUTSIDE the lock: the device state is engine-thread-
+                # exclusive, and holding _cv through a multi-second XLA
+                # compile would block feed/open_session/stop and time out
+                # other sessions' waiters (review r5).
+                pre_out = []
+                for slot, sess, xp, n in prefills:
+                    # prefill replaces the slot's continuation state:
+                    # one compiled causal pass per (bucketed) prompt
+                    y_last, cache, pos = self._prefill_fn(xp.shape[0])(
+                        jnp.asarray(xp), jnp.int32(n))
+                    cache = cache.astype(self._caches.dtype)
+                    if self.mesh is not None:
+                        # the jitted prefill commits to the default device;
+                        # replicate over the mesh so the slot update
+                        # composes with the sharded state (review r5)
+                        from .parallel.mesh import replicated
+
+                        cache = jax.device_put(cache, replicated(self.mesh))
+                        pos = jax.device_put(pos, replicated(self.mesh))
+                    self._caches = self._caches.at[slot].set(cache)
+                    self._poss = self._poss.at[slot].set(pos)
+                    pre_out.append((sess, y_last, n))
+                if fed:
                     ys, self._caches, self._poss = self._step(
                         jnp.asarray(xs), self._caches, self._poss,
                         jnp.asarray(gates),
                     )
-                ys_np = np.asarray(ys)  # sync outside the state handoff
-                self.ticks += 1
-                self.steps_total += len(fed)
-                for slot, sess in fed.items():
+                else:
+                    ys = None
+                for sess, y_last, n in pre_out:
+                    # a prefill is one compiled dispatch serving one
+                    # output: counters stay consistent with sess.steps
+                    self.prefill_tokens += n
+                    self.ticks += 1
+                    self.steps_total += 1
                     sess.steps += 1
-                    sess._q_out.put(ys_np[slot].copy())
+                    sess._q_out.put(np.asarray(y_last).copy())
+                if ys is not None:
+                    ys_np = np.asarray(ys)  # sync outside the state handoff
+                    self.ticks += 1
+                    self.steps_total += len(fed)
+                    for slot, sess in fed.items():
+                        sess.steps += 1
+                        sess._q_out.put(ys_np[slot].copy())
         except BaseException as exc:  # noqa: BLE001 — wake the waiters
             self._fail(exc)
 
@@ -497,16 +585,21 @@ class DecodeServer:
                     if len(tensors) != 1:
                         raise ValueError(
                             f"decode step takes 1 tensor, got {len(tensors)}")
+                    shp = tuple(tensors[0].shape)
+                    is_step = shp == (self.engine.d_in,)
+                    is_prompt = (len(shp) == 2 and shp[1] == self.engine.d_in
+                                 and 1 <= shp[0] <= self.engine.t_max)
                     if pts == PROBE_PTS:
                         # the stock client's negotiation probe: answer the
                         # output geometry WITHOUT advancing decode state.
                         # Validate the PROBE's geometry so a mismatched
                         # client fails at configure time with a clear
                         # message, not mid-stream (review r5).
-                        if tuple(tensors[0].shape) != (self.engine.d_in,):
+                        if not (is_step or is_prompt):
                             raise ValueError(
                                 f"decode server expects ({self.engine.d_in},)"
-                                f" float32 steps, got {tensors[0].shape}")
+                                f" steps or (T, {self.engine.d_in}) prompts,"
+                                f" got {shp}")
                         send_tensors(
                             conn,
                             (np.zeros((self.engine.n_out,), np.float32),),
@@ -517,7 +610,14 @@ class DecodeServer:
                         # capacity slot
                         sess = self.engine.open_session(
                             timeout=self.session_timeout)
-                    sess.feed(tensors[0])
+                    if tensors[0].ndim == 2:
+                        # rank-2 frame = a whole prompt: ONE compiled
+                        # prefill pass builds the slot's KV state (an
+                        # over-length prompt gets prefill's specific
+                        # t_max error, not a generic shape complaint)
+                        sess.prefill(tensors[0])
+                    else:
+                        sess.feed(tensors[0])
                     y = sess.get(timeout=self.session_timeout)
                     send_tensors(conn, (y,), pts)
                 except (ValueError, RuntimeError, TimeoutError) as exc:

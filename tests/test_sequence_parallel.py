@@ -394,3 +394,78 @@ class TestSlidingWindowDecode:
             transformer.decode_step(
                 params, jnp.zeros((4,), jnp.float32), cache,
                 jnp.zeros((1,), jnp.int32), window=True)
+
+
+class TestPrefill:
+    """transformer.prefill: a whole prompt in one causal pass, returning
+    continuation state bit-compatible with decode_step's (the serving
+    engine's prefill/decode split rides this)."""
+
+    @staticmethod
+    def _setup(t_max=16, d_in=6, n_out=5, d_model=16):
+        import jax
+
+        from nnstreamer_tpu.models import transformer
+
+        params = transformer.init_params(
+            jax.random.PRNGKey(4), d_model, 2, 2, 32, d_in, n_out)
+        return transformer, params, t_max
+
+    def _stepwise(self, tr, params, xs, t_max, d_model=16):
+        import jax.numpy as jnp
+
+        cache = tr.init_decode_cache(2, d_model, t_max)
+        pos = jnp.zeros((1,), jnp.int32)
+        ys = []
+        for x in xs:
+            y, cache, pos = tr.decode_step(params, jnp.asarray(x), cache, pos)
+            ys.append(np.asarray(y))
+        return ys, cache, pos
+
+    def test_matches_stepwise_state_exactly(self):
+        import jax.numpy as jnp
+
+        tr, params, t_max = self._setup()
+        xs = np.random.default_rng(5).standard_normal((7, 6)).astype(np.float32)
+        ys, cache, pos = self._stepwise(tr, params, xs, t_max)
+        y2, cache2, pos2 = tr.prefill(params, jnp.asarray(xs), t_max)
+        np.testing.assert_allclose(np.asarray(y2), ys[-1], rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(cache2), np.asarray(cache),
+                                   rtol=1e-5, atol=1e-5)
+        assert int(pos2[0]) == int(pos[0]) == 7
+
+    def test_bucketed_padding_is_invisible(self):
+        import jax.numpy as jnp
+
+        tr, params, t_max = self._setup()
+        xs = np.random.default_rng(6).standard_normal((5, 6)).astype(np.float32)
+        ys, cache, pos = self._stepwise(tr, params, xs, t_max)
+        pad = np.zeros((8, 6), np.float32)
+        pad[:5] = xs
+        y2, cache2, pos2 = tr.prefill(params, jnp.asarray(pad), t_max,
+                                      n_valid=5)
+        np.testing.assert_allclose(np.asarray(y2), ys[-1], rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(cache2), np.asarray(cache),
+                                   rtol=1e-5, atol=1e-5)
+        # continuation from the bucketed state == all-stepwise
+        more = np.random.default_rng(7).standard_normal((3, 6)).astype(np.float32)
+        ca, pa, cb, pb = cache, pos, cache2, pos2
+        for x in more:
+            ya, ca, pa = tr.decode_step(params, jnp.asarray(x), ca, pa)
+            yb, cb, pb = tr.decode_step(params, jnp.asarray(x), cb, pb)
+            np.testing.assert_allclose(np.asarray(ya), np.asarray(yb),
+                                       rtol=1e-5, atol=1e-5)
+
+    def test_rejects_overflow_and_moe(self):
+        import jax
+        import jax.numpy as jnp
+
+        import pytest
+
+        tr, params, t_max = self._setup()
+        with pytest.raises(ValueError, match="exceeds cache t_max"):
+            tr.prefill(params, jnp.zeros((t_max + 1, 6)), t_max)
+        moe_params = tr.init_params(
+            jax.random.PRNGKey(8), 16, 2, 1, 32, 6, 5, moe_experts=2)
+        with pytest.raises(NotImplementedError, match="MoE"):
+            tr.prefill(moe_params, jnp.zeros((4, 6)), t_max)

@@ -505,3 +505,149 @@ class TestShardedEngine:
             ContinuousBatcher(capacity=4, devices=0, **KW)
         with pytest.raises(ValueError, match="devices must be >= 1"):
             ContinuousBatcher(capacity=4, devices=-2, **KW)
+
+
+class TestPrefill:
+    """The prefill/decode split: a (T, d_in) prompt is ONE compiled causal
+    pass whose continuation state is indistinguishable from stepping."""
+
+    def test_prefill_then_decode_matches_all_stepwise(self):
+        xs = stream_inputs(90, 9)
+        with ContinuousBatcher(capacity=2, **KW) as eng:
+            s = eng.open_session()
+            s.prefill(np.stack(xs[:5]))
+            got = [s.get(timeout=30)]          # last prompt token's output
+            for x in xs[5:]:
+                s.feed(x)
+                got.append(s.get(timeout=30))
+            assert eng.prefill_tokens == 5
+            params = eng.params
+        want = single_stream_outputs(params, xs)
+        np.testing.assert_allclose(got[0], want[4], rtol=1e-5, atol=1e-5)
+        for g, w in zip(got[1:], want[5:]):
+            np.testing.assert_allclose(g, w, rtol=1e-5, atol=1e-5)
+
+    def test_prompt_lengths_bucket_and_stay_exact(self):
+        """Lengths pad to power-of-two buckets: 3 and 5 both compile the
+        4/8 buckets; the padding must be invisible to the outputs."""
+        with ContinuousBatcher(capacity=2, **KW) as eng:
+            for n in (3, 5, 8):
+                xs = stream_inputs(91 + n, n)
+                s = eng.open_session()
+                s.prefill(np.stack(xs))
+                got = s.get(timeout=30)
+                s.close()
+                want = single_stream_outputs(eng.params, xs)
+                np.testing.assert_allclose(got, want[-1], rtol=1e-5,
+                                           atol=1e-5)
+            # 3 and 5 share nothing; buckets compiled: 4, 8
+            assert sorted(eng._prefill_fns) == [4, 8]
+
+    def test_midstream_prefill_restarts_the_context(self):
+        with ContinuousBatcher(capacity=1, **KW) as eng:
+            s = eng.open_session()
+            for x in stream_inputs(95, 6):     # old context
+                s.feed(x)
+                s.get(timeout=30)
+            fresh = stream_inputs(96, 4)
+            s.prefill(np.stack(fresh[:2]))     # restart with a new prompt
+            got = [s.get(timeout=30)]
+            for x in fresh[2:]:
+                s.feed(x)
+                got.append(s.get(timeout=30))
+            params = eng.params
+        want = single_stream_outputs(params, fresh)
+        np.testing.assert_allclose(got[0], want[1], rtol=1e-5, atol=1e-5)
+        for g, w in zip(got[1:], want[2:]):
+            np.testing.assert_allclose(g, w, rtol=1e-5, atol=1e-5)
+
+    def test_prefill_validation(self):
+        with ContinuousBatcher(capacity=1, **KW) as eng:
+            s = eng.open_session()
+            with pytest.raises(ValueError, match="prefill expects"):
+                s.prefill(np.zeros((3, KW["d_in"] + 1), np.float32))
+            with pytest.raises(ValueError, match="exceeds cache t_max"):
+                s.prefill(np.zeros((KW["t_max"] + 1, KW["d_in"]),
+                                   np.float32))
+
+    def test_tcp_prompt_frame_prefills(self):
+        import socket as socket_mod
+
+        from nnstreamer_tpu.elements.query import recv_tensors, send_tensors
+        from nnstreamer_tpu.serving import DecodeServer
+
+        xs = stream_inputs(97, 6)
+        with ContinuousBatcher(capacity=2, **KW) as eng, \
+                DecodeServer(eng) as srv:
+            c = socket_mod.create_connection(("127.0.0.1", srv.port))
+            try:
+                send_tensors(c, (np.stack(xs[:4]),), 0)   # rank-2 = prompt
+                outs, _ = recv_tensors(c)
+                got = [outs[0]]
+                for i, x in enumerate(xs[4:]):
+                    send_tensors(c, (x,), i + 1)
+                    outs, _ = recv_tensors(c)
+                    got.append(outs[0])
+            finally:
+                c.close()
+            params = eng.params
+        want = single_stream_outputs(params, xs)
+        np.testing.assert_allclose(got[0], want[3], rtol=1e-5, atol=1e-5)
+        for g, w in zip(got[1:], want[4:]):
+            np.testing.assert_allclose(g, w, rtol=1e-5, atol=1e-5)
+
+    def test_prefill_on_the_sharded_engine(self):
+        """Prefill must compose with devices=N: the jitted prefill commits
+        to one device while the state is mesh-sharded (review r5 crash)."""
+        import jax
+
+        if len(jax.devices()) < 8:
+            pytest.skip("needs the 8-device CPU mesh")
+        xs = stream_inputs(98, 6)
+        with ContinuousBatcher(capacity=8, devices=8, **KW) as eng:
+            s = eng.open_session()
+            s.prefill(np.stack(xs[:4]))
+            got = [s.get(timeout=60)]
+            for x in xs[4:]:
+                s.feed(x)
+                got.append(s.get(timeout=60))
+            params = eng.params
+        want = single_stream_outputs(params, xs)
+        np.testing.assert_allclose(got[0], want[3], rtol=1e-5, atol=1e-5)
+        for g, w in zip(got[1:], want[4:]):
+            np.testing.assert_allclose(g, w, rtol=1e-5, atol=1e-5)
+
+    def test_probe_rejects_overlong_prompt_geometry(self):
+        import socket as socket_mod
+
+        from nnstreamer_tpu.elements.query import (
+            PROBE_PTS,
+            recv_tensors,
+            send_tensors,
+        )
+        from nnstreamer_tpu.serving import DecodeServer
+
+        with ContinuousBatcher(capacity=1, **KW) as eng, \
+                DecodeServer(eng) as srv:
+            c = socket_mod.create_connection(("127.0.0.1", srv.port))
+            try:
+                bad = np.zeros((KW["t_max"] + 4, KW["d_in"]), np.float32)
+                send_tensors(c, (bad,), PROBE_PTS)
+                with pytest.raises(RuntimeError, match="decode server"):
+                    recv_tensors(c)   # negotiation-time rejection
+            finally:
+                c.close()
+
+    def test_counters_consistent_across_prefill_and_steps(self):
+        with ContinuousBatcher(capacity=2, **KW) as eng:
+            a, b = eng.open_session(), eng.open_session()
+            a.prefill(np.stack(stream_inputs(99, 3)))
+            a.get(timeout=30)
+            for x in stream_inputs(100, 2):
+                for s in (a, b):
+                    s.feed(x)
+                for s in (a, b):
+                    s.get(timeout=30)
+            # steps_total == sum of per-session outputs served
+            assert eng.steps_total == a.steps + b.steps == 5
+            assert eng.prefill_tokens == 3
