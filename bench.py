@@ -2009,6 +2009,41 @@ def main(standalone=False):
             results["config4d_vs_single_stream"] = round(cb_fps / single, 2)
         log(f"# config4d continuous batching: {cb_fps:.2f} steps/s "
             f"aggregate (capacity {cap}, {cb_ticks} ticks)")
+        rep.snapshot()
+        # prefill half of the split: T context tokens in ONE causal pass
+        # vs T dispatch-bound decode ticks on the SAME cell (config4c is
+        # the stepwise denominator)
+        if not rep.over_budget("config4d prefill"):
+            import jax as _jax
+            import jax.numpy as _jnp
+
+            from nnstreamer_tpu.models import transformer as _tr
+
+            t_pf = n_cb  # already clamped to < t_max above
+            # the SAME cell as config4c/4d by construction: take the
+            # params from the shared builder, not re-derived literals
+            cell = _tr.build_decode_cell(
+                t_max=128, d_in=64, n_out=16, d_model=256, n_heads=8,
+                n_layers=2)
+            params4 = cell.params
+            pf = _jax.jit(lambda xp, n: _tr.prefill(params4, xp, 128, n))
+            xp = _jnp.asarray(np.random.default_rng(5).standard_normal(
+                (128, 64)).astype(np.float32))
+            nv = _jnp.int32(t_pf)
+            _jax.block_until_ready(pf(xp, nv))  # compile outside timing
+            reps = []
+            for _ in range(3):
+                t0 = time.perf_counter()
+                _jax.block_until_ready(pf(xp, nv))
+                reps.append(time.perf_counter() - t0)
+            pf_tps = t_pf / min(reps)
+            results["config4d_prefill_tokens_per_sec"] = round(pf_tps, 1)
+            results["config4d_prefill_tokens"] = t_pf
+            if single:
+                results["config4d_prefill_vs_stepwise"] = round(
+                    pf_tps / single, 2)
+            log(f"# config4d prefill: {pf_tps:.1f} context tokens/s "
+                f"(one pass, T={t_pf})")
 
     # -- config #4b: windowed sequence LSTM (lax.scan) ----------------------
     # The TPU-native recurrence: tensor_aggregator windows → ONE compiled
