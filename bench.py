@@ -48,7 +48,10 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 import numpy as np  # noqa: E402
 
 NORMALIZE = "typecast:float32,add:-127.5,div:127.5"
-PROBE_TIMEOUT = float(os.environ.get("BENCH_PROBE_TIMEOUT", "120"))
+# 90 s covers a sick-but-alive tunnel's init (healthy ≈ 5-15 s); a WEDGED
+# tunnel hangs the full timeout per attempt, and probing must not eat the
+# run's whole BENCH_BUDGET_S (two attempts + pause ≈ 195 s of 480)
+PROBE_TIMEOUT = float(os.environ.get("BENCH_PROBE_TIMEOUT", "90"))
 
 
 def log(*args):
@@ -561,7 +564,14 @@ def run_lstm_recurrence_fps(steps, hidden=64, framework="jax", model=None,
     return run(steps)
 
 
-def run_kvdecode_fps(steps, t_max=128, d_model=256, n_layers=2):
+# THE decode cell for configs 4c/4d (stepwise, continuous batching, and
+# prefill all measure this exact model — one definition so their ratios
+# can never silently compare different shapes)
+DECODE_CELL = dict(t_max=128, d_in=64, n_out=16, d_model=256, n_heads=8,
+                   n_layers=2)
+
+
+def run_kvdecode_fps(steps, cell_kw=None):
     """Config #4c: transformer KV-cache decode cell through repo slots
     (models/transformer.py decode_step — the transformer-era analog of the
     reference's repo-LSTM, ``tests/nnstreamer_repo_lstm/runTest.sh:10-22``).
@@ -578,11 +588,10 @@ def run_kvdecode_fps(steps, t_max=128, d_model=256, n_layers=2):
     from nnstreamer_tpu.models import transformer
     from nnstreamer_tpu.spec import TensorSpec, TensorsSpec
 
-    d_in, n_out = 64, 16
-    model = transformer.build_decode_cell(
-        t_max=t_max, d_in=d_in, n_out=n_out, d_model=d_model,
-        n_heads=8, n_layers=n_layers,
-    )
+    kw = {**DECODE_CELL, **(cell_kw or {})}
+    t_max, d_model, n_layers = kw["t_max"], kw["d_model"], kw["n_layers"]
+    d_in, n_out = kw["d_in"], kw["n_out"]
+    model = transformer.build_decode_cell(**kw)
     cache_spec = TensorsSpec(tensors=(
         TensorSpec(dtype=np.float32, shape=(n_layers, 2, t_max, d_model)),))
     pos_spec = TensorsSpec(tensors=(TensorSpec(dtype=np.int32, shape=(1,)),))
@@ -631,21 +640,19 @@ def run_kvdecode_fps(steps, t_max=128, d_model=256, n_layers=2):
     return run(steps)
 
 
-def run_contbatch_fps(steps, capacity=8, t_max=128, d_model=256, n_layers=2):
+def run_contbatch_fps(steps, capacity=8, cell_kw=None):
     """Config #4d: continuous batching (nnstreamer_tpu.serving) — the same
-    transformer decode cell as config4c, but ``capacity`` independent
-    streams share ONE compiled step per tick.  Aggregate steps/sec: the
-    batch multiplies MXU arithmetic intensity at the same per-tick
-    dispatch cost, which is the TPU-era serving answer to config4c's
-    dispatch-bound single stream."""
+    transformer decode cell as config4c (``DECODE_CELL``), but
+    ``capacity`` independent streams share ONE compiled step per tick.
+    Aggregate steps/sec: the batch multiplies MXU arithmetic intensity at
+    the same per-tick dispatch cost, which is the TPU-era serving answer
+    to config4c's dispatch-bound single stream."""
     from nnstreamer_tpu.serving import ContinuousBatcher
 
     rng = np.random.default_rng(3)
-    d_in = 64
-    with ContinuousBatcher(
-        capacity=capacity, t_max=t_max, d_in=d_in, n_out=16,
-        d_model=d_model, n_heads=8, n_layers=n_layers,
-    ) as eng:
+    kw = {**DECODE_CELL, **(cell_kw or {})}
+    d_in = kw["d_in"]
+    with ContinuousBatcher(capacity=capacity, **kw) as eng:
         sessions = [eng.open_session(timeout=60) for _ in range(capacity)]
         warm = rng.standard_normal(d_in).astype(np.float32)
         for s in sessions:  # warmup tick pays the compile
@@ -839,13 +846,18 @@ def measure_mfu(batches=None, image_size=224, model_name="mobilenet_v2"):
     return out
 
 
-def run_baseline_leg(which: str, timeout: float = 1800.0):
+def run_baseline_leg(which: str, timeout: float = 1800.0, drop_env=()):
     """One CPU baseline config in an isolated subprocess (tools/
     bench_baselines.py): the TPU runtime's helper threads never contend
-    with the baseline, thread counts are pinned and recorded."""
+    with the baseline, thread counts are pinned and recorded.
+
+    ``drop_env`` strips keys from the child env — the CPU-fallback frame
+    shrinking must never reach a baseline child, or the cached/reused
+    denominators would be measured under different conditions than the
+    documented defaults (review r5)."""
     script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                           "tools", "bench_baselines.py")
-    env = dict(os.environ)
+    env = {k: v for k, v in os.environ.items() if k not in set(drop_env)}
     env.setdefault("BENCH_BASELINE_FRAMES", "200")
     out = subprocess.run(
         [sys.executable, script, which],
@@ -1668,6 +1680,26 @@ def main(standalone=False):
         errors.append("no accelerator registered; CPU-only measurements")
     rep.platform = platform
     log(f"# jax platform: {platform or 'cpu-fallback'}")
+    cpu_shrunk = []
+    if platform in (None, "cpu"):
+        # CPU-fallback legs prove plumbing, not perf (the notes say so in
+        # bold): don't spend the budget streaming 400 frames through a
+        # ~5 fps CPU model — shrink the per-leg defaults so MORE legs fit
+        # the budget.  Explicit env settings always win, and the shrunken
+        # values are stripped from the late-reprobe child's env (a run
+        # that lands on a real accelerator must use the full counts).
+        for var, small in (("BENCH_FRAMES", "60"),
+                           ("BENCH_QUANT_FRAMES", "30"),
+                           ("BENCH_SSD_FRAMES", "20"),
+                           ("BENCH_POSE_FRAMES", "30"),
+                           ("BENCH_CASCADE_FRAMES", "8"),
+                           ("BENCH_MUX_FRAMES", "10"),
+                           ("BENCH_LSTM_STEPS", "60"),
+                           ("BENCH_SEQ_WINDOWS", "12"),
+                           ("BENCH_BREAKDOWN_FRAMES", "20")):
+            if var not in os.environ:
+                os.environ[var] = small
+                cpu_shrunk.append(var)
 
     # Baselines first (reused rows cost nothing) so every snapshot from the
     # first leg on carries real vs_baseline ratios.
@@ -2020,15 +2052,14 @@ def main(standalone=False):
             from nnstreamer_tpu.models import transformer as _tr
 
             t_pf = n_cb  # already clamped to < t_max above
-            # the SAME cell as config4c/4d by construction: take the
-            # params from the shared builder, not re-derived literals
-            cell = _tr.build_decode_cell(
-                t_max=128, d_in=64, n_out=16, d_model=256, n_heads=8,
-                n_layers=2)
+            # the SAME cell as config4c/4d by construction: one shared
+            # DECODE_CELL definition, params from the shared builder
+            cell = _tr.build_decode_cell(**DECODE_CELL)
             params4 = cell.params
-            pf = _jax.jit(lambda xp, n: _tr.prefill(params4, xp, 128, n))
+            t_max4 = DECODE_CELL["t_max"]
+            pf = _jax.jit(lambda xp, n: _tr.prefill(params4, xp, t_max4, n))
             xp = _jnp.asarray(np.random.default_rng(5).standard_normal(
-                (128, 64)).astype(np.float32))
+                (t_max4, DECODE_CELL["d_in"])).astype(np.float32))
             nv = _jnp.int32(t_pf)
             _jax.block_until_ready(pf(xp, nv))  # compile outside timing
             reps = []
@@ -2188,7 +2219,8 @@ def main(standalone=False):
                 continue
             try:
                 timeout = max(60.0, rep.remaining() + 60.0)
-                leg = run_baseline_leg(which, timeout=timeout)
+                leg = run_baseline_leg(which, timeout=timeout,
+                                       drop_env=cpu_shrunk)
                 rep.baselines[which] = leg
                 log(f"# baseline {which}: {leg}")
                 if not leg.get("ok"):
@@ -2212,7 +2244,8 @@ def main(standalone=False):
             raise _Skipped("still no accelerator")
         log("# accelerator reachable again — re-running accel legs")
         env = {k: v for k, v in os.environ.items()
-               if k != "JAX_PLATFORMS"}  # don't inherit the CPU pin
+               if k != "JAX_PLATFORMS"     # don't inherit the CPU pin
+               and k not in cpu_shrunk}    # nor the CPU-sized frame counts
         child_budget = max(120.0, rep.remaining() - 30.0)
         env.update(BENCH_NO_RETRY="1", BENCH_SKIP_BASELINES="1",
                    BENCH_PROBE_RETRIES="1",
@@ -2301,6 +2334,12 @@ def main(standalone=False):
     rep.current_leg = "finalize"
     out = rep.finalize()
     rep.done = True
+    # undo the CPU-fallback env shrinking: a SECOND main() in this process
+    # (in-process harnesses) must re-derive it, not mistake our values for
+    # explicit user settings (review r5; async exits skip this — the
+    # process dies anyway)
+    for var in cpu_shrunk:
+        os.environ.pop(var, None)
     return out
 
 
