@@ -651,3 +651,26 @@ class TestPrefill:
             # steps_total == sum of per-session outputs served
             assert eng.steps_total == a.steps + b.steps == 5
             assert eng.prefill_tokens == 3
+
+    def test_prefill_composes_with_ring_window_streaming(self):
+        """Prompt → ring-window decode: prefill fills slots 0..T-1 (valid
+        while T <= t_max), then the stream runs PAST capacity on the ring
+        — the infinite-stream mode and the prompt path must compose."""
+        # n_prompt=5 pads to bucket 8: the padded rows' zeroing and
+        # the ring's overwrite/live-mask interaction are both exercised
+        n_prompt, n_more = 5, KW["t_max"] + 3
+        xs = stream_inputs(110, n_prompt + n_more)
+        with ContinuousBatcher(capacity=1, window=True, **KW) as eng:
+            s = eng.open_session()
+            s.prefill(np.stack(xs[:n_prompt]))
+            got = [s.get(timeout=30)]
+            for x in xs[n_prompt:]:
+                s.feed(x)
+                got.append(s.get(timeout=30))
+            params = eng.params
+        assert all(np.isfinite(g).all() for g in got)
+        want = single_stream_outputs(params, xs, window=True)
+        np.testing.assert_allclose(got[0], want[n_prompt - 1],
+                                   rtol=1e-5, atol=1e-5)
+        for g, w in zip(got[1:], want[n_prompt:]):
+            np.testing.assert_allclose(g, w, rtol=1e-5, atol=1e-5)
