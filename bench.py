@@ -1361,6 +1361,8 @@ class Reporter:
             "config1_quant": ratio("config1_quant_fps", "config1_quant"),
             "config1_quant_upload": ratio("config1_quant_upload_fps",
                                           "config1_quant"),
+            "config1_quant_dynbatch": ratio("config1_quant_dynbatch_fps",
+                                            "config1_quant"),
             "config2": ratio("config2_ssd_fps", "config2"),
             "config2_upload": ratio("config2_ssd_upload_fps", "config2"),
             "config2c": ratio("config2c_cascade_fps", "config2c"),
@@ -1852,6 +1854,23 @@ def main(standalone=False):
         )
         results["config1_quant_upload_fps"] = round(qu_fps, 2)
         log(f"# config1 quantized upload fps: {qu_fps:.2f}")
+        rep.snapshot()
+        # dynbatch variant: int8 + amortization stacked — the float
+        # headline's best variant is usually dynbatch, so the quant-vs-
+        # float comparison needs the same machinery on both sides
+        if not rep.over_budget("config1 quant dynbatch variant"):
+            h = wire_gate("config1_quant_dynbatch")
+            maxb = dynbatch_max_for_wire(h)
+            qd_fps, qd_batches, _ = run_dynbatch_fps(
+                [image_u8.copy() for _ in range(n_q)], max_batch=maxb,
+                poly_model=poly_wire_model(quant_model, 224),
+            )
+            results["config1_quant_dynbatch_fps"] = round(qd_fps, 2)
+            results["config1_quant_dynbatch_max"] = maxb
+            results["config1_quant_dynbatch_invokes"] = qd_batches
+            results["config1_quant_dynbatch_frames"] = n_q
+            log(f"# config1 quantized dynbatch fps: {qd_fps:.2f} "
+                f"({qd_batches} invokes / {n_q} frames, cap {maxb})")
 
     # -- config #2: SSD-MobileNet bounding-box pipeline --------------------
     # fused on-device decode head (lax.top_k inside the model's program) +
