@@ -674,3 +674,29 @@ class TestPrefill:
                                    rtol=1e-5, atol=1e-5)
         for g, w in zip(got[1:], want[n_prompt:]):
             np.testing.assert_allclose(g, w, rtol=1e-5, atol=1e-5)
+
+
+class TestQuantizedServing:
+    def test_engine_serves_w8a8_cell_exactly(self):
+        """Continuous batching over QUANTIZED params: decode_step routes
+        int8 leaves through the W8A8 matmul path, and the engine's ctor
+        derives geometry from the quantized leaves — a quantized
+        checkpoint serves unchanged."""
+        from nnstreamer_tpu.ops.quant import QuantizedWeight, quantize_params
+
+        params = transformer.init_params(
+            __import__("jax").random.PRNGKey(12), KW["d_model"],
+            KW["n_heads"], KW["n_layers"], 4 * KW["d_model"],
+            KW["d_in"], KW["n_out"])
+        qparams = quantize_params(params)
+        assert isinstance(qparams["embed"]["w"], QuantizedWeight)
+        xs = stream_inputs(120, 5)
+        with ContinuousBatcher(capacity=2, params=qparams, **KW) as eng:
+            s = eng.open_session()
+            got = []
+            for x in xs:
+                s.feed(x)
+                got.append(s.get(timeout=60))
+        want = single_stream_outputs(qparams, xs)
+        for g, w in zip(got, want):
+            np.testing.assert_allclose(g, w, rtol=1e-4, atol=1e-4)
