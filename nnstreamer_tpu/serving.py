@@ -323,6 +323,23 @@ class ContinuousBatcher:
             self._resets.append(slot)
             return sess
 
+    def stats(self) -> dict:
+        """Engine observability snapshot (the ``tensor_debug`` discipline:
+        thread-safe, no device pulls): occupancy, served counters, and the
+        tick-coalescing ratio."""
+        with self._cv:
+            return {
+                "capacity": self.capacity,
+                "active_sessions": len(self._active),
+                "free_slots": len(self._free),
+                "ticks": self.ticks,
+                "steps_total": self.steps_total,
+                "prefill_tokens": self.prefill_tokens,
+                "coalescing": round(self.steps_total / self.ticks, 3)
+                if self.ticks else None,
+                "running": self._running,
+            }
+
     def stop(self) -> None:
         """Stop the engine; every active session's blocked ``get()`` raises
         RuntimeError (a sentinel wakes the output queues — a plain notify
@@ -463,15 +480,19 @@ class ContinuousBatcher:
                 for sess, y_last, n in pre_out:
                     # a prefill is one compiled dispatch serving one
                     # output: counters stay consistent with sess.steps
-                    self.prefill_tokens += n
-                    self.ticks += 1
-                    self.steps_total += 1
+                    # (incremented under the lock so stats() never reads a
+                    # torn ticks/steps pair — review r5)
+                    with self._cv:
+                        self.prefill_tokens += n
+                        self.ticks += 1
+                        self.steps_total += 1
                     sess.steps += 1
                     sess._q_out.put(np.asarray(y_last).copy())
                 if ys is not None:
                     ys_np = np.asarray(ys)  # sync outside the state handoff
-                    self.ticks += 1
-                    self.steps_total += len(fed)
+                    with self._cv:
+                        self.ticks += 1
+                        self.steps_total += len(fed)
                     for slot, sess in fed.items():
                         sess.steps += 1
                         sess._q_out.put(ys_np[slot].copy())

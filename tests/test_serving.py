@@ -700,3 +700,20 @@ class TestQuantizedServing:
         want = single_stream_outputs(qparams, xs)
         for g, w in zip(got, want):
             np.testing.assert_allclose(g, w, rtol=1e-4, atol=1e-4)
+
+
+def test_engine_stats_snapshot():
+    with ContinuousBatcher(capacity=2, **KW) as eng:
+        st0 = eng.stats()
+        assert st0["capacity"] == 2 and st0["free_slots"] == 2
+        assert st0["active_sessions"] == 0 and st0["running"]
+        s = eng.open_session()
+        s.prefill(np.stack(stream_inputs(130, 3)))
+        s.get(timeout=30)
+        s.feed(stream_inputs(131, 1)[0])
+        s.get(timeout=30)
+        st = eng.stats()
+        assert st["active_sessions"] == 1 and st["free_slots"] == 1
+        assert st["steps_total"] == 2 and st["prefill_tokens"] == 3
+        assert st["ticks"] == 2 and st["coalescing"] == 1.0
+    assert eng.stats()["running"] is False
