@@ -43,6 +43,8 @@ DEFAULTS: Dict[str, Dict[str, str]] = {
         "enable_profiling": "false",
         "native_runtime": "true",   # C++ frame queue (nnstreamer_tpu/native)
         "dump_dot_dir": "",         # write <pipeline>.PLAYING.dot here
+        "tracers": "",              # GST_TRACERS analog: "latency;stats;drops"
+        "metrics_port": "",         # Prometheus scrape port ("" = disabled)
     },
     "filter": {
         "jax_dtype": "bfloat16",    # compute dtype for the jax backend

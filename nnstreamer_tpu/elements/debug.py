@@ -10,8 +10,11 @@ numbers and the question is "which hop corrupted them":
   ``capacity`` records; negligible overhead — no tensor copies);
 - optional ``checksum=True`` adds a uint64 byte-sum per tensor (catches
   silent corruption across transports — the sparse/protobuf/query hops);
-- optional ``console=True`` prints one line per frame (the GST_DEBUG
-  analog, off by default);
+- optional ``console=True`` logs one line per frame through the
+  ``nnstreamer_tpu.debug`` logger (the GST_DEBUG analog, off by default) —
+  a real ``logging`` logger, so server deployments route it with the rest
+  of their logs and pytest's log capture sees it; a default stdout handler
+  keeps it visible with no logging config at all;
 - counters: ``frames``, ``bytes``; ``stats()`` summarizes (count, fps
   from pts span, per-tensor spec string).
 
@@ -22,6 +25,8 @@ Everything is observable from the object; nothing perturbs the stream
 from __future__ import annotations
 
 import collections
+import logging
+import sys
 import threading
 from typing import Dict, Optional
 
@@ -32,6 +37,30 @@ from ..graph.node import Node, Pad
 from ..graph.registry import register_element
 from ..spec import TensorsSpec, dtype_name
 from ..utils.props import parse_bool
+
+# The console tap's logger.  Out of the box it mirrors the old bare-print
+# behavior (stdout, message only) via a module-local handler, but because
+# it is a standard logger, applications that configure ``logging`` get the
+# records through their own handlers too (propagation stays on).
+
+
+class _ConsoleHandler(logging.Handler):
+    """print()-based handler: resolves ``sys.stdout`` at emit time, so
+    stream redirection (pytest capture, daemonization) is honored."""
+
+    def emit(self, record):
+        try:
+            print(self.format(record), file=sys.stdout, flush=True)
+        except Exception:  # noqa: BLE001 — logging contract
+            self.handleError(record)
+
+
+_LOG = logging.getLogger("nnstreamer_tpu.debug")
+if not _LOG.handlers:
+    _handler = _ConsoleHandler()
+    _handler.setFormatter(logging.Formatter("%(message)s"))
+    _LOG.addHandler(_handler)
+    _LOG.setLevel(logging.INFO)
 
 
 def _tensor_nbytes(t) -> int:
@@ -107,10 +136,9 @@ class TensorDebug(Node):
                 self._last_pts = frame.pts
             n = self.frames
         if self.console:
-            print(f"[{self.name}] #{n} pts={frame.pts} "
-                  f"{' '.join(rec['tensors'])}"
-                  + (f" sum={rec['checksum']}" if self.checksum else ""),
-                  flush=True)
+            _LOG.info("[%s] #%d pts=%s %s%s", self.name, n, frame.pts,
+                      " ".join(rec["tensors"]),
+                      f" sum={rec['checksum']}" if self.checksum else "")
         self.src_pads["src"].push(frame)
         return None
 

@@ -39,6 +39,7 @@ from ..graph.node import NegotiationError, Node, Pad
 from ..graph.registry import register_element
 from ..native import OK, SHUTDOWN
 from ..native.queue import make_frame_queue
+from ..obs import hooks as _hooks
 from ..spec import TensorSpec, TensorsSpec
 
 _POLL_MS = 100
@@ -121,6 +122,8 @@ class DynBatch(Node):
         }
         self.frames_in += n
         self.batches_emitted += 1
+        if _hooks.enabled:
+            _hooks.emit("dynbatch_flush", self, n, b)
         self.push(Frame(tensors=tuple(stacked), pts=frames[0].pts,
                         duration=frames[0].duration, meta=meta))
 

@@ -23,8 +23,9 @@ from typing import List, Optional
 from ..buffer import Event
 from ..graph.node import Node, Pad
 from ..graph.registry import register_element
-from ..native import OK, SHUTDOWN
+from ..native import DROPPED_INCOMING, OK, OK_DROPPED_OLDEST, SHUTDOWN
 from ..native.queue import make_frame_queue
+from ..obs import hooks as _hooks
 
 _POLL_MS = 100  # wake periodically so shutdown is never missed
 
@@ -45,6 +46,9 @@ class Queue(Node):
             raise ValueError(f"unknown leaky mode {leaky!r}")
         self.leaky = str(leaky)
         self._q = None
+        # cumulative leaky-mode drops; element-level (survives stop(),
+        # unlike the backend queue's own counter) — feeds the drops tracer
+        self.dropped = 0
 
     @property
     def backend_kind(self) -> str:
@@ -62,7 +66,17 @@ class Queue(Node):
     def _dispatch(self, pad: Pad, item) -> None:
         del pad
         self._ensure_queue()
-        self._q.push(item, leaky=self.leaky)
+        status = self._q.push(item, leaky=self.leaky)
+        if status in (OK_DROPPED_OLDEST, DROPPED_INCOMING):
+            self.dropped += 1
+            if _hooks.enabled:
+                _hooks.emit(
+                    "queue_drop", self,
+                    "downstream" if status == OK_DROPPED_OLDEST
+                    else "upstream",
+                )
+        if _hooks.enabled:
+            _hooks.emit("queue_push", self, len(self._q))
 
     def spawn_threads(self) -> List[threading.Thread]:
         self._ensure_queue()
@@ -76,6 +90,8 @@ class Queue(Node):
                 return
             if status != OK:
                 continue  # timeout poll: retry
+            if _hooks.enabled:
+                _hooks.emit("queue_pop", self, len(q))
             try:
                 if isinstance(item, Event):
                     if item.kind == "eos":
@@ -95,6 +111,18 @@ class Queue(Node):
                 if self.pipeline is not None:
                     self.pipeline.post_error(self, exc)
                 return
+
+    def stats(self) -> dict:
+        """Occupancy + drop readout (the GStreamer ``current-level-buffers``
+        / leaky accounting analog); safe to call while streaming."""
+        q = self._q
+        return {
+            "backend": self.backend_kind if q is not None else None,
+            "capacity": self.max_size,
+            "depth": len(q) if q is not None else 0,
+            "dropped": self.dropped,
+            "leaky": self.leaky,
+        }
 
     def interrupt(self) -> None:
         if self._q is not None:

@@ -35,6 +35,7 @@ from typing import Dict, Optional
 from ..buffer import Frame, is_valid_ts
 from ..graph.node import Node, Pad
 from ..graph.registry import register_element
+from ..obs import hooks as _hooks
 from ..spec import TensorsSpec
 from ..utils.props import parse_bool
 
@@ -87,6 +88,8 @@ class TensorRate(Node):
         self.out_frames += 1
         if duplicated:
             self.dup += 1
+            if _hooks.enabled:
+                _hooks.emit("rate_dup", self)
         self.src_pads["src"].push(frame.with_tensors(
             frame.tensors,
             pts=slot * self._period_ns,
@@ -108,6 +111,8 @@ class TensorRate(Node):
         slot = self._slot_of(pts)
         if slot < self._next_slot:
             self.drop += 1  # this slot (and all earlier) already claimed
+            if _hooks.enabled:
+                _hooks.emit("rate_drop", self)
             # still the most recently *received* frame: later gap slots
             # must duplicate it, not an older one (videorate semantics)
             self._pending = frame

@@ -22,9 +22,11 @@ from __future__ import annotations
 
 import itertools
 import threading
+import time
 from typing import Dict, Iterable, List, Optional, Tuple, Union
 
 from ..buffer import Event, Frame
+from ..obs import hooks as _hooks
 from ..spec import ANY, TensorsSpec
 
 
@@ -93,6 +95,8 @@ class Pad:
             sig = _frame_sig(item.tensors)
             if sig != self.sig:
                 self._spec_changed(sig, item)
+        if _hooks.enabled:
+            _hooks.emit("pad_push", self, item)
         self.peer.node._dispatch(self.peer, item)
 
     def _spec_changed(self, sig: tuple, frame: Frame) -> None:
@@ -233,12 +237,29 @@ class Node:
     def _dispatch(self, pad: Pad, item: Union[Frame, Event]) -> None:
         """Entry point for items arriving on a sink pad.  Serializes the
         element by default (safe for multi-upstream fan-in); queue-like
-        nodes override this to decouple threads."""
+        nodes override this to decouple threads.
+
+        Tracer hook points bracket the dispatch (the GstTracer
+        ``element-*`` hook analog); with no tracer attached the cost is
+        one flag test — the clock is never read."""
+        if _hooks.enabled:
+            t0 = time.perf_counter_ns()
+            _hooks.emit("dispatch_enter", self, pad, item, t0)
+            try:
+                with self._lock:
+                    self._dispatch_locked(pad, item)
+            finally:
+                _hooks.emit("dispatch_exit", self, pad, item,
+                            time.perf_counter_ns() - t0)
+            return
         with self._lock:
-            if isinstance(item, Event):
-                self._handle_event(pad, item)
-            else:
-                self._handle_frame(pad, item)
+            self._dispatch_locked(pad, item)
+
+    def _dispatch_locked(self, pad: Pad, item: Union[Frame, Event]) -> None:
+        if isinstance(item, Event):
+            self._handle_event(pad, item)
+        else:
+            self._handle_frame(pad, item)
 
     def _handle_frame(self, pad: Pad, frame: Frame) -> None:
         result = self.process(pad, frame)

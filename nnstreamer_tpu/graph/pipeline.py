@@ -24,6 +24,7 @@ import traceback
 from typing import Dict, List, Optional, Union
 
 from ..buffer import Event, Frame
+from ..obs import hooks as _hooks
 from .node import NegotiationError, Node, Pad, SourceNode
 
 
@@ -45,6 +46,7 @@ class Pipeline:
         self._error_node: Optional[str] = None
         self._lock = threading.Lock()
         self._xplane_tracing = False
+        self._tracers: List = []  # attached obs tracers (GST_TRACERS analog)
 
     # -- graph construction -------------------------------------------------
 
@@ -171,6 +173,8 @@ class Pipeline:
             raise
         self.state = "PLAYING"
         self._post_negotiate_hooks()
+        if _hooks.enabled:
+            _hooks.emit("state_change", self, "NULL", "PLAYING")
         # Spawn worker threads requested by nodes (queues), then sources.
         for node in self.nodes.values():
             spawn = getattr(node, "spawn_threads", None)
@@ -181,6 +185,8 @@ class Pipeline:
                     t.start()
         for node in self.nodes.values():
             if isinstance(node, SourceNode):
+                if _hooks.enabled:
+                    _hooks.emit("source_spawn", self, node)
                 t = threading.Thread(
                     target=self._source_loop, args=(node,), name=f"src:{node.name}",
                     daemon=True,
@@ -194,6 +200,10 @@ class Pipeline:
             for frame in node.frames():
                 if node.stopped or self.state != "PLAYING":
                     break
+                if _hooks.enabled:
+                    # pre-chain: the latency tracer stamps frame identity
+                    # here, before the first pad push
+                    _hooks.emit("source_push", self, node, frame)
                 node.push(frame)
             for pad in node.src_pads.values():
                 pad.push(Event.eos())
@@ -205,6 +215,8 @@ class Pipeline:
             if self._error is None:
                 self._error = exc
                 self._error_node = node.name if node else None
+        if _hooks.enabled:
+            _hooks.emit("error", self, node, exc)
         traceback.print_exception(type(exc), exc, exc.__traceback__)
         self._done.set()
 
@@ -233,6 +245,8 @@ class Pipeline:
             self.state = "STOPPED"
             return
         self.state = "STOPPED"
+        if _hooks.enabled:
+            _hooks.emit("state_change", self, "PLAYING", "STOPPED")
         for node in self.nodes.values():
             if isinstance(node, SourceNode):
                 node.request_stop()
@@ -257,6 +271,10 @@ class Pipeline:
         self.threads.clear()
         for node in self.nodes.values():
             node.stop()
+        # detach tracers from the hook bus (accumulated data stays readable
+        # through stats(); a re-start reconnects them)
+        for tracer in self._tracers:
+            tracer.stop()
         if self._xplane_tracing:
             self._xplane_tracing = False
             try:
@@ -310,16 +328,66 @@ class Pipeline:
                 os.makedirs(trace_dir, exist_ok=True)
                 jax.profiler.start_trace(trace_dir)
                 self._xplane_tracing = True
+            self._attach_observability()
         except Exception as exc:  # noqa: BLE001
             warnings.warn(f"observability hooks failed: {exc!r}", stacklevel=2)
 
+    def _attach_observability(self) -> None:
+        """Conf-driven tracer activation (``NNSTPU_TRACERS=latency;stats``)
+        + the Prometheus scrape endpoint (``NNSTPU_METRICS_PORT``) — the
+        GST_TRACERS analog, resolved at every transition to PLAYING."""
+        from ..obs import (
+            configured_metrics_port,
+            configured_tracers,
+            ensure_server,
+        )
+
+        attached = {t.name for t in self._tracers}
+        for name in configured_tracers():
+            if name not in attached:
+                self.attach_tracer(name)
+                attached.add(name)
+        for tracer in self._tracers:
+            tracer.start(self)
+        port = configured_metrics_port()
+        if port is not None:
+            ensure_server(port)
+
+    def attach_tracer(self, tracer):
+        """Attach a tracer (name or instance) to this pipeline — the
+        programmatic ``GST_TRACERS`` surface.  Hooks connect immediately
+        when PLAYING, else at the next start; returns the tracer so the
+        caller can read ``tracer.summary()`` (also merged into
+        :meth:`stats` under ``"tracers"``)."""
+        from ..obs.tracers import make_tracer
+
+        if isinstance(tracer, str):
+            tracer = make_tracer(tracer)
+        self._tracers.append(tracer)
+        if self.state == "PLAYING":
+            tracer.start(self)
+        return tracer
+
+    def detach_tracer(self, tracer) -> None:
+        tracer.stop()
+        if tracer in self._tracers:
+            self._tracers.remove(tracer)
+
+    @property
+    def tracers(self) -> List:
+        return list(self._tracers)
+
     def stats(self) -> dict:
-        """Per-node invoke-latency summary (ms) for this pipeline's nodes;
-        populated when profiling is enabled."""
+        """Per-node invoke-latency summary (ms) for this pipeline's nodes
+        (populated when profiling is enabled), plus one ``"tracers"`` entry
+        per attached tracer — e2e latency, throughput, drop accounting."""
         from ..utils import profiling
 
         all_stats = profiling.stats()
-        return {k: v for k, v in all_stats.items() if k in self.nodes}
+        out = {k: v for k, v in all_stats.items() if k in self.nodes}
+        if self._tracers:
+            out["tracers"] = {t.name: t.summary() for t in self._tracers}
+        return out
 
     def to_dot(self) -> str:
         """Graphviz dump of the graph with negotiated specs — the analog of

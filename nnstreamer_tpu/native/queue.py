@@ -5,7 +5,11 @@ Both classes expose the same small surface the ``queue`` element drives:
 - ``push(item, leaky)`` → one of the status codes in
   :mod:`nnstreamer_tpu.native` (``OK``/``OK_DROPPED_OLDEST``/…);
 - ``pop(timeout)`` → ``(status, item)``;
-- ``shutdown()`` / ``close()`` / ``__len__``.
+- ``shutdown()`` / ``close()`` / ``__len__``;
+- ``dropped`` / ``stats()`` — leaky-mode drop accounting.  Leaky drops
+  used to vanish silently inside the queue; both backends now count every
+  ``OK_DROPPED_OLDEST`` / ``DROPPED_INCOMING`` outcome (the native backend
+  counts in this binding layer, where the status code surfaces).
 
 The native path keeps Python objects in a handle table and moves opaque
 ``uint64`` handles through C++; blocking waits run outside the GIL.
@@ -41,11 +45,13 @@ class NativeFrameQueue:
         if lib is None:
             raise RuntimeError("native runtime library unavailable")
         self._lib = lib
-        self._q = lib.nns_queue_new(max(1, int(capacity)))
+        self.capacity = max(1, int(capacity))
+        self._q = lib.nns_queue_new(self.capacity)
         self._objs = {}
         self._ids = itertools.count(1)
         self._table_lock = threading.Lock()
         self._closed = False
+        self.dropped = 0  # leaky-mode drops observed through this binding
 
     def push(self, item, leaky: str = "no", timeout_ms: int = -1) -> int:
         handle = next(self._ids)
@@ -61,9 +67,12 @@ class NativeFrameQueue:
         if status in (SHUTDOWN, TIMEOUT, DROPPED_INCOMING):
             with self._table_lock:
                 self._objs.pop(handle, None)
+                if status == DROPPED_INCOMING:
+                    self.dropped += 1
         if status == OK_DROPPED_OLDEST:
             with self._table_lock:
                 self._objs.pop(dropped.value, None)
+                self.dropped += 1
         return status
 
     def pop(self, timeout_ms: int = -1) -> Tuple[int, Optional[object]]:
@@ -79,6 +88,10 @@ class NativeFrameQueue:
 
     def __len__(self) -> int:
         return int(self._lib.nns_queue_len(self._q))
+
+    def stats(self) -> dict:
+        return {"depth": len(self), "capacity": self.capacity,
+                "dropped": self.dropped}
 
     def close(self) -> None:
         if not self._closed:
@@ -105,6 +118,7 @@ class PyFrameQueue:
         self._buf = collections.deque()
         self._cv = threading.Condition()
         self._shutdown = False
+        self.dropped = 0  # leaky-mode drops
 
     def push(self, item, leaky: str = "no", timeout_ms: int = -1) -> int:
         is_event = isinstance(item, Event)
@@ -116,9 +130,11 @@ class PyFrameQueue:
                         if not isinstance(queued, Event):
                             del self._buf[i]
                             self._buf.append(item)
+                            self.dropped += 1
                             self._cv.notify_all()
                             return OK_DROPPED_OLDEST
                 elif leaky == "upstream" and not is_event:
+                    self.dropped += 1
                     return DROPPED_INCOMING
                 if not self._cv.wait_for(
                     lambda: self._shutdown or len(self._buf) < self.capacity,
@@ -152,6 +168,11 @@ class PyFrameQueue:
     def __len__(self) -> int:
         with self._cv:
             return len(self._buf)
+
+    def stats(self) -> dict:
+        with self._cv:
+            return {"depth": len(self._buf), "capacity": self.capacity,
+                    "dropped": self.dropped}
 
     def close(self) -> None:
         self.shutdown()

@@ -1,0 +1,72 @@
+"""Observability subsystem: tracer hooks, metrics, Prometheus exposition.
+
+The GstTracer-design analog for this runtime (the reference leans on
+``GST_TRACERS=latency;stats;leaks`` for exactly the per-element profiling
+both NNStreamer papers use to find on-device bottlenecks):
+
+- :mod:`.hooks` — a near-zero-overhead hook bus wired into the graph core
+  (pad pushes, dispatch enter/exit, queue push/pop/drop, source spawn,
+  state changes, errors);
+- :mod:`.metrics` — labeled counter/gauge/histogram registry;
+- :mod:`.tracers` — pluggable ``latency`` / ``stats`` / ``drops`` tracers;
+- :mod:`.export` — Prometheus text exposition + stdlib scrape endpoint.
+
+Activation is conf-driven like the other ``NNSTPU_COMMON_*`` knobs —
+``NNSTPU_TRACERS=latency;stats`` and ``NNSTPU_METRICS_PORT=9464`` (the
+short spellings take precedence; ``NNSTPU_COMMON_TRACERS`` /
+``NNSTPU_COMMON_METRICS_PORT`` and the ini ``[common]`` keys also work) —
+or programmatic via ``pipeline.attach_tracer("latency")``.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional
+
+from . import hooks  # noqa: F401
+from .export import (  # noqa: F401
+    MetricsServer,
+    ensure_server,
+    register_engine,
+    render_text,
+    shutdown_server,
+)
+from .metrics import (  # noqa: F401
+    LATENCY_BUCKETS_MS,
+    REGISTRY,
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+)
+from .tracers import (  # noqa: F401
+    TRACERS,
+    DropsTracer,
+    LatencyTracer,
+    StatsTracer,
+    Tracer,
+    make_tracer,
+    parse_tracer_names,
+)
+
+
+def configured_tracers() -> List[str]:
+    """Tracer names requested by the environment/conf (may be empty)."""
+    val = os.environ.get("NNSTPU_TRACERS")
+    if val is None:
+        from ..conf import conf
+
+        val = conf.get("common", "tracers", "") or ""
+    return parse_tracer_names(val)
+
+
+def configured_metrics_port() -> Optional[int]:
+    """Scrape-endpoint port from the environment/conf; None = disabled."""
+    val = os.environ.get("NNSTPU_METRICS_PORT")
+    if val is None:
+        from ..conf import conf
+
+        val = conf.get("common", "metrics_port", "")
+    if val in (None, ""):
+        return None
+    return int(val)

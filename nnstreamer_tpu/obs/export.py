@@ -1,0 +1,179 @@
+"""Prometheus exposition: text rendering + a stdlib scrape endpoint.
+
+``render_text`` serializes a :class:`~nnstreamer_tpu.obs.metrics.
+MetricsRegistry` in the Prometheus text format (version 0.0.4: ``# HELP`` /
+``# TYPE`` headers, ``_bucket{le=...}/_sum/_count`` histogram series).
+``MetricsServer`` serves it over plain ``http.server`` — no dependency, one
+daemon thread — at ``/metrics``; activation is conf-driven from
+``Pipeline`` start (``NNSTPU_METRICS_PORT=9464``) or programmatic.
+
+``register_engine`` republishes a serving engine's ``stats()`` snapshot
+(:meth:`nnstreamer_tpu.serving.ContinuousBatcher.stats`) as
+``nnstpu_serving_*`` gauges, refreshed at scrape time via a registry
+collector — pull-style, no background poller.
+"""
+
+from __future__ import annotations
+
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from .metrics import REGISTRY, MetricsRegistry
+
+CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+def _fmt(value: float) -> str:
+    """Prometheus number rendering: integral values without the '.0'."""
+    if value != value:  # NaN
+        return "NaN"
+    if value in (float("inf"), float("-inf")):
+        return "+Inf" if value > 0 else "-Inf"
+    if float(value).is_integer() and abs(value) < 1e15:
+        return str(int(value))
+    return repr(float(value))
+
+
+def _escape(value: str) -> str:
+    return (value.replace("\\", r"\\").replace('"', r"\"")
+            .replace("\n", r"\n"))
+
+
+def _labels(names, values, extra: str = "") -> str:
+    pairs = [f'{n}="{_escape(v)}"' for n, v in zip(names, values)]
+    if extra:
+        pairs.append(extra)
+    return "{" + ",".join(pairs) + "}" if pairs else ""
+
+
+def render_text(registry: Optional[MetricsRegistry] = None) -> str:
+    """The whole registry in Prometheus text exposition format."""
+    registry = registry if registry is not None else REGISTRY
+    lines = []
+    for metric in registry.collect():
+        if metric.help:
+            lines.append(f"# HELP {metric.name} {_escape(metric.help)}")
+        lines.append(f"# TYPE {metric.name} {metric.kind}")
+        for key, child in metric.children():
+            if metric.kind == "histogram":
+                cumulative, total_sum, count = child.snapshot()
+                for bound, acc in cumulative:
+                    le = _labels(metric.labelnames, key,
+                                 extra=f'le="{_fmt(bound)}"')
+                    lines.append(f"{metric.name}_bucket{le} {acc}")
+                base = _labels(metric.labelnames, key)
+                lines.append(f"{metric.name}_sum{base} {_fmt(total_sum)}")
+                lines.append(f"{metric.name}_count{base} {count}")
+            else:
+                base = _labels(metric.labelnames, key)
+                lines.append(f"{metric.name}{base} {_fmt(child.value)}")
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
+class MetricsServer:
+    """Scrape endpoint on a stdlib threading HTTP server.
+
+    ``port=0`` binds an ephemeral port (tests/CI); the bound port is
+    readable at :attr:`port` after :meth:`start`.
+    """
+
+    def __init__(self, port: int = 9464, host: str = "127.0.0.1",
+                 registry: Optional[MetricsRegistry] = None):
+        self.host = host
+        self.port = int(port)
+        self.registry = registry if registry is not None else REGISTRY
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "MetricsServer":
+        if self._httpd is not None:
+            return self
+        registry = self.registry
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 — http.server API
+                if self.path.split("?")[0] not in ("/metrics", "/"):
+                    self.send_error(404)
+                    return
+                body = render_text(registry).encode("utf-8")
+                self.send_response(200)
+                self.send_header("Content-Type", CONTENT_TYPE)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args):  # silence per-scrape stderr spam
+                del args
+
+        self._httpd = ThreadingHTTPServer((self.host, self.port), Handler)
+        self._httpd.daemon_threads = True
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="nnstpu-metrics",
+            daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}/metrics"
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+
+_server_lock = threading.Lock()
+_server: Optional[MetricsServer] = None
+
+
+def ensure_server(port: int, host: str = "127.0.0.1") -> MetricsServer:
+    """Process-singleton scrape endpoint (conf-driven activation): the
+    first caller binds, later callers get the running server — repeated
+    ``pipeline.start()`` must not collide on the port."""
+    global _server
+    with _server_lock:
+        if _server is None:
+            _server = MetricsServer(port=port, host=host).start()
+        return _server
+
+
+def shutdown_server() -> None:
+    global _server
+    with _server_lock:
+        if _server is not None:
+            _server.stop()
+            _server = None
+
+
+def register_engine(engine, registry: Optional[MetricsRegistry] = None,
+                    prefix: str = "nnstpu_serving"):
+    """Republish a serving engine's ``stats()`` as gauges, refreshed per
+    scrape.  Returns the collector handle for
+    :meth:`MetricsRegistry.remove_collector`."""
+    registry = registry if registry is not None else REGISTRY
+
+    def collect():
+        for key, val in engine.stats().items():
+            if isinstance(val, bool):
+                val = int(val)
+            if not isinstance(val, (int, float)):
+                continue
+            registry.gauge(
+                f"{prefix}_{key}",
+                f"serving engine stats() field {key!r}",
+            ).set(val)
+
+    return registry.add_collector(collect)

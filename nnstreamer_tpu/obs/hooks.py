@@ -1,0 +1,111 @@
+"""Near-zero-overhead hook bus: the GstTracer hook-point analog.
+
+GStreamer's tracer subsystem exposes named hook points (``pad-push-pre``,
+``element-post-message``, ...) that tracer plugins attach to; with no
+tracer loaded the hooks compile down to a flag test.  This module is that
+bus for the graph runtime:
+
+- hot-path sites guard every emission with ``if hooks.enabled:`` — one
+  module-global load + truth test when nothing is attached (pinned by the
+  micro-benchmark in ``tests/test_observability.py``);
+- callbacks are held in per-hook tuples, swapped atomically under a lock
+  on connect/disconnect, iterated lock-free on emit;
+- a callback that raises is disabled after logging once — observability
+  must never take the pipeline down (same contract as
+  ``Pipeline._post_negotiate_hooks``).
+
+Hook points and their emit signatures (positional, no kwargs — emission
+must stay allocation-light):
+
+=================  ====================================================
+``pad_push``       ``(pad, item)`` — a src pad pushed a frame/event
+``dispatch_enter`` ``(node, pad, item, t0_ns)`` — sink-side entry
+``dispatch_exit``  ``(node, pad, item, dur_ns)`` — sink-side exit
+``queue_push``     ``(node, depth)`` — frame-queue push (post-push depth)
+``queue_pop``      ``(node, depth)`` — frame-queue pop (post-pop depth)
+``queue_drop``     ``(node, reason)`` — leaky drop ("downstream"/"upstream")
+``source_push``    ``(pipeline, node, frame)`` — source-thread push, pre-chain
+``source_spawn``   ``(pipeline, node)`` — streaming thread spawned
+``state_change``   ``(pipeline, old, new)`` — pipeline state transition
+``error``          ``(pipeline, node, exc)`` — posted pipeline error
+``rate_drop``      ``(node,)`` — tensor_rate dropped a frame
+``rate_dup``       ``(node,)`` — tensor_rate duplicated a frame
+``dynbatch_flush`` ``(node, n, bucket)`` — dynbatch emitted a batch
+=================  ====================================================
+
+Timestamps passed through hooks are ``time.perf_counter_ns()`` — every
+producer and consumer must use that one clock.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Callable, Dict, Tuple
+
+_LOG = logging.getLogger("nnstreamer_tpu.obs")
+
+HOOKS = (
+    "pad_push",
+    "dispatch_enter",
+    "dispatch_exit",
+    "queue_push",
+    "queue_pop",
+    "queue_drop",
+    "source_push",
+    "source_spawn",
+    "state_change",
+    "error",
+    "rate_drop",
+    "rate_dup",
+    "dynbatch_flush",
+)
+
+# The fast-path gate: True iff at least one callback is connected anywhere.
+# Hot sites read this module attribute directly; everything past the gate
+# only runs while tracing is active.
+enabled = False
+
+_lock = threading.Lock()
+_callbacks: Dict[str, Tuple[Callable, ...]] = {h: () for h in HOOKS}
+
+
+def connect(hook: str, fn: Callable) -> None:
+    """Attach ``fn`` to a hook point (idempotent per (hook, fn) pair)."""
+    global enabled
+    if hook not in _callbacks:
+        raise ValueError(f"unknown hook {hook!r} (known: {', '.join(HOOKS)})")
+    with _lock:
+        if fn not in _callbacks[hook]:
+            _callbacks[hook] = _callbacks[hook] + (fn,)
+        enabled = True
+
+
+def disconnect(hook: str, fn: Callable) -> None:
+    global enabled
+    with _lock:
+        # equality, not identity: bound methods (a common callback shape)
+        # are re-created on every attribute access
+        _callbacks[hook] = tuple(f for f in _callbacks[hook] if f != fn)
+        enabled = any(_callbacks.values())
+
+
+def clear() -> None:
+    """Detach everything (test isolation)."""
+    global enabled
+    with _lock:
+        for h in _callbacks:
+            _callbacks[h] = ()
+        enabled = False
+
+
+def emit(hook: str, *args) -> None:
+    """Run every callback attached to ``hook``.  A raising callback is
+    logged and disconnected — tracers are observers, never participants."""
+    for fn in _callbacks[hook]:
+        try:
+            fn(*args)
+        except Exception:  # noqa: BLE001 — observability must not kill flow
+            _LOG.exception("tracer callback %r on hook %r failed; detaching",
+                           fn, hook)
+            disconnect(hook, fn)

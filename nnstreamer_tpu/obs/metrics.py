@@ -1,0 +1,269 @@
+"""Labeled metrics registry: counters, gauges, histograms.
+
+The storage layer under the tracer subsystem (:mod:`.tracers`) and the
+Prometheus exposition (:mod:`.export`).  Modeled on the prometheus_client
+data model — ``metric.labels(element="q0").inc()`` — but dependency-free
+and sized to this runtime:
+
+- metrics are get-or-create on the registry (idempotent across pipeline
+  restarts; a kind or label-schema mismatch on re-register raises);
+- label children are keyed by their value tuple, created on first touch;
+- histograms use **fixed bucket boundaries** chosen at creation
+  (:data:`LATENCY_BUCKETS_MS` spans 50 µs – 2.5 s, the useful range for
+  per-frame pipeline latencies) so observation is a bisect + two adds;
+- ``add_collector(fn)`` registers a callback run at collect/scrape time —
+  how pull-style snapshots (serving-engine ``stats()``, queue depths)
+  republish as gauges without a background poller.
+
+All mutation is thread-safe (one lock per metric; the registry lock only
+guards creation).
+"""
+
+from __future__ import annotations
+
+import bisect
+import math
+import threading
+from typing import Callable, Dict, Iterable, List, Optional, Tuple
+
+# Fixed latency buckets (milliseconds): 50 µs to 2.5 s, roughly 1-2.5-5
+# per decade — the GstShark/Prometheus-convention spacing.
+LATENCY_BUCKETS_MS = (
+    0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0,
+    100.0, 250.0, 500.0, 1000.0, 2500.0,
+)
+
+_INF = math.inf
+
+
+def _check_labels(labelnames: Tuple[str, ...], kv: Dict[str, str]) -> Tuple[str, ...]:
+    if tuple(sorted(kv)) != tuple(sorted(labelnames)):
+        raise ValueError(
+            f"labels {sorted(kv)} do not match declared {sorted(labelnames)}"
+        )
+    return tuple(str(kv[name]) for name in labelnames)
+
+
+class _Metric:
+    """Shared child management for all metric kinds."""
+
+    kind = "untyped"
+
+    def __init__(self, name: str, help: str = "", labelnames: Iterable[str] = ()):
+        self.name = name
+        self.help = help
+        self.labelnames = tuple(labelnames)
+        self._lock = threading.Lock()
+        self._children: Dict[Tuple[str, ...], object] = {}
+
+    def _make_child(self):
+        raise NotImplementedError
+
+    def labels(self, **kv):
+        key = _check_labels(self.labelnames, kv)
+        with self._lock:
+            child = self._children.get(key)
+            if child is None:
+                child = self._make_child()
+                self._children[key] = child
+            return child
+
+    def _default(self):
+        """The no-label child (metrics declared without labelnames)."""
+        if self.labelnames:
+            raise ValueError(f"{self.name}: labels required {self.labelnames}")
+        with self._lock:
+            child = self._children.get(())
+            if child is None:
+                child = self._make_child()
+                self._children[()] = child
+            return child
+
+    def children(self) -> List[Tuple[Tuple[str, ...], object]]:
+        with self._lock:
+            return sorted(self._children.items())
+
+
+class _Value:
+    __slots__ = ("_v", "_lock")
+
+    def __init__(self):
+        self._v = 0.0
+        self._lock = threading.Lock()
+
+    @property
+    def value(self) -> float:
+        return self._v
+
+
+class _CounterChild(_Value):
+    def inc(self, amount: float = 1.0) -> None:
+        if amount < 0:
+            raise ValueError("counters only go up")
+        with self._lock:
+            self._v += amount
+
+
+class _GaugeChild(_Value):
+    def set(self, value: float) -> None:
+        with self._lock:
+            self._v = float(value)
+
+    def inc(self, amount: float = 1.0) -> None:
+        with self._lock:
+            self._v += amount
+
+    def dec(self, amount: float = 1.0) -> None:
+        with self._lock:
+            self._v -= amount
+
+
+class _HistogramChild:
+    __slots__ = ("_bounds", "_counts", "_sum", "_count", "_lock")
+
+    def __init__(self, bounds: Tuple[float, ...]):
+        self._bounds = bounds
+        self._counts = [0] * (len(bounds) + 1)  # +1: the +Inf bucket
+        self._sum = 0.0
+        self._count = 0
+        self._lock = threading.Lock()
+
+    def observe(self, value: float) -> None:
+        i = bisect.bisect_left(self._bounds, value)
+        with self._lock:
+            self._counts[i] += 1
+            self._sum += value
+            self._count += 1
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+    @property
+    def sum(self) -> float:
+        return self._sum
+
+    def snapshot(self) -> Tuple[List[Tuple[float, int]], float, int]:
+        """(cumulative (le, count) pairs incl. +Inf, sum, count)."""
+        with self._lock:
+            counts = list(self._counts)
+            total, s = self._count, self._sum
+        out, acc = [], 0
+        for bound, c in zip(self._bounds + (_INF,), counts):
+            acc += c
+            out.append((bound, acc))
+        return out, s, total
+
+
+class Counter(_Metric):
+    kind = "counter"
+
+    def _make_child(self):
+        return _CounterChild()
+
+    def inc(self, amount: float = 1.0, **kv) -> None:
+        (self.labels(**kv) if kv else self._default()).inc(amount)
+
+
+class Gauge(_Metric):
+    kind = "gauge"
+
+    def _make_child(self):
+        return _GaugeChild()
+
+    def set(self, value: float, **kv) -> None:
+        (self.labels(**kv) if kv else self._default()).set(value)
+
+
+class Histogram(_Metric):
+    kind = "histogram"
+
+    def __init__(self, name, help="", labelnames=(), buckets=LATENCY_BUCKETS_MS):
+        super().__init__(name, help, labelnames)
+        bounds = tuple(sorted(float(b) for b in buckets))
+        if not bounds:
+            raise ValueError("histogram needs at least one bucket bound")
+        self.buckets = bounds
+
+    def _make_child(self):
+        return _HistogramChild(self.buckets)
+
+    def observe(self, value: float, **kv) -> None:
+        (self.labels(**kv) if kv else self._default()).observe(value)
+
+
+class MetricsRegistry:
+    """Named metrics + scrape-time collectors."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: Dict[str, _Metric] = {}
+        self._collectors: List[Callable[[], None]] = []
+
+    def _get_or_create(self, cls, name, help, labelnames, **kwargs):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = cls(name, help, labelnames, **kwargs)
+                self._metrics[name] = m
+                return m
+        if not isinstance(m, cls) or m.labelnames != tuple(labelnames):
+            raise ValueError(
+                f"metric {name!r} already registered as {m.kind} "
+                f"with labels {m.labelnames}"
+            )
+        return m
+
+    def counter(self, name: str, help: str = "", labelnames=()) -> Counter:
+        return self._get_or_create(Counter, name, help, labelnames)
+
+    def gauge(self, name: str, help: str = "", labelnames=()) -> Gauge:
+        return self._get_or_create(Gauge, name, help, labelnames)
+
+    def histogram(self, name: str, help: str = "", labelnames=(),
+                  buckets=LATENCY_BUCKETS_MS) -> Histogram:
+        return self._get_or_create(Histogram, name, help, labelnames,
+                                   buckets=buckets)
+
+    def get(self, name: str) -> Optional[_Metric]:
+        with self._lock:
+            return self._metrics.get(name)
+
+    def add_collector(self, fn: Callable[[], None]) -> Callable[[], None]:
+        """Register a scrape-time callback (sets gauges from live state);
+        returns ``fn`` as the removal handle."""
+        with self._lock:
+            if fn not in self._collectors:
+                self._collectors.append(fn)
+        return fn
+
+    def remove_collector(self, fn: Callable[[], None]) -> None:
+        with self._lock:
+            if fn in self._collectors:
+                self._collectors.remove(fn)
+
+    def collect(self) -> List[_Metric]:
+        """Run collectors, then return metrics sorted by name."""
+        with self._lock:
+            collectors = list(self._collectors)
+        for fn in collectors:
+            try:
+                fn()
+            except Exception:  # noqa: BLE001 — a bad collector must not 500 the scrape
+                import logging
+
+                logging.getLogger("nnstreamer_tpu.obs").exception(
+                    "metrics collector %r failed", fn)
+        with self._lock:
+            return [self._metrics[k] for k in sorted(self._metrics)]
+
+    def reset(self) -> None:
+        """Drop every metric and collector (test isolation)."""
+        with self._lock:
+            self._metrics.clear()
+            self._collectors.clear()
+
+
+# Process-default registry: tracers and the scrape endpoint share it, the
+# same way utils.profiling keeps one process-global record table.
+REGISTRY = MetricsRegistry()

@@ -323,6 +323,15 @@ class ContinuousBatcher:
             self._resets.append(slot)
             return sess
 
+    def publish_metrics(self, registry=None):
+        """Republish :meth:`stats` as ``nnstpu_serving_*`` gauges on the
+        observability registry, refreshed at every scrape (pull-style, no
+        poller thread).  Returns the collector handle for
+        ``registry.remove_collector``."""
+        from .obs.export import register_engine
+
+        return register_engine(self, registry=registry)
+
     def stats(self) -> dict:
         """Engine observability snapshot (the ``tensor_debug`` discipline:
         thread-safe, no device pulls): occupancy, served counters, and the

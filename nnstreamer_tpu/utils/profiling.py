@@ -5,13 +5,20 @@ The reference documents external tracing tools (gst-instruments/HawkTracer,
 profiling is built in: a process-global registry of per-node invoke
 latencies, toggled at runtime, plus helpers to bracket regions with
 ``jax.profiler`` traces.
+
+Recorded invoke latencies are additionally folded into the observability
+metrics registry (:mod:`nnstreamer_tpu.obs.metrics`) as the
+``nnstpu_node_invoke_latency_ms`` histogram, so enabling profiling makes
+per-node latencies scrapeable from the Prometheus endpoint alongside the
+tracer metrics.
 """
 
 from __future__ import annotations
 
 import contextlib
+import math
 import threading
-from typing import Dict, List
+from typing import Dict, List, Sequence
 
 _enabled = False
 _lock = threading.Lock()
@@ -30,6 +37,16 @@ def enabled() -> bool:
 def record(node_name: str, duration_ns: int) -> None:
     with _lock:
         _records.setdefault(node_name, []).append(duration_ns)
+    # re-home onto the obs registry: get-or-create is idempotent, so this
+    # survives registry resets between test runs
+    from ..obs.metrics import REGISTRY
+
+    REGISTRY.histogram(
+        "nnstpu_node_invoke_latency_ms",
+        "Per-node invoke latency (milliseconds), recorded while profiling "
+        "is enabled",
+        labelnames=("node",),
+    ).observe(duration_ns / 1e6, node=node_name)
 
 
 def block_outputs(outs) -> None:
@@ -40,24 +57,36 @@ def block_outputs(outs) -> None:
             o.block_until_ready()
 
 
+def summarize_ns(ns: Sequence[int]) -> Dict[str, float]:
+    """Latency summary (ms) of a sample of nanosecond durations.
+
+    Percentiles use **ceil-based nearest rank** — ``s[ceil(q*n) - 1]`` —
+    so p99 is the smallest value ≥ 99% of the sample.  The previous
+    ``s[min(n-1, int(n*0.99))]`` floor-rank returned the MAX for every
+    n ≤ 100, biasing small-sample p99 upward by the full tail.
+    """
+    s = sorted(ns)
+    n = len(s)
+
+    def rank(q: float) -> int:
+        return s[max(0, math.ceil(q * n) - 1)]
+
+    return {
+        "count": n,
+        "mean_ms": sum(s) / n / 1e6,
+        "p50_ms": rank(0.50) / 1e6,
+        "p90_ms": rank(0.90) / 1e6,
+        "p99_ms": rank(0.99) / 1e6,
+        "min_ms": s[0] / 1e6,
+        "max_ms": s[-1] / 1e6,
+    }
+
+
 def stats() -> Dict[str, Dict[str, float]]:
     """Per-node latency summary in milliseconds."""
-    out = {}
     with _lock:
-        for name, ns in _records.items():
-            if not ns:
-                continue
-            s = sorted(ns)
-            n = len(s)
-            out[name] = {
-                "count": n,
-                "mean_ms": sum(s) / n / 1e6,
-                "p50_ms": s[n // 2] / 1e6,
-                "p99_ms": s[min(n - 1, int(n * 0.99))] / 1e6,
-                "min_ms": s[0] / 1e6,
-                "max_ms": s[-1] / 1e6,
-            }
-    return out
+        snap = {name: list(ns) for name, ns in _records.items() if ns}
+    return {name: summarize_ns(ns) for name, ns in snap.items()}
 
 
 def reset() -> None:

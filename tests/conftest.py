@@ -55,8 +55,10 @@ def _reset_global_state():
     """Isolate tests from the process-global repo slots / profiling."""
     yield
     from nnstreamer_tpu.elements.repo import GLOBAL_REPO
+    from nnstreamer_tpu.obs import hooks as obs_hooks
     from nnstreamer_tpu.utils import profiling
 
     GLOBAL_REPO.reset()
     profiling.reset()
     profiling.enable(False)
+    obs_hooks.clear()  # no tracer callback outlives its test

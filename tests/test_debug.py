@@ -52,6 +52,18 @@ class TestTensorDebug:
         out = capfd.readouterr().out
         assert "#1" in out and "float32(2,)" in out and "sum=" in out
 
+    def test_console_mode_routes_through_logging(self, rng, caplog):
+        """console=True goes through the ``nnstreamer_tpu.debug`` logger
+        (not a bare print), so server log routing and pytest's log
+        capture both see it."""
+        import logging
+
+        with caplog.at_level(logging.INFO, logger="nnstreamer_tpu.debug"):
+            run_debug([np.zeros((2,), np.float32)], console=True)
+        msgs = [r.getMessage() for r in caplog.records
+                if r.name == "nnstreamer_tpu.debug"]
+        assert any("#1" in m and "float32(2,)" in m for m in msgs)
+
     def test_parse_launch(self):
         p = parse_launch(
             "tensor_debug name=d checksum=true ! tensor_sink name=out collect=true")

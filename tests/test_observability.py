@@ -1,13 +1,28 @@
-"""Observability: conf-driven dot dumps + per-pipeline latency stats."""
+"""Observability: tracer hooks, metrics registry, Prometheus exposition,
+plus the older conf-driven dot dumps + per-pipeline latency stats."""
 
 import os
+import time
+import urllib.request
 
 import numpy as np
+import pytest
 
-from nnstreamer_tpu import Pipeline
+from nnstreamer_tpu import Frame, Pipeline
 from nnstreamer_tpu.elements.filter import TensorFilter
+from nnstreamer_tpu.elements.queue import Queue
 from nnstreamer_tpu.elements.sink import TensorSink
 from nnstreamer_tpu.elements.testsrc import DataSrc
+from nnstreamer_tpu.obs import hooks
+from nnstreamer_tpu.obs.export import MetricsServer, render_text
+from nnstreamer_tpu.obs.metrics import MetricsRegistry
+from nnstreamer_tpu.obs.tracers import (
+    DropsTracer,
+    LatencyTracer,
+    StatsTracer,
+    make_tracer,
+    parse_tracer_names,
+)
 
 
 def simple_pipeline(got):
@@ -67,3 +82,376 @@ def test_xplane_trace_dir(tmp_path, monkeypatch):
         for r, _, fs in os.walk(trace_dir) for f in fs
     ]
     assert files, "no xplane trace files were written"
+
+
+class TestHookBus:
+    def test_enabled_tracks_connections(self):
+        assert hooks.enabled is False
+        seen = []
+        hooks.connect("pad_push", seen.append)
+        assert hooks.enabled is True
+        hooks.emit("pad_push", "x")
+        assert seen == ["x"]
+        hooks.disconnect("pad_push", seen.append)
+        assert hooks.enabled is False
+
+    def test_unknown_hook_rejected(self):
+        with pytest.raises(ValueError, match="unknown hook"):
+            hooks.connect("nope", lambda: None)
+
+    def test_raising_callback_is_detached_not_fatal(self):
+        def bad(*a):
+            raise RuntimeError("boom")
+
+        hooks.connect("error", bad)
+        hooks.emit("error", None, None, None)  # must not raise
+        assert hooks.enabled is False  # bad callback auto-detached
+
+    def test_disabled_hot_loop_overhead(self):
+        """The acceptance guard: with no tracer installed the hook gate
+        must add no measurable per-frame cost.  2000 frames through a
+        3-node chain; the bound is generous (100 us/frame) — it catches a
+        regression to unconditional emission (dict/kwargs building,
+        clock reads), not scheduler noise."""
+        assert hooks.enabled is False
+        from nnstreamer_tpu.graph.node import Node
+
+        a, b = Node(), Node()
+        sink = TensorSink()
+        ap = a.add_src_pad()
+        b.add_sink_pad()
+        bp = b.add_src_pad()
+        ap.link(b.sink_pads["sink"])
+        bp.link(sink.sink_pads["sink"])
+        frame = Frame.of(np.zeros((4,), np.float32))
+        n = 2000
+        ap.push(frame)  # warm signature binding
+        t0 = time.perf_counter_ns()
+        for _ in range(n):
+            ap.push(frame)
+        per_frame_ns = (time.perf_counter_ns() - t0) / n
+        assert per_frame_ns < 100_000, (
+            f"disabled hook bus costs {per_frame_ns:.0f} ns/frame"
+        )
+
+
+class TestMetricsRegistry:
+    def test_counter_gauge_labels(self):
+        reg = MetricsRegistry()
+        c = reg.counter("c_total", "help", labelnames=("el",))
+        c.inc(2, el="a")
+        c.labels(el="a").inc()
+        assert c.labels(el="a").value == 3
+        g = reg.gauge("g")
+        g.set(7)
+        assert g.labels().__class__  # no-label child path
+        with pytest.raises(ValueError, match="labels"):
+            c.inc(1)  # labelnames declared, labels required
+        with pytest.raises(ValueError, match="already registered"):
+            reg.gauge("c_total")
+
+    def test_counter_monotonic(self):
+        reg = MetricsRegistry()
+        with pytest.raises(ValueError, match="only go up"):
+            reg.counter("c").inc(-1)
+
+    def test_histogram_buckets(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("h_ms", buckets=(1.0, 5.0))
+        for v in (0.5, 3.0, 100.0):
+            h.observe(v)
+        cumulative, total, count = h.labels().snapshot()
+        assert cumulative == [(1.0, 1), (5.0, 2), (float("inf"), 3)]
+        assert count == 3 and total == 103.5
+
+    def test_exposition_golden(self):
+        """Pin the Prometheus text format exactly: HELP/TYPE headers,
+        label quoting, histogram _bucket/_sum/_count, +Inf, int-vs-float
+        value rendering."""
+        reg = MetricsRegistry()
+        reg.counter("nns_frames_total", "Frames seen",
+                    labelnames=("element",)).inc(5, element="q0")
+        reg.gauge("nns_depth", "Queue depth").set(2)
+        h = reg.histogram("nns_lat_ms", "Latency", buckets=(1.0, 2.5))
+        h.observe(0.5)
+        h.observe(2.0)
+        h.observe(9.75)
+        expected = "\n".join([
+            '# HELP nns_depth Queue depth',
+            '# TYPE nns_depth gauge',
+            'nns_depth 2',
+            '# HELP nns_frames_total Frames seen',
+            '# TYPE nns_frames_total counter',
+            'nns_frames_total{element="q0"} 5',
+            '# HELP nns_lat_ms Latency',
+            '# TYPE nns_lat_ms histogram',
+            'nns_lat_ms_bucket{le="1"} 1',
+            'nns_lat_ms_bucket{le="2.5"} 2',
+            'nns_lat_ms_bucket{le="+Inf"} 3',
+            'nns_lat_ms_sum 12.25',
+            'nns_lat_ms_count 3',
+        ]) + "\n"
+        assert render_text(reg) == expected
+
+    def test_label_escaping(self):
+        reg = MetricsRegistry()
+        reg.counter("c", labelnames=("p",)).inc(1, p='a"b\\c\nd')
+        assert r'c{p="a\"b\\c\nd"} 1' in render_text(reg)
+
+    def test_collector_runs_at_collect_time(self):
+        reg = MetricsRegistry()
+        state = {"v": 1}
+        reg.add_collector(lambda: reg.gauge("live").set(state["v"]))
+        assert "live 1" in render_text(reg)
+        state["v"] = 42
+        assert "live 42" in render_text(reg)
+
+
+class TestLatencyTracer:
+    def test_end_to_end_latency_per_frame(self):
+        """The flagship acceptance path: per-frame src->sink latency is
+        recorded for EVERY frame, correlated across a queue (thread hop)
+        and a filter (payload replaced via with_tensors)."""
+        reg = MetricsRegistry()
+        got = []
+        p = Pipeline(name="lat")
+        src = p.add(DataSrc(
+            data=[np.full(4, i, np.float32) for i in range(5)], name="s"))
+        q = p.add(Queue(max_size_buffers=8))
+        filt = p.add(TensorFilter(framework="custom", model=lambda x: x + 1,
+                                  name="f"))
+        sink = p.add(TensorSink(callback=got.append, name="out"))
+        p.link_chain(src, q, filt, sink)
+        tracer = p.attach_tracer(LatencyTracer(registry=reg))
+        p.run(timeout=30)
+        assert len(got) == 5
+        summ = tracer.summary()
+        assert list(summ) == ["s->out"]
+        s = summ["s->out"]
+        assert s["count"] == 5
+        assert 0 < s["min_ms"] <= s["p50_ms"] <= s["p90_ms"] \
+            <= s["p99_ms"] <= s["max_ms"]
+        # same data as a histogram on the registry
+        text = render_text(reg)
+        assert ('nnstpu_e2e_latency_ms_count{pipeline="lat",src="s",'
+                'sink="out"} 5') in text
+        # and via pipeline.stats()
+        assert p.stats()["tracers"]["latency"]["s->out"]["count"] == 5
+
+    def test_hooks_detached_after_stop(self):
+        p = Pipeline()
+        src = p.add(DataSrc(data=[np.zeros((2,), np.float32)]))
+        sink = p.add(TensorSink())
+        p.link(src, sink)
+        p.attach_tracer(LatencyTracer(registry=MetricsRegistry()))
+        p.run(timeout=30)
+        assert hooks.enabled is False
+
+
+class TestStatsTracer:
+    def test_per_element_throughput(self):
+        reg = MetricsRegistry()
+        got = []
+        p = Pipeline(name="thr")
+        src = p.add(DataSrc(
+            data=[np.zeros((8,), np.float32) for _ in range(4)], name="s"))
+        q = p.add(Queue(max_size_buffers=4, name="q"))
+        sink = p.add(TensorSink(callback=got.append, name="out"))
+        p.link_chain(src, q, sink)
+        tracer = p.attach_tracer(StatsTracer(registry=reg))
+        p.run(timeout=30)
+        summ = tracer.summary()
+        assert summ["s"] == {"frames": 4, "bytes": 128}
+        assert summ["q"]["frames"] == 4 and summ["q"]["bytes"] == 128
+        assert summ["q"]["queue_depth"] == 0  # drained at EOS
+        text = render_text(reg)
+        assert ('nnstpu_element_frames_total{pipeline="thr",element="s",'
+                'pad="src"} 4') in text
+        assert ('nnstpu_element_bytes_total{pipeline="thr",element="s",'
+                'pad="src"} 128') in text
+
+
+class TestDropCounters:
+    """Satellite: leaky-mode drops are counted, not silent."""
+
+    def _frames(self, n):
+        return [Frame.of(np.full((2,), i, np.float32)) for i in range(n)]
+
+    @pytest.mark.parametrize("backend", ["python", "native"])
+    def test_frame_queue_backends_count_drops(self, backend):
+        if backend == "native":
+            from nnstreamer_tpu.native import available
+            from nnstreamer_tpu.native.queue import NativeFrameQueue
+
+            if not available():
+                pytest.skip("native runtime unavailable")
+            q = NativeFrameQueue(2)
+        else:
+            from nnstreamer_tpu.native.queue import PyFrameQueue
+
+            q = PyFrameQueue(2)
+        for f in self._frames(5):
+            q.push(f, leaky="downstream")
+        assert q.dropped == 3
+        assert q.stats() == {"depth": 2, "capacity": 2, "dropped": 3}
+        q.push(self._frames(1)[0], leaky="upstream")
+        assert q.dropped == 4
+        q.close()
+
+    def test_queue_element_counts_and_reports(self):
+        q = Queue(max_size_buffers=2, leaky="downstream", name="lq")
+        for f in self._frames(5):
+            q._dispatch(None, f)
+        assert q.dropped == 3
+        st = q.stats()
+        assert st["dropped"] == 3 and st["depth"] == 2 \
+            and st["capacity"] == 2 and st["leaky"] == "downstream"
+        assert st["backend"] in ("native", "python")
+        q.stop()
+        # element-level counter survives the backend queue teardown
+        assert q.stats()["dropped"] == 3
+
+    def test_drops_tracer_sees_leaky_downstream(self):
+        reg = MetricsRegistry()
+        p = Pipeline(name="dr")
+        q = p.add(Queue(max_size_buffers=2, leaky="downstream", name="lq"))
+        tracer = p.attach_tracer(DropsTracer(registry=reg))
+        tracer.start(p)  # install hooks without running the pipeline
+        for f in self._frames(6):
+            q._dispatch(None, f)
+        assert q.dropped == 4
+        assert tracer.summary()["lq"]["queue_downstream"] == 4
+        assert ('nnstpu_drops_total{pipeline="dr",element="lq",'
+                'reason="queue_downstream"} 4') in render_text(reg)
+        q.stop()
+
+    def test_drops_tracer_sees_rate_and_dynbatch(self):
+        from nnstreamer_tpu.elements.dynbatch import DynBatch
+        from nnstreamer_tpu.elements.rate import TensorRate
+
+        reg = MetricsRegistry()
+        p = Pipeline(name="rd")
+        rate = p.add(TensorRate(framerate="10/1", name="r"))
+        dyn = p.add(DynBatch(max_batch=4, name="d"))
+        tracer = p.attach_tracer(DropsTracer(registry=reg))
+        tracer.start(p)
+        ms = 1_000_000
+        # 3 frames inside the same 100ms slot: 2 drops
+        for pts in (0, 10 * ms, 20 * ms):
+            rate.process(None, Frame.of(np.zeros((2,), np.float32), pts=pts))
+        # a 350ms jump: slots 1..3 fill by duplication (3 dups)
+        rate.process(None, Frame.of(np.zeros((2,), np.float32), pts=350 * ms))
+        # a 3-frame dynbatch flush pads to bucket 4 (1 padding row)
+        dyn._emit_batch([Frame.of(np.zeros((2,), np.float32))
+                         for _ in range(3)])
+        summ = tracer.summary()
+        assert summ["r"]["rate_drop"] == 2 == rate.drop
+        assert summ["r"]["rate_dup"] == 3 == rate.dup
+        assert summ["d"] == {"dynbatch_flushes": 1, "dynbatch_pad_rows": 1}
+        text = render_text(reg)
+        assert ('nnstpu_dups_total{pipeline="rd",element="d",'
+                'reason="dynbatch_pad"} 1') in text
+
+
+class TestConfActivation:
+    """NNSTPU_TRACERS / NNSTPU_METRICS_PORT: the GST_TRACERS analog."""
+
+    def test_parse_tracer_names(self):
+        assert parse_tracer_names("latency;stats") == ["latency", "stats"]
+        assert parse_tracer_names(" latency, drops ") == ["latency", "drops"]
+        assert parse_tracer_names("") == []
+        with pytest.raises(ValueError, match="unknown tracer"):
+            make_tracer("nope")
+
+    def test_env_driven_tracers(self, monkeypatch):
+        monkeypatch.setenv("NNSTPU_TRACERS", "latency;stats")
+        got = []
+        p = simple_pipeline(got)
+        p.run(timeout=30)
+        tr = p.stats()["tracers"]
+        assert set(tr) == {"latency", "stats"}
+        lat = tr["latency"]
+        assert len(lat) == 1
+        (key, s), = lat.items()
+        assert key.endswith("->" + [n for n in p.nodes
+                                    if "sink" in n or "tensorsink" in n][0]) \
+            or s["count"] == 5
+        assert s["count"] == 5
+        # a second run must not attach duplicate tracers
+        p.run(timeout=30)
+        assert set(p.stats()["tracers"]) == {"latency", "stats"}
+
+    def test_scrape_endpoint_serves_exposition(self, monkeypatch):
+        """Acceptance: run with tracers on, then pull the text exposition
+        over HTTP from the stdlib scrape endpoint."""
+        from nnstreamer_tpu.obs import export
+
+        monkeypatch.setenv("NNSTPU_TRACERS", "latency;stats")
+        monkeypatch.setenv("NNSTPU_METRICS_PORT", "0")  # ephemeral bind
+        got = []
+        try:
+            simple_pipeline(got).run(timeout=30)
+            server = export._server
+            assert server is not None
+            with urllib.request.urlopen(server.url, timeout=10) as resp:
+                assert resp.status == 200
+                assert resp.headers["Content-Type"].startswith("text/plain")
+                body = resp.read().decode("utf-8")
+            assert "nnstpu_e2e_latency_ms_bucket" in body
+            assert "nnstpu_element_frames_total" in body
+        finally:
+            export.shutdown_server()
+
+    def test_metrics_server_direct(self):
+        reg = MetricsRegistry()
+        reg.counter("hits_total").inc(3)
+        with MetricsServer(port=0, registry=reg) as srv:
+            with urllib.request.urlopen(srv.url, timeout=10) as resp:
+                body = resp.read().decode("utf-8")
+        assert "hits_total 3" in body
+
+
+class TestProfilingRehome:
+    def test_p99_ceil_rank_and_p90(self):
+        """Satellite: the old floor-rank p99 returned the MAX for any
+        n <= 100; ceil-based nearest rank must return the 99th of 100."""
+        from nnstreamer_tpu.utils import profiling
+
+        for v in range(1, 101):  # 1..100 ms
+            profiling.record("el", v * 1_000_000)
+        s = profiling.stats()["el"]
+        assert s["p99_ms"] == 99.0  # not 100.0
+        assert s["p90_ms"] == 90.0
+        assert s["p50_ms"] == 50.0
+        assert s["min_ms"] == 1.0 and s["max_ms"] == 100.0
+
+    def test_record_feeds_obs_registry(self):
+        from nnstreamer_tpu.obs.metrics import REGISTRY
+        from nnstreamer_tpu.utils import profiling
+
+        profiling.record("rehomed_node", 2_000_000)  # 2 ms
+        hist = REGISTRY.get("nnstpu_node_invoke_latency_ms")
+        assert hist is not None
+        child = hist.labels(node="rehomed_node")
+        assert child.count >= 1
+
+
+class TestServingExport:
+    def test_engine_stats_republished_as_gauges(self):
+        from nnstreamer_tpu.serving import ContinuousBatcher
+
+        eng = ContinuousBatcher(capacity=2, t_max=8, d_in=4, n_out=2,
+                                d_model=8, n_heads=2, n_layers=1)
+        reg = MetricsRegistry()
+        handle = eng.publish_metrics(registry=reg)
+        try:
+            with eng.open_session() as sess:
+                sess.feed(np.zeros((4,), np.float32))
+                sess.get(timeout=10)
+                text = render_text(reg)
+                assert "nnstpu_serving_capacity 2" in text
+                assert "nnstpu_serving_active_sessions 1" in text
+                assert "nnstpu_serving_steps_total 1" in text
+        finally:
+            reg.remove_collector(handle)
+            eng.stop()

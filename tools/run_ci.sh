@@ -61,6 +61,43 @@ g.dryrun_multichip(8)
 print('dryrun OK')
 "
 
+run_step "Observability smoke (tracers + Prometheus scrape)" \
+  env NNSTPU_TRACERS="latency;stats" NNSTPU_METRICS_PORT=0 \
+  python - <<'PY'
+import urllib.request
+
+import jax
+jax.config.update('jax_platforms', 'cpu')
+import numpy as np
+
+from nnstreamer_tpu import Pipeline
+from nnstreamer_tpu.elements.sink import TensorSink
+from nnstreamer_tpu.elements.testsrc import DataSrc
+from nnstreamer_tpu.obs import export
+
+got = []
+p = Pipeline(name="ci_obs")
+src = p.add(DataSrc(data=[np.full(4, i, np.float32) for i in range(8)]))
+p.link(src, p.add(TensorSink(callback=got.append, name="out")))
+p.run(timeout=120)
+assert len(got) == 8, got
+
+tr = p.stats()["tracers"]
+(lat,), = (list(tr["latency"].values()),)
+assert lat["count"] == 8, tr
+
+server = export._server
+assert server is not None, "NNSTPU_METRICS_PORT did not start the endpoint"
+with urllib.request.urlopen(server.url, timeout=30) as resp:
+    body = resp.read().decode("utf-8")
+assert resp.status == 200 and body.strip(), "empty exposition"
+assert "nnstpu_e2e_latency_ms_bucket" in body, body[:400]
+assert "nnstpu_element_frames_total" in body, body[:400]
+export.shutdown_server()
+print(f"observability smoke OK: {len(body)} bytes of exposition, "
+      f"e2e p99={lat['p99_ms']:.3f} ms")
+PY
+
 run_step "Bench smoke (final JSON line parses, rc=0)" \
   bash -c '
     env BENCH_FRAMES=10 BENCH_QUANT_FRAMES=4 BENCH_BASELINE_FRAMES=3 \
