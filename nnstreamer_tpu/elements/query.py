@@ -48,6 +48,39 @@ from ..spec import TensorSpec, TensorsSpec
 MAGIC = b"NNSQ"
 VERSION = 1
 ERR_SENTINEL = 0xFFFF
+
+
+class QueryError(RuntimeError):
+    """Base for typed server-side error frames."""
+
+    code = ""
+
+
+class QueryOverloadError(QueryError):
+    """The server shed this request (admission limit / rate / queue)."""
+
+    code = "OVERLOAD"
+
+
+class QueryExpiredError(QueryOverloadError):
+    """The request's deadline passed while it was queued."""
+
+    code = "EXPIRED"
+
+
+class QueryUnavailableError(QueryError):
+    """The backend circuit breaker is open; retry later."""
+
+    code = "UNAVAILABLE"
+
+
+# wire code -> client-side exception; unknown/absent codes stay the
+# legacy RuntimeError so old servers interoperate with new clients
+ERROR_TYPES = {
+    "OVERLOAD": QueryOverloadError,
+    "EXPIRED": QueryExpiredError,
+    "UNAVAILABLE": QueryUnavailableError,
+}
 # pts of the client's negotiation probe frame.  DISTINCT from NONE_TS (-1):
 # unstamped stream frames are legitimate, and a stateful server (the
 # serving.DecodeServer) must answer a probe without advancing its session —
@@ -81,7 +114,13 @@ def send_tensors(sock: socket.socket, tensors, pts: int) -> None:
     sock.sendall(b"".join(parts))
 
 
-def send_error(sock: socket.socket, msg: str) -> None:
+def send_error(sock: socket.socket, msg: str, code: str = "") -> None:
+    """Error frame on the ``ntensors=0xFFFF`` framing.  ``code`` (one of
+    :data:`ERROR_TYPES`) rides as a ``[CODE] `` message prefix so the
+    receiver raises the matching typed exception — same bytes-on-wire
+    format, old peers just see the prefix as text."""
+    if code:
+        msg = f"[{code}] {msg}"
     m = msg.encode()[:4096]
     sock.sendall(MAGIC + struct.pack("<HHq", VERSION, ERR_SENTINEL, 0)
                  + struct.pack("<I", len(m)) + m)
@@ -103,9 +142,11 @@ def recv_tensors(sock: socket.socket) -> Tuple[Tuple[np.ndarray, ...], int]:
         (mlen,) = struct.unpack("<I", _recv_exact(sock, 4))
         if mlen > MAX_ERRMSG:
             raise ConnectionError(f"oversized error frame ({mlen} bytes)")
-        raise RuntimeError(
-            f"query server error: {_recv_exact(sock, mlen).decode()}"
-        )
+        text = _recv_exact(sock, mlen).decode()
+        cls: type = RuntimeError
+        if text.startswith("[") and "]" in text:
+            cls = ERROR_TYPES.get(text[1:text.index("]")], RuntimeError)
+        raise cls(f"query server error: {text}")
     if n > MAX_TENSORS:
         raise ConnectionError(f"{n} tensors exceeds the {MAX_TENSORS} limit")
     out = []
@@ -149,6 +190,7 @@ class QueryServer:
         batch: int = 0,
         batch_window_ms: float = 2.0,
         max_batch: int = 64,
+        scheduler=None,
     ):
         """``batch=K`` (K ≥ 2) turns on **cross-client batching**: requests
         from concurrent connections with the same tensor geometry coalesce
@@ -173,7 +215,17 @@ class QueryServer:
         invoke runs, other specs' groups can sit past their
         ``batch_window_ms`` deadline — a latency/fairness wart under
         mixed-geometry load, not a correctness bug (ordering and replies
-        are per-connection regardless)."""
+        are per-connection regardless).
+
+        ``scheduler`` (a :class:`nnstreamer_tpu.sched.Scheduler`) bounds
+        that wart and adds admission control: requests are admitted (or
+        shed with a typed ``NNSQ`` error frame) at receipt, ready batch
+        groups dispatch in the policy's order (DRR fairness across
+        clients, strict priority, EDF, ...), deadline-expired requests
+        drop before dispatch, and the circuit breaker turns a failing
+        backend into immediate typed rejections.  ``scheduler=None``
+        consults conf (``NNSTPU_SCHED_POLICY=...``); with nothing
+        configured, dispatch is byte-identical to the unscheduled path."""
         self._framework = framework
         self._model = model
         self._custom = custom
@@ -198,6 +250,13 @@ class QueryServer:
         self._dispatch_thread: Optional[threading.Thread] = None
         self.batched_invokes = 0   # observability
         self.batched_frames = 0
+        self._own_sched = False
+        if scheduler is None:
+            from ..sched import configured_scheduler
+
+            scheduler = configured_scheduler("query_server")
+            self._own_sched = scheduler is not None
+        self.scheduler = scheduler
 
     def _backend_for(self, spec: TensorsSpec):
         """Backend configured for ``spec`` (caller holds the lock)."""
@@ -246,48 +305,85 @@ class QueryServer:
                              daemon=True, name="query-server-conn").start()
 
     def _serve(self, conn: socket.socket) -> None:
+        from ..sched import BreakerOpenError, OverloadError
+
+        try:
+            peer = conn.getpeername()
+            client, tenant = f"{peer[0]}:{peer[1]}", str(peer[0])
+        except (OSError, IndexError):
+            client = tenant = "unknown"
         with conn:
             while self._running:
                 try:
                     tensors, pts = recv_tensors(conn)
                 except (ConnectionError, OSError):
                     return
+                item = None
                 try:
-                    if self.batch:
-                        outs = self._invoke_batched(tensors)
-                    else:
-                        with self._lock:
-                            if not self._running:
-                                return  # stop() raced us: backend closing
-                            spec = TensorsSpec.from_arrays(tensors)
-                            outs = self._backend_for(spec).invoke(tensors)
-                    send_tensors(conn, outs, pts)
+                    try:
+                        if self.scheduler is not None:
+                            t0 = tensors[0] if tensors else None
+                            cost = (int(np.asarray(t0).shape[0])
+                                    if t0 is not None
+                                    and np.asarray(t0).ndim >= 1 else 1)
+                            # may raise OverloadError: shed with a typed
+                            # frame, keep the connection serving
+                            item = self.scheduler.admit(
+                                client, tenant=tenant, cost=max(1, cost))
+                        if self.batch:
+                            outs = self._invoke_batched(tensors, item)
+                        else:
+                            outs = self._invoke_direct(tensors)
+                        send_tensors(conn, outs, pts)
+                    finally:
+                        if item is not None:
+                            self.scheduler.release(item)
+                except (OverloadError, BreakerOpenError) as exc:
+                    try:
+                        send_error(conn, str(exc), code=exc.code)
+                    except OSError:
+                        return
                 except Exception as exc:  # noqa: BLE001 — report, keep serving
                     try:
                         send_error(conn, repr(exc))
                     except OSError:
                         return
 
+    def _invoke_direct(self, tensors):
+        """Unbatched invoke (breaker-gated when a scheduler is attached)."""
+
+        def run():
+            with self._lock:
+                if not self._running:
+                    raise RuntimeError("query server stopped")
+                spec = TensorsSpec.from_arrays(tensors)
+                return self._backend_for(spec).invoke(tensors)
+
+        if self.scheduler is not None:
+            return self.scheduler.invoke(run)
+        return run()
+
     # -- cross-client batching ---------------------------------------------
 
     class _Pending:
-        __slots__ = ("spec", "tensors", "event", "outs", "error")
+        __slots__ = ("spec", "tensors", "event", "outs", "error", "item")
 
-        def __init__(self, spec, tensors):
+        def __init__(self, spec, tensors, item=None):
             self.spec = spec
             self.tensors = tensors
             self.event = threading.Event()
             self.outs = None
             self.error = None
+            self.item = item  # SchedItem when a scheduler is attached
 
-    def _invoke_batched(self, tensors):
+    def _invoke_batched(self, tensors, item=None):
         """Enqueue for the dispatcher; block until this request's slice of
         the batched result arrives.  The wait polls ``_running`` so a
         request racing ``stop()`` (enqueued after the final queue drain)
         errors out instead of hanging its connection thread forever."""
         if not self._running:
             raise RuntimeError("query server stopped")
-        req = self._Pending(TensorsSpec.from_arrays(tensors), tensors)
+        req = self._Pending(TensorsSpec.from_arrays(tensors), tensors, item)
         self._rq.put(req)
         while not req.event.wait(0.5):
             if not self._running:
@@ -301,15 +397,25 @@ class QueryServer:
         mixed-geometry traffic progresses independently (a lone spec
         flushes after its own window; no spec serializes behind another's
         wait).  Safe to group across connections in any order — each has
-        at most one request in flight."""
+        at most one request in flight.
+
+        With a scheduler attached, a *ready* group (full, or past its
+        window) is not dispatched inline: it becomes one schedulable item
+        (client = first member, cost = total rows) and the policy decides
+        which ready group the dispatcher runs next — DRR keeps one heavy
+        client's groups from starving everyone else's tick."""
+        sch = self.scheduler
         pending: Dict[TensorsSpec, list] = {}  # spec -> [deadline, group]
         while self._running:
             timeout = 0.1
             if pending:
                 nearest = min(d for d, _ in pending.values())
                 timeout = min(timeout, max(0.001, nearest - time.monotonic()))
+            if sch is not None and sch.queued():
+                timeout = 0  # ready groups waiting: drain, don't block
             try:
-                req = self._rq.get(timeout=timeout)
+                req = (self._rq.get(timeout=timeout) if timeout > 0
+                       else self._rq.get_nowait())
             except queue.Empty:
                 req = None
             if req is not None:
@@ -321,18 +427,70 @@ class QueryServer:
                     entry[1].append(req)
                     if len(entry[1]) >= self.batch:
                         del pending[req.spec]
-                        self._dispatch_group(entry[1])
+                        self._group_ready(entry[1])
             now = time.monotonic()
             for spec in [s for s, (d, _) in pending.items() if d <= now]:
-                self._dispatch_group(pending.pop(spec)[1])
+                self._group_ready(pending.pop(spec)[1])
+            if sch is not None:
+                gitem = sch.dequeue()
+                if gitem is not None:
+                    self._dispatch_group(gitem.payload)
         # exit: every still-pending waiter must wake (stop() drains only
         # the queue, not groups already collected here)
         for _, group in pending.values():
             for g in group:
                 g.error = RuntimeError("query server stopped")
                 g.event.set()
+        while sch is not None:
+            gitem = sch.dequeue()
+            if gitem is None:
+                break
+            for g in gitem.payload:
+                g.error = RuntimeError("query server stopped")
+                g.event.set()
+
+    def _group_ready(self, group) -> None:
+        """A coalesced group is ready: dispatch inline (no scheduler) or
+        hand it to the policy as one schedulable item."""
+        sch = self.scheduler
+        if sch is None:
+            self._dispatch_group(group)
+            return
+        from ..sched import SchedItem
+
+        members = [g.item for g in group if g.item is not None]
+        first = members[0] if members else None
+        deadlines = [m.deadline for m in members if m.deadline is not None]
+        sch.enqueue(SchedItem(
+            first.client if first else "unknown",
+            cost=sum(m.cost for m in members) or 1.0,
+            priority=max((m.priority for m in members), default=0),
+            deadline=min(deadlines) if deadlines else None,
+            enqueue_t=min((m.enqueue_t for m in members),
+                          default=time.monotonic()),
+            payload=group,
+            tenant=first.tenant if first else None,
+        ))
 
     def _dispatch_group(self, group) -> None:
+        sch = self.scheduler
+        if sch is not None:
+            # deadline-expired members drop BEFORE dispatch: late work is
+            # cancelled with a typed reply, not served to a gone client
+            now = time.monotonic()
+            live = []
+            for g in group:
+                if g.item is not None and g.item.expired(now):
+                    g.error = sch.expired_error(g.item)
+                    g.event.set()
+                else:
+                    live.append(g)
+            group = live
+            if not group:
+                return
+            for g in group:
+                if g.item is not None:
+                    sch.observe_wait(g.item, now)
         n_tensors = len(group[0].tensors)
         try:
             # requests already carry the batch dim ((k_i, ...) frames — the
@@ -380,11 +538,14 @@ class QueryServer:
                 if pad:
                     parts.append(np.repeat(parts[-1][-1:], pad, axis=0))
                 cat.append(np.concatenate(parts, axis=0))
-            with self._lock:
-                if not self._running:
-                    raise RuntimeError("server stopping")
-                spec = TensorsSpec.from_arrays(cat)
-                outs = self._backend_for(spec).invoke(cat)
+            def run():
+                with self._lock:
+                    if not self._running:
+                        raise RuntimeError("server stopping")
+                    spec = TensorsSpec.from_arrays(cat)
+                    return self._backend_for(spec).invoke(cat)
+
+            outs = sch.invoke(run) if sch is not None else run()
             self.batched_invokes += 1
             self.batched_frames += total
             off = 0
@@ -396,6 +557,20 @@ class QueryServer:
             for g in group:
                 g.error = exc
                 g.event.set()
+
+    def stats(self) -> dict:
+        """Server observability snapshot (merged into the obs exposition
+        via ``register_engine``-style collectors; thread-safe)."""
+        out = {
+            "running": self._running,
+            "batch": self.batch,
+            "batched_invokes": self.batched_invokes,
+            "batched_frames": self.batched_frames,
+            "spec_backends": len(self._backends),
+        }
+        if self.scheduler is not None:
+            out["sched"] = self.scheduler.stats()
+        return out
 
     def stop(self) -> None:
         self._running = False
@@ -415,6 +590,9 @@ class QueryServer:
             for be in self._backends.values():
                 be.close()
             self._backends.clear()
+        if self._own_sched and self.scheduler is not None:
+            # conf-activated scheduler: this server owns its collector
+            self.scheduler.close()
 
     def __enter__(self) -> "QueryServer":
         return self.start()

@@ -486,24 +486,29 @@ class ContinuousBatcher:
                     )
                 else:
                     ys = None
-                for sess, y_last, n in pre_out:
-                    # a prefill is one compiled dispatch serving one
-                    # output: counters stay consistent with sess.steps
-                    # (incremented under the lock so stats() never reads a
-                    # torn ticks/steps pair — review r5)
-                    with self._cv:
+                ys_np = np.asarray(ys) if ys is not None else None  # sync
+                # ONE critical section for the whole tick's counters: a
+                # concurrent stats() either sees the entire tick or none
+                # of it, so the coalescing ratio is never computed from a
+                # half-updated ticks/steps pair (the per-dispatch lock
+                # windows flagged at review r5 kept each pair atomic but
+                # let a multi-prefill tick publish piecemeal).  Device
+                # syncs stay outside; only integer adds run under _cv.
+                with self._cv:
+                    for sess, y_last, n in pre_out:
                         self.prefill_tokens += n
                         self.ticks += 1
                         self.steps_total += 1
-                    sess.steps += 1
-                    sess._q_out.put(np.asarray(y_last).copy())
-                if ys is not None:
-                    ys_np = np.asarray(ys)  # sync outside the state handoff
-                    with self._cv:
+                        sess.steps += 1
+                    if ys_np is not None:
                         self.ticks += 1
                         self.steps_total += len(fed)
+                        for sess in fed.values():
+                            sess.steps += 1
+                for sess, y_last, n in pre_out:
+                    sess._q_out.put(np.asarray(y_last).copy())
+                if ys_np is not None:
                     for slot, sess in fed.items():
-                        sess.steps += 1
                         sess._q_out.put(ys_np[slot].copy())
         except BaseException as exc:  # noqa: BLE001 — wake the waiters
             self._fail(exc)
@@ -536,7 +541,16 @@ class DecodeServer:
     """
 
     def __init__(self, engine: ContinuousBatcher, host: str = "127.0.0.1",
-                 port: int = 0, session_timeout: float = 30.0):
+                 port: int = 0, session_timeout: float = 30.0,
+                 scheduler=None):
+        """``scheduler`` (:class:`nnstreamer_tpu.sched.Scheduler`) makes
+        session admission priority-aware when capacity slots are
+        contended: joiners wait in (priority, FIFO) order behind a
+        bounded waiting room, and an over-full room sheds with a typed
+        ``NNSQ`` error frame instead of parking the connection for the
+        whole ``session_timeout``.  ``scheduler=None`` consults conf
+        (``NNSTPU_SCHED_POLICY``); unset keeps the legacy first-come
+        ``open_session`` path."""
         self.engine = engine
         self.host, self.port = host, int(port)
         self.session_timeout = float(session_timeout)
@@ -544,6 +558,13 @@ class DecodeServer:
         self._accept: Optional[threading.Thread] = None
         self._running = False
         self.connections = 0  # observability
+        self._own_sched = False
+        if scheduler is None:
+            from .sched import configured_scheduler
+
+            scheduler = configured_scheduler("decode_server")
+            self._own_sched = scheduler is not None
+        self.scheduler = scheduler
         # live client sockets: stop() must shut these down too — an idle
         # client's _serve thread is parked in recv, and only unblocking it
         # releases the session's capacity slot (review r5)
@@ -577,6 +598,30 @@ class DecodeServer:
                 pass
         if self._accept is not None:
             self._accept.join(timeout=10)
+        if self._own_sched and self.scheduler is not None:
+            # conf-activated scheduler: this server owns its collector
+            self.scheduler.close()
+
+    def stats(self) -> dict:
+        """Server snapshot (engine state lives in ``engine.stats()``)."""
+        out = {"running": self._running, "connections": self.connections}
+        if self.scheduler is not None:
+            out["sched"] = self.scheduler.stats()
+        return out
+
+    def _admit_session(self, client: str) -> DecodeSession:
+        """Priority-aware slot assignment: non-blocking grant attempts in
+        the gate's (priority, FIFO) order until a slot frees or the
+        session timeout / waiting-room bound sheds the join."""
+
+        def try_grant():
+            try:
+                return self.engine.open_session(timeout=0)
+            except TimeoutError:
+                return None  # full right now: stay in the gate
+
+        return self.scheduler.acquire_slot(
+            client, try_grant, timeout=self.session_timeout)
 
     def __enter__(self):
         return self.start()
@@ -603,7 +648,13 @@ class DecodeServer:
             send_error,
             send_tensors,
         )
+        from .sched import OverloadError
 
+        try:
+            peer = conn.getpeername()
+            client = f"{peer[0]}:{peer[1]}"
+        except (OSError, IndexError):
+            client = "unknown"
         sess: Optional[DecodeSession] = None
         try:
             while self._running:
@@ -638,8 +689,11 @@ class DecodeServer:
                     if sess is None:
                         # lazy join: a probe-only connection never holds a
                         # capacity slot
-                        sess = self.engine.open_session(
-                            timeout=self.session_timeout)
+                        if self.scheduler is not None:
+                            sess = self._admit_session(client)
+                        else:
+                            sess = self.engine.open_session(
+                                timeout=self.session_timeout)
                     if tensors[0].ndim == 2:
                         # rank-2 frame = a whole prompt: ONE compiled
                         # prefill pass builds the slot's KV state (an
@@ -650,6 +704,15 @@ class DecodeServer:
                         sess.feed(tensors[0])
                     y = sess.get(timeout=self.session_timeout)
                     send_tensors(conn, (y,), pts)
+                except OverloadError as exc:
+                    # shed join: typed wire rejection, never a parked
+                    # connection (the client raises QueryOverloadError)
+                    try:
+                        send_error(conn, f"decode server: {exc}",
+                                   code=exc.code)
+                    except OSError:
+                        pass
+                    return
                 except (ValueError, RuntimeError, TimeoutError) as exc:
                     try:
                         send_error(conn, f"decode server: {exc}")

@@ -717,3 +717,38 @@ def test_engine_stats_snapshot():
         assert st["steps_total"] == 2 and st["prefill_tokens"] == 3
         assert st["ticks"] == 2 and st["coalescing"] == 1.0
     assert eng.stats()["running"] is False
+
+
+def test_stats_counters_never_torn_under_concurrent_reads():
+    """The tick counters (ticks, steps_total, prefill_tokens) publish in
+    ONE critical section per tick: a stats() racing the engine thread
+    must never observe a half-updated pair (the coalescing ratio would
+    lie).  With a single stream every tick adds exactly +1/+1, and a
+    prefill adds +1/+1 as well, so any snapshot where the two counters
+    differ is a torn read."""
+    torn = []
+    stop = threading.Event()
+
+    with ContinuousBatcher(capacity=2, **KW) as eng:
+
+        def hammer():
+            while not stop.is_set():
+                st = eng.stats()
+                if st["ticks"] != st["steps_total"]:
+                    torn.append((st["ticks"], st["steps_total"]))
+
+        readers = [threading.Thread(target=hammer) for _ in range(2)]
+        for r in readers:
+            r.start()
+        with eng.open_session() as sess:
+            sess.prefill(np.stack(stream_inputs(7, 4)))
+            sess.get(timeout=30)
+            for x in stream_inputs(8, 40):
+                sess.feed(x)
+                sess.get(timeout=30)
+        stop.set()
+        for r in readers:
+            r.join(timeout=30)
+        assert not torn, f"torn ticks/steps_total snapshots: {torn[:5]}"
+        st = eng.stats()
+        assert st["ticks"] == st["steps_total"] == 41

@@ -1,10 +1,34 @@
 #!/usr/bin/env python
-"""Per-frame overhead of the mux->batch->filter->unbatch->demux path
-vs a single stream, identity model, CPU: isolates the collect/batch
-machinery cost that config5 adds."""
-import os, sys, time
+"""Why does CPU-fallback mux throughput DECLINE as streams are added?
+
+VERDICT r5 item 4: `config5_scaling {1: 5.84 -> 8: 4.81}` — on the CPU
+fallback the mux->batch->filter->unbatch->demux path LOSES aggregate
+throughput per added stream, where batching should at worst be flat.
+This tool isolates where the per-stream cost lands:
+
+- sweeps STREAM COUNTS (1, 2, 4, 8 by default) at a fixed TOTAL frame
+  budget, identity jax model, CPU pin — so the filter's work is constant
+  and any decline is pure machinery;
+- attributes wall time per element via the obs hook bus
+  (``dispatch_exit`` carries wall-ns per sink-pad dispatch): mux collect
+  vs batch concat vs filter invoke vs unbatch/demux fan-out;
+- reports source/sink thread counts per config (each added stream adds a
+  source thread and a sink dispatch — on a GIL'd 1-core host those time-
+  slice rather than parallelize).
+
+Usage: ``python tools/profile_mux_overhead.py [TOTAL_FRAMES] [SWEEP...]``
+e.g. ``python tools/profile_mux_overhead.py 2000 1 2 4 8``.
+Appends nothing; copy the table + verdict into BENCH_NOTES.md.
+"""
+import os
+import sys
+import threading
+import time
+from collections import defaultdict
+
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import jax
+
 jax.config.update("jax_platforms", "cpu")
 import numpy as np
 
@@ -16,53 +40,137 @@ from nnstreamer_tpu.elements.filter import TensorFilter
 from nnstreamer_tpu.elements.mux import TensorMux
 from nnstreamer_tpu.elements.sink import TensorSink
 from nnstreamer_tpu.elements.testsrc import DataSrc
+from nnstreamer_tpu.obs import hooks
 from nnstreamer_tpu.spec import TensorSpec, TensorsSpec
 
-N = int(sys.argv[1]) if len(sys.argv) > 1 else 2000
-STREAMS = 4
-arr = np.zeros((16,), np.float32)
+TOTAL = int(sys.argv[1]) if len(sys.argv) > 1 else 2000
+SWEEP = [int(a) for a in sys.argv[2:]] or [1, 2, 4, 8]
+# identity isolates the collect/batch machinery; matmul emulates the
+# compute-bound config5 regime (is the decline machinery or model?)
+MODEL = os.environ.get("MUX_PROFILE_MODEL", "identity")
+D = int(os.environ.get("MUX_PROFILE_DIM",
+                       "16" if MODEL == "identity" else "1024"))
+arr = np.zeros((D,), np.float32)
+_W = None
 
-ident1 = JaxModel(apply=lambda p, x: x,
-    input_spec=TensorsSpec.of(TensorSpec(dtype=np.float32, shape=(16,))))
-identB = JaxModel(apply=lambda p, x: x,
-    input_spec=TensorsSpec.of(TensorSpec(dtype=np.float32, shape=(STREAMS, 16))))
 
-def run_single(n):
+def model_for(streams):
+    shape = (D,) if streams == 1 else (streams, D)
+    spec = TensorsSpec.of(TensorSpec(dtype=np.float32, shape=shape))
+    if MODEL == "identity":
+        return JaxModel(apply=lambda p, x: x, input_spec=spec)
+    global _W
+    if _W is None:
+        import jax.numpy as jnp
+
+        _W = jnp.asarray(np.random.default_rng(0)
+                         .standard_normal((D, D)).astype(np.float32))
+
+    def apply(p, x):
+        h = x
+        for _ in range(8):  # ~8 * D^2 flops/frame: compute-bound on CPU
+            h = jax.numpy.tanh(h @ _W)
+        return h
+
+    return JaxModel(apply=apply, input_spec=spec)
+
+
+class Attribution:
+    """Per-element busy wall-ns from the dispatch_exit hook."""
+
+    def __init__(self):
+        self.ns = defaultdict(int)
+        self.calls = defaultdict(int)
+        self._lock = threading.Lock()
+
+    def __call__(self, node, pad, item, dur_ns):
+        with self._lock:
+            self.ns[type(node).__name__] += dur_ns
+            self.calls[type(node).__name__] += 1
+
+    def table(self):
+        return sorted(self.ns.items(), key=lambda kv: -kv[1])
+
+
+def run_mux(streams, frames_per_stream, attribute=False):
     state = {"count": 0, "t0": None}
-    def cb(frame):
-        if state["t0"] is None: state["t0"] = time.perf_counter()
-        state["count"] += 1
-    p = Pipeline()
-    p.add(DataSrc(name="s", data=[arr.copy() for _ in range(n)]))
-    p.add(TensorFilter(name="f", framework="jax", model=ident1))
-    p.add(TensorSink(name="o", callback=cb))
-    p.link_chain("s", "f", "o")
-    p.run(timeout=300)
-    return (state["count"] - 1) / (time.perf_counter() - state["t0"])
 
-def run_mux(n_per_stream):
-    state = {"count": 0, "t0": None}
     def cb(frame):
-        if state["t0"] is None: state["t0"] = time.perf_counter()
+        if state["t0"] is None:
+            state["t0"] = time.perf_counter()
         state["count"] += 1
-    p = Pipeline()
-    mux = p.add(TensorMux(sync_mode="nosync"))
-    for i in range(STREAMS):
-        src = p.add(DataSrc(name=f"s{i}", data=[arr.copy() for _ in range(n_per_stream)]))
-        p.link(src, f"{mux.name}.sink_{i}")
-    batch = p.add(TensorBatch())
-    filt = p.add(TensorFilter(name="f", framework="jax", model=identB))
-    unb = p.add(TensorUnbatch())
-    demux = p.add(TensorDemux())
-    p.link_chain(mux, batch, filt, unb, demux)
-    for i in range(STREAMS):
-        p.link(f"{demux.name}.src_{i}", p.add(TensorSink(name=f"o{i}", callback=cb)))
-    p.run(timeout=300)
-    return (state["count"] - STREAMS) / (time.perf_counter() - state["t0"])
 
-run_single(50); run_mux(20)  # warm
-fps1 = run_single(N)
-print(f"single stream:  {1e6/fps1:8.1f} us/frame ({fps1:9.0f}/s)")
-fpsM = run_mux(N // STREAMS)
-print(f"mux x{STREAMS} batched: {1e6/fpsM:8.1f} us/frame ({fpsM:9.0f}/s aggregate)")
-print(f"per-batched-invoke overhead: {STREAMS*1e6/fpsM:8.1f} us")
+    p = Pipeline()
+    if streams == 1:
+        src = p.add(DataSrc(name="s0", data=[arr.copy() for _ in
+                                             range(frames_per_stream)]))
+        filt = p.add(TensorFilter(name="f", framework="jax",
+                                  model=model_for(1)))
+        sink = p.add(TensorSink(name="o0", callback=cb))
+        p.link_chain(src, filt, sink)
+    else:
+        mux = p.add(TensorMux(sync_mode="nosync"))
+        for i in range(streams):
+            src = p.add(DataSrc(name=f"s{i}", data=[arr.copy() for _ in
+                                                    range(frames_per_stream)]))
+            p.link(src, f"{mux.name}.sink_{i}")
+        batch = p.add(TensorBatch())
+        filt = p.add(TensorFilter(name="f", framework="jax",
+                                  model=model_for(streams)))
+        unb = p.add(TensorUnbatch())
+        demux = p.add(TensorDemux())
+        p.link_chain(mux, batch, filt, unb, demux)
+        for i in range(streams):
+            p.link(f"{demux.name}.src_{i}",
+                   p.add(TensorSink(name=f"o{i}", callback=cb)))
+    attr = Attribution()
+    if attribute:
+        hooks.connect("dispatch_exit", attr)
+    try:
+        t_start = time.perf_counter()
+        p.run(timeout=600)
+        wall = time.perf_counter() - t_start
+    finally:
+        if attribute:
+            hooks.disconnect("dispatch_exit", attr)
+    done = state["count"] - max(1, streams)  # exclude the clock-start frame(s)
+    fps = done / (time.perf_counter() - state["t0"])
+    return fps, wall, attr
+
+
+def main():
+    ncpu = os.cpu_count()
+    print(f"mux overhead sweep: total={TOTAL} frames, host cpus={ncpu}, "
+          f"threads-per-config = streams sources + 1/elt + sinks")
+    run_mux(1, 50)
+    base_fps, _, _ = run_mux(1, TOTAL)
+    print(f"\n{'streams':>7} {'agg fps':>10} {'us/frame':>10} "
+          f"{'vs 1-stream':>11}")
+    print(f"{1:>7} {base_fps:>10.0f} {1e6 / base_fps:>10.1f} {'1.00x':>11}")
+    results = {1: base_fps}
+    for s in [s for s in SWEEP if s != 1]:
+        run_mux(s, 40)  # warm the s-wide executable
+        fps, _, _ = run_mux(s, TOTAL // s)
+        results[s] = fps
+        print(f"{s:>7} {fps:>10.0f} {1e6 / fps:>10.1f} "
+              f"{fps / base_fps:>10.2f}x")
+
+    # attribution pass at the widest sweep point
+    widest = max(SWEEP)
+    run_mux(widest, 30)
+    fps, wall, attr = run_mux(widest, TOTAL // widest, attribute=True)
+    print(f"\nper-element busy time at {widest} streams "
+          f"({TOTAL // widest} frames/stream, wall {wall:.2f}s; "
+          "dispatch_exit hook, sink-pad wall-ns):")
+    total_busy = sum(attr.ns.values()) or 1
+    for name, ns in attr.table():
+        per_call = ns / max(1, attr.calls[name]) / 1e3
+        print(f"  {name:<14} {ns / 1e9:>8.3f}s  {100 * ns / total_busy:>5.1f}%"
+              f"  {per_call:>8.1f} us/dispatch  x{attr.calls[name]}")
+    busy_frac = total_busy / 1e9 / wall
+    print(f"  busy/wall = {busy_frac:.2f} "
+          f"(the rest is source threads + queue waits + GIL slicing)")
+
+
+if __name__ == "__main__":
+    main()

@@ -98,6 +98,117 @@ print(f"observability smoke OK: {len(body)} bytes of exposition, "
       f"e2e p99={lat['p99_ms']:.3f} ms")
 PY
 
+run_step "Scheduling smoke (DRR fairness + typed shed + live scrape)" \
+  python - <<'PY'
+import socket
+import threading
+import time
+import urllib.request
+
+import numpy as np
+
+from nnstreamer_tpu.elements.query import (
+    QueryOverloadError, QueryServer, recv_tensors, send_tensors)
+from nnstreamer_tpu.obs.export import MetricsServer
+from nnstreamer_tpu.obs.metrics import MetricsRegistry
+from nnstreamer_tpu.sched import AdmissionController, Scheduler
+
+
+def model(x):  # invoke cost proportional to rows
+    time.sleep(0.002 * x.shape[0])
+    return x * 2.0
+
+
+def query(port, tensors):
+    s = socket.create_connection(("127.0.0.1", port))
+    try:
+        send_tensors(s, tensors, 0)
+        return recv_tensors(s)
+    finally:
+        s.close()
+
+
+reg = MetricsRegistry()
+sch = Scheduler("drr", quantum=8.0,
+                admission=AdmissionController(max_queue=32),
+                name="ci", registry=reg)
+done, failures, shed = [], [], []
+stop = threading.Event()
+with QueryServer(framework="custom", model=model, batch=8,
+                 batch_window_ms=5.0, scheduler=sch) as srv, \
+        MetricsServer(port=0, registry=reg) as ms:
+
+    def slow_flood():
+        conns = [socket.create_connection(("127.0.0.1", srv.port))
+                 for _ in range(3)]
+        try:
+            while not stop.is_set():
+                for s in conns:
+                    send_tensors(s, (np.ones((24, 4), np.float32),), 0)
+                for s in conns:
+                    recv_tensors(s)
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            for s in conns:
+                s.close()
+
+    def fast(k):
+        try:
+            for i in range(8):
+                out, _ = query(srv.port,
+                               (np.full((1, 4), float(i), np.float32),))
+                np.testing.assert_allclose(out[0], 2.0 * i)
+            done.append(k)
+        except Exception as exc:  # noqa: BLE001
+            failures.append((k, exc))
+
+    flood = threading.Thread(target=slow_flood, daemon=True)
+    flood.start()
+    time.sleep(0.1)
+    fasts = [threading.Thread(target=fast, args=(k,)) for k in range(7)]
+    for t in fasts:
+        t.start()
+    for t in fasts:
+        t.join(timeout=120)
+    stop.set()
+    flood.join(timeout=30)
+    assert not failures, failures
+    assert len(done) == 7, done  # every fast client completed under flood
+    # overload beyond admission limits sheds typed (zero hung conns)
+    tight = Scheduler("fifo", admission=AdmissionController(max_queue=1),
+                      name="ci_tight", registry=reg)
+    with QueryServer(framework="custom", model=model,
+                     scheduler=tight) as srv2:
+        outcomes = []
+
+        def burst():
+            try:
+                query(srv2.port, (np.ones((40, 4), np.float32),))
+                outcomes.append("ok")
+            except QueryOverloadError:
+                outcomes.append("shed")
+
+        bs = [threading.Thread(target=burst) for _ in range(3)]
+        for t in bs:
+            t.start()
+        for t in bs:
+            t.join(timeout=60)
+        assert sorted(outcomes) == ["ok", "shed", "shed"], outcomes
+    tight.close()
+    with urllib.request.urlopen(ms.url, timeout=30) as resp:
+        body = resp.read().decode("utf-8")
+    assert "nnstpu_sched_queue_wait_ms_bucket" in body, body[:400]
+    assert 'nnstpu_sched_dispatched_total{server="ci"}' in body
+    assert 'nnstpu_sched_shed_total{server="ci_tight",reason="queue_full"} 2' \
+        in body, [l for l in body.splitlines() if "shed" in l]
+st = srv.stats()["sched"]
+sch.close()
+print(f"scheduling smoke OK: {st['dispatched']} scheduled dispatches, "
+      f"7/7 fast clients under flood, 2 typed sheds, live scrape carried "
+      "nnstpu_sched_*")
+PY
+
 run_step "Bench smoke (final JSON line parses, rc=0)" \
   bash -c '
     env BENCH_FRAMES=10 BENCH_QUANT_FRAMES=4 BENCH_BASELINE_FRAMES=3 \
