@@ -51,6 +51,14 @@ DEFAULTS: Dict[str, Dict[str, str]] = {
         "torch_device": "cpu",      # the `torch use gpu` knob analog
     },
     "decoder": {},
+    # Observability (nnstreamer_tpu/obs): span tracing + metric shaping.
+    # Short env spellings NNSTPU_METRICS_BUCKETS / NNSTPU_FLIGHT_RECORDS
+    # take precedence over the NNSTPU_OBS_* forms mapped here.
+    "obs": {
+        "buckets": "",              # latency-histogram bounds, ms ("0.1,1,10")
+        "flight_records": "",       # span flight-recorder ring size per thread
+        "flight_dump_dir": "",      # write {pipeline}.error.trace.json here
+    },
     # Serving QoS (nnstreamer_tpu/sched): NNSTPU_SCHED_* env vars map here.
     # An empty policy disables scheduling entirely (legacy FIFO dispatch).
     "sched": {

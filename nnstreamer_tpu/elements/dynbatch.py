@@ -40,6 +40,7 @@ from ..graph.registry import register_element
 from ..native import OK, SHUTDOWN
 from ..native.queue import make_frame_queue
 from ..obs import hooks as _hooks
+from ..obs import spans as _spans
 from ..spec import TensorSpec, TensorsSpec
 
 _POLL_MS = 100
@@ -120,6 +121,12 @@ class DynBatch(Node):
                 "meta": [f.meta for f in frames],
             }
         }
+        if _spans.enabled:
+            # the batched frame gets its own span with parent links to
+            # every constituent frame's span (their per-frame contexts
+            # survive inside meta["dynbatch"]["meta"] and are restored by
+            # tensor_dynunbatch)
+            _spans.merge_context(frames, meta, self.name)
         self.frames_in += n
         self.batches_emitted += 1
         if _hooks.enabled:

@@ -10,11 +10,12 @@ turns N camera streams into one sharded XLA invocation.
 
 from __future__ import annotations
 
-from typing import Dict, Optional
+from typing import Any, Dict, Optional
 
 from ..buffer import Frame
 from ..graph.node import NegotiationError
 from ..graph.registry import register_element
+from ..obs import spans as _spans
 from ..spec import NNS_TENSOR_SIZE_LIMIT, TensorsSpec
 from .collect import CollectNode
 
@@ -43,4 +44,10 @@ class TensorMux(CollectNode):
         for name in sorted(frames, key=lambda n: (len(n), n)):
             tensors.extend(frames[name].tensors)
         pts, dur = self.output_timing(frames)
-        return Frame(tensors=tuple(tensors), pts=pts, duration=dur)
+        meta: Dict[str, Any] = {}
+        if _spans.enabled:
+            # one collection round = one new span, parent-linked to every
+            # contributed stream's frame span (their cross-thread flows
+            # terminate at this collect point)
+            _spans.merge_context(frames.values(), meta, self.name)
+        return Frame(tensors=tuple(tensors), pts=pts, duration=dur, meta=meta)

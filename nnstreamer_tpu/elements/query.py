@@ -11,10 +11,23 @@ hosts — stream frames to it and get results back.
 Wire protocol (version 1, little-endian):
 
     request :  MAGIC(4s=b"NNSQ") ver(u16) ntensors(u16) pts(i64)
+               [trace_id(u64) span_id(u64) reserved(u32)]   — iff FLAG_TRACE
                [dtype_len(u16) dtype_str shape_rank(u16) shape(u32 × rank)
                 payload_len(u64) payload] × ntensors
     reply   :  same framing; ntensors == 0 + dtype_str b"ERR" never sent —
                errors use ntensors=0xFFFF followed by msg_len(u32) + utf-8.
+
+The ``ver`` field is split ``flags | version``: the low byte is the
+protocol version (still 1), the high byte carries header flag bits.
+``FLAG_TRACE`` (0x0100) marks an optional 20-byte **trace-context
+block** between the fixed header and the tensor list — how a span trace
+(``NNSTPU_TRACERS=spans``, :mod:`nnstreamer_tpu.obs.spans`) follows a
+frame across the wire so server-side spans attach to the client's
+trace.  Version gating keeps old peers working: senders emit the flag
+only after the peer proved it speaks it (the server echoes the flag on
+flagged requests; the client's flagged negotiation probe falls back to
+a plain probe when a strict-v1 server drops the connection), so a
+pre-trace peer only ever sees plain version-1 bytes.
 
 Raw C-order bytes, no pickle — safe against untrusted peers and portable
 across hosts (same discipline as ``utils/checkpoint.py``).
@@ -43,10 +56,14 @@ import numpy as np
 from ..buffer import Frame
 from ..graph.node import NegotiationError, Node, Pad
 from ..graph.registry import register_element
+from ..obs import spans as _spans
 from ..spec import TensorSpec, TensorsSpec
 
 MAGIC = b"NNSQ"
 VERSION = 1
+VER_MASK = 0x00FF   # low byte: protocol version
+FLAG_TRACE = 0x0100  # high-byte flag: trace-context block follows the header
+_TRACE_BLOCK = struct.Struct("<QQI")  # trace_id, span_id, reserved
 ERR_SENTINEL = 0xFFFF
 
 
@@ -98,8 +115,16 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
     return bytes(buf)
 
 
-def send_tensors(sock: socket.socket, tensors, pts: int) -> None:
-    parts = [MAGIC, struct.pack("<HHq", VERSION, len(tensors), pts)]
+def send_tensors(sock: socket.socket, tensors, pts: int,
+                 trace: Optional[Tuple[int, int]] = None) -> None:
+    """``trace=(trace_id, span_id)`` sets :data:`FLAG_TRACE` and prepends
+    the trace-context block.  Only send it to a peer that proved trace
+    support (see the module docstring) — a strict version-1 peer rejects
+    the flagged header."""
+    ver = VERSION | (FLAG_TRACE if trace is not None else 0)
+    parts = [MAGIC, struct.pack("<HHq", ver, len(tensors), pts)]
+    if trace is not None:
+        parts.append(_TRACE_BLOCK.pack(trace[0], trace[1], 0))
     for t in tensors:
         # np.asarray (not ascontiguousarray: it promotes 0-d to 1-d);
         # tobytes() below emits C-order regardless of memory layout
@@ -132,12 +157,31 @@ MAX_ERRMSG = 4096  # mirrors the cap send_error applies
 
 
 def recv_tensors(sock: socket.socket) -> Tuple[Tuple[np.ndarray, ...], int]:
+    """Receive one frame, discarding any trace context (the pre-trace
+    call shape — every legacy call site keeps its 2-tuple)."""
+    tensors, pts, _ = recv_tensors_ex(sock)
+    return tensors, pts
+
+
+def recv_tensors_ex(
+    sock: socket.socket,
+) -> Tuple[Tuple[np.ndarray, ...], int, Optional[Tuple[int, int]]]:
+    """Receive one frame plus its optional trace context: returns
+    ``(tensors, pts, (trace_id, span_id) | None)``.  Tolerates (and
+    consumes) the :data:`FLAG_TRACE` header bit; any other flag or
+    version still rejects."""
     head = _recv_exact(sock, 4 + 12)
     if head[:4] != MAGIC:
         raise ConnectionError(f"bad magic {head[:4]!r}")
     ver, n, pts = struct.unpack("<HHq", head[4:])
-    if ver != VERSION:
+    flags = ver & ~VER_MASK
+    if (ver & VER_MASK) != VERSION or (flags & ~FLAG_TRACE):
         raise ConnectionError(f"protocol version {ver} != {VERSION}")
+    trace = None
+    if flags & FLAG_TRACE:
+        t_id, s_id, _reserved = _TRACE_BLOCK.unpack(
+            _recv_exact(sock, _TRACE_BLOCK.size))
+        trace = (t_id, s_id)
     if n == ERR_SENTINEL:
         (mlen,) = struct.unpack("<I", _recv_exact(sock, 4))
         if mlen > MAX_ERRMSG:
@@ -170,7 +214,7 @@ def recv_tensors(sock: socket.socket) -> Tuple[Tuple[np.ndarray, ...], int]:
             )
         a = np.frombuffer(_recv_exact(sock, nbytes), dtype=dtype)
         out.append(a.reshape(shape))
-    return tuple(out), pts
+    return tuple(out), pts, trace
 
 
 class QueryServer:
@@ -315,9 +359,15 @@ class QueryServer:
         with conn:
             while self._running:
                 try:
-                    tensors, pts = recv_tensors(conn)
+                    tensors, pts, wire_trace = recv_tensors_ex(conn)
                 except (ConnectionError, OSError):
                     return
+                # a flagged request attaches this serve span to the
+                # CLIENT's trace (the span id travels back in the reply);
+                # replies echo the flag only when the request carried it,
+                # so plain-v1 clients never see the bit
+                tok = (_spans.span_begin(wire_trace[0], wire_trace[1])
+                       if wire_trace is not None and _spans.enabled else None)
                 item = None
                 try:
                     try:
@@ -331,13 +381,22 @@ class QueryServer:
                             item = self.scheduler.admit(
                                 client, tenant=tenant, cost=max(1, cost))
                         if self.batch:
-                            outs = self._invoke_batched(tensors, item)
+                            outs = self._invoke_batched(
+                                tensors, item,
+                                trace=((wire_trace[0], tok[0])
+                                       if tok is not None else None))
                         else:
                             outs = self._invoke_direct(tensors)
-                        send_tensors(conn, outs, pts)
+                        reply_trace = wire_trace
+                        if tok is not None:
+                            reply_trace = (wire_trace[0], tok[0])
+                        send_tensors(conn, outs, pts, trace=reply_trace)
                     finally:
                         if item is not None:
                             self.scheduler.release(item)
+                        if tok is not None:
+                            _spans.span_end(tok, "nnsq_serve", "query",
+                                            args={"client": client})
                 except (OverloadError, BreakerOpenError) as exc:
                     try:
                         send_error(conn, str(exc), code=exc.code)
@@ -366,24 +425,27 @@ class QueryServer:
     # -- cross-client batching ---------------------------------------------
 
     class _Pending:
-        __slots__ = ("spec", "tensors", "event", "outs", "error", "item")
+        __slots__ = ("spec", "tensors", "event", "outs", "error", "item",
+                     "trace")
 
-        def __init__(self, spec, tensors, item=None):
+        def __init__(self, spec, tensors, item=None, trace=None):
             self.spec = spec
             self.tensors = tensors
             self.event = threading.Event()
             self.outs = None
             self.error = None
             self.item = item  # SchedItem when a scheduler is attached
+            self.trace = trace  # (trace_id, span_id) from the wire, if any
 
-    def _invoke_batched(self, tensors, item=None):
+    def _invoke_batched(self, tensors, item=None, trace=None):
         """Enqueue for the dispatcher; block until this request's slice of
         the batched result arrives.  The wait polls ``_running`` so a
         request racing ``stop()`` (enqueued after the final queue drain)
         errors out instead of hanging its connection thread forever."""
         if not self._running:
             raise RuntimeError("query server stopped")
-        req = self._Pending(TensorsSpec.from_arrays(tensors), tensors, item)
+        req = self._Pending(TensorsSpec.from_arrays(tensors), tensors, item,
+                            trace)
         self._rq.put(req)
         while not req.event.wait(0.5):
             if not self._running:
@@ -490,7 +552,9 @@ class QueryServer:
                 return
             for g in group:
                 if g.item is not None:
-                    sch.observe_wait(g.item, now)
+                    # the group dispatches on the dispatcher thread, so
+                    # each member's wire trace rides along explicitly
+                    sch.observe_wait(g.item, now, trace=g.trace)
         n_tensors = len(group[0].tensors)
         try:
             # requests already carry the batch dim ((k_i, ...) frames — the
@@ -624,6 +688,10 @@ class TensorQueryClient(Node):
         self.out_spec = out_spec  # optional static declaration
         self._sock: Optional[socket.socket] = None
         self._interrupted = False
+        # does the peer speak the FLAG_TRACE header? learned during the
+        # negotiation probe (False until proven — old servers must only
+        # ever see plain version-1 bytes)
+        self._trace_wire = False
 
     def _connect(self) -> socket.socket:
         if self._interrupted:
@@ -651,26 +719,71 @@ class TensorQueryClient(Node):
                 f"(got {spec}); pass out_spec= for polymorphic streams"
             )
         # probe the server with a zero frame to learn the output spec —
-        # the remote analog of the filter's reconcile-at-negotiation
+        # the remote analog of the filter's reconcile-at-negotiation.
+        # With span tracing active the first probe is FLAGGED (capability
+        # check): a trace-aware server echoes the flag, a strict-v1 server
+        # rejects the header and drops the connection — we reconnect and
+        # re-probe plain, leaving trace propagation off for this link.
+        zeros = tuple(np.zeros(t.shape, t.dtype) for t in spec.tensors)
+        outs = None
+        first_exc: Optional[BaseException] = None
         try:
-            sock = self._connect()
-            zeros = tuple(
-                np.zeros(t.shape, t.dtype) for t in spec.tensors
-            )
-            send_tensors(sock, zeros, PROBE_PTS)
-            outs, _ = recv_tensors(sock)
+            outs = self._probe(zeros, want_trace=_spans.enabled)
         except (OSError, RuntimeError) as exc:
+            first_exc = exc
+            if _spans.enabled:
+                self._reset_socket()
+                try:
+                    outs = self._probe(zeros, want_trace=False)
+                except (OSError, RuntimeError):
+                    outs = None
+        if outs is None:
             raise NegotiationError(
                 f"{self.name}: query server at {self.host}:{self.port} "
-                f"failed the negotiation probe: {exc}"
-            ) from exc
+                f"failed the negotiation probe: {first_exc}"
+            ) from first_exc
         return {"src": TensorsSpec.from_arrays(outs, rate=spec.rate)}
+
+    def _probe(self, zeros, want_trace: bool):
+        sock = self._connect()
+        trace = (_spans.new_trace_id(), 0) if want_trace else None
+        send_tensors(sock, zeros, PROBE_PTS, trace=trace)
+        outs, _, reply_trace = recv_tensors_ex(sock)
+        self._trace_wire = reply_trace is not None
+        return outs
+
+    def _reset_socket(self) -> None:
+        """Drop the socket for a reconnect (NOT interrupt(): negotiation
+        fallback must be able to dial again)."""
+        sock, self._sock = self._sock, None
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass
 
     def process(self, pad: Pad, frame: Frame):
         del pad
         sock = self._connect()
-        send_tensors(sock, frame.tensors, frame.pts)
-        outs, pts = recv_tensors(sock)
+        ctx = (frame.meta.get(_spans.META_KEY)
+               if self._trace_wire and _spans.enabled else None)
+        if ctx is None:
+            send_tensors(sock, frame.tensors, frame.pts)
+            outs, pts = recv_tensors(sock)
+            return frame.with_tensors(outs, pts=pts)
+        # traced round trip: the rtt span rides the frame's trace, its id
+        # goes out as the server-side parent, and the reply names the
+        # server's serve span so the cross-process link is bidirectional
+        tok = _spans.span_begin(ctx[0], ctx[1])
+        args = {"server": f"{self.host}:{self.port}"}
+        try:
+            send_tensors(sock, frame.tensors, frame.pts,
+                         trace=(ctx[0], tok[0]))
+            outs, pts, reply_trace = recv_tensors_ex(sock)
+            if reply_trace is not None:
+                args["server_span"] = f"{reply_trace[1]:x}"
+        finally:
+            _spans.span_end(tok, "nnsq_rtt", "query", args=args)
         return frame.with_tensors(outs, pts=pts)
 
     def interrupt(self) -> None:

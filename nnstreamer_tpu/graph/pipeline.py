@@ -151,10 +151,8 @@ class Pipeline:
                 pad.sig = None
         started = []
         try:
-            for node in self.nodes.values():
-                node.start()
-                started.append(node)
-            self.negotiate()
+            # leaves depend only on link topology (known before caps), so
+            # they are computed up front: tracers need them at install
             self._leaves = {
                 n.name
                 for n in self.nodes.values()
@@ -162,12 +160,30 @@ class Pipeline:
             }
             if not self._leaves:
                 raise PipelineError("pipeline has no leaf (sink) nodes")
+            # tracers/metrics attach BEFORE negotiation: an element whose
+            # configure() talks to a remote peer (tensor_query_client's
+            # probe) must see span tracing active to negotiate trace
+            # propagation on the wire.  Failures stay warnings — same
+            # contract as _post_negotiate_hooks.
+            try:
+                self._attach_observability()
+            except Exception as exc:  # noqa: BLE001
+                import warnings
+
+                warnings.warn(f"observability hooks failed: {exc!r}",
+                              stacklevel=2)
+            for node in self.nodes.values():
+                node.start()
+                started.append(node)
+            self.negotiate()
         except BaseException:
             for node in started:
                 try:
                     node.stop()
                 except Exception:
                     pass
+            for tracer in self._tracers:
+                tracer.stop()  # failed start: no hook may stay connected
             for undo in reversed(fuse_undos):
                 undo()
             raise
@@ -212,12 +228,18 @@ class Pipeline:
 
     def post_error(self, node: Node, exc: BaseException) -> None:
         with self._lock:
-            if self._error is None:
+            first = self._error is None
+            if first:
                 self._error = exc
                 self._error_node = node.name if node else None
         if _hooks.enabled:
             _hooks.emit("error", self, node, exc)
         traceback.print_exception(type(exc), exc, exc.__traceback__)
+        if first:
+            # crash forensics: the graph as it died (GST_DEBUG_DUMP_DOT_DIR
+            # writes an error dot the same way) + the span flight recorder
+            self._dump_dot("ERROR")
+            self._dump_flight("error")
         self._done.set()
 
     def _node_eos(self, node: Node) -> None:
@@ -247,6 +269,9 @@ class Pipeline:
         self.state = "STOPPED"
         if _hooks.enabled:
             _hooks.emit("state_change", self, "PLAYING", "STOPPED")
+        # dot dump on EVERY transition (tracers are still connected here,
+        # so the STOPPED dump carries final frame counts / queue depths)
+        self._dump_dot("STOPPED")
         for node in self.nodes.values():
             if isinstance(node, SourceNode):
                 node.request_stop()
@@ -312,12 +337,6 @@ class Pipeline:
                 from ..utils import profiling
 
                 profiling.enable(True)
-            dot_dir = conf.get_path("common", "dump_dot_dir", "")
-            if dot_dir:
-                os.makedirs(dot_dir, exist_ok=True)
-                path = os.path.join(dot_dir, f"{self.name}.PLAYING.dot")
-                with open(path, "w") as f:
-                    f.write(self.to_dot())
             trace_dir = conf.get_path("common", "xplane_trace_dir", "")
             if trace_dir:
                 # device-level xplane trace (jax.profiler) for the whole
@@ -328,14 +347,15 @@ class Pipeline:
                 os.makedirs(trace_dir, exist_ok=True)
                 jax.profiler.start_trace(trace_dir)
                 self._xplane_tracing = True
-            self._attach_observability()
+            self._dump_dot("PLAYING")
         except Exception as exc:  # noqa: BLE001
             warnings.warn(f"observability hooks failed: {exc!r}", stacklevel=2)
 
     def _attach_observability(self) -> None:
         """Conf-driven tracer activation (``NNSTPU_TRACERS=latency;stats``)
         + the Prometheus scrape endpoint (``NNSTPU_METRICS_PORT``) — the
-        GST_TRACERS analog, resolved at every transition to PLAYING."""
+        GST_TRACERS analog, resolved at every start(), before
+        negotiation (see the note in :meth:`start`)."""
         from ..obs import (
             configured_metrics_port,
             configured_tracers,
@@ -352,6 +372,11 @@ class Pipeline:
         port = configured_metrics_port()
         if port is not None:
             ensure_server(port)
+        # structured twin of the scrape endpoint: this pipeline's stats()
+        # joins the merged /stats.json document
+        from ..obs.export import register_stats
+
+        register_stats(self.name, self.stats)
 
     def attach_tracer(self, tracer):
         """Attach a tracer (name or instance) to this pipeline — the
@@ -389,12 +414,108 @@ class Pipeline:
             out["tracers"] = {t.name: t.summary() for t in self._tracers}
         return out
 
-    def to_dot(self) -> str:
+    def flight_snapshot(self) -> list:
+        """Span records accumulated by a ``spans`` tracer (the flight
+        recorder), time-ordered and ready for
+        :func:`nnstreamer_tpu.obs.spans.chrome_trace` /
+        :func:`~nnstreamer_tpu.obs.spans.waterfall`.  Readable during
+        PLAYING and after stop (the recorder outlives the hooks)."""
+        from ..obs import spans
+
+        return spans.snapshot()
+
+    def _tracers_active(self) -> bool:
+        return any(t.active for t in self._tracers)
+
+    def _dump_dot(self, transition: str) -> None:
+        """Write ``{name}.{transition}.dot`` into the conf'd dump dir on a
+        state transition / error — the full GST_DEBUG_DUMP_DOT_DIR analog
+        (the reference dumps on every transition, not just PLAYING)."""
+        import os
+        import warnings
+
+        from ..conf import conf
+
+        try:
+            dot_dir = conf.get_path("common", "dump_dot_dir", "")
+            if not dot_dir:
+                return
+            os.makedirs(dot_dir, exist_ok=True)
+            path = os.path.join(dot_dir, f"{self.name}.{transition}.dot")
+            with open(path, "w") as f:
+                f.write(self.to_dot(annotate=self._tracers_active()))
+        except Exception as exc:  # noqa: BLE001 — observability stays non-fatal
+            warnings.warn(f"dot dump ({transition}) failed: {exc!r}",
+                          stacklevel=2)
+
+    def _dump_flight(self, transition: str) -> None:
+        """Write the flight recorder as Chrome-trace JSON on error (conf
+        ``[obs] flight_dump_dir``) — the post-mortem the span layer exists
+        for: open ``{name}.error.trace.json`` in Perfetto."""
+        import json
+        import os
+        import warnings
+
+        from ..conf import conf
+        from ..obs import spans
+
+        try:
+            if not spans.enabled:
+                return
+            dump_dir = conf.get_path("obs", "flight_dump_dir", "")
+            if not dump_dir:
+                return
+            os.makedirs(dump_dir, exist_ok=True)
+            path = os.path.join(dump_dir, f"{self.name}.{transition}.trace.json")
+            with open(path, "w") as f:
+                json.dump(spans.chrome_trace(spans.snapshot(),
+                                             process_name=self.name), f)
+        except Exception as exc:  # noqa: BLE001
+            warnings.warn(f"flight dump ({transition}) failed: {exc!r}",
+                          stacklevel=2)
+
+    def _dot_annotations(self) -> Dict[str, str]:
+        """Live per-node stats for annotated dot dumps: frames pushed from
+        the stats tracer, queue depth from queue-like nodes' stats()."""
+        notes: Dict[str, str] = {}
+        for tracer in self._tracers:
+            if tracer.name != "stats" or not tracer.active:
+                continue
+            for name, s in tracer.summary().items():
+                parts = []
+                if s.get("frames") is not None:
+                    parts.append(f"{s['frames']} frames")
+                if s.get("queue_depth") is not None:
+                    parts.append(f"depth {s['queue_depth']}")
+                if parts:
+                    notes[name] = ", ".join(parts)
+        for node in self.nodes.values():
+            if node.name in notes:
+                continue
+            node_stats = getattr(node, "stats", None)
+            if node_stats is None:
+                continue
+            try:
+                s = node_stats()
+            except Exception:  # noqa: BLE001 — annotation is best-effort
+                continue
+            if isinstance(s, dict) and s.get("depth") is not None:
+                notes[node.name] = f"depth {s['depth']}"
+        return notes
+
+    def to_dot(self, annotate: bool = False) -> str:
         """Graphviz dump of the graph with negotiated specs — the analog of
-        GST_DEBUG_DUMP_DOT_DIR pipeline dumps (``tools/debugging/``)."""
+        GST_DEBUG_DUMP_DOT_DIR pipeline dumps (``tools/debugging/``).
+        ``annotate=True`` adds live stats (frames pushed, queue depth) to
+        node labels when tracers are collecting."""
+        notes = self._dot_annotations() if annotate else {}
         lines = [f'digraph "{self.name}" {{', "  rankdir=LR;", "  node [shape=box];"]
         for node in self.nodes.values():
-            lines.append(f'  "{node.name}" [label="{node.name}\\n{type(node).__name__}"];')
+            label = f"{node.name}\\n{type(node).__name__}"
+            extra = notes.get(node.name)
+            if extra:
+                label += f"\\n{extra}"
+            lines.append(f'  "{node.name}" [label="{label}"];')
         for node in self.nodes.values():
             for pad in node.src_pads.values():
                 if pad.peer is not None:

@@ -9,7 +9,12 @@ both NNStreamer papers use to find on-device bottlenecks):
   state changes, errors);
 - :mod:`.metrics` — labeled counter/gauge/histogram registry;
 - :mod:`.tracers` — pluggable ``latency`` / ``stats`` / ``drops`` tracers;
-- :mod:`.export` — Prometheus text exposition + stdlib scrape endpoint.
+- :mod:`.spans` / :mod:`.flight` — per-frame span tracing
+  (``NNSTPU_TRACERS=spans``): trace-context stamping, a bounded
+  per-thread flight recorder, Chrome-trace/Perfetto + waterfall export,
+  NNSQ trace-context propagation;
+- :mod:`.export` — Prometheus text exposition + stdlib scrape endpoint
+  (plus ``/healthz`` and the merged ``/stats.json``).
 
 Activation is conf-driven like the other ``NNSTPU_COMMON_*`` knobs —
 ``NNSTPU_TRACERS=latency;stats`` and ``NNSTPU_METRICS_PORT=9464`` (the
@@ -28,9 +33,13 @@ from .export import (  # noqa: F401
     MetricsServer,
     ensure_server,
     register_engine,
+    register_stats,
     render_text,
     shutdown_server,
+    stats_snapshot,
+    unregister_stats,
 )
+from .flight import FlightRecorder  # noqa: F401
 from .metrics import (  # noqa: F401
     LATENCY_BUCKETS_MS,
     REGISTRY,
@@ -38,6 +47,7 @@ from .metrics import (  # noqa: F401
     Gauge,
     Histogram,
     MetricsRegistry,
+    configured_latency_buckets,
 )
 from .tracers import (  # noqa: F401
     TRACERS,
@@ -48,6 +58,10 @@ from .tracers import (  # noqa: F401
     make_tracer,
     parse_tracer_names,
 )
+
+# importing .spans registers the "spans" tracer with TRACERS
+from . import spans  # noqa: E402,F401
+from .spans import SpanTracer, chrome_trace, waterfall  # noqa: F401
 
 
 def configured_tracers() -> List[str]:

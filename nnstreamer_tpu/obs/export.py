@@ -11,17 +11,59 @@ daemon thread — at ``/metrics``; activation is conf-driven from
 (:meth:`nnstreamer_tpu.serving.ContinuousBatcher.stats`) as
 ``nnstpu_serving_*`` gauges, refreshed at scrape time via a registry
 collector — pull-style, no background poller.
+
+Beyond ``/metrics`` the server answers ``/healthz`` (liveness probe:
+``200 ok``) and ``/stats.json`` — every registered stats provider
+(pipelines via ``Pipeline.start``, schedulers via
+:class:`nnstreamer_tpu.sched.Scheduler`) merged into one JSON document,
+the structured twin of the Prometheus exposition.
 """
 
 from __future__ import annotations
 
+import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Optional
+from typing import Callable, Dict, Optional
 
 from .metrics import REGISTRY, MetricsRegistry
 
 CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+_stats_lock = threading.Lock()
+_stats_providers: Dict[str, Callable[[], dict]] = {}
+
+
+def register_stats(name: str, fn: Callable[[], dict]) -> Callable[[], dict]:
+    """Publish a ``stats()``-style callable under ``name`` in the
+    ``/stats.json`` document (idempotent; a re-register replaces)."""
+    with _stats_lock:
+        _stats_providers[name] = fn
+    return fn
+
+
+def unregister_stats(name: str, fn: Optional[Callable] = None) -> None:
+    """Remove a provider.  Passing ``fn`` makes removal conditional on
+    the mapping still pointing at it — two same-named registrants don't
+    tear each other down."""
+    with _stats_lock:
+        if fn is None or _stats_providers.get(name) is fn:
+            _stats_providers.pop(name, None)
+
+
+def stats_snapshot() -> dict:
+    """Every registered provider's snapshot; a raising provider becomes
+    an ``{"error": ...}`` entry, never a 500 (same contract as registry
+    collectors)."""
+    with _stats_lock:
+        providers = dict(_stats_providers)
+    out = {}
+    for name, fn in providers.items():
+        try:
+            out[name] = fn()
+        except Exception as exc:  # noqa: BLE001 — one bad provider != no stats
+            out[name] = {"error": repr(exc)}
+    return out
 
 
 def _fmt(value: float) -> str:
@@ -92,16 +134,28 @@ class MetricsServer:
         registry = self.registry
 
         class Handler(BaseHTTPRequestHandler):
-            def do_GET(self):  # noqa: N802 — http.server API
-                if self.path.split("?")[0] not in ("/metrics", "/"):
-                    self.send_error(404)
-                    return
-                body = render_text(registry).encode("utf-8")
+            def _reply(self, body: bytes, content_type: str) -> None:
                 self.send_response(200)
-                self.send_header("Content-Type", CONTENT_TYPE)
+                self.send_header("Content-Type", content_type)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
+
+            def do_GET(self):  # noqa: N802 — http.server API
+                path = self.path.split("?")[0]
+                if path in ("/metrics", "/"):
+                    self._reply(render_text(registry).encode("utf-8"),
+                                CONTENT_TYPE)
+                elif path == "/healthz":
+                    self._reply(b"ok\n", "text/plain; charset=utf-8")
+                elif path == "/stats.json":
+                    # default=str: stats() snapshots may carry numpy
+                    # scalars / deadline floats json can't serialize
+                    body = json.dumps(stats_snapshot(), default=str,
+                                      sort_keys=True).encode("utf-8")
+                    self._reply(body, "application/json; charset=utf-8")
+                else:
+                    self.send_error(404)
 
             def log_message(self, *args):  # silence per-scrape stderr spam
                 del args

@@ -26,12 +26,49 @@ import math
 import threading
 from typing import Callable, Dict, Iterable, List, Optional, Tuple
 
-# Fixed latency buckets (milliseconds): 50 µs to 2.5 s, roughly 1-2.5-5
-# per decade — the GstShark/Prometheus-convention spacing.
+# Default latency buckets (milliseconds): 50 µs to 2.5 s, roughly 1-2.5-5
+# per decade — the GstShark/Prometheus-convention spacing.  Overridable
+# per deployment via NNSTPU_METRICS_BUCKETS / ini [obs] buckets (see
+# configured_latency_buckets) — a sub-ms edge pipeline and a multi-second
+# batch server need different tails.
 LATENCY_BUCKETS_MS = (
     0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0,
     100.0, 250.0, 500.0, 1000.0, 2500.0,
 )
+
+
+def parse_buckets(value: str) -> Optional[Tuple[float, ...]]:
+    """``"0.1, 1; 10"`` → (0.1, 1.0, 10.0); empty/blank → None."""
+    vals = [x.strip() for x in (value or "").replace(";", ",").split(",")
+            if x.strip()]
+    if not vals:
+        return None
+    return tuple(sorted(float(x) for x in vals))
+
+
+def configured_latency_buckets() -> Tuple[float, ...]:
+    """Histogram bucket bounds from the environment/conf, resolved at
+    metric creation: ``NNSTPU_METRICS_BUCKETS`` (short spelling, a
+    comma/semicolon-separated ms list) over ``NNSTPU_OBS_BUCKETS`` / ini
+    ``[obs] buckets`` over :data:`LATENCY_BUCKETS_MS`.  A malformed list
+    warns and falls back — observability never takes the process down."""
+    import os
+
+    val = os.environ.get("NNSTPU_METRICS_BUCKETS")
+    if val is None:
+        from ..conf import conf
+
+        val = conf.get("obs", "buckets", "") or ""
+    try:
+        bounds = parse_buckets(val)
+    except ValueError:
+        import warnings
+
+        warnings.warn(
+            f"latency bucket override is not a number list: {val!r}; "
+            "using the defaults", stacklevel=2)
+        bounds = None
+    return bounds if bounds else LATENCY_BUCKETS_MS
 
 _INF = math.inf
 
@@ -178,8 +215,10 @@ class Gauge(_Metric):
 class Histogram(_Metric):
     kind = "histogram"
 
-    def __init__(self, name, help="", labelnames=(), buckets=LATENCY_BUCKETS_MS):
+    def __init__(self, name, help="", labelnames=(), buckets=None):
         super().__init__(name, help, labelnames)
+        if buckets is None:  # conf-driven default, resolved at creation
+            buckets = configured_latency_buckets()
         bounds = tuple(sorted(float(b) for b in buckets))
         if not bounds:
             raise ValueError("histogram needs at least one bucket bound")
@@ -221,7 +260,7 @@ class MetricsRegistry:
         return self._get_or_create(Gauge, name, help, labelnames)
 
     def histogram(self, name: str, help: str = "", labelnames=(),
-                  buckets=LATENCY_BUCKETS_MS) -> Histogram:
+                  buckets=None) -> Histogram:
         return self._get_or_create(Histogram, name, help, labelnames,
                                    buckets=buckets)
 

@@ -28,8 +28,9 @@ from __future__ import annotations
 
 import threading
 import time
-from typing import Callable, Dict, Optional
+from typing import Callable, Dict, Optional, Tuple
 
+from ..obs import spans as _spans
 from .admission import (  # noqa: F401
     CODE_EXPIRED,
     CODE_OVERLOAD,
@@ -131,6 +132,13 @@ class Scheduler:
             labelnames=("server",))
         self._trips_seen = 0
         self._collector = registry.add_collector(self._collect)
+        # structured snapshot in the merged /stats.json document (one
+        # bound-method object kept: unregister matches by identity)
+        from ..obs.export import register_stats
+
+        self._stats_key = f"sched:{self.name}"
+        self._stats_fn = self.stats
+        register_stats(self._stats_key, self._stats_fn)
 
     # -- admission ----------------------------------------------------------
 
@@ -173,9 +181,20 @@ class Scheduler:
         with self._lock:
             return len(self.policy)
 
-    def observe_wait(self, item: SchedItem, now: Optional[float] = None) -> None:
+    def observe_wait(self, item: SchedItem, now: Optional[float] = None,
+                     trace: Optional[Tuple[int, int]] = None) -> None:
         now = now if now is not None else self._clock()
-        self._m_wait.observe((now - item.enqueue_t) * 1e3, server=self.name)
+        waited_s = max(0.0, now - item.enqueue_t)
+        self._m_wait.observe(waited_s * 1e3, server=self.name)
+        if _spans.enabled:
+            # the queue-wait interval as a span on the request's trace
+            # (``trace`` from the caller, else the thread's current serve
+            # span — the QueryServer direct path)
+            end = _spans.now_ns()
+            _spans.record_span(
+                "sched_wait", end - int(waited_s * 1e9),
+                int(waited_s * 1e9), cat="sched", trace=trace,
+                args={"server": self.name, "client": item.client})
 
     def expired_error(self, item: SchedItem) -> OverloadError:
         """Count one deadline-expired drop and build its typed error."""
@@ -192,14 +211,33 @@ class Scheduler:
     # -- breaker ------------------------------------------------------------
 
     def invoke(self, fn: Callable[[], object]):
-        """Run a backend invoke under the circuit breaker (if any)."""
-        if self.breaker is None:
-            return fn()
+        """Run a backend invoke under the circuit breaker (if any); with
+        span tracing on, the invoke (or the breaker rejection) is recorded
+        on the calling thread's current trace."""
+        t0 = _spans.now_ns() if _spans.enabled else 0
         try:
-            return self.breaker.call(fn)
+            if self.breaker is None:
+                out = fn()
+            else:
+                out = self.breaker.call(fn)
         except BreakerOpenError:
             self._m_shed.inc(server=self.name, reason="breaker")
+            if t0:
+                _spans.record_span(
+                    "breaker_open", t0, _spans.now_ns() - t0, cat="sched",
+                    args={"server": self.name})
             raise
+        except Exception:
+            if t0:
+                _spans.record_span(
+                    "backend_invoke", t0, _spans.now_ns() - t0, cat="sched",
+                    args={"server": self.name, "ok": False})
+            raise
+        if t0:
+            _spans.record_span(
+                "backend_invoke", t0, _spans.now_ns() - t0, cat="sched",
+                args={"server": self.name, "ok": True})
+        return out
 
     # -- slot assignment (DecodeServer) -------------------------------------
 
@@ -268,8 +306,11 @@ class Scheduler:
         return out
 
     def close(self) -> None:
-        """Detach the scrape collector (idempotent)."""
+        """Detach the scrape collector + stats provider (idempotent)."""
         self._registry.remove_collector(self._collector)
+        from ..obs.export import unregister_stats
+
+        unregister_stats(self._stats_key, self._stats_fn)
 
 
 def _parse_kv_ints(spec: str) -> Dict[str, int]:

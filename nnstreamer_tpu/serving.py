@@ -612,7 +612,10 @@ class DecodeServer:
     def _admit_session(self, client: str) -> DecodeSession:
         """Priority-aware slot assignment: non-blocking grant attempts in
         the gate's (priority, FIFO) order until a slot frees or the
-        session timeout / waiting-room bound sheds the join."""
+        session timeout / waiting-room bound sheds the join.  With span
+        tracing on, the slot wait is recorded on the joining request's
+        trace (queue-wait decomposition, same family as ``sched_wait``)."""
+        from .obs import spans as _spans
 
         def try_grant():
             try:
@@ -620,8 +623,14 @@ class DecodeServer:
             except TimeoutError:
                 return None  # full right now: stay in the gate
 
-        return self.scheduler.acquire_slot(
+        t0 = _spans.now_ns() if _spans.enabled else 0
+        sess = self.scheduler.acquire_slot(
             client, try_grant, timeout=self.session_timeout)
+        if t0:
+            _spans.record_span(
+                "slot_wait", t0, _spans.now_ns() - t0, cat="sched",
+                args={"server": "decode_server", "client": client})
+        return sess
 
     def __enter__(self):
         return self.start()
@@ -644,7 +653,7 @@ class DecodeServer:
     def _serve(self, conn: socket.socket) -> None:
         from .elements.query import (
             PROBE_PTS,
-            recv_tensors,
+            recv_tensors_ex,
             send_error,
             send_tensors,
         )
@@ -659,7 +668,10 @@ class DecodeServer:
         try:
             while self._running:
                 try:
-                    tensors, pts = recv_tensors(conn)
+                    # trace context is consumed and echoed (a traced
+                    # client keeps its flag; a plain-v1 client never
+                    # sees the bit)
+                    tensors, pts, wtrace = recv_tensors_ex(conn)
                 except (ConnectionError, OSError):
                     return  # client left: free the slot in finally
                 try:
@@ -684,7 +696,7 @@ class DecodeServer:
                         send_tensors(
                             conn,
                             (np.zeros((self.engine.n_out,), np.float32),),
-                            pts)
+                            pts, trace=wtrace)
                         continue
                     if sess is None:
                         # lazy join: a probe-only connection never holds a
@@ -703,7 +715,7 @@ class DecodeServer:
                     else:
                         sess.feed(tensors[0])
                     y = sess.get(timeout=self.session_timeout)
-                    send_tensors(conn, (y,), pts)
+                    send_tensors(conn, (y,), pts, trace=wtrace)
                 except OverloadError as exc:
                     # shed join: typed wire rejection, never a parked
                     # connection (the client raises QueryOverloadError)

@@ -56,9 +56,11 @@ def _reset_global_state():
     yield
     from nnstreamer_tpu.elements.repo import GLOBAL_REPO
     from nnstreamer_tpu.obs import hooks as obs_hooks
+    from nnstreamer_tpu.obs import spans as obs_spans
     from nnstreamer_tpu.utils import profiling
 
     GLOBAL_REPO.reset()
     profiling.reset()
     profiling.enable(False)
     obs_hooks.clear()  # no tracer callback outlives its test
+    obs_spans.reset()  # flight recorder + enable flag are process-global

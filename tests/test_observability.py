@@ -46,6 +46,52 @@ def test_dump_dot_dir(tmp_path, monkeypatch):
     assert "digraph" in dot and "double" in dot
 
 
+class TestDotTransitions:
+    """Satellite: {name}.{transition}.dot on EVERY state transition and on
+    post_error — the full GST_DEBUG_DUMP_DOT_DIR analog."""
+
+    def test_playing_and_stopped_dumps(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("NNSTPU_COMMON_DUMP_DOT_DIR", str(tmp_path))
+        got = []
+        simple_pipeline(got).run(timeout=30)
+        assert (tmp_path / "obs_test.PLAYING.dot").exists()
+        assert (tmp_path / "obs_test.STOPPED.dot").exists()
+
+    def test_error_dump(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("NNSTPU_COMMON_DUMP_DOT_DIR", str(tmp_path))
+
+        def boom(x):
+            if float(np.max(x)) > 0:  # negotiation probes with zeros
+                raise RuntimeError("dot crash")
+            return x
+
+        p = Pipeline(name="dot_err")
+        src = p.add(DataSrc(data=[np.ones(4, np.float32)], name="s"))
+        filt = p.add(TensorFilter(framework="custom", model=boom, name="f"))
+        p.link_chain(src, filt, p.add(TensorSink(name="out")))
+        from nnstreamer_tpu.graph.pipeline import PipelineError
+
+        with pytest.raises(PipelineError):
+            p.run(timeout=30)
+        assert (tmp_path / "dot_err.ERROR.dot").exists()
+
+    def test_stopped_dump_annotated_with_live_stats(self, tmp_path,
+                                                    monkeypatch):
+        monkeypatch.setenv("NNSTPU_COMMON_DUMP_DOT_DIR", str(tmp_path))
+        got = []
+        p = Pipeline(name="dot_ann")
+        src = p.add(DataSrc(
+            data=[np.zeros((4,), np.float32) for _ in range(5)], name="s"))
+        q = p.add(Queue(max_size_buffers=8, name="q"))
+        sink = p.add(TensorSink(callback=got.append, name="out"))
+        p.link_chain(src, q, sink)
+        p.attach_tracer(StatsTracer(registry=MetricsRegistry()))
+        p.run(timeout=30)
+        dot = (tmp_path / "dot_ann.STOPPED.dot").read_text()
+        assert "5 frames" in dot, dot
+        assert "depth" in dot
+
+
 def test_conf_enables_profiling_and_stats(monkeypatch):
     monkeypatch.setenv("NNSTPU_COMMON_ENABLE_PROFILING", "true")
     got = []
@@ -409,6 +455,101 @@ class TestConfActivation:
             with urllib.request.urlopen(srv.url, timeout=10) as resp:
                 body = resp.read().decode("utf-8")
         assert "hits_total 3" in body
+
+
+class TestHealthAndStatsEndpoints:
+    """Satellite: /healthz liveness + /stats.json (pipeline + sched
+    stats() merged) next to the Prometheus scrape path."""
+
+    def _get(self, srv, path):
+        url = f"http://{srv.host}:{srv.port}{path}"
+        with urllib.request.urlopen(url, timeout=10) as resp:
+            return resp.status, resp.headers["Content-Type"], resp.read()
+
+    def test_healthz(self):
+        with MetricsServer(port=0, registry=MetricsRegistry()) as srv:
+            status, ctype, body = self._get(srv, "/healthz")
+        assert status == 200 and body == b"ok\n"
+        assert ctype.startswith("text/plain")
+
+    def test_stats_json_merges_providers(self):
+        import json as _json
+
+        from nnstreamer_tpu.obs.export import register_stats, unregister_stats
+
+        fn = lambda: {"frames": 7, "note": "hi"}  # noqa: E731
+        bad = lambda: 1 / 0  # noqa: E731
+        register_stats("pipe_x", fn)
+        register_stats("bad_prov", bad)
+        try:
+            with MetricsServer(port=0, registry=MetricsRegistry()) as srv:
+                status, ctype, body = self._get(srv, "/stats.json")
+            assert status == 200 and ctype.startswith("application/json")
+            doc = _json.loads(body)
+            assert doc["pipe_x"] == {"frames": 7, "note": "hi"}
+            assert "error" in doc["bad_prov"]  # a bad provider never 500s
+        finally:
+            unregister_stats("pipe_x", fn)
+            unregister_stats("bad_prov", bad)
+
+    def test_pipeline_and_sched_register(self, monkeypatch):
+        from nnstreamer_tpu.obs.export import stats_snapshot, unregister_stats
+        from nnstreamer_tpu.sched import Scheduler
+
+        got = []
+        p = simple_pipeline(got)
+        p.run(timeout=30)
+        sch = Scheduler("fifo", name="statsrv", registry=MetricsRegistry())
+        try:
+            snap = stats_snapshot()
+            assert "obs_test" in snap  # the pipeline's stats()
+            assert snap["sched:statsrv"]["dispatched"] == 0
+        finally:
+            sch.close()
+            unregister_stats("obs_test")
+        assert "sched:statsrv" not in stats_snapshot()
+
+
+class TestConfigurableBuckets:
+    """Satellite: NNSTPU_METRICS_BUCKETS / [obs] buckets override the
+    fixed latency-bucket list, resolved at histogram creation."""
+
+    def test_env_override_short_spelling(self, monkeypatch):
+        monkeypatch.setenv("NNSTPU_METRICS_BUCKETS", "1, 10; 100")
+        reg = MetricsRegistry()
+        h = reg.histogram("lat_custom_ms")
+        assert h.buckets == (1.0, 10.0, 100.0)
+
+    def test_conf_section_spelling(self, monkeypatch):
+        monkeypatch.delenv("NNSTPU_METRICS_BUCKETS", raising=False)
+        monkeypatch.setenv("NNSTPU_OBS_BUCKETS", "0.5,5")
+        reg = MetricsRegistry()
+        assert reg.histogram("lat_conf_ms").buckets == (0.5, 5.0)
+
+    def test_default_and_malformed_fall_back(self, monkeypatch):
+        from nnstreamer_tpu.obs.metrics import (
+            LATENCY_BUCKETS_MS,
+            configured_latency_buckets,
+        )
+
+        monkeypatch.delenv("NNSTPU_METRICS_BUCKETS", raising=False)
+        assert configured_latency_buckets() == LATENCY_BUCKETS_MS
+        monkeypatch.setenv("NNSTPU_METRICS_BUCKETS", "fast,slow")
+        with pytest.warns(UserWarning, match="bucket"):
+            assert configured_latency_buckets() == LATENCY_BUCKETS_MS
+
+    def test_exposition_uses_override(self, monkeypatch):
+        monkeypatch.setenv("NNSTPU_METRICS_BUCKETS", "2.5,25")
+        reg = MetricsRegistry()
+        got = []
+        p = Pipeline(name="bkt")
+        src = p.add(DataSrc(data=[np.zeros(4, np.float32)], name="s"))
+        p.link(src, p.add(TensorSink(callback=got.append, name="out")))
+        p.attach_tracer(LatencyTracer(registry=reg))
+        p.run(timeout=30)
+        text = render_text(reg)
+        assert 'le="2.5"' in text and 'le="25"' in text
+        assert 'le="0.05"' not in text  # the stock list is replaced
 
 
 class TestProfilingRehome:

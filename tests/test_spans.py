@@ -1,0 +1,516 @@
+"""Per-frame span tracing: flight recorder, trace-context survival
+(queue hops, dynbatch coalescing, mux collect), Chrome-trace/waterfall
+export, and NNSQ trace-context propagation (version-gated interop)."""
+
+import json
+import socket
+import struct
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu import Frame, Pipeline
+from nnstreamer_tpu.elements.dynbatch import DynBatch, DynUnbatch
+from nnstreamer_tpu.elements.filter import TensorFilter
+from nnstreamer_tpu.elements.mux import TensorMux
+from nnstreamer_tpu.elements.query import (
+    FLAG_TRACE,
+    PROBE_PTS,
+    QueryServer,
+    TensorQueryClient,
+    recv_tensors_ex,
+    send_tensors,
+)
+from nnstreamer_tpu.elements.queue import Queue
+from nnstreamer_tpu.elements.sink import TensorSink
+from nnstreamer_tpu.elements.testsrc import DataSrc
+from nnstreamer_tpu.obs import spans
+from nnstreamer_tpu.obs.flight import FlightRecorder
+from nnstreamer_tpu.obs.spans import SpanTracer
+
+
+def frames_of(got):
+    return [f for f in got if isinstance(f, Frame)]
+
+
+def x_spans(records):
+    return [r for r in records if r[0] == spans.PH_COMPLETE]
+
+
+def cross_thread_flows(records):
+    """(start, end) flow record pairs that changed threads."""
+    return list(spans._flow_pairs(records).values())
+
+
+class TestFlightRecorder:
+    def test_ring_bounded_with_overflow_accounting(self):
+        rec = FlightRecorder(capacity=4)
+        for i in range(10):
+            rec.append(("X", i, 0, "t", "n", "c", 0, 0, 0, None))
+        snap = rec.snapshot()
+        assert [r[1] for r in snap] == [6, 7, 8, 9]  # oldest overwritten
+        st = rec.stats()
+        assert st["records"] == 4 and st["dropped"] == 6
+        rec.clear()
+        assert rec.snapshot() == []
+
+    def test_threads_write_their_own_rings(self):
+        rec = FlightRecorder(capacity=64)
+
+        def writer(k):
+            for i in range(8):
+                rec.append(("i", k * 100 + i, 0, "t", "n", "c", 0, 0, 0, None))
+
+        ts = [threading.Thread(target=writer, args=(k,)) for k in range(4)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        snap = rec.snapshot()
+        assert len(snap) == 32
+        assert [r[1] for r in snap] == sorted(r[1] for r in snap)
+        assert rec.stats()["threads"] == 4
+
+
+class TestSpanTracerPipeline:
+    def _run(self, nodes_factory, n_frames=5):
+        got = []
+        p = Pipeline(name="sp")
+        nodes_factory(p, got, n_frames)
+        tracer = p.attach_tracer(SpanTracer())
+        p.run(timeout=60)
+        return p, tracer, got
+
+    def test_trace_id_survives_queue_to_queue_hop(self):
+        """src -> q1 -> q2 -> sink: the context stamped at the source is
+        the SAME object in the sink's frame meta, and both thread hops
+        produced cross-thread flow pairs."""
+
+        def build(p, got, n):
+            src = p.add(DataSrc(
+                data=[np.full(4, i, np.float32) for i in range(n)], name="s"))
+            q1 = p.add(Queue(max_size_buffers=8, name="q1"))
+            q2 = p.add(Queue(max_size_buffers=8, name="q2"))
+            sink = p.add(TensorSink(callback=got.append, name="out"))
+            p.link_chain(src, q1, q2, sink)
+
+        p, tracer, got = self._run(build)
+        assert len(got) == 5
+        trace_ids = set()
+        for f in got:
+            ctx = f.meta.get(spans.META_KEY)
+            assert ctx is not None, "trace context lost across queue hops"
+            trace_ids.add(ctx[0])
+        assert len(trace_ids) == 5  # one trace per frame
+        snap = p.flight_snapshot()
+        flows = cross_thread_flows(snap)
+        assert len(flows) >= 10, (  # >= 2 hops x 5 frames
+            f"expected cross-thread flow pairs for both queue hops, got "
+            f"{len(flows)}")
+        tids = {(s[3], e[3]) for s, e in flows}
+        assert len(tids) >= 2, f"flows should span two hop boundaries: {tids}"
+        # dispatch spans at the sink carry the frames' trace ids
+        sink_spans = [r for r in x_spans(snap)
+                      if r[4] == "out" and r[5] == "dispatch"]
+        assert {r[6] for r in sink_spans} >= trace_ids
+
+    def test_chrome_trace_is_valid_and_nested(self):
+        """src -> q -> filter -> sink: export parses as trace-event JSON,
+        dispatch spans nest (filter encloses sink on the queue thread),
+        and at least one flow arrow crosses threads."""
+
+        def build(p, got, n):
+            src = p.add(DataSrc(
+                data=[np.full(4, i, np.float32) for i in range(n)], name="s"))
+            q = p.add(Queue(max_size_buffers=8, name="q"))
+            filt = p.add(TensorFilter(framework="custom",
+                                      model=lambda x: x * 2, name="f"))
+            sink = p.add(TensorSink(callback=got.append, name="out"))
+            p.link_chain(src, q, filt, sink)
+
+        p, tracer, got = self._run(build)
+        snap = p.flight_snapshot()
+        doc = json.loads(json.dumps(spans.chrome_trace(snap)))
+        events = doc["traceEvents"]
+        assert isinstance(events, list) and events
+        xs = [e for e in events if e.get("ph") == "X"]
+        assert all(isinstance(e["ts"], float) and e["dur"] >= 0 for e in xs)
+        # nesting: an 'f' span strictly contains an 'out' span on one tid
+        fs = [e for e in xs if e["name"] == "f"]
+        outs = [e for e in xs if e["name"] == "out"]
+        nested = any(
+            f["tid"] == o["tid"]
+            and f["ts"] <= o["ts"]
+            and o["ts"] + o["dur"] <= f["ts"] + f["dur"] + 1e-6
+            for f in fs for o in outs)
+        assert nested, "filter dispatch span should enclose the sink's"
+        flow_s = [e for e in events if e.get("ph") == "s"]
+        flow_f = [e for e in events if e.get("ph") == "f"]
+        assert flow_s and flow_f
+        by_id = {e["id"]: e for e in flow_s}
+        assert any(by_id[e["id"]]["tid"] != e["tid"]
+                   for e in flow_f if e["id"] in by_id), \
+            "no flow event crosses threads"
+        # queue depth became a counter track
+        assert any(e.get("ph") == "C" for e in events)
+        # parent links recorded on the span args
+        assert all("trace_id" in e["args"] for e in xs)
+
+    def test_waterfall_renders_per_frame_blocks(self):
+        def build(p, got, n):
+            src = p.add(DataSrc(
+                data=[np.full(4, i, np.float32) for i in range(n)], name="s"))
+            sink = p.add(TensorSink(callback=got.append, name="out"))
+            p.link_chain(src, sink)
+
+        p, tracer, got = self._run(build, n_frames=3)
+        text = spans.waterfall(p.flight_snapshot())
+        assert text.count("trace ") == 3
+        assert "out" in text and "ms" in text
+
+    def test_tracer_detaches_and_disables(self):
+        def build(p, got, n):
+            src = p.add(DataSrc(data=[np.zeros(2, np.float32)], name="s"))
+            p.link(src, p.add(TensorSink(callback=got.append, name="out")))
+
+        p, tracer, got = self._run(build, n_frames=1)
+        from nnstreamer_tpu.obs import hooks
+
+        assert hooks.enabled is False
+        assert spans.enabled is False  # refcount dropped at stop()
+        assert tracer.summary()["records"] > 0  # data outlives the hooks
+
+    def test_disabled_path_stamps_nothing(self):
+        got = []
+        p = Pipeline(name="plain")
+        src = p.add(DataSrc(data=[np.zeros(2, np.float32)], name="s"))
+        p.link(src, p.add(TensorSink(callback=got.append, name="out")))
+        p.run(timeout=30)
+        assert spans.enabled is False
+        assert all(spans.META_KEY not in f.meta for f in got)
+
+
+class TestCoalescePropagation:
+    def test_dynbatch_records_parent_links(self):
+        """3 stamped frames coalesce: the batched frame carries a fresh
+        span whose parents are the constituents', and dynunbatch restores
+        each frame's own context."""
+        spans.enable()
+        got = []
+        dyn = DynBatch(max_batch=4, name="d")
+        sink = TensorSink(callback=got.append, name="cap")
+        dyn.src_pads["src"].link(sink.sink_pads["sink"])
+        frames = []
+        for i in range(3):
+            f = Frame.of(np.full((2,), i, np.float32))
+            f.meta[spans.META_KEY] = spans.new_context()
+            frames.append(f)
+        dyn._emit_batch(list(frames))
+        (batched,) = got
+        ctx = batched.meta[spans.META_KEY]
+        parents = batched.meta[spans.PARENTS_KEY]
+        assert len(parents) == 3
+        assert parents == tuple((f.meta[spans.META_KEY][0],
+                                 f.meta[spans.META_KEY][1]) for f in frames)
+        assert ctx[0] == frames[0].meta[spans.META_KEY][0]  # first's trace
+        assert ctx[1] not in {p[1] for p in parents}  # fresh span id
+        # unbatch restores the original per-frame contexts
+        unb = DynUnbatch(name="u")
+        restored = unb.process(None, batched)
+        assert [f.meta[spans.META_KEY][1] for f in restored] == \
+            [f.meta[spans.META_KEY][1] for f in frames]
+        # the coalesce instant landed in the flight recorder
+        coalesce = [r for r in spans.snapshot() if r[5] == "coalesce"]
+        assert coalesce and coalesce[-1][4] == "d"
+        assert len(coalesce[-1][9]["parents"]) == 3
+
+    def test_mux_collect_records_parent_links(self):
+        """Two live streams muxed: every collection round's output frame
+        links back to both contributed frames' spans."""
+        got = []
+        p = Pipeline(name="muxsp")
+        a = p.add(DataSrc(
+            data=[np.full(2, i, np.float32) for i in range(4)], name="a"))
+        b = p.add(DataSrc(
+            data=[np.full(3, 10 + i, np.float32) for i in range(4)], name="b"))
+        mux = p.add(TensorMux(name="m", sync_mode="nosync"))
+        sink = p.add(TensorSink(callback=got.append, name="out"))
+        p.link(a, mux)
+        p.link(b, mux)
+        p.link(mux, sink)
+        p.attach_tracer(SpanTracer())
+        p.run(timeout=60)
+        assert len(got) == 4
+        for f in got:
+            ctx = f.meta.get(spans.META_KEY)
+            parents = f.meta.get(spans.PARENTS_KEY)
+            assert ctx is not None and parents is not None
+            assert len(parents) == 2
+            assert ctx[0] in {t for t, _ in parents}
+
+
+class TestSchedSpans:
+    def test_queue_wait_and_invoke_spans(self):
+        from nnstreamer_tpu.obs.metrics import MetricsRegistry
+        from nnstreamer_tpu.sched import Scheduler
+
+        spans.enable()
+        sch = Scheduler("fifo", name="spsched", registry=MetricsRegistry())
+        try:
+            item = sch.admit("cli")
+            time.sleep(0.005)
+            sch.observe_wait(item, trace=(77, 5))
+            assert sch.invoke(lambda: 41 + 1) == 42
+        finally:
+            sch.close()
+        snap = spans.snapshot()
+        waits = [r for r in x_spans(snap) if r[4] == "sched_wait"]
+        assert waits and waits[-1][6] == 77 and waits[-1][8] == 5
+        assert waits[-1][2] >= 4_000_000  # >= 4ms of recorded wait
+        invokes = [r for r in x_spans(snap) if r[4] == "backend_invoke"]
+        assert invokes and invokes[-1][9]["ok"] is True
+
+    def test_breaker_open_span(self):
+        from nnstreamer_tpu.obs.metrics import MetricsRegistry
+        from nnstreamer_tpu.sched import (
+            BreakerOpenError,
+            CircuitBreaker,
+            Scheduler,
+        )
+
+        spans.enable()
+        sch = Scheduler("fifo", name="spbrk", registry=MetricsRegistry(),
+                        breaker=CircuitBreaker(failure_threshold=1))
+        try:
+            def boom():
+                raise RuntimeError("down")
+
+            with pytest.raises(RuntimeError):
+                sch.invoke(boom)
+            with pytest.raises(BreakerOpenError):
+                sch.invoke(lambda: 1)
+        finally:
+            sch.close()
+        snap = spans.snapshot()
+        assert any(r[4] == "breaker_open" for r in x_spans(snap))
+        failed = [r for r in x_spans(snap) if r[4] == "backend_invoke"]
+        assert failed and failed[-1][9]["ok"] is False
+
+
+def _model(x):
+    return x * 2.0
+
+
+class TestNnsqTracePropagation:
+    def test_flagged_roundtrip_attaches_server_span(self):
+        """A flagged request yields a flagged reply carrying the server's
+        serve-span id, and the server-side span lands on the CLIENT's
+        trace id."""
+        spans.enable()
+        with QueryServer(framework="custom", model=_model) as srv:
+            s = socket.create_connection(("127.0.0.1", srv.port))
+            try:
+                send_tensors(s, (np.ones((2, 4), np.float32),), 7,
+                             trace=(0xABCD, 0x11))
+                outs, pts, reply = recv_tensors_ex(s)
+            finally:
+                s.close()
+        np.testing.assert_allclose(outs[0], 2.0)
+        assert pts == 7
+        assert reply is not None and reply[0] == 0xABCD and reply[1] != 0x11
+        # the serve span closes on the server's connection thread AFTER
+        # the reply bytes go out: poll briefly instead of racing it
+        deadline = time.monotonic() + 5.0
+        serve = []
+        while not serve and time.monotonic() < deadline:
+            serve = [r for r in x_spans(spans.snapshot())
+                     if r[4] == "nnsq_serve"]
+            if not serve:
+                time.sleep(0.01)
+        assert serve, "no server-side span recorded"
+        assert serve[-1][6] == 0xABCD  # client's trace id
+        assert serve[-1][8] == 0x11    # parent = client's span id
+
+    def test_plain_v1_client_sees_no_flag(self):
+        """An old (pre-trace) client speaks plain version 1; a traced
+        server must reply in kind — the new header bit never reaches a
+        peer that didn't send it."""
+        spans.enable()
+        with QueryServer(framework="custom", model=_model) as srv:
+            s = socket.create_connection(("127.0.0.1", srv.port))
+            try:
+                send_tensors(s, (np.ones((4,), np.float32),), 3)  # no trace
+                head = b""
+                while len(head) < 16:
+                    head += s.recv(16 - len(head))
+                ver, n, pts = struct.unpack("<HHq", head[4:])
+                assert ver == 1, f"reply to a v1 peer must be plain v1: {ver}"
+                assert not (ver & FLAG_TRACE)
+            finally:
+                s.close()
+
+    def test_old_server_rejects_flag_client_falls_back(self):
+        """Version gating end to end: against a strict-v1 server the
+        flagged negotiation probe is refused (connection dropped), the
+        client reconnects and re-probes plain, and the stream runs with
+        trace propagation off — old peers never parse the new bit."""
+        srv, port, rejected, stop = _strict_v1_server(_model)
+        spans.enable()
+        got = []
+        try:
+            p = Pipeline(name="oldpeer")
+            src = p.add(DataSrc(
+                data=[np.full(4, i, np.float32) for i in range(3)], name="s"))
+            cli = p.add(TensorQueryClient(port=port, name="qc"))
+            sink = p.add(TensorSink(callback=got.append, name="out"))
+            p.link_chain(src, cli, sink)
+            p.run(timeout=60)
+            assert len(got) == 3
+            for i, f in enumerate(got):
+                np.testing.assert_allclose(f.tensors[0], 2.0 * i)
+            assert rejected, "the flagged probe never reached the old server"
+            assert all(v & FLAG_TRACE for v in rejected)
+            assert cli._trace_wire is False
+        finally:
+            stop.set()
+            srv.close()
+
+    def test_pipeline_end_to_end_trace_over_nnsq(self):
+        """Acceptance: a client-side trace id shows up on QueryServer-side
+        spans.  Full pipeline with a spans tracer -> rtt + serve spans on
+        the same per-frame trace."""
+        with QueryServer(framework="custom", model=_model) as srv:
+            got = []
+            p = Pipeline(name="nnsqsp")
+            src = p.add(DataSrc(
+                data=[np.full(4, i, np.float32) for i in range(4)], name="s"))
+            cli = p.add(TensorQueryClient(port=srv.port, name="qc"))
+            sink = p.add(TensorSink(callback=got.append, name="out"))
+            p.link_chain(src, cli, sink)
+            p.attach_tracer(SpanTracer())
+            p.run(timeout=60)
+        assert len(got) == 4
+        assert cli._trace_wire is True
+        frame_traces = {f.meta[spans.META_KEY][0] for f in got}
+        assert len(frame_traces) == 4
+        snap = spans.snapshot()
+        rtt = {r[6] for r in x_spans(snap) if r[4] == "nnsq_rtt"}
+        serve = {r[6] for r in x_spans(snap) if r[4] == "nnsq_serve"}
+        assert rtt == frame_traces
+        assert serve >= frame_traces, (
+            "server-side spans must attach to the client's per-frame traces")
+
+    def test_probe_pts_flagged_still_probe(self):
+        """A flagged probe is still a probe (DecodeServer-style peers key
+        on PROBE_PTS): pts rides untouched next to the trace block."""
+        spans.enable()
+        with QueryServer(framework="custom", model=_model) as srv:
+            s = socket.create_connection(("127.0.0.1", srv.port))
+            try:
+                send_tensors(s, (np.zeros((4,), np.float32),), PROBE_PTS,
+                             trace=(1, 0))
+                outs, pts, reply = recv_tensors_ex(s)
+                assert pts == PROBE_PTS and reply is not None
+            finally:
+                s.close()
+
+
+class TestConfActivation:
+    def test_env_driven_spans_tracer(self, monkeypatch):
+        monkeypatch.setenv("NNSTPU_TRACERS", "spans")
+        monkeypatch.setenv("NNSTPU_FLIGHT_RECORDS", "512")
+        got = []
+        p = Pipeline(name="confsp")
+        src = p.add(DataSrc(
+            data=[np.full(4, i, np.float32) for i in range(3)], name="s"))
+        p.link(src, p.add(TensorSink(callback=got.append, name="out")))
+        p.run(timeout=30)
+        assert len(got) == 3
+        summ = p.stats()["tracers"]["spans"]
+        assert summ["records"] > 0
+        assert summ["capacity"] == 512
+        assert p.flight_snapshot()
+
+    def test_flight_dump_on_post_error(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("NNSTPU_OBS_FLIGHT_DUMP_DIR", str(tmp_path))
+        monkeypatch.setenv("NNSTPU_TRACERS", "spans")
+
+        def boom(x):
+            # negotiation probes with zeros; only real frames detonate
+            if float(np.max(x)) > 0:
+                raise RuntimeError("kaboom")
+            return x
+
+        p = Pipeline(name="crashsp")
+        src = p.add(DataSrc(data=[np.ones(4, np.float32)], name="s"))
+        filt = p.add(TensorFilter(framework="custom", model=boom, name="f"))
+        sink = p.add(TensorSink(name="out"))
+        p.link_chain(src, filt, sink)
+        from nnstreamer_tpu.graph.pipeline import PipelineError
+
+        with pytest.raises(PipelineError):
+            p.run(timeout=30)
+        dump = tmp_path / "crashsp.error.trace.json"
+        assert dump.exists(), "post_error must dump the flight recorder"
+        doc = json.loads(dump.read_text())
+        assert doc["traceEvents"]
+        assert any(e.get("name") == "pipeline_error"
+                   for e in doc["traceEvents"])
+
+
+def _strict_v1_server(model):
+    """A pre-trace NNSQ peer: parses the version field with the OLD exact
+    check (``ver != 1`` -> protocol error, connection dropped) and speaks
+    plain version-1 replies.  Returns (listener, port, rejected_vers,
+    stop_event)."""
+    srv = socket.create_server(("127.0.0.1", 0))
+    port = srv.getsockname()[1]
+    rejected = []
+    stop = threading.Event()
+
+    def recvn(c, n):
+        buf = b""
+        while len(buf) < n:
+            chunk = c.recv(n - len(buf))
+            if not chunk:
+                raise ConnectionError("closed")
+            buf += chunk
+        return buf
+
+    def serve():
+        srv.settimeout(0.2)
+        while not stop.is_set():
+            try:
+                conn, _ = srv.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            with conn:
+                try:
+                    while not stop.is_set():
+                        head = recvn(conn, 16)
+                        ver, n, pts = struct.unpack("<HHq", head[4:])
+                        if ver != 1:  # the old strict check, verbatim
+                            rejected.append(ver)
+                            break
+                        tensors = []
+                        for _ in range(n):
+                            (dlen,) = struct.unpack("<H", recvn(conn, 2))
+                            dt = np.dtype(recvn(conn, dlen).decode())
+                            (rank,) = struct.unpack("<H", recvn(conn, 2))
+                            shape = (struct.unpack(f"<{rank}I",
+                                                   recvn(conn, 4 * rank))
+                                     if rank else ())
+                            (nb,) = struct.unpack("<Q", recvn(conn, 8))
+                            tensors.append(np.frombuffer(
+                                recvn(conn, nb), dt).reshape(shape))
+                        outs = tuple(model(t) for t in tensors)
+                        send_tensors(conn, outs, pts)  # plain v1 bytes
+                except (ConnectionError, OSError):
+                    pass
+
+    threading.Thread(target=serve, daemon=True).start()
+    return srv, port, rejected, stop

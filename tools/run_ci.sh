@@ -98,6 +98,61 @@ print(f"observability smoke OK: {len(body)} bytes of exposition, "
       f"e2e p99={lat['p99_ms']:.3f} ms")
 PY
 
+run_step "Tracing smoke (spans tracer + Chrome-trace export)" \
+  env NNSTPU_TRACERS=spans \
+  python - <<'PY'
+import json
+
+import jax
+jax.config.update('jax_platforms', 'cpu')
+import numpy as np
+
+from nnstreamer_tpu import Pipeline
+from nnstreamer_tpu.elements.filter import TensorFilter
+from nnstreamer_tpu.elements.queue import Queue
+from nnstreamer_tpu.elements.sink import TensorSink
+from nnstreamer_tpu.elements.testsrc import DataSrc
+from nnstreamer_tpu.obs import spans
+
+got = []
+p = Pipeline(name="ci_spans")
+src = p.add(DataSrc(data=[np.full(4, i, np.float32) for i in range(8)],
+                    name="s"))
+q = p.add(Queue(max_size_buffers=8, name="q"))
+filt = p.add(TensorFilter(framework="custom", model=lambda x: x * 2,
+                          name="f"))
+sink = p.add(TensorSink(callback=got.append, name="out"))
+p.link_chain(src, q, filt, sink)
+p.run(timeout=120)
+assert len(got) == 8, got
+assert all(spans.META_KEY in fr.meta for fr in got), \
+    "trace context lost before the sink"
+
+snap = p.flight_snapshot()
+doc = json.loads(json.dumps(spans.chrome_trace(snap)))  # valid JSON
+events = doc["traceEvents"]
+xs = [e for e in events if e.get("ph") == "X"]
+assert xs, "no complete spans recorded"
+
+# nested dispatch spans: the filter's slice strictly contains the sink's
+# on the queue worker thread
+nested = any(
+    a["tid"] == b["tid"] and a["name"] == "f" and b["name"] == "out"
+    and a["ts"] <= b["ts"] and b["ts"] + b["dur"] <= a["ts"] + a["dur"] + 1e-6
+    for a in xs for b in xs)
+assert nested, "dispatch spans are not nested"
+
+# at least one flow event pair crossing threads (src thread -> queue worker)
+starts = {e["id"]: e for e in events if e.get("ph") == "s"}
+cross = [e for e in events if e.get("ph") == "f"
+         and e["id"] in starts and starts[e["id"]]["tid"] != e["tid"]]
+assert cross, "no cross-thread flow event"
+
+print(f"tracing smoke OK: {len(snap)} records, {len(xs)} spans, "
+      f"{len(cross)} cross-thread flows; waterfall:")
+print("\n".join(spans.waterfall(snap, limit=2).splitlines()[:8]))
+PY
+
 run_step "Scheduling smoke (DRR fairness + typed shed + live scrape)" \
   python - <<'PY'
 import socket
