@@ -48,6 +48,8 @@ import jax
 import jax.numpy as jnp
 
 from ..buffer import WireTensor
+from ..obs import hooks as _hooks
+from ..pool import RowBatch, fence as _pool_fence
 from ..spec import TensorSpec, TensorsSpec
 from .base import FilterBackend, register_backend
 
@@ -210,6 +212,11 @@ class JaxBackend(FilterBackend):
         self._cache: "OrderedDict[tuple, tuple]" = OrderedDict()
         self._cache_size = DEFAULT_COMPILE_CACHE
         self._donate_wire = False
+        # zero-copy hot-path state (nnstreamer_tpu/pool.py): batch-1
+        # executable for deferred RowBatch inputs, and pooled ping-pong
+        # staging for non-contiguous host frames on the flat wire entry
+        self._row_jit = None
+        self._host_stager = None
 
     # -- open/close ---------------------------------------------------------
 
@@ -270,6 +277,8 @@ class JaxBackend(FilterBackend):
         self._flat_compiled = None
         self._expected = None
         self._cache.clear()
+        self._row_jit = None
+        self._host_stager = None
 
     # -- spec discovery -----------------------------------------------------
 
@@ -305,6 +314,7 @@ class JaxBackend(FilterBackend):
         self._wrapper = wrapper
         self._compiled = None
         self._flat_compiled = None
+        self._row_jit = None
         if wrapper is None:
             self._drift_hook = None
         if invalidate:
@@ -490,6 +500,18 @@ class JaxBackend(FilterBackend):
                     self._drift_hook(drifted)
                 else:
                     self.reconfigure(drifted)
+        if tensors and isinstance(tensors[0], RowBatch):
+            # deferred batch from tensor_batch's over-threshold path: keep
+            # the zero-concat promise by invoking per row (batch-1
+            # executable); outputs ride back as RowBatches so the whole
+            # batch→filter→unbatch chain never assembles a host batch.
+            # Fused programs bake batched geometry into their stages, and
+            # multi-input frames would need row alignment — both fall back
+            # to one real stack + the normal path (correctness is never
+            # conditional on the fast path).
+            if len(tensors) == 1 and self._wrapper is None:
+                return self._invoke_rows(tensors[0])
+            return self.invoke(tuple(np.asarray(t) for t in tensors))
         if tensors and isinstance(tensors[0], WireTensor):
             # tensor_upload already moved the bytes (wire layout, upstream
             # thread): dispatch-only here — the transfer/dispatch overlap
@@ -516,19 +538,84 @@ class JaxBackend(FilterBackend):
             self._wire_shapes
         ) and not any(isinstance(t, jax.Array) for t in tensors):
             # host frames cross the wire flat (1-D view — no copy for
-            # C-contiguous arrays) and reshape on device; device-resident
+            # C-contiguous arrays) and reshape on device; strided frames
+            # copy ONCE into a pooled ping-pong staging buffer (a slot is
+            # rewritten only after the dispatch issued from it completed,
+            # so frame N+1's copy overlaps frame N); device-resident
             # frames take the shaped entry untouched
-            out = self._flat_compiled(
-                *(
-                    np.ascontiguousarray(t).reshape(w)
-                    for t, w in zip(tensors, self._wire_shapes)
-                )
-            )
+            staged = []
+            args = []
+            for i, (t, w) in enumerate(zip(tensors, self._wire_shapes)):
+                a = np.asarray(t)
+                if a.flags["C_CONTIGUOUS"]:
+                    args.append(a.reshape(w))
+                    continue
+                if self._host_stager is None:
+                    from ..pool import WireStager
+
+                    self._host_stager = WireStager()
+                buf = self._host_stager.stage(i, a, tuple(w))
+                if _hooks.enabled:
+                    _hooks.emit("copy", self, buf.nbytes,
+                                self._host_stager.last_alloc)
+                args.append(buf)
+                staged.append(i)
+            out = self._flat_compiled(*args)
+            # output readiness implies every host input was consumed
+            # (donation composes: donate frees the DEVICE twin, never a
+            # host buffer): gate staged-slot reuse AND any pooled batch
+            # buffer's rewrite-after-recycle on it
+            head = out[0] if isinstance(out, (tuple, list)) else out
+            for i in staged:
+                self._host_stager.track(i, head)
+            for a in args:
+                if isinstance(a, np.ndarray):
+                    _pool_fence(a, head)
         else:
             out = self._compiled(*tensors)
+            head = out[0] if isinstance(out, (tuple, list)) else out
+            for t in tensors:
+                if isinstance(t, np.ndarray):
+                    _pool_fence(t, head)
         if self._single_output:
             return (out,)
         return tuple(out)
+
+    def _invoke_rows(self, rb: RowBatch) -> Tuple:
+        """Per-row dispatch for a deferred :class:`RowBatch`.
+
+        The negotiated ``(N, *row)`` spec stays the pad contract; each row
+        runs through a batch-1 executable (plain ``jax.jit`` — batch 1
+        cannot shard, and this path only triggers on the CPU fallback where
+        ``pool.skip_host_concat`` decided coalescing loses) and the outputs
+        ride back as RowBatches with the negotiated batched geometry."""
+        if self._row_jit is None:
+            self._row_jit = jax.jit(self._fn)
+        jit = self._row_jit
+        per_out: Optional[list] = None
+        single = True
+        for i in range(len(rb)):
+            row = rb.row(i)[None]  # [None]: a view, keeps the batch dim
+            o = jit(row)
+            single = not isinstance(o, (tuple, list))
+            outs = (o,) if single else tuple(o)
+            if isinstance(row, np.ndarray):
+                _pool_fence(row, outs[0])  # rows may view a pooled buffer
+            if per_out is None:
+                per_out = [[] for _ in outs]
+            for j, oj in enumerate(outs):
+                per_out[j].append(oj)
+        out_specs = self._out_spec.tensors if self._out_spec is not None else ()
+        results = []
+        for j, rows in enumerate(per_out):
+            if j < len(out_specs) and out_specs[j].is_fixed:
+                row_shape = tuple(out_specs[j].shape)[1:]
+                dtype = out_specs[j].dtype
+            else:
+                row_shape = tuple(rows[0].shape)[1:]
+                dtype = rows[0].dtype
+            results.append(RowBatch(rows, row_shape=row_shape, dtype=dtype))
+        return tuple(results)
 
 
 @register_backend("jax-sharded")

@@ -57,12 +57,22 @@ class Frame:
         return self.tensors[i]
 
     def with_tensors(self, tensors, **updates) -> "Frame":
-        """New frame with replaced payloads, timing/meta preserved."""
+        """New frame with replaced payloads, timing/meta preserved.
+
+        ``meta`` is copied ONLY when a ``meta=`` update is passed: the
+        common payload-swap on the hot path shares the dict by reference
+        (one less allocation per element per frame), which also preserves
+        the spans tracer's contract that a frame's mutable trace-context
+        list rides through every payload swap (``obs/spans.py``).  A caller
+        that wants to mutate the result's meta must pass ``meta=`` (even
+        ``meta=frame.meta``) to get its own copy.
+        """
+        meta = updates.get("meta")
         return Frame(
             tensors=tuple(tensors),
             pts=updates.get("pts", self.pts),
             duration=updates.get("duration", self.duration),
-            meta=dict(updates.get("meta", self.meta)),
+            meta=dict(meta) if meta is not None else self.meta,
         )
 
     def to_host(self) -> "Frame":

@@ -59,6 +59,16 @@ DEFAULTS: Dict[str, Dict[str, str]] = {
         "flight_records": "",       # span flight-recorder ring size per thread
         "flight_dump_dir": "",      # write {pipeline}.error.trace.json here
     },
+    # Host staging-buffer pool (nnstreamer_tpu/pool): the zero-copy batch
+    # assembly + wire staging path.  NNSTPU_POOL_* env vars map here.
+    "pool": {
+        "enabled": "true",          # false = every lease allocates fresh
+        "max_per_class": "4",       # free buffers kept per (shape, dtype)
+        "max_bytes": "67108864",    # total free-list bytes (64 MiB)
+        "concat_threshold": "0",    # per-row bytes: skip host concat on the
+                                    # CPU fallback above this (0=off; opt-in
+                                    # — see BENCH_NOTES zero-copy sweep)
+    },
     # Serving QoS (nnstreamer_tpu/sched): NNSTPU_SCHED_* env vars map here.
     # An empty policy disables scheduling entirely (legacy FIFO dispatch).
     "sched": {

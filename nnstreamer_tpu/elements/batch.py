@@ -15,25 +15,48 @@ streams at once, with the batch dim sharded over the device mesh by the
   tensor (``jnp.stack``: stays on device when inputs are device-resident).
 - ``tensor_unbatch`` — inverse: ``(N, *shape)`` → N tensors, so the demuxed
   per-stream outputs line up with the original pads.
+
+Host-side assembly is **slot-wise into a pooled batch buffer** (each row
+copied once, directly into its slot of a recycled staging buffer —
+``nnstreamer_tpu/pool.py``), never a fresh ``np.stack``: the cold
+multi-MB allocation per dispatch was 59% of 8-stream busy time on the CPU
+fallback (BENCH_NOTES.md "Mux per-stream overhead finding").  Above the
+payload/platform threshold (``pool.skip_host_concat``) host concat is
+skipped entirely: rows ride downstream as a deferred
+:class:`~nnstreamer_tpu.pool.RowBatch` and the jax filter invokes per
+stream — the regime where coalescing 602 KB host rows costs more than the
+dispatch amortization saves.
 """
 
 from __future__ import annotations
 
 from typing import Dict, Optional
 
+import numpy as np
+
 from ..buffer import Frame
 from ..graph.node import NegotiationError, Node, Pad
 from ..graph.registry import register_element
+from ..obs import hooks as _hooks
 from ..spec import TensorSpec, TensorsSpec
 
 
 @register_element("tensor_batch")
 class TensorBatch(Node):
-    def __init__(self, name: Optional[str] = None):
+    def __init__(self, name: Optional[str] = None, pool=None):
         super().__init__(name)
         self.add_sink_pad("sink")
         self.add_src_pad("src")
         self._n = 0
+        self._pool = pool  # default shared pool unless injected (tests)
+        self._per_stream = False  # skip host concat (pool.skip_host_concat)
+
+    def _pool_or_default(self):
+        if self._pool is None:
+            from ..pool import default_pool
+
+            self._pool = default_pool()
+        return self._pool
 
     def configure(self, in_specs: Dict[str, TensorsSpec]) -> Dict[str, TensorsSpec]:
         spec = in_specs["sink"]
@@ -48,6 +71,16 @@ class TensorBatch(Node):
                 )
         self._n = spec.num_tensors
         out = TensorSpec(dtype=first.dtype, shape=(self._n,) + tuple(first.shape))
+        # payload/platform-aware host-concat decision: on the CPU fallback
+        # with large rows, hand the filter a RowBatch (per-stream invoke)
+        # instead of coalescing — the consumer's platform decides, so a
+        # real accelerator always gets the batched transfer
+        from ..graph.residency import consumer_platform
+        from ..pool import skip_host_concat
+
+        self._per_stream = first.is_fixed and skip_host_concat(
+            first.nbytes, consumer_platform(self)
+        )
         return {"src": TensorsSpec(tensors=(out,), rate=spec.rate)}
 
     def process(self, pad: Pad, frame: Frame):
@@ -59,12 +92,28 @@ class TensorBatch(Node):
 
             # device-resident inputs: stack on device, stays resident
             return frame.with_tensors((jnp.stack(frame.tensors, axis=0),))
-        # host inputs: one host memcpy — the downstream jax filter's flat
-        # wire path then moves the whole batch in a single cheap transfer
-        # (per-tensor jnp.stack here would pay N tiled-layout device_puts)
-        import numpy as np
+        if self._per_stream:
+            # zero host concat: rows ride as-is; the jax filter invokes
+            # per row and tensor_unbatch splits without materializing
+            from ..pool import RowBatch
 
-        return frame.with_tensors((np.stack(frame.tensors, axis=0),))
+            return frame.with_tensors(
+                (RowBatch([np.asarray(t) for t in frame.tensors]),)
+            )
+        # host inputs: each row copied ONCE, directly into its slot of a
+        # recycled pooled batch buffer — the downstream jax filter's flat
+        # wire path then moves the whole batch in a single cheap transfer
+        # (np.stack here would add a cold multi-MB allocation per dispatch;
+        # per-tensor jnp.stack would pay N tiled-layout device_puts)
+        rows = [np.asarray(t) for t in frame.tensors]
+        buf = self._pool_or_default().lease(
+            (len(rows),) + rows[0].shape, rows[0].dtype
+        )
+        for i, r in enumerate(rows):
+            np.copyto(buf[i], r)
+        if _hooks.enabled:
+            _hooks.emit("copy", self, buf.nbytes, 1 if buf.pool_fresh else 0)
+        return frame.with_tensors((buf,))
 
 
 @register_element("tensor_unbatch")
@@ -129,5 +178,6 @@ class TensorUnbatch(Node):
                 batched = np.asarray(batched)
             else:
                 return frame.with_tensors(self._device_split(batched))
-        # numpy: row views share the parent buffer, no copies
+        # numpy: row views share the parent buffer; RowBatch: the deferred
+        # rows come back out individually — no copies either way
         return frame.with_tensors(tuple(batched[i] for i in range(batched.shape[0])))

@@ -76,6 +76,8 @@ class DynBatch(Node):
         self._q = None
         self.batches_emitted = 0  # observability: how often we coalesced
         self.frames_in = 0
+        self._pool = None  # shared staging pool, resolved lazily
+        self._skip_concat = False  # pool.skip_host_concat at configure
 
     def configure(self, in_specs: Dict[str, TensorsSpec]) -> Dict[str, TensorsSpec]:
         spec = in_specs["sink"]
@@ -86,6 +88,16 @@ class DynBatch(Node):
         out = tuple(
             TensorSpec(dtype=t.dtype, shape=(None,) + tuple(t.shape))
             for t in spec.tensors
+        )
+        # payload/platform-aware threshold (same rule as tensor_batch): on
+        # the CPU fallback with large frames, coalescing costs more host
+        # memcpy than the dispatch amortization saves — emit batch-1 views
+        # (zero concat) instead of stacking the pile-up
+        from ..graph.residency import consumer_platform
+        from ..pool import skip_host_concat
+
+        self._skip_concat = skip_host_concat(
+            sum(t.nbytes for t in spec.tensors), consumer_platform(self)
         )
         # batch dim None → downstream pads skip per-frame sig checks and the
         # jax backend treats each new bucket as spec drift (LRU-cached)
@@ -104,15 +116,44 @@ class DynBatch(Node):
         self._ensure_queue()
         return [threading.Thread(target=self._worker, name=f"dynbatch:{self.name}")]
 
+    def _pool_or_default(self):
+        if self._pool is None:
+            from ..pool import default_pool
+
+            self._pool = default_pool()
+        return self._pool
+
     def _emit_batch(self, frames: List[Frame]) -> None:
+        if self._skip_concat:
+            # over-threshold CPU regime: each frame leaves as a batch-1
+            # reshape VIEW (zero concat, zero padding); the polymorphic
+            # downstream spec already admits bucket 1
+            for f in frames:
+                self._emit_one(f)
+            return
         n = len(frames)
         b = _bucket(n, self.max_batch)
         pad_rows = b - n
         stacked = []
+        copied = 0
+        allocs = 0
         for ti in range(frames[0].num_tensors):
             rows = [np.asarray(f.tensors[ti]) for f in frames]
-            rows.extend([rows[-1]] * pad_rows)  # pad: repeat last frame
-            stacked.append(np.stack(rows, axis=0))
+            # slot-wise assembly into a recycled pooled buffer: each row
+            # (and each padding repeat of the last row) copied exactly once
+            # into its slot — no fresh np.stack allocation per flush
+            buf = self._pool_or_default().lease(
+                (b,) + rows[0].shape, rows[0].dtype
+            )
+            for i, r in enumerate(rows):
+                np.copyto(buf[i], r)
+            for i in range(n, b):  # pad: repeat last frame
+                np.copyto(buf[i], rows[-1])
+            stacked.append(buf)
+            copied += buf.nbytes
+            allocs += 1 if buf.pool_fresh else 0
+        if _hooks.enabled:
+            _hooks.emit("copy", self, copied, allocs)
         meta = {
             "dynbatch": {
                 "n": n,
@@ -133,6 +174,28 @@ class DynBatch(Node):
             _hooks.emit("dynbatch_flush", self, n, b)
         self.push(Frame(tensors=tuple(stacked), pts=frames[0].pts,
                         duration=frames[0].duration, meta=meta))
+
+    def _emit_one(self, f: Frame) -> None:
+        """Batch-1 emission (over-threshold path): reshape views, no copy;
+        the dynbatch meta/span discipline stays identical so dynunbatch and
+        the tracers cannot tell the paths apart."""
+        tensors = tuple(np.asarray(t)[None] for t in f.tensors)
+        meta = {
+            "dynbatch": {
+                "n": 1,
+                "pts": [f.pts],
+                "duration": [f.duration],
+                "meta": [f.meta],
+            }
+        }
+        if _spans.enabled:
+            _spans.merge_context([f], meta, self.name)
+        self.frames_in += 1
+        self.batches_emitted += 1
+        if _hooks.enabled:
+            _hooks.emit("dynbatch_flush", self, 1, 1)
+        self.push(Frame(tensors=tensors, pts=f.pts, duration=f.duration,
+                        meta=meta))
 
     def _worker(self) -> None:
         q = self._q

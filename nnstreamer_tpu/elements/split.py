@@ -13,9 +13,10 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
-from ..buffer import Frame
+from ..buffer import Frame, WireTensor
 from ..graph.node import NegotiationError, Node, Pad
 from ..graph.registry import register_element
+from ..obs import hooks as _hooks
 from ..spec import TensorSpec, TensorsSpec
 
 
@@ -98,6 +99,13 @@ class TensorSplit(Node):
     def process(self, pad: Pad, frame: Frame):
         del pad
         arr = frame.tensor(0)
+        if isinstance(arr, WireTensor):
+            # materialize ONCE and slice the cached host array: WireTensor
+            # subscripting pays a full device→host copy per __getitem__, so
+            # the old per-pad slicing cost N d2h round trips per frame
+            arr = np.asarray(arr)
+            if _hooks.enabled:
+                _hooks.emit("copy", self, arr.nbytes, 1)
         sel = self._selected()
         out = []
         for i, pad_name in enumerate(self._pad_order()):

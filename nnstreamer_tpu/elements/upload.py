@@ -37,6 +37,8 @@ import numpy as np
 from ..buffer import Frame, WireTensor
 from ..graph.node import Node, Pad
 from ..graph.registry import register_element
+from ..obs import hooks as _hooks
+from ..pool import fence as _pool_fence
 from ..spec import TensorsSpec
 
 
@@ -49,15 +51,12 @@ class TensorUpload(Node):
         self._wire_shape = None  # downstream backend's wire rule
         self._backend = None  # downstream backend (sharding queried lazily)
         self._shardings = None  # per-tensor-index device_put shardings
+        self._stager = None  # pooled ping-pong staging (non-contiguous hosts)
 
     def _downstream_backend(self):
-        from ..elements.queue import Queue
-        from ..graph.residency import hop_plumbing
+        from ..graph.residency import downstream_backend
 
-        pad = hop_plumbing(
-            self.src_pads["src"].peer, "down", (Queue, TensorUpload)
-        )
-        return getattr(pad.node, "backend", None) if pad is not None else None
+        return downstream_backend(self)
 
     def _downstream_wire_rule(self):
         """The wire layout is the *consumer's* contract: the base jax
@@ -87,6 +86,8 @@ class TensorUpload(Node):
     def configure(self, in_specs: Dict[str, TensorsSpec]) -> Dict[str, TensorsSpec]:
         self._wire_shape = self._downstream_wire_rule()
         self._shardings = None
+        if self._stager is not None:
+            self._stager.reset()  # wire shapes may change with the spec
         return {"src": in_specs["sink"]}
 
     def process(self, pad: Pad, frame: Frame):
@@ -102,8 +103,24 @@ class TensorUpload(Node):
                 continue
             arr = np.asarray(t)
             wire = self._wire_shape(tuple(arr.shape))
+            staged = False
             if wire != tuple(arr.shape):
-                arr_w = np.ascontiguousarray(arr).reshape(wire)
+                if arr.flags["C_CONTIGUOUS"]:
+                    arr_w = arr.reshape(wire)  # pure view: zero-copy
+                else:
+                    # strided host frame: ONE copy into a pooled ping-pong
+                    # staging buffer — frame N+1's copy lands in the other
+                    # slot while frame N's put is still in flight (a slot
+                    # is rewritten only after its transfer completed)
+                    if self._stager is None:
+                        from ..pool import WireStager
+
+                        self._stager = WireStager()
+                    arr_w = self._stager.stage(i, arr, wire)
+                    staged = True
+                    if _hooks.enabled:
+                        _hooks.emit("copy", self, arr_w.nbytes,
+                                    self._stager.last_alloc)
             else:
                 arr_w = arr
             sharding = self._sharding_for(i)
@@ -112,5 +129,12 @@ class TensorUpload(Node):
                 if sharding is not None
                 else jax.device_put(arr_w)
             )
+            if staged:
+                self._stager.track(i, put)
+            else:
+                # pooled batch buffers (tensor_batch/dynbatch slot assembly)
+                # must not be rewritten after recycle while this async put
+                # is still reading them; no-op for unpooled arrays
+                _pool_fence(arr_w, put)
             out.append(WireTensor(put, arr.shape, arr.dtype))
         return frame.with_tensors(out)

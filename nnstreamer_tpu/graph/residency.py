@@ -50,6 +50,44 @@ def hop_plumbing(pad, direction: str, transparent, max_hops: int = 4):
     return pad
 
 
+def downstream_backend(node: Node, max_hops: int = 4):
+    """The first filter backend downstream of ``node``, hopping over
+    queue/upload plumbing (None when the chain ends, branches, or lands on
+    a non-filter).  Shared by ``tensor_upload`` (wire-rule/sharding
+    discovery) and the batch elements (the host-concat threshold is
+    platform-aware: it needs the CONSUMER's platform, not the producer's).
+    """
+    from ..elements.queue import Queue
+    from ..elements.upload import TensorUpload
+
+    pads = node.src_pads
+    if len(pads) != 1:
+        return None
+    pad = hop_plumbing(
+        next(iter(pads.values())).peer, "down", (Queue, TensorUpload),
+        max_hops,
+    )
+    return getattr(pad.node, "backend", None) if pad is not None else None
+
+
+def consumer_platform(node: Node, max_hops: int = 4):
+    """``jax.default_backend()`` string when the downstream consumer is a
+    jax-family filter backend, else None.  Used by the batch elements'
+    payload/platform-aware host-concat threshold (``pool.skip_host_concat``):
+    only a jax consumer understands the deferred ``RowBatch`` fast path,
+    and only the CPU fallback benefits from it."""
+    backend = downstream_backend(node, max_hops)
+    if backend is None:
+        return None
+    from ..backends.jax_backend import JaxBackend
+
+    if not isinstance(backend, JaxBackend):
+        return None
+    import jax
+
+    return jax.default_backend()
+
+
 def chain_device_resident(node: Node, direction: str, max_hops: int = 4) -> bool:
     """Walk the up- or downstream chain a few hops from ``node``: a
     device_resident filter with only residency-*preserving* elements between

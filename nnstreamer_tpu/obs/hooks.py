@@ -31,6 +31,11 @@ must stay allocation-light):
 ``rate_drop``      ``(node,)`` — tensor_rate dropped a frame
 ``rate_dup``       ``(node,)`` — tensor_rate duplicated a frame
 ``dynbatch_flush`` ``(node, n, bucket)`` — dynbatch emitted a batch
+``copy``           ``(node, nbytes, allocs)`` — a hot-path host memcpy
+                   (batch assembly, wire staging, forced materialization);
+                   ``allocs`` counts fresh buffer allocations (0 when the
+                   bytes landed in a recycled pool buffer).  ``node`` may
+                   be a backend object on filter-internal copies.
 =================  ====================================================
 
 Timestamps passed through hooks are ``time.perf_counter_ns()`` — every
@@ -59,6 +64,7 @@ HOOKS = (
     "rate_drop",
     "rate_dup",
     "dynbatch_flush",
+    "copy",
 )
 
 # The fast-path gate: True iff at least one callback is connected anywhere.

@@ -284,10 +284,89 @@ class DropsTracer(Tracer):
             return {name: dict(per) for name, per in self._by_element.items()}
 
 
+class CopiesTracer(Tracer):
+    """Host memcpy + allocation accounting on the zero-copy hot path.
+
+    Every ``copy`` hook emission (batch slot assembly, wire staging,
+    forced WireTensor materialization) folds into per-element byte/copy/
+    alloc counters; source pushes count frames so ``summary()`` can report
+    **bytes copied per source frame** — the number the CI copy-regression
+    gate and ``tools/profile_mux_overhead.py`` watch.  Copies emitted by
+    backend objects (no ``pipeline`` attribute) are attributed by type
+    name: they belong to whichever pipeline's filter invoked them, which a
+    single-pipeline process (the bench/CI shape) makes unambiguous.
+    """
+
+    name = "copies"
+
+    def __init__(self, registry: Optional[MetricsRegistry] = None):
+        super().__init__(registry)
+        self._lock = threading.Lock()
+        self._by_element: Dict[str, list] = {}  # name -> [bytes, copies, allocs]
+        self._frames = 0
+
+    def _install(self) -> None:
+        self._bytes = self._registry.counter(
+            "nnstpu_copy_bytes_total",
+            "Host bytes memcpy'd on the frame hot path",
+            labelnames=("pipeline", "element"),
+        )
+        self._copies = self._registry.counter(
+            "nnstpu_copies_total",
+            "Host memcpy operations on the frame hot path",
+            labelnames=("pipeline", "element"),
+        )
+        self._allocs = self._registry.counter(
+            "nnstpu_copy_allocs_total",
+            "Fresh (unpooled) buffer allocations behind hot-path copies",
+            labelnames=("pipeline", "element"),
+        )
+        self._connect("copy", self._on_copy)
+        self._connect("source_push", self._on_source_push)
+
+    def _on_copy(self, node, nbytes, allocs) -> None:
+        pipeline = getattr(node, "pipeline", None)
+        if pipeline is not None and pipeline is not self._pipeline:
+            return
+        name = getattr(node, "name", None) or type(node).__name__
+        self._bytes.inc(nbytes, pipeline=self._pipeline.name, element=name)
+        self._copies.inc(1, pipeline=self._pipeline.name, element=name)
+        if allocs:
+            self._allocs.inc(allocs, pipeline=self._pipeline.name,
+                             element=name)
+        with self._lock:
+            c = self._by_element.setdefault(name, [0, 0, 0])
+            c[0] += int(nbytes)
+            c[1] += 1
+            c[2] += int(allocs)
+
+    def _on_source_push(self, pipeline, node, frame) -> None:
+        del node, frame
+        if pipeline is self._pipeline:
+            with self._lock:
+                self._frames += 1
+
+    def summary(self) -> dict:
+        with self._lock:
+            per = {name: {"bytes": c[0], "copies": c[1], "allocs": c[2]}
+                   for name, c in self._by_element.items()}
+            frames = self._frames
+        total = sum(c["bytes"] for c in per.values())
+        allocs = sum(c["allocs"] for c in per.values())
+        return {
+            "elements": per,
+            "frames": frames,
+            "total_bytes": total,
+            "total_allocs": allocs,
+            "bytes_per_frame": total / frames if frames else 0.0,
+        }
+
+
 TRACERS = {
     LatencyTracer.name: LatencyTracer,
     StatsTracer.name: StatsTracer,
     DropsTracer.name: DropsTracer,
+    CopiesTracer.name: CopiesTracer,
 }
 
 

@@ -64,3 +64,6 @@ def _reset_global_state():
     profiling.enable(False)
     obs_hooks.clear()  # no tracer callback outlives its test
     obs_spans.reset()  # flight recorder + enable flag are process-global
+    from nnstreamer_tpu import pool as _pool
+
+    _pool.reset_default_pool()  # conf-driven singleton: re-read per test

@@ -33,9 +33,33 @@ class TestFrame:
         assert g.pts == 10 and g.duration == 3 and g.meta == {"tag": "x"}
         h = f.with_tensors((np.ones(2),), pts=99, meta={"tag": "y"})
         assert h.pts == 99 and h.meta == {"tag": "y"}
-        # meta is copied, never shared
-        g.meta["tag"] = "mutated"
-        assert f.meta["tag"] == "x"
+
+    def test_with_tensors_meta_lazy_copy(self):
+        """meta copies ONLY on a meta= update: the plain payload swap (the
+        per-element hot path) shares the dict by reference — one less dict
+        allocation per element per frame."""
+        f = Frame.of(np.zeros(2), tag="x")
+        g = f.with_tensors((np.ones(2),))
+        assert g.meta is f.meta  # shared, not copied
+        src = {"tag": "y"}
+        h = f.with_tensors((np.ones(2),), meta=src)
+        assert h.meta == src and h.meta is not src  # updates still copy
+        h.meta["tag"] = "mutated"
+        assert src["tag"] == "y" and f.meta["tag"] == "x"
+
+    def test_with_tensors_shares_trace_context_list(self):
+        """Regression (obs/spans.py contract): a frame's mutable
+        trace-context list must ride through EVERY payload swap — both the
+        shared-dict fast path and a meta= shallow copy — so spans stamped
+        in one hop are visible to all downstream hops of the same frame."""
+        ctx = ["trace", 1, 0, None]
+        f = Frame.of(np.zeros(2), obs_span_ctx=ctx)
+        g = f.with_tensors((np.ones(2),))
+        h = f.with_tensors((np.ones(2),), meta=f.meta)  # explicit copy path
+        assert h.meta is not f.meta
+        ctx[2] = 42  # a pad-push updates the flow id in place
+        assert g.meta["obs_span_ctx"][2] == 42
+        assert h.meta["obs_span_ctx"][2] == 42
 
     def test_to_host_materializes_device_arrays(self):
         f = Frame.of(jnp.arange(6).reshape(2, 3))

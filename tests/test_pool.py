@@ -1,0 +1,436 @@
+"""Buffer-pool lifecycle (`nnstreamer_tpu.pool`) — the zero-copy hot path.
+
+Pins the contracts the batched front doors now lean on: refcount-aware
+recycling (a buffer returns to the free list only when the LAST view
+drops — tee fan-out must not recycle early), bounded free-list accounting
+(per-class and total-byte eviction, renegotiated size classes draining
+out instead of leaking), the async-transfer fence (recycled memory is
+never rewritten while a ``device_put``/dispatch issued from it is still
+reading), the deferred ``RowBatch``, ping-pong ``WireStager`` staging,
+and the ``copies`` tracer the CI regression gate reads.
+"""
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.buffer import Frame
+from nnstreamer_tpu.pool import (
+    BufferPool,
+    PooledArray,
+    RowBatch,
+    WireStager,
+    fence,
+    skip_host_concat,
+)
+
+
+class FakeInflight:
+    """Stands in for a jax.Array: readiness is explicit."""
+
+    def __init__(self):
+        self.waits = 0
+
+    def block_until_ready(self):
+        self.waits += 1
+        return self
+
+
+class TestLeaseRecycle:
+    def test_miss_then_hit_reuses_memory(self):
+        pool = BufferPool(max_per_class=4, max_bytes=1 << 20)
+        a = pool.lease((8,), np.float32)
+        assert isinstance(a, PooledArray) and a.pool_fresh
+        ptr = a.ctypes.data
+        pool.recycle(a)
+        del a
+        b = pool.lease((8,), np.float32)
+        assert not b.pool_fresh and b.ctypes.data == ptr
+        st = pool.stats()
+        assert st["hits"] == 1 and st["misses"] == 1 and st["recycles"] == 1
+
+    def test_distinct_classes_never_cross(self):
+        pool = BufferPool(max_per_class=4, max_bytes=1 << 20)
+        a = pool.lease((8,), np.float32)
+        pool.recycle(a)
+        del a
+        assert pool.lease((8,), np.int32).pool_fresh  # dtype differs
+        assert pool.lease((4, 2), np.float32).pool_fresh  # shape differs
+
+    def test_auto_recycle_when_last_ref_drops(self):
+        pool = BufferPool(max_per_class=4, max_bytes=1 << 20)
+        a = pool.lease((8,), np.float32)
+        nbytes = a.nbytes
+        assert pool.stats()["leased_bytes"] == nbytes
+        del a  # no explicit recycle: the GC finalizer returns it
+        st = pool.stats()
+        assert st["recycles"] == 1
+        assert st["leased_bytes"] == 0 and st["free_bytes"] == nbytes
+
+    def test_views_keep_lease_alive_tee_fanout(self):
+        """Two branches holding views of one pooled batch (tee fan-out):
+        the buffer must stay leased until BOTH drop."""
+        pool = BufferPool(max_per_class=4, max_bytes=1 << 20)
+        a = pool.lease((4, 8), np.float32)
+        a[:] = 7.0
+        branch1 = np.asarray(a)[0]  # base-class views, like frame consumers
+        branch2 = np.asarray(a).reshape(32)
+        del a
+        assert pool.stats()["recycles"] == 0  # views pin the lease
+        del branch1
+        assert pool.stats()["recycles"] == 0
+        np.testing.assert_array_equal(branch2, np.full(32, 7.0, np.float32))
+        del branch2
+        st = pool.stats()
+        assert st["recycles"] == 1 and st["leased_bytes"] == 0
+
+    def test_explicit_recycle_is_idempotent(self):
+        pool = BufferPool(max_per_class=4, max_bytes=1 << 20)
+        a = pool.lease((8,), np.float32)
+        pool.recycle(a)
+        pool.recycle(a)  # finalizers fire at most once
+        del a
+        assert pool.stats()["recycles"] == 1
+
+
+class TestBounds:
+    def test_per_class_overflow_counts_eviction(self):
+        pool = BufferPool(max_per_class=1, max_bytes=1 << 20)
+        a, b = pool.lease((8,), np.float32), pool.lease((8,), np.float32)
+        pool.recycle(a)
+        pool.recycle(b)  # class already full: dropped, accounted
+        del a, b
+        st = pool.stats()
+        assert st["evictions"] == 1
+        assert st["free_buffers"] == 1 and st["free_bytes"] == 32
+
+    def test_byte_bound_evicts_oldest_first(self):
+        """Renegotiation: a stream that switches (8,)→(16,) must drain the
+        old size class out of the bounded pool, oldest first."""
+        pool = BufferPool(max_per_class=8, max_bytes=96)
+        old = [pool.lease((8,), np.float32) for _ in range(2)]  # 32 B each
+        for x in old:
+            pool.recycle(x)
+        del old
+        assert pool.stats()["free_bytes"] == 64
+        new = pool.lease((16,), np.float32)  # 64 B: the renegotiated shape
+        pool.recycle(new)
+        del new
+        st = pool.stats()
+        # 64 + 64 > 96: one old (8,) buffer evicted to make room
+        assert st["evictions"] == 1
+        assert st["free_bytes"] == 96 and st["free_buffers"] == 2
+        # and the survivors are one of each class
+        assert st["classes"] == 2
+
+    def test_oversize_buffer_never_pooled(self):
+        pool = BufferPool(max_per_class=4, max_bytes=16)
+        a = pool.lease((64,), np.float32)
+        pool.recycle(a)
+        del a
+        st = pool.stats()
+        assert st["evictions"] == 1 and st["free_bytes"] == 0
+
+    def test_disabled_via_conf_always_fresh(self, monkeypatch):
+        monkeypatch.setenv("NNSTPU_POOL_ENABLED", "false")
+        pool = BufferPool()  # conf-driven bounds
+        a = pool.lease((8,), np.float32)
+        pool.recycle(a)
+        del a
+        b = pool.lease((8,), np.float32)
+        assert b.pool_fresh  # nothing was retained
+        assert pool.stats()["free_buffers"] == 0
+
+
+class TestFence:
+    def test_fence_blocks_rewrite_until_transfer_ready(self):
+        pool = BufferPool(max_per_class=4, max_bytes=1 << 20)
+        a = pool.lease((8,), np.float32)
+        inflight = FakeInflight()
+        assert fence(a, inflight) is True
+        pool.recycle(a)
+        del a
+        assert inflight.waits == 0  # recycle itself never blocks
+        b = pool.lease((8,), np.float32)  # rewrite imminent: must wait
+        assert not b.pool_fresh and inflight.waits == 1
+
+    def test_fence_through_view_chain(self):
+        """Elements fence the VIEW they handed to jax (reshape of an
+        asarray of the lease); the owner is found through .base."""
+        pool = BufferPool(max_per_class=4, max_bytes=1 << 20)
+        a = pool.lease((4, 2), np.float32)
+        view = np.asarray(a).reshape(8)
+        inflight = FakeInflight()
+        assert fence(view, inflight) is True
+        del view
+        pool.recycle(a)
+        del a
+        pool.lease((4, 2), np.float32)
+        assert inflight.waits == 1
+
+    def test_fence_noop_for_unpooled_arrays(self):
+        assert fence(np.zeros(4), FakeInflight()) is False
+
+    def test_fresh_lease_never_waits(self):
+        pool = BufferPool(max_per_class=4, max_bytes=1 << 20)
+        a = pool.lease((8,), np.float32)
+        inflight = FakeInflight()
+        fence(a, inflight)
+        # a still leased: a second lease allocates fresh, no fence applies
+        b = pool.lease((8,), np.float32)
+        assert b.pool_fresh and inflight.waits == 0
+
+
+class TestRowBatch:
+    def test_geometry_and_rows(self):
+        rows = [np.arange(4, dtype=np.float32) + i for i in range(3)]
+        rb = RowBatch(rows)
+        assert rb.shape == (3, 4) and rb.dtype == np.float32
+        assert len(rb) == 3 and rb.ndim == 2
+        assert rb.size == 12 and rb.nbytes == 48
+        np.testing.assert_array_equal(rb[1], rows[1])
+        np.testing.assert_array_equal(rb[-1], rows[2])
+        assert "RowBatch" in repr(rb)
+
+    def test_row_normalizes_leading_one(self):
+        """Per-row invoke outputs carry a (1, *row) batch dim; row() views
+        them back to the logical row shape."""
+        rb = RowBatch([np.zeros((1, 4), np.float32)], row_shape=(4,))
+        assert rb.shape == (1, 4)
+        assert rb.row(0).shape == (4,)
+
+    def test_materialize_fallback(self):
+        rows = [np.full(4, i, np.float32) for i in range(3)]
+        rb = RowBatch(rows)
+        np.testing.assert_array_equal(np.asarray(rb), np.stack(rows))
+        assert rb.__array__(dtype=np.int32).dtype == np.int32
+        # fancy subscripts go through one real stack
+        np.testing.assert_array_equal(rb[:, 1], np.stack(rows)[:, 1])
+
+    def test_refuses_zero_copy_materialize(self):
+        rb = RowBatch([np.zeros(4, np.float32)])
+        with pytest.raises(ValueError, match="copy"):
+            np.asarray(rb, copy=False)
+
+    def test_index_bounds(self):
+        rb = RowBatch([np.zeros(4, np.float32)])
+        with pytest.raises(IndexError):
+            rb[1]
+        with pytest.raises(ValueError):
+            RowBatch([])
+
+
+class TestWireStager:
+    def test_ping_pong_alternates_and_gates_reuse(self):
+        pool = BufferPool(max_per_class=8, max_bytes=1 << 20)
+        stager = WireStager(pool=pool)
+        src = np.arange(8, dtype=np.float32).reshape(2, 4).T  # strided
+        b1 = stager.stage(0, src, (8,))
+        f1 = FakeInflight()
+        stager.track(0, f1)
+        b2 = stager.stage(0, src + 1, (8,))
+        assert b2.ctypes.data != b1.ctypes.data  # the other slot
+        f2 = FakeInflight()
+        stager.track(0, f2)
+        assert f1.waits == 0
+        b3 = stager.stage(0, src + 2, (8,))  # slot 0 again: must wait on f1
+        assert f1.waits == 1 and f2.waits == 0
+        assert b3.ctypes.data == b1.ctypes.data
+
+    def test_stage_copies_strided_source_once(self):
+        stager = WireStager(pool=BufferPool(max_per_class=8,
+                                            max_bytes=1 << 20))
+        src = np.arange(12, dtype=np.float32).reshape(3, 4).T
+        buf = stager.stage(0, src, (12,))
+        np.testing.assert_array_equal(
+            np.asarray(buf).reshape(src.shape), src)
+
+    def test_reset_returns_buffers_to_pool(self):
+        pool = BufferPool(max_per_class=8, max_bytes=1 << 20)
+        stager = WireStager(pool=pool)
+        stager.stage(0, np.zeros((2, 2), np.float32).T, (4,))
+        stager.reset()
+        assert pool.stats()["recycles"] == 1
+
+
+class TestSkipHostConcat:
+    def test_platform_and_payload_gating(self, monkeypatch):
+        monkeypatch.setenv("NNSTPU_POOL_CONCAT_THRESHOLD", str(256 << 10))
+        big, small = 602 << 10, 4 << 10
+        assert skip_host_concat(big, "cpu") is True  # the config5 regime
+        assert skip_host_concat(small, "cpu") is False
+        assert skip_host_concat(big, "tpu") is False  # accelerator: batch!
+        assert skip_host_concat(big, None) is False  # unknown consumer
+
+    def test_threshold_zero_disables(self, monkeypatch):
+        monkeypatch.setenv("NNSTPU_POOL_CONCAT_THRESHOLD", "0")
+        assert skip_host_concat(1 << 30, "cpu") is False
+
+
+class TestPipelineIntegration:
+    """End-to-end lifecycle through real elements."""
+
+    @staticmethod
+    def _batch_pipeline(pool, n_frames, shape=(4,), collect=False):
+        from nnstreamer_tpu import Pipeline
+        from nnstreamer_tpu.elements.batch import TensorBatch
+        from nnstreamer_tpu.elements.sink import TensorSink
+        from nnstreamer_tpu.elements.testsrc import DataSrc
+
+        frames = [
+            Frame.of(np.full(shape, 2 * i, np.float32),
+                     np.full(shape, 2 * i + 1, np.float32), pts=i)
+            for i in range(n_frames)
+        ]
+        got = []
+        p = Pipeline()
+        src = p.add(DataSrc(data=frames))
+        batch = p.add(TensorBatch(pool=pool))
+        sink = p.add(TensorSink(collect=collect))
+        if not collect:
+            sink.connect("new-data",
+                         lambda f: got.append(np.array(f.tensor(0))))
+        p.link_chain(src, batch, sink)
+        p.run(timeout=120)
+        return p, sink, got
+
+    def test_recycle_after_sink_and_reuse(self):
+        """Batches assembled into pooled buffers recycle once the sink is
+        done with each frame — after the first miss, every dispatch is a
+        pool hit and nothing stays leased."""
+        pool = BufferPool(max_per_class=4, max_bytes=1 << 20)
+        _, _, got = self._batch_pipeline(pool, 6, collect=False)
+        assert len(got) == 6
+        for a in got:  # correctness: rows landed in their slots
+            assert a.shape == (2, 4) and a[1][0] == a[0][0] + 1
+        st = pool.stats()
+        assert st["misses"] == 1 and st["hits"] == 5
+        assert st["recycles"] == 6 and st["leased_bytes"] == 0
+
+    def test_collected_frames_pin_their_buffers(self):
+        """A sink that RETAINS frames (collect=True) holds views of the
+        pooled batches: none may recycle early, and payloads must stay
+        intact — the refcount contract under downstream retention."""
+        pool = BufferPool(max_per_class=8, max_bytes=1 << 20)
+        _, sink, _ = self._batch_pipeline(pool, 4, collect=True)
+        st = pool.stats()
+        assert st["recycles"] == 0 and st["hits"] == 0  # all 4 still live
+        for i, f in enumerate(sink.frames):  # no buffer was rewritten
+            np.testing.assert_array_equal(
+                np.asarray(f.tensor(0))[0], np.full(4, 2 * i, np.float32))
+        del f  # the loop variable would pin the last frame's buffer
+        sink.frames.clear()
+        assert pool.stats()["recycles"] == 4
+
+    def test_per_stream_rowbatch_path_correct_and_copyless(self, monkeypatch):
+        """Above the host-concat threshold on the CPU fallback the chain
+        batch→filter→unbatch must produce identical results WITHOUT ever
+        leasing a batch buffer (the deferred RowBatch path)."""
+        monkeypatch.setenv("NNSTPU_POOL_CONCAT_THRESHOLD", "8")
+        from nnstreamer_tpu import Pipeline
+        from nnstreamer_tpu.backends.jax_backend import JaxModel
+        from nnstreamer_tpu.elements.batch import TensorBatch, TensorUnbatch
+        from nnstreamer_tpu.elements.filter import TensorFilter
+        from nnstreamer_tpu.elements.sink import TensorSink
+        from nnstreamer_tpu.elements.testsrc import DataSrc
+        from nnstreamer_tpu.spec import TensorSpec, TensorsSpec
+
+        pool = BufferPool(max_per_class=4, max_bytes=1 << 20)
+        frames = [
+            Frame.of(np.full(4, 2 * i, np.float32),
+                     np.full(4, 2 * i + 1, np.float32), pts=i)
+            for i in range(5)
+        ]
+        model = JaxModel(
+            apply=lambda p_, x: x * 3.0,
+            input_spec=TensorsSpec.of(
+                TensorSpec(dtype=np.float32, shape=(2, 4))),
+        )
+        p = Pipeline()
+        src = p.add(DataSrc(data=frames))
+        batch = p.add(TensorBatch(pool=pool))
+        filt = p.add(TensorFilter(framework="jax", model=model))
+        unb = p.add(TensorUnbatch())
+        sink = p.add(TensorSink())
+        got = []
+        sink.connect("new-data",
+                     lambda f: got.append([np.asarray(t) for t in f.tensors]))
+        p.link_chain(src, batch, filt, unb, sink)
+        p.run(timeout=120)
+        assert len(got) == 5
+        for i, (r0, r1) in enumerate(got):
+            np.testing.assert_allclose(r0, 3.0 * 2 * i)
+            np.testing.assert_allclose(r1, 3.0 * (2 * i + 1))
+        st = pool.stats()
+        assert st["misses"] == 0 and st["hits"] == 0  # zero host concat
+
+    def test_dynbatch_padding_path_pools_and_stays_correct(self):
+        from nnstreamer_tpu import Pipeline
+        from nnstreamer_tpu.backends.jax_backend import JaxModel
+        from nnstreamer_tpu.elements.dynbatch import DynBatch, DynUnbatch
+        from nnstreamer_tpu.elements.filter import TensorFilter
+        from nnstreamer_tpu.elements.sink import TensorSink
+        from nnstreamer_tpu.elements.testsrc import DataSrc
+        from nnstreamer_tpu.spec import TensorSpec, TensorsSpec
+
+        pool = BufferPool(max_per_class=8, max_bytes=1 << 20)
+        frames = [Frame.of(np.full(4, i, np.float32), pts=i)
+                  for i in range(9)]
+        model = JaxModel(
+            apply=lambda p_, x: x + 1.0,
+            input_spec=TensorsSpec.of(
+                TensorSpec(dtype=np.float32, shape=(None, 4))),
+        )
+        got = []
+        p = Pipeline()
+        src = p.add(DataSrc(data=frames))
+        dyn = p.add(DynBatch(max_batch=4))
+        dyn._pool = pool
+        filt = p.add(TensorFilter(framework="jax", model=model))
+        unb = p.add(DynUnbatch())
+        sink = p.add(TensorSink())
+        sink.connect("new-data", lambda f: got.append(np.asarray(f.tensor(0))))
+        p.link_chain(src, dyn, filt, unb, sink)
+        p.run(timeout=120)
+        assert len(got) == 9
+        for i, a in enumerate(got):
+            np.testing.assert_allclose(a, i + 1.0)
+        st = pool.stats()
+        assert st["misses"] >= 1
+        # jax's jit fastpath keeps the MOST RECENT call's arguments alive
+        # (released by the next call), so at most one batch buffer may
+        # still be leased — bounded runtime retention, not a pool leak
+        assert st["leased_bytes"] <= 4 * 4 * 4  # ≤ one (4, 4) f32 batch
+        assert st["recycles"] >= st["misses"] + st["hits"] - 1
+
+
+class TestCopiesTracer:
+    def test_counts_batch_assembly_bytes_per_frame(self):
+        from nnstreamer_tpu import Pipeline
+        from nnstreamer_tpu.elements.batch import TensorBatch
+        from nnstreamer_tpu.elements.sink import TensorSink
+        from nnstreamer_tpu.elements.testsrc import DataSrc
+        from nnstreamer_tpu.obs.metrics import MetricsRegistry
+        from nnstreamer_tpu.obs.tracers import CopiesTracer
+
+        frames = [Frame.of(np.zeros(4, np.float32),
+                           np.ones(4, np.float32), pts=i) for i in range(4)]
+        reg = MetricsRegistry()
+        p = Pipeline()
+        src = p.add(DataSrc(data=frames))
+        batch = p.add(TensorBatch(pool=BufferPool(max_per_class=4,
+                                                  max_bytes=1 << 20)))
+        sink = p.add(TensorSink())
+        p.link_chain(src, batch, sink)
+        tracer = p.attach_tracer(CopiesTracer(registry=reg))
+        p.run(timeout=120)
+        summ = tracer.summary()
+        assert summ["frames"] == 4
+        per = summ["elements"][batch.name]
+        assert per["copies"] == 4
+        assert per["bytes"] == 4 * 2 * 4 * 4  # 4 batches × (2, 4) f32
+        assert per["allocs"] == 1  # first lease only; the rest pooled
+        assert summ["bytes_per_frame"] == pytest.approx(per["bytes"] / 4)
+        from nnstreamer_tpu.obs.export import render_text
+
+        text = render_text(reg)
+        assert "nnstpu_copy_bytes_total" in text

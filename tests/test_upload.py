@@ -337,6 +337,44 @@ class TestUploadElement:
             np.concatenate(got, axis=0).reshape(4, 6), frames[0]
         )
 
+    def test_split_materializes_wire_tensor_once(self, rng, monkeypatch):
+        """Regression: WireTensor subscripting pays one device→host copy
+        per __getitem__, so split must materialize the frame ONCE and
+        slice the cached host array — never per output pad."""
+        import nnstreamer_tpu as nns
+        from nnstreamer_tpu.buffer import WireTensor
+
+        calls = {"array": 0, "getitem": 0}
+        orig_array = WireTensor.__array__
+        orig_getitem = WireTensor.__getitem__
+        monkeypatch.setattr(
+            WireTensor, "__array__",
+            lambda self, *a, **k: (calls.__setitem__(
+                "array", calls["array"] + 1) or orig_array(self, *a, **k)))
+        monkeypatch.setattr(
+            WireTensor, "__getitem__",
+            lambda self, key: (calls.__setitem__(
+                "getitem", calls["getitem"] + 1) or orig_getitem(self, key)))
+
+        frames = [rng.standard_normal((4, 6)).astype(np.float32)
+                  for _ in range(3)]
+        got = []
+        p = Pipeline()
+        src = p.add(DataSrc(data=[f.copy() for f in frames]))
+        up = p.add(TensorUpload())
+        split = p.add(nns.make("tensor_split", name="sp",
+                               tensorseg="6:2,6:2"))
+        for i, name in enumerate(("a", "b")):
+            sink = p.add(TensorSink(name=name))
+            sink.connect("new-data",
+                         lambda f: got.append(np.asarray(f.tensor(0))))
+            p.link(f"sp.src_{i}", sink)
+        p.link_chain(src, up, split)
+        p.run(timeout=60)
+        assert len(got) == 2 * len(frames)
+        assert calls["getitem"] == 0  # never a per-pad d2h round trip
+        assert calls["array"] == len(frames)  # exactly once per frame
+
     def test_midstream_renegotiation_through_upload(self):
         """Mid-stream shape change: upload recomputes the wire layout per
         frame and the caps event renegotiates downstream."""

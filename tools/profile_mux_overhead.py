@@ -14,11 +14,18 @@ This tool isolates where the per-stream cost lands:
   vs batch concat vs filter invoke vs unbatch/demux fan-out;
 - reports source/sink thread counts per config (each added stream adds a
   source thread and a sink dispatch — on a GIL'd 1-core host those time-
-  slice rather than parallelize).
+  slice rather than parallelize);
+- accounts hot-path host memcpy via the ``copy`` hook (the zero-copy
+  path's tracer signal, ``nnstreamer_tpu/pool.py``): bytes-copied and
+  fresh allocations per frame ride as sweep-table columns, so the
+  pooled slot-wise assembly / RowBatch concat-skip savings are visible
+  next to the fps they buy.
 
 Usage: ``python tools/profile_mux_overhead.py [TOTAL_FRAMES] [SWEEP...]``
 e.g. ``python tools/profile_mux_overhead.py 2000 1 2 4 8``.
-Appends nothing; copy the table + verdict into BENCH_NOTES.md.
+``NNSTPU_POOL_ENABLED=false NNSTPU_POOL_CONCAT_THRESHOLD=0`` reproduces
+the pre-pool behavior for an A/B.  Appends nothing; copy the table +
+verdict into BENCH_NOTES.md.
 """
 import os
 import sys
@@ -92,6 +99,22 @@ class Attribution:
         return sorted(self.ns.items(), key=lambda kv: -kv[1])
 
 
+class CopyCount:
+    """Hot-path host memcpy accounting from the ``copy`` hook."""
+
+    def __init__(self):
+        self.nbytes = 0
+        self.copies = 0
+        self.allocs = 0
+        self._lock = threading.Lock()
+
+    def __call__(self, node, nbytes, allocs):
+        with self._lock:
+            self.nbytes += int(nbytes)
+            self.copies += 1
+            self.allocs += int(allocs)
+
+
 def run_mux(streams, frames_per_stream, attribute=False):
     state = {"count": 0, "t0": None}
 
@@ -124,6 +147,8 @@ def run_mux(streams, frames_per_stream, attribute=False):
             p.link(f"{demux.name}.src_{i}",
                    p.add(TensorSink(name=f"o{i}", callback=cb)))
     attr = Attribution()
+    copies = CopyCount()
+    hooks.connect("copy", copies)
     if attribute:
         hooks.connect("dispatch_exit", attr)
     try:
@@ -131,11 +156,15 @@ def run_mux(streams, frames_per_stream, attribute=False):
         p.run(timeout=600)
         wall = time.perf_counter() - t_start
     finally:
+        hooks.disconnect("copy", copies)
         if attribute:
             hooks.disconnect("dispatch_exit", attr)
     done = state["count"] - max(1, streams)  # exclude the clock-start frame(s)
     fps = done / (time.perf_counter() - state["t0"])
-    return fps, wall, attr
+    total_in = streams * frames_per_stream
+    copies.per_frame = copies.nbytes / max(1, total_in)
+    copies.allocs_per_frame = copies.allocs / max(1, total_in)
+    return fps, wall, attr, copies
 
 
 def main():
@@ -143,22 +172,25 @@ def main():
     print(f"mux overhead sweep: total={TOTAL} frames, host cpus={ncpu}, "
           f"threads-per-config = streams sources + 1/elt + sinks")
     run_mux(1, 50)
-    base_fps, _, _ = run_mux(1, TOTAL)
+    base_fps, _, _, base_cp = run_mux(1, TOTAL)
     print(f"\n{'streams':>7} {'agg fps':>10} {'us/frame':>10} "
-          f"{'vs 1-stream':>11}")
-    print(f"{1:>7} {base_fps:>10.0f} {1e6 / base_fps:>10.1f} {'1.00x':>11}")
+          f"{'vs 1-stream':>11} {'copy KB/fr':>11} {'allocs/fr':>10}")
+    print(f"{1:>7} {base_fps:>10.0f} {1e6 / base_fps:>10.1f} {'1.00x':>11} "
+          f"{base_cp.per_frame / 1024:>11.1f} "
+          f"{base_cp.allocs_per_frame:>10.3f}")
     results = {1: base_fps}
     for s in [s for s in SWEEP if s != 1]:
         run_mux(s, 40)  # warm the s-wide executable
-        fps, _, _ = run_mux(s, TOTAL // s)
+        fps, _, _, cp = run_mux(s, TOTAL // s)
         results[s] = fps
         print(f"{s:>7} {fps:>10.0f} {1e6 / fps:>10.1f} "
-              f"{fps / base_fps:>10.2f}x")
+              f"{fps / base_fps:>10.2f}x {cp.per_frame / 1024:>11.1f} "
+              f"{cp.allocs_per_frame:>10.3f}")
 
     # attribution pass at the widest sweep point
     widest = max(SWEEP)
     run_mux(widest, 30)
-    fps, wall, attr = run_mux(widest, TOTAL // widest, attribute=True)
+    fps, wall, attr, cp = run_mux(widest, TOTAL // widest, attribute=True)
     print(f"\nper-element busy time at {widest} streams "
           f"({TOTAL // widest} frames/stream, wall {wall:.2f}s; "
           "dispatch_exit hook, sink-pad wall-ns):")
@@ -170,6 +202,10 @@ def main():
     busy_frac = total_busy / 1e9 / wall
     print(f"  busy/wall = {busy_frac:.2f} "
           f"(the rest is source threads + queue waits + GIL slicing)")
+    print(f"  hot-path copies at {widest} streams: "
+          f"{cp.per_frame / 1024:.1f} KB/frame, "
+          f"{cp.allocs_per_frame:.3f} fresh allocs/frame "
+          f"({cp.copies} memcpys, {cp.nbytes / 1e6:.1f} MB total)")
 
 
 if __name__ == "__main__":

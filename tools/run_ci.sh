@@ -153,6 +153,70 @@ print(f"tracing smoke OK: {len(snap)} records, {len(xs)} spans, "
 print("\n".join(spans.waterfall(snap, limit=2).splitlines()[:8]))
 PY
 
+run_step "Zero-copy smoke (pooled batch assembly + copies-per-frame gate)" \
+  python - <<'PY'
+import jax
+jax.config.update('jax_platforms', 'cpu')
+import numpy as np
+
+from nnstreamer_tpu import Pipeline
+from nnstreamer_tpu.backends.jax_backend import JaxModel
+from nnstreamer_tpu.elements.batch import TensorBatch, TensorUnbatch
+from nnstreamer_tpu.elements.demux import TensorDemux
+from nnstreamer_tpu.elements.filter import TensorFilter
+from nnstreamer_tpu.elements.mux import TensorMux
+from nnstreamer_tpu.elements.sink import TensorSink
+from nnstreamer_tpu.elements.testsrc import DataSrc
+from nnstreamer_tpu.obs.metrics import MetricsRegistry
+from nnstreamer_tpu.obs.tracers import CopiesTracer
+from nnstreamer_tpu.pool import default_pool
+from nnstreamer_tpu.spec import TensorSpec, TensorsSpec
+
+STREAMS, FRAMES, DIM = 2, 100, 4096  # 16 KB rows, slot-wise pooled path
+row = np.zeros((DIM,), np.float32)
+model = JaxModel(apply=lambda p_, x: x,
+                 input_spec=TensorsSpec.of(
+                     TensorSpec(dtype=np.float32, shape=(STREAMS, DIM))))
+count = [0]
+p = Pipeline(name="ci_zerocopy")
+mux = p.add(TensorMux(sync_mode="nosync"))
+for i in range(STREAMS):
+    src = p.add(DataSrc(name=f"s{i}",
+                        data=[row.copy() for _ in range(FRAMES)]))
+    p.link(src, f"{mux.name}.sink_{i}")
+batch = p.add(TensorBatch())
+filt = p.add(TensorFilter(name="f", framework="jax", model=model))
+unb = p.add(TensorUnbatch())
+demux = p.add(TensorDemux())
+p.link_chain(mux, batch, filt, unb, demux)
+for i in range(STREAMS):
+    p.link(f"{demux.name}.src_{i}",
+           p.add(TensorSink(name=f"o{i}",
+                            callback=lambda fr: count.__setitem__(
+                                0, count[0] + 1))))
+tracer = p.attach_tracer(CopiesTracer(registry=MetricsRegistry()))
+p.run(timeout=300)
+assert count[0] == STREAMS * FRAMES, count
+
+summ = tracer.summary()
+row_bytes = row.nbytes
+# copy-count regression gate: slot-wise assembly copies each source frame
+# into the batch exactly ONCE (<= 1.05x payload bytes per frame), and the
+# pool keeps fresh allocations to a handful of warmup leases — a new copy
+# or allocation on this path fails CI before it costs throughput
+budget = row_bytes * 1.05
+assert summ["frames"] > 0
+per_frame = summ["bytes_per_frame"]
+assert per_frame <= budget, (per_frame, budget, summ)
+assert summ["total_allocs"] <= 4, summ
+st = default_pool().stats()
+assert st["hits"] > 0, st  # the free list is actually being reused
+print(f"zero-copy smoke OK: {per_frame / 1024:.1f} KB copied/frame "
+      f"(budget {budget / 1024:.1f}), {summ['total_allocs']} fresh allocs "
+      f"over {summ['frames']} frames, pool hits={st['hits']} "
+      f"misses={st['misses']}")
+PY
+
 run_step "Scheduling smoke (DRR fairness + typed shed + live scrape)" \
   python - <<'PY'
 import socket
