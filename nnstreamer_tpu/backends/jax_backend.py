@@ -39,6 +39,7 @@ from __future__ import annotations
 import dataclasses
 import importlib.util
 import os
+import time
 from collections import OrderedDict
 from typing import Any, Callable, Optional, Sequence, Tuple
 
@@ -374,6 +375,8 @@ class JaxBackend(FilterBackend):
         return flat_fn, wire
 
     def _compile(self, in_spec: TensorsSpec) -> TensorsSpec:
+        from ..obs.device import cost_info, record_compile
+
         self._in_spec = in_spec
         self._expected = tuple(
             (tuple(t.shape), np.dtype(t.dtype)) for t in in_spec.tensors
@@ -384,7 +387,10 @@ class JaxBackend(FilterBackend):
             self._cache.move_to_end(key)
             (self._compiled, self._flat_compiled, self._wire_shapes,
              self._out_spec, self._single_output) = hit
+            record_compile(self, key, "hit")
             return self._out_spec
+        t0 = time.perf_counter_ns()
+        aot = None  # whichever entry AOT-compiles carries cost_analysis()
         structs = _as_shape_structs(in_spec)
         flat_fn, wire_shapes = self._make_flat_entry(in_spec)
         if flat_fn is not None:
@@ -398,7 +404,7 @@ class JaxBackend(FilterBackend):
                 # Pre-warm the flat entry (frames arrive from host); the
                 # shaped twin compiles lazily if a device-resident frame
                 # ever shows up.
-                self._flat_compiled.lower(*flat_structs).compile()
+                aot = self._flat_compiled.lower(*flat_structs).compile()
         else:
             self._flat_compiled = None
             self._wire_shapes = None
@@ -409,7 +415,7 @@ class JaxBackend(FilterBackend):
             # path overlaps host→device transfers with compute, which the
             # AOT executable's __call__ does not (measured ~2× on a
             # tunneled chip).
-            jitted.lower(*structs).compile()
+            aot = jitted.lower(*structs).compile()
         self._compiled = jitted
         outs = jax.eval_shape(self._effective_fn, *structs)
         self._single_output = not isinstance(outs, (tuple, list))
@@ -420,7 +426,10 @@ class JaxBackend(FilterBackend):
             self._single_output,
         )
         while len(self._cache) > self._cache_size:
-            self._cache.popitem(last=False)  # evict LRU executable
+            evicted_key, _ = self._cache.popitem(last=False)  # evict LRU
+            record_compile(self, evicted_key, "evict")
+        record_compile(self, key, "miss", time.perf_counter_ns() - t0,
+                       cost_info(aot) if aot is not None else {})
         return out_spec
 
     def _jit(self, fn, wire: bool = False):

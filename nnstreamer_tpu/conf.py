@@ -58,6 +58,14 @@ DEFAULTS: Dict[str, Dict[str, str]] = {
         "buckets": "",              # latency-histogram bounds, ms ("0.1,1,10")
         "flight_records": "",       # span flight-recorder ring size per thread
         "flight_dump_dir": "",      # write {pipeline}.error.trace.json here
+        # Device lane (obs/device.py): completion-probe queue bound for the
+        # DeviceTracer reaper thread (overflow drops probes, counted).
+        "device_probe_queue": "1024",
+        # Pipeline health watchdog (obs/watchdog.py, tracer "watchdog").
+        "watchdog_interval": "1.0",         # monitor tick, seconds
+        "watchdog_stall_s": "5.0",          # source/queue stall window
+        "watchdog_queue_depth": "1",        # min depth to call a queue wedged
+        "watchdog_device_deadline_s": "30", # device completion deadline
     },
     # Host staging-buffer pool (nnstreamer_tpu/pool): the zero-copy batch
     # assembly + wire staging path.  NNSTPU_POOL_* env vars map here.

@@ -27,6 +27,7 @@ from ..backends.base import FilterBackend, get_backend
 from ..buffer import Frame
 from ..graph.node import NegotiationError, Node, Pad
 from ..graph.registry import register_element
+from ..obs import hooks as _hooks
 from ..spec import TensorSpec, TensorsSpec
 
 
@@ -266,6 +267,15 @@ class TensorFilter(Node):
             dt = time.perf_counter_ns() - t0
             self.invoke_ns.append(dt)
             profiling.record(self.name, dt)
+            if _hooks.enabled:
+                _hooks.emit("device_dispatch", self, frame, outs, t0)
+        elif _hooks.enabled:
+            # async dispatch: invoke() returns at ENQUEUE.  The device
+            # tracer's completion probe recovers the true device time —
+            # t0 here is the enqueue timestamp of its device_exec span.
+            t0 = time.perf_counter_ns()
+            outs = self.backend.invoke(frame.tensors)
+            _hooks.emit("device_dispatch", self, frame, outs, t0)
         else:
             outs = self.backend.invoke(frame.tensors)
         if not outs:

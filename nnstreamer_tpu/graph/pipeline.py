@@ -467,9 +467,19 @@ class Pipeline:
                 return
             os.makedirs(dump_dir, exist_ok=True)
             path = os.path.join(dump_dir, f"{self.name}.{transition}.trace.json")
+            doc = spans.chrome_trace(spans.snapshot(), process_name=self.name)
+            try:
+                from ..obs.device import device_memory_snapshot
+
+                mem = device_memory_snapshot()
+                if mem:
+                    # "otherData" is the trace-event format's sidecar slot:
+                    # what the device allocators held when the graph died
+                    doc["otherData"] = {"device_memory": mem}
+            except Exception:  # noqa: BLE001 — the dump matters more
+                pass
             with open(path, "w") as f:
-                json.dump(spans.chrome_trace(spans.snapshot(),
-                                             process_name=self.name), f)
+                json.dump(doc, f)
         except Exception as exc:  # noqa: BLE001
             warnings.warn(f"flight dump ({transition}) failed: {exc!r}",
                           stacklevel=2)

@@ -125,14 +125,19 @@ def conv2d_int8(
     assert isinstance(w, QuantizedWeight), "conv2d_int8 needs quantized weights"
     act_scale = params.get("act_scale")
     if quant_ops.is_calibrating():
-        # eager calibration pass: record the running max activation scale
-        # into the param dict (a float leaf), then fall through to the
-        # dynamic path so the forward still produces real outputs
+        # eager calibration pass: record the RAW running max activation
+        # scale into the param dict (a float leaf; 0.0 allowed — the
+        # zero-guard floor is applied once at the end of calibration,
+        # quant._floor_act_scales, so one all-zero sample can't pin the
+        # scale at >= 1.0), then fall through to the dynamic path so the
+        # forward still produces real outputs
         amax = float(jnp.max(jnp.abs(x)))
         prev = float(act_scale) if act_scale is not None else 0.0
-        params["act_scale"] = max(prev, amax / 127.0) or 1.0
+        params["act_scale"] = max(prev, amax / 127.0)
         act_scale = None
-    if act_scale is not None:
+    # a 0.0 scale is "mid-calibration, nothing recorded yet", never a
+    # usable divisor: treat as uncalibrated and quantize dynamically
+    if act_scale:
         s = jnp.asarray(act_scale, jnp.float32)
         q = quant_ops.quantize_static(x, s)
         # s scalar; w.scale is (1,1,1,cout) for HWIO → (1,1,1,cout)

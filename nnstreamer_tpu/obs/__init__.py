@@ -13,6 +13,12 @@ both NNStreamer papers use to find on-device bottlenecks):
   (``NNSTPU_TRACERS=spans``): trace-context stamping, a bounded
   per-thread flight recorder, Chrome-trace/Perfetto + waterfall export,
   NNSQ trace-context propagation;
+- :mod:`.device` — the device lane (``NNSTPU_TRACERS=device``): true
+  device timing via completion probes, compile/executable-cache
+  accounting, per-device memory gauges;
+- :mod:`.watchdog` — pipeline health watchdog (``watchdog`` tracer):
+  stalled sources, wedged queues, overdue device dispatches →
+  ``/healthz`` + ``nnstpu_health`` + automatic stall flight dumps;
 - :mod:`.export` — Prometheus text exposition + stdlib scrape endpoint
   (plus ``/healthz`` and the merged ``/stats.json``).
 
@@ -62,6 +68,22 @@ from .tracers import (  # noqa: F401
 # importing .spans registers the "spans" tracer with TRACERS
 from . import spans  # noqa: E402,F401
 from .spans import SpanTracer, chrome_trace, waterfall  # noqa: F401
+
+# importing .device / .watchdog registers the "device" / "watchdog" tracers
+from . import device  # noqa: E402,F401
+from . import watchdog  # noqa: E402,F401
+from .device import (  # noqa: F401
+    DeviceTracer,
+    device_memory_snapshot,
+    record_compile,
+    register_memory_gauges,
+)
+from .export import (  # noqa: F401
+    health_snapshot,
+    register_health,
+    unregister_health,
+)
+from .watchdog import PipelineWatchdog  # noqa: F401
 
 
 def configured_tracers() -> List[str]:

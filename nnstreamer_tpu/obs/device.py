@@ -1,0 +1,441 @@
+"""Device lane: true device timing, compile accounting, memory gauges.
+
+Every other tracer measures **host** wall time — but JAX dispatch is
+asynchronous: ``backend.invoke`` returns when the XLA call is *enqueued*,
+not when the executable finishes, so the ``dispatch_exit`` hook and the
+nested dispatch span systematically misattribute device compute to
+whichever downstream element first blocks on the result (exactly the
+blind spot device-side TPU tracing exists to close — PAPERS.md).  This
+module is the device lane of the obs subsystem:
+
+- :class:`DeviceTracer` (``NNSTPU_TRACERS=device``) stamps each filter
+  dispatch with a **completion probe**: the ``device_dispatch`` hook
+  hands the returned arrays to a bounded queue drained by a background
+  *reaper* thread that blocks on readiness (``jax.block_until_ready`` —
+  duck-typed, so host-backend outputs complete instantly) and emits a
+  real ``device_exec`` span with enqueue→done timing into the flight
+  recorder on a dedicated device track (the reaper thread's row in
+  Perfetto), with a flow arrow from the host dispatch span.  The queue
+  is bounded so a wedged device can never grow host memory without
+  bound — overflow drops the probe and counts it.
+- :func:`record_compile` is the sink for backend executable-cache
+  events (``backends/jax_backend.py`` calls it on every hit/miss/evict):
+  ``nnstpu_compile_total{result=...}`` counters, a compile wall-time
+  histogram, flops/bytes from ``cost_analysis()`` when the runtime
+  exposes them, a ``compile`` span when span tracing is active, and the
+  ``compile`` hook for per-pipeline tracers.  Counters are fed
+  unconditionally (compiles are rare and expensive; one counter inc is
+  noise) so compile churn is visible in any scrape, tracer or not.
+- :func:`register_memory_gauges` / :func:`device_memory_snapshot` sample
+  per-device ``memory_stats()`` (bytes in use, peak, pool limit) as
+  ``nnstpu_device_memory_bytes`` gauges at scrape time and as a dict for
+  error flight dumps.  Host platforms without allocator stats simply
+  contribute nothing.
+
+The watchdog (:mod:`.watchdog`) reads :func:`oldest_inflight` to flag
+dispatches whose device completion exceeds its deadline.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from . import hooks as _hooks
+from . import spans
+from .metrics import REGISTRY, MetricsRegistry
+from .tracers import Tracer
+
+now_ns = time.perf_counter_ns
+
+# Seconds-unit buckets for device execution / compile time: the latency
+# bucket ladder shifted into seconds (50 µs – 2.5 s) plus a long tail for
+# cold compiles.
+DEVICE_EXEC_BUCKETS_S = (
+    5e-5, 1e-4, 2.5e-4, 5e-4, 1e-3, 2.5e-3, 5e-3, 1e-2, 2.5e-2, 5e-2,
+    0.1, 0.25, 0.5, 1.0, 2.5,
+)
+COMPILE_BUCKETS_S = DEVICE_EXEC_BUCKETS_S + (5.0, 10.0, 30.0, 60.0)
+
+DEFAULT_PROBE_CAPACITY = 1024
+
+# In-flight dispatch registry (probe id -> (t0_ns, element name)), shared
+# by every active DeviceTracer so the watchdog can ask "how old is the
+# oldest dispatch still executing on device" without touching jax.
+_inflight_lock = threading.Lock()
+_inflight: Dict[int, Tuple[int, str]] = {}
+
+
+def oldest_inflight() -> Optional[Tuple[int, str]]:
+    """(enqueue ts_ns, element name) of the oldest dispatch whose device
+    completion has not been observed yet, or None.  Only meaningful while
+    a :class:`DeviceTracer` is attached (otherwise nothing registers)."""
+    with _inflight_lock:
+        if not _inflight:
+            return None
+        return min(_inflight.values())
+
+
+def configured_probe_capacity() -> int:
+    """Completion-probe queue bound: ``NNSTPU_OBS_DEVICE_PROBE_QUEUE`` /
+    ini ``[obs] device_probe_queue`` over the default."""
+    from ..conf import conf
+
+    try:
+        cap = conf.get_int("obs", "device_probe_queue",
+                           DEFAULT_PROBE_CAPACITY)
+    except ValueError:
+        return DEFAULT_PROBE_CAPACITY
+    return cap if cap > 0 else DEFAULT_PROBE_CAPACITY
+
+
+# -- compile accounting ------------------------------------------------------
+
+def _compile_metrics(registry: MetricsRegistry):
+    return (
+        registry.counter(
+            "nnstpu_compile_total",
+            "Backend executable-cache events (hit/miss/evict)",
+            labelnames=("result",),
+        ),
+        registry.histogram(
+            "nnstpu_compile_seconds",
+            "Wall time spent compiling backend executables (seconds)",
+            buckets=COMPILE_BUCKETS_S,
+        ),
+        registry.counter(
+            "nnstpu_compile_flops_total",
+            "Sum of cost_analysis() flops over compiled executables",
+        ),
+        registry.counter(
+            "nnstpu_compile_bytes_total",
+            "Sum of cost_analysis() bytes accessed over compiled executables",
+        ),
+    )
+
+
+def cost_info(compiled) -> dict:
+    """flops/bytes out of an AOT ``Compiled.cost_analysis()`` (shape
+    varies by jax version: a dict, or a per-program list of dicts); {}
+    when the runtime doesn't expose it."""
+    try:
+        ca = compiled.cost_analysis()
+    except Exception:  # noqa: BLE001 — optional on many backends
+        return {}
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else None
+    if not isinstance(ca, dict):
+        return {}
+    info = {}
+    if ca.get("flops"):
+        info["flops"] = float(ca["flops"])
+    by = ca.get("bytes accessed") or ca.get("bytes_accessed")
+    if by:
+        info["bytes"] = float(by)
+    return info
+
+
+def record_compile(backend, key, result: str, dur_ns: int = 0,
+                   info: Optional[dict] = None,
+                   registry: Optional[MetricsRegistry] = None) -> None:
+    """Account one executable-cache event (called by filter backends).
+
+    Feeds the ``nnstpu_compile_*`` metrics unconditionally, records a
+    ``compile`` span when span tracing is active, and emits the
+    ``compile`` hook for attached tracers.  Never raises — compile
+    accounting must not take a compile down."""
+    try:
+        counters, hist, flops_c, bytes_c = _compile_metrics(
+            registry if registry is not None else REGISTRY)
+        counters.inc(1, result=result)
+        if result == "miss":
+            hist.observe(dur_ns / 1e9)
+            if info:
+                if info.get("flops"):
+                    flops_c.inc(info["flops"])
+                if info.get("bytes"):
+                    bytes_c.inc(info["bytes"])
+        if spans.enabled and result == "miss":
+            args = {"key": repr(key), "backend": type(backend).__name__}
+            if info:
+                args.update(info)
+            spans.record_span("compile", now_ns() - dur_ns, dur_ns,
+                              cat="compile", trace=(0, 0), args=args)
+        if _hooks.enabled:
+            _hooks.emit("compile", backend, key, result, dur_ns, info or {})
+    except Exception:  # noqa: BLE001
+        import logging
+
+        logging.getLogger("nnstreamer_tpu.obs").exception(
+            "compile accounting failed")
+
+
+# -- device memory gauges ----------------------------------------------------
+
+# memory_stats() keys worth exposing (allocator implementations differ;
+# anything absent is skipped)
+_MEMORY_KEYS = (
+    "bytes_in_use",
+    "peak_bytes_in_use",
+    "bytes_limit",
+    "bytes_reservable_limit",
+    "pool_bytes",
+    "largest_alloc_size",
+)
+
+
+def _device_label(d) -> str:
+    plat = getattr(d, "platform", None) or "device"
+    return f"{plat}:{getattr(d, 'id', 0)}"
+
+
+def device_memory_snapshot(devices=None) -> Dict[str, Dict[str, int]]:
+    """Per-device ``memory_stats()`` snapshot ({"tpu:0": {bytes_in_use:
+    ...}}), for /metrics collectors and error flight dumps.  Devices
+    without allocator stats (CPU) are omitted."""
+    if devices is None:
+        try:
+            import jax
+
+            devices = jax.devices()
+        except Exception:  # noqa: BLE001 — no backend at all
+            return {}
+    out: Dict[str, Dict[str, int]] = {}
+    for d in devices:
+        try:
+            stats = d.memory_stats()
+        except Exception:  # noqa: BLE001 — unimplemented on this platform
+            continue
+        if not stats:
+            continue
+        kept = {k: int(stats[k]) for k in _MEMORY_KEYS
+                if isinstance(stats.get(k), (int, float))}
+        if kept:
+            out[_device_label(d)] = kept
+    return out
+
+
+def register_memory_gauges(registry: Optional[MetricsRegistry] = None,
+                           devices=None):
+    """Sample per-device memory into ``nnstpu_device_memory_bytes``
+    gauges at every scrape (a registry collector — pull-style, no
+    poller).  Returns the collector handle for ``remove_collector``."""
+    registry = registry if registry is not None else REGISTRY
+    gauge = registry.gauge(
+        "nnstpu_device_memory_bytes",
+        "Per-device allocator stats (bytes), sampled at scrape time",
+        labelnames=("device", "kind"),
+    )
+
+    def collect():
+        for dev, stats in device_memory_snapshot(devices).items():
+            for kind, val in stats.items():
+                gauge.set(val, device=dev, kind=kind)
+
+    return registry.add_collector(collect)
+
+
+# -- the tracer --------------------------------------------------------------
+
+class DeviceTracer(Tracer):
+    """True device timing via completion probes.
+
+    ``device_dispatch`` (emitted by ``tensor_filter`` right after the
+    backend invoke returns) hands the output arrays to a bounded probe
+    queue; a background reaper thread blocks on their readiness and
+    records a ``device_exec`` span (ts = enqueue, dur = enqueue→done) on
+    its own thread — a dedicated device track in the Perfetto export —
+    linked to the host dispatch span by a flow arrow.  Histograms and
+    counters land on the metrics registry; the queue bound plus overflow
+    accounting keep a wedged device from backing memory up into the
+    pipeline.
+    """
+
+    name = "device"
+
+    def __init__(self, registry: Optional[MetricsRegistry] = None,
+                 capacity: Optional[int] = None):
+        super().__init__(registry)
+        self._capacity = capacity
+        self._q: collections.deque = collections.deque()
+        self._cv = threading.Condition()
+        self._reaper: Optional[threading.Thread] = None
+        self._running = False
+        self._lock = threading.Lock()
+        self._by_element: Dict[str, List[int]] = {}  # name -> [count, ns]
+        self._sent = 0
+        self._completed = 0
+        self._dropped = 0
+        self._compiles: Dict[str, int] = {"hit": 0, "miss": 0, "evict": 0}
+        self._last_compile: Optional[dict] = None
+        self._mem_handle = None
+
+    def _install(self) -> None:
+        cap = self._capacity if self._capacity is not None \
+            else configured_probe_capacity()
+        self._cap = max(1, int(cap))
+        # the device lane records into the span flight recorder even when
+        # no SpanTracer is attached: NNSTPU_TRACERS=device alone must
+        # still yield a chrome trace with device_exec spans
+        spans._activate(spans.configured_flight_records())
+        self._hist = self._registry.histogram(
+            "nnstpu_device_exec_seconds",
+            "True device execution time per dispatch, enqueue to "
+            "completion (seconds)",
+            labelnames=("pipeline", "element"),
+            buckets=DEVICE_EXEC_BUCKETS_S,
+        )
+        self._dispatches = self._registry.counter(
+            "nnstpu_device_dispatches_total",
+            "Dispatches handed to the device completion reaper",
+            labelnames=("pipeline", "element"),
+        )
+        self._drop_counter = self._registry.counter(
+            "nnstpu_device_probe_dropped_total",
+            "Completion probes dropped on reaper-queue overflow",
+            labelnames=("pipeline",),
+        )
+        self._mem_handle = register_memory_gauges(self._registry)
+        self._running = True
+        try:
+            import jax
+
+            platform = jax.default_backend()
+        except Exception:  # noqa: BLE001
+            platform = "device"
+        self._reaper = threading.Thread(
+            target=self._reap, name=f"device:{platform}", daemon=True)
+        self._reaper.start()
+        self._connect("device_dispatch", self._on_device_dispatch)
+        self._connect("compile", self._on_compile)
+
+    def stop(self) -> None:
+        was_active = bool(self._conns)
+        super().stop()
+        if not was_active:
+            return
+        with self._cv:
+            self._running = False
+            self._cv.notify_all()
+        if self._reaper is not None:
+            # a reaper blocked on a wedged device is abandoned (daemon);
+            # its probes stay registered as in-flight for the watchdog
+            self._reaper.join(timeout=5)
+            self._reaper = None
+        if self._mem_handle is not None:
+            self._registry.remove_collector(self._mem_handle)
+            self._mem_handle = None
+        spans._deactivate()
+
+    # -- hook callbacks ------------------------------------------------------
+
+    def _on_device_dispatch(self, node, frame, outs, t0_ns) -> None:
+        if node.pipeline is not self._pipeline:
+            return
+        ctx = spans.context_of(frame)
+        trace_id, parent = (ctx[0], ctx[1]) if ctx is not None else (0, 0)
+        head = outs[0] if isinstance(outs, (tuple, list)) and outs else outs
+        pid = next(spans._ids)
+        fid = next(spans._flow_ids)
+        # flow START on the dispatching (host) thread, inside the host
+        # dispatch span: Perfetto draws the arrow host span -> device span
+        spans._recorder.append((
+            spans.PH_FLOW_START, now_ns(), 0,
+            threading.current_thread().name, "device", "device",
+            trace_id, fid, 0, None))
+        with self._cv:
+            if len(self._q) >= self._cap:
+                self._dropped += 1
+                self._drop_counter.inc(1, pipeline=self._pipeline.name)
+                return
+            self._sent += 1
+            with _inflight_lock:
+                _inflight[pid] = (t0_ns, node.name)
+            self._q.append((pid, node.name, head, t0_ns, trace_id, parent,
+                            fid))
+            self._cv.notify()
+
+    def _on_compile(self, backend, key, result, dur_ns, info) -> None:
+        del backend, key, dur_ns
+        with self._lock:
+            self._compiles[result] = self._compiles.get(result, 0) + 1
+            if result == "miss" and info:
+                self._last_compile = dict(info)
+
+    # -- the reaper ----------------------------------------------------------
+
+    def _reap(self) -> None:
+        pipeline_name = self._pipeline.name
+        while True:
+            with self._cv:
+                while self._running and not self._q:
+                    self._cv.wait(0.5)
+                if not self._running and not self._q:
+                    return
+                pid, name, head, t0, trace_id, parent, fid = self._q.popleft()
+            try:
+                try:
+                    import jax
+
+                    jax.block_until_ready(head)
+                except ImportError:  # pragma: no cover
+                    bur = getattr(head, "block_until_ready", None)
+                    if bur is not None:
+                        bur()
+                t_done = now_ns()
+                dur = max(0, t_done - t0)
+                sid = next(spans._ids)
+                # both records land on THIS thread: the device track
+                spans._recorder.append((
+                    spans.PH_FLOW_END, t0, 0,
+                    threading.current_thread().name, "device", "device",
+                    trace_id, fid, 0, None))
+                spans._recorder.append((
+                    spans.PH_COMPLETE, t0, dur,
+                    threading.current_thread().name, "device_exec", "device",
+                    trace_id, sid, parent, {"element": name}))
+                self._hist.observe(dur / 1e9, pipeline=pipeline_name,
+                                   element=name)
+                self._dispatches.inc(1, pipeline=pipeline_name, element=name)
+                with self._lock:
+                    self._completed += 1
+                    c = self._by_element.setdefault(name, [0, 0])
+                    c[0] += 1
+                    c[1] += dur
+            except Exception:  # noqa: BLE001 — a poison probe must not
+                import logging  # kill the reaper
+
+                logging.getLogger("nnstreamer_tpu.obs").exception(
+                    "device completion probe failed for %s", name)
+            finally:
+                with _inflight_lock:
+                    _inflight.pop(pid, None)
+
+    def summary(self) -> dict:
+        with self._cv:
+            inflight = len(self._q)
+        with self._lock:
+            per = {name: {"count": c[0], "device_ns": c[1]}
+                   for name, c in self._by_element.items()}
+            total_ns = sum(c[1] for c in self._by_element.values())
+            out = {
+                "dispatches": self._sent,
+                "completed": self._completed,
+                "dropped": self._dropped,
+                "inflight": inflight,
+                "device_ns": total_ns,
+                "by_element": per,
+                "compiles": dict(self._compiles),
+            }
+            if self._last_compile:
+                out["last_compile"] = dict(self._last_compile)
+        return out
+
+
+# self-registration (obs/__init__ imports this module, so
+# NNSTPU_TRACERS=device / attach_tracer("device") always resolve)
+from .tracers import TRACERS  # noqa: E402
+
+TRACERS[DeviceTracer.name] = DeviceTracer

@@ -13,8 +13,10 @@ daemon thread — at ``/metrics``; activation is conf-driven from
 collector — pull-style, no background poller.
 
 Beyond ``/metrics`` the server answers ``/healthz`` (liveness probe:
-``200 ok``) and ``/stats.json`` — every registered stats provider
-(pipelines via ``Pipeline.start``, schedulers via
+``200 ok`` — or ``503`` with reasons once any registered health
+provider, e.g. a pipeline watchdog, reports unhealthy) and
+``/stats.json`` — every registered stats provider (pipelines via
+``Pipeline.start``, schedulers via
 :class:`nnstreamer_tpu.sched.Scheduler`) merged into one JSON document,
 the structured twin of the Prometheus exposition.
 """
@@ -24,7 +26,7 @@ from __future__ import annotations
 import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Callable, Dict, Optional
+from typing import Callable, Dict, Optional, Tuple
 
 from .metrics import REGISTRY, MetricsRegistry
 
@@ -64,6 +66,43 @@ def stats_snapshot() -> dict:
         except Exception as exc:  # noqa: BLE001 — one bad provider != no stats
             out[name] = {"error": repr(exc)}
     return out
+
+
+_health_lock = threading.Lock()
+_health_providers: Dict[str, Callable[[], tuple]] = {}
+
+
+def register_health(name: str, fn: Callable[[], tuple]) -> Callable:
+    """Register a health provider under ``name``: a callable returning
+    ``(healthy: bool, reason: str)``.  While any provider reports
+    unhealthy, ``/healthz`` answers 503 with the reasons (the pipeline
+    watchdog is the canonical registrant)."""
+    with _health_lock:
+        _health_providers[name] = fn
+    return fn
+
+
+def unregister_health(name: str, fn: Optional[Callable] = None) -> None:
+    with _health_lock:
+        if fn is None or _health_providers.get(name) is fn:
+            _health_providers.pop(name, None)
+
+
+def health_snapshot() -> Tuple[bool, Dict[str, str]]:
+    """(overall healthy, {provider: reason for each unhealthy one}).  A
+    raising provider counts as unhealthy — a broken watchdog must not
+    read as a green check."""
+    with _health_lock:
+        providers = dict(_health_providers)
+    failures: Dict[str, str] = {}
+    for name, fn in providers.items():
+        try:
+            healthy, reason = fn()
+        except Exception as exc:  # noqa: BLE001
+            healthy, reason = False, f"health provider raised: {exc!r}"
+        if not healthy:
+            failures[name] = reason or "unhealthy"
+    return (not failures), failures
 
 
 def _fmt(value: float) -> str:
@@ -134,8 +173,9 @@ class MetricsServer:
         registry = self.registry
 
         class Handler(BaseHTTPRequestHandler):
-            def _reply(self, body: bytes, content_type: str) -> None:
-                self.send_response(200)
+            def _reply(self, body: bytes, content_type: str,
+                       status: int = 200) -> None:
+                self.send_response(status)
                 self.send_header("Content-Type", content_type)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
@@ -147,7 +187,15 @@ class MetricsServer:
                     self._reply(render_text(registry).encode("utf-8"),
                                 CONTENT_TYPE)
                 elif path == "/healthz":
-                    self._reply(b"ok\n", "text/plain; charset=utf-8")
+                    healthy, failures = health_snapshot()
+                    if healthy:
+                        self._reply(b"ok\n", "text/plain; charset=utf-8")
+                    else:
+                        body = "unhealthy\n" + "".join(
+                            f"{name}: {reason}\n"
+                            for name, reason in sorted(failures.items()))
+                        self._reply(body.encode("utf-8"),
+                                    "text/plain; charset=utf-8", status=503)
                 elif path == "/stats.json":
                     # default=str: stats() snapshots may carry numpy
                     # scalars / deadline floats json can't serialize

@@ -36,6 +36,20 @@ must stay allocation-light):
                    ``allocs`` counts fresh buffer allocations (0 when the
                    bytes landed in a recycled pool buffer).  ``node`` may
                    be a backend object on filter-internal copies.
+``device_dispatch`` ``(node, frame, outs, t0_ns)`` — a filter handed work
+                   to an async device runtime (JAX dispatch returned;
+                   the device may still be executing).  ``outs`` are the
+                   returned arrays — probing their readiness is how the
+                   device tracer recovers TRUE device timing.
+``compile``        ``(backend, key, result, dur_ns, info)`` — an
+                   executable-cache event on a filter backend.  ``result``
+                   is ``"hit"``/``"miss"``/``"evict"``; ``dur_ns`` is the
+                   compile wall time (0 for hit/evict); ``info`` is a dict
+                   with ``flops``/``bytes`` from ``cost_analysis()`` when
+                   the runtime exposes them (else empty).
+``health``         ``(pipeline, healthy, reason)`` — the pipeline
+                   watchdog flipped health state (``reason`` names the
+                   stalled source / wedged queue / overdue dispatch).
 =================  ====================================================
 
 Timestamps passed through hooks are ``time.perf_counter_ns()`` — every
@@ -65,6 +79,9 @@ HOOKS = (
     "rate_dup",
     "dynbatch_flush",
     "copy",
+    "device_dispatch",
+    "compile",
+    "health",
 )
 
 # The fast-path gate: True iff at least one callback is connected anywhere.

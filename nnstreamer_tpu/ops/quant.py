@@ -15,6 +15,7 @@ The TPU-native equivalent implemented here:
 
 from __future__ import annotations
 
+import threading
 from contextlib import contextmanager
 from dataclasses import dataclass
 from typing import Any
@@ -178,24 +179,31 @@ def quantize_static(x, scale, dtype=jnp.int8):
 # scales the same way: tflite bakes activation ranges at conversion time,
 # ``tests/nnstreamer_filter_tensorflow_lite/runTest.sh:30-38``) -----------
 
-_CALIBRATING = False
+# Thread-LOCAL, not a process global (ADVICE r5 #1): calibration on one
+# thread must never flip another thread's int8 convs into the eager
+# recording branch — under jit that raises ConcretizationTypeError in the
+# victim thread; eagerly it silently pollutes the other model's
+# act_scale leaves.
+_CALIBRATING = threading.local()
 
 
 def is_calibrating() -> bool:
-    return _CALIBRATING
+    return getattr(_CALIBRATING, "active", False)
 
 
 @contextmanager
 def calibration():
-    """While active, int8 convs run their dynamic path EAGERLY and record
-    ``max(|activation|)/127`` into their own param dict as a float
-    ``act_scale`` leaf (max over all samples seen)."""
-    global _CALIBRATING
-    _CALIBRATING = True
+    """While active ON THIS THREAD, int8 convs run their dynamic path
+    EAGERLY and record the raw running ``max(|activation|)/127`` into
+    their own param dict as a float ``act_scale`` leaf (max over all
+    samples seen; the zero-guard floor is applied once at the end of
+    :func:`calibrate_static_scales`, never per sample)."""
+    prev = getattr(_CALIBRATING, "active", False)
+    _CALIBRATING.active = True
     try:
         yield
     finally:
-        _CALIBRATING = False
+        _CALIBRATING.active = prev
 
 
 def calibrate_static_scales(apply_fn, params, samples, device=None):
@@ -222,4 +230,23 @@ def calibrate_static_scales(apply_fn, params, samples, device=None):
         else:
             for x in samples:
                 apply_fn(params, jnp.asarray(x))
+    _floor_act_scales(params)
     return params
+
+
+def _floor_act_scales(tree) -> None:
+    """Apply the zero-guard ONCE, after all samples: an ``act_scale``
+    still 0.0 (every calibration sample was all-zero) floors to 1.0.
+    Applying the floor per sample (ADVICE r5 #4) pinned the scale at
+    >= 1.0 forever after one degenerate sample — ``max(1.0, real)``
+    never shrinks — silently coarsening tensors whose true activation
+    range is far below 1.0."""
+    if isinstance(tree, dict):
+        v = tree.get("act_scale")
+        if isinstance(v, (int, float)) and not v:
+            tree["act_scale"] = 1.0
+        for child in tree.values():
+            _floor_act_scales(child)
+    elif isinstance(tree, (list, tuple)):
+        for child in tree:
+            _floor_act_scales(child)

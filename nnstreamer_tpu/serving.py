@@ -150,7 +150,25 @@ class DecodeSession:
                 except queue.Empty:
                     continue  # re-check liveness/deadline (≤100 ms lag)
             if out is _STOPPED:
+                # stop()/_fail() enqueue the sentinel concurrently with
+                # the engine thread's output delivery: a result computed
+                # by the final in-flight tick can land BEHIND it (review
+                # r5).  Drain any real outputs queued after the sentinel
+                # and re-put it last, so already-computed steps are
+                # delivered before the stop surfaces.
+                behind = []
+                while True:
+                    try:
+                        item = self._q_out.get_nowait()
+                    except queue.Empty:
+                        break
+                    if item is not _STOPPED:  # collapse duplicate sentinels
+                        behind.append(item)
+                for item in behind:
+                    self._q_out.put(item)
                 self._q_out.put(_STOPPED)  # keep later gets loud too
+                if behind:
+                    continue  # deliver the rescued outputs first
                 err = self._engine._error
                 raise RuntimeError(
                     "engine stopped while this stream was waiting"

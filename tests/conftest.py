@@ -64,6 +64,10 @@ def _reset_global_state():
     profiling.enable(False)
     obs_hooks.clear()  # no tracer callback outlives its test
     obs_spans.reset()  # flight recorder + enable flag are process-global
+    from nnstreamer_tpu.obs import export as obs_export
+
+    with obs_export._health_lock:  # no health verdict outlives its test
+        obs_export._health_providers.clear()
     from nnstreamer_tpu import pool as _pool
 
     _pool.reset_default_pool()  # conf-driven singleton: re-read per test

@@ -441,3 +441,109 @@ class TestStaticScales:
             mobilenet_v2.build_quantized(
                 **kw, int8_convs=True, static_scales=True, params=f.params,
                 calib_data=[])
+
+
+class TestCalibrationThreadIsolation:
+    """The calibration flag is thread-LOCAL (ADVICE r5 #1): calibrating
+    on one thread must not flip another thread's int8 convs into the
+    eager recording branch — under jit that raises
+    ConcretizationTypeError in the victim; eagerly it pollutes the other
+    model's act_scale leaves."""
+
+    @staticmethod
+    def _conv_setup(rng_seed=0):
+        rng = np.random.default_rng(rng_seed)
+        w = rng.standard_normal((1, 1, 3, 8)).astype(np.float32)
+        x = rng.uniform(-1, 1, (1, 4, 4, 3)).astype(np.float32)
+        from nnstreamer_tpu.ops.quant import quantize_weight
+
+        return {"w": quantize_weight(w)}, x
+
+    def test_concurrent_inference_survives_calibration(self):
+        import threading
+
+        import jax
+
+        from nnstreamer_tpu.models.layers import conv2d_int8
+        from nnstreamer_tpu.ops import quant
+
+        params, x = self._conv_setup()
+        entered = threading.Event()
+        release = threading.Event()
+        seen = []
+
+        def calibrator():
+            with quant.calibration():
+                seen.append(quant.is_calibrating())
+                entered.set()
+                release.wait(30)
+
+        t = threading.Thread(target=calibrator)
+        t.start()
+        try:
+            assert entered.wait(30)
+            # the serving thread: calibration elsewhere is invisible here
+            assert quant.is_calibrating() is False
+            # first trace happens WHILE the other thread calibrates: the
+            # old process-global flag made this raise
+            # ConcretizationTypeError (float() of a tracer) inside jit
+            out = jax.jit(lambda p, a: conv2d_int8(p, a))(params, x)
+            assert np.asarray(out).shape == (1, 4, 4, 8)
+            # ...and the serving model's params were not polluted
+            assert "act_scale" not in params
+        finally:
+            release.set()
+            t.join(timeout=30)
+        assert seen == [True]  # the calibrating thread did see the flag
+
+    def test_context_restores_nested_state(self):
+        from nnstreamer_tpu.ops import quant
+
+        assert quant.is_calibrating() is False
+        with quant.calibration():
+            with quant.calibration():
+                assert quant.is_calibrating() is True
+            assert quant.is_calibrating() is True  # outer still active
+        assert quant.is_calibrating() is False
+
+
+class TestCalibrationZeroGuard:
+    """The `or 1.0` floor applies ONCE at the end of calibration (ADVICE
+    r5 #4): one all-zero sample must not pin act_scale at >= 1.0."""
+
+    @staticmethod
+    def _run_calibration(samples):
+        from nnstreamer_tpu.models.layers import conv2d_int8
+        from nnstreamer_tpu.ops.quant import (
+            calibrate_static_scales,
+            quantize_weight,
+        )
+
+        w = np.random.default_rng(3).standard_normal(
+            (1, 1, 3, 8)).astype(np.float32)
+        params = {"w": quantize_weight(w)}
+        calibrate_static_scales(
+            lambda p, a: conv2d_int8(p, a), params, samples)
+        return params
+
+    def test_zero_sample_does_not_pin_scale(self):
+        zero = np.zeros((1, 4, 4, 3), np.float32)
+        real = np.full((1, 4, 4, 3), 0.5, np.float32)
+        params = self._run_calibration([zero, real])
+        # raw running amax: max(0, 0.5)/127 — far below the old 1.0 pin
+        assert params["act_scale"] == pytest.approx(0.5 / 127.0)
+
+    def test_all_zero_calibration_still_floors(self):
+        zero = np.zeros((1, 4, 4, 3), np.float32)
+        params = self._run_calibration([zero, zero])
+        assert params["act_scale"] == 1.0  # the one-time end floor
+
+    def test_mid_calibration_zero_scale_never_divides(self):
+        """A 0.0 recorded scale is 'nothing seen yet', not a divisor:
+        outside calibration it must fall back to the dynamic path."""
+        from nnstreamer_tpu.models.layers import conv2d_int8
+
+        params, x = TestCalibrationThreadIsolation._conv_setup(5)
+        params["act_scale"] = 0.0
+        out = np.asarray(conv2d_int8(params, x))
+        assert np.isfinite(out).all()

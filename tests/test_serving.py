@@ -466,6 +466,29 @@ class TestStopDrain:
         for g, w in zip(got, want):
             np.testing.assert_allclose(g, w, rtol=1e-5, atol=1e-5)
 
+    def test_output_delivered_behind_sentinel_is_not_lost(self):
+        """stop()/_fail() post the sentinel concurrently with the engine
+        thread's output delivery: a result computed by the final in-flight
+        tick can land BEHIND it (ADVICE r5 #2).  get() must drain real
+        outputs queued after the sentinel (re-putting it last) instead of
+        raising over an already-computed result."""
+        from nnstreamer_tpu.serving import _STOPPED
+
+        eng = ContinuousBatcher(capacity=1, **KW)
+        s = eng.open_session()
+        eng.stop()  # queue now holds the sentinel
+        rescued = np.full((KW["n_out"],), 7.0, np.float32)
+        s._q_out.put(rescued)  # the in-flight tick's late delivery
+        out = s.get(timeout=10)  # must return the result, not raise
+        np.testing.assert_allclose(out, rescued)
+        # the sentinel was re-put last: every later get stays loud
+        for _ in range(2):
+            with pytest.raises(RuntimeError, match="engine stopped"):
+                s.get(timeout=5)
+        # and duplicate sentinels (stop + _fail racing) collapse to one
+        assert s._q_out.qsize() == 1
+        assert s._q_out.get_nowait() is _STOPPED
+
 
 class TestShardedEngine:
     """devices=N shards the slot axis over a mesh (virtual 8-dev CPU mesh

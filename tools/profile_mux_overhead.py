@@ -19,7 +19,13 @@ This tool isolates where the per-stream cost lands:
   path's tracer signal, ``nnstreamer_tpu/pool.py``): bytes-copied and
   fresh allocations per frame ride as sweep-table columns, so the
   pooled slot-wise assembly / RowBatch concat-skip savings are visible
-  next to the fps they buy.
+  next to the fps they buy;
+- separates TRUE device time from host machinery via the device lane
+  (``nnstreamer_tpu/obs/device.py``): a ``DeviceTracer`` completion
+  probe per dispatch yields a ``dev us/fr`` column — on an async
+  backend the ``dispatch_exit`` attribution only times the enqueue, so
+  without this column device compute hides inside whichever element
+  blocks first.
 
 Usage: ``python tools/profile_mux_overhead.py [TOTAL_FRAMES] [SWEEP...]``
 e.g. ``python tools/profile_mux_overhead.py 2000 1 2 4 8``.
@@ -48,6 +54,8 @@ from nnstreamer_tpu.elements.mux import TensorMux
 from nnstreamer_tpu.elements.sink import TensorSink
 from nnstreamer_tpu.elements.testsrc import DataSrc
 from nnstreamer_tpu.obs import hooks
+from nnstreamer_tpu.obs.device import DeviceTracer
+from nnstreamer_tpu.obs.metrics import MetricsRegistry
 from nnstreamer_tpu.spec import TensorSpec, TensorsSpec
 
 TOTAL = int(sys.argv[1]) if len(sys.argv) > 1 else 2000
@@ -148,6 +156,7 @@ def run_mux(streams, frames_per_stream, attribute=False):
                    p.add(TensorSink(name=f"o{i}", callback=cb)))
     attr = Attribution()
     copies = CopyCount()
+    dev = p.attach_tracer(DeviceTracer(registry=MetricsRegistry()))
     hooks.connect("copy", copies)
     if attribute:
         hooks.connect("dispatch_exit", attr)
@@ -164,6 +173,10 @@ def run_mux(streams, frames_per_stream, attribute=False):
     total_in = streams * frames_per_stream
     copies.per_frame = copies.nbytes / max(1, total_in)
     copies.allocs_per_frame = copies.allocs / max(1, total_in)
+    # stop() drained the completion-probe queue: summary is final
+    dsum = dev.summary()
+    copies.dev_us_per_frame = dsum["device_ns"] / 1e3 / max(1, total_in)
+    copies.dev_dispatches = dsum["completed"]
     return fps, wall, attr, copies
 
 
@@ -174,10 +187,12 @@ def main():
     run_mux(1, 50)
     base_fps, _, _, base_cp = run_mux(1, TOTAL)
     print(f"\n{'streams':>7} {'agg fps':>10} {'us/frame':>10} "
-          f"{'vs 1-stream':>11} {'copy KB/fr':>11} {'allocs/fr':>10}")
+          f"{'vs 1-stream':>11} {'copy KB/fr':>11} {'allocs/fr':>10} "
+          f"{'dev us/fr':>10}")
     print(f"{1:>7} {base_fps:>10.0f} {1e6 / base_fps:>10.1f} {'1.00x':>11} "
           f"{base_cp.per_frame / 1024:>11.1f} "
-          f"{base_cp.allocs_per_frame:>10.3f}")
+          f"{base_cp.allocs_per_frame:>10.3f} "
+          f"{base_cp.dev_us_per_frame:>10.1f}")
     results = {1: base_fps}
     for s in [s for s in SWEEP if s != 1]:
         run_mux(s, 40)  # warm the s-wide executable
@@ -185,7 +200,7 @@ def main():
         results[s] = fps
         print(f"{s:>7} {fps:>10.0f} {1e6 / fps:>10.1f} "
               f"{fps / base_fps:>10.2f}x {cp.per_frame / 1024:>11.1f} "
-              f"{cp.allocs_per_frame:>10.3f}")
+              f"{cp.allocs_per_frame:>10.3f} {cp.dev_us_per_frame:>10.1f}")
 
     # attribution pass at the widest sweep point
     widest = max(SWEEP)
@@ -206,6 +221,10 @@ def main():
           f"{cp.per_frame / 1024:.1f} KB/frame, "
           f"{cp.allocs_per_frame:.3f} fresh allocs/frame "
           f"({cp.copies} memcpys, {cp.nbytes / 1e6:.1f} MB total)")
+    print(f"  true device time at {widest} streams: "
+          f"{cp.dev_us_per_frame:.1f} us/frame over {cp.dev_dispatches} "
+          f"probed dispatches (device lane; host attribution above times "
+          f"the enqueue only)")
 
 
 if __name__ == "__main__":

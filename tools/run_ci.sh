@@ -153,6 +153,99 @@ print(f"tracing smoke OK: {len(snap)} records, {len(xs)} spans, "
 print("\n".join(spans.waterfall(snap, limit=2).splitlines()[:8]))
 PY
 
+run_step "Device-obs smoke (device lane + compile counters + watchdog)" \
+  env NNSTPU_TRACERS="latency,spans,device" NNSTPU_METRICS_PORT=0 \
+      NNSTPU_OBS_FLIGHT_DUMP_DIR=/tmp/ci_device_obs_dumps \
+  python - <<'PY'
+import json
+import os
+import time
+import urllib.error
+import urllib.request
+
+import jax
+jax.config.update('jax_platforms', 'cpu')
+import numpy as np
+
+from nnstreamer_tpu import Frame, Pipeline
+from nnstreamer_tpu.backends.jax_backend import JaxModel
+from nnstreamer_tpu.elements.filter import TensorFilter
+from nnstreamer_tpu.elements.sink import TensorSink
+from nnstreamer_tpu.elements.testsrc import DataSrc
+from nnstreamer_tpu.graph.node import SourceNode
+from nnstreamer_tpu.obs import export, spans
+from nnstreamer_tpu.obs.watchdog import PipelineWatchdog
+from nnstreamer_tpu.spec import TensorSpec, TensorsSpec
+
+model = JaxModel(apply=lambda p_, x: x * 2,
+                 input_spec=TensorsSpec.of(
+                     TensorSpec(dtype=np.float32, shape=(4,))))
+got = []
+p = Pipeline(name="ci_device")
+src = p.add(DataSrc(data=[np.full(4, i, np.float32) for i in range(8)],
+                    name="s"))
+filt = p.add(TensorFilter(framework="jax", model=model, name="f"))
+p.link_chain(src, filt, p.add(TensorSink(callback=got.append, name="out")))
+p.run(timeout=120)
+assert len(got) == 8, got
+(dev,) = [t for t in p.tracers if t.name == "device"]
+deadline = time.time() + 30
+while time.time() < deadline and dev.summary()["completed"] < 8:
+    time.sleep(0.05)
+summ = dev.summary()
+assert summ["completed"] == 8 and summ["dropped"] == 0, summ
+assert summ["compiles"]["miss"] >= 1, summ
+
+doc = json.loads(json.dumps(spans.chrome_trace(p.flight_snapshot())))
+execs = [e for e in doc["traceEvents"]
+         if e.get("ph") == "X" and e["name"] == "device_exec"]
+assert len(execs) == 8, "no per-dispatch device_exec spans"
+rows = {e["tid"]: e["args"]["name"] for e in doc["traceEvents"]
+        if e.get("ph") == "M" and e["name"] == "thread_name"}
+assert any(v.startswith("device:") for v in rows.values()), rows
+
+server = export._server
+assert server is not None, "NNSTPU_METRICS_PORT did not start the endpoint"
+with urllib.request.urlopen(server.url, timeout=30) as resp:
+    body = resp.read().decode("utf-8")
+assert "nnstpu_device_exec_seconds_bucket" in body, body[:400]
+assert 'nnstpu_compile_total{result="miss"}' in body, \
+    [l for l in body.splitlines() if "compile" in l]
+assert "nnstpu_device_dispatches_total" in body
+
+# -- watchdog: a deliberately stalled source flips /healthz + dumps -----
+class StallSrc(SourceNode):
+    def output_spec(self):
+        return TensorsSpec.of(TensorSpec(dtype=np.float32, shape=(4,)))
+    def frames(self):
+        yield Frame.of(np.zeros(4, np.float32))
+        self._stop_evt.wait()
+
+p2 = Pipeline(name="ci_stall")
+p2.link(p2.add(StallSrc(name="cam")), p2.add(TensorSink(name="out")))
+wd = p2.attach_tracer(PipelineWatchdog(interval_s=0.05, stall_s=0.2))
+p2.start()
+deadline = time.time() + 30
+while time.time() < deadline and wd.summary()["healthy"]:
+    time.sleep(0.05)
+assert not wd.summary()["healthy"], wd.summary()
+assert any("stalled_source:cam" in r for r in wd.summary()["reasons"])
+try:
+    urllib.request.urlopen(
+        f"http://{server.host}:{server.port}/healthz", timeout=30)
+    raise AssertionError("/healthz stayed 200 on a stalled pipeline")
+except urllib.error.HTTPError as e:
+    assert e.code == 503 and b"stalled_source:cam" in e.read()
+dump = "/tmp/ci_device_obs_dumps/ci_stall.stall.trace.json"
+assert os.path.exists(dump), "watchdog wrote no stall flight dump"
+p2.stop()
+export.shutdown_server()
+print(f"device-obs smoke OK: {len(execs)} device_exec spans on "
+      f"{[v for v in rows.values() if v.startswith('device:')]}, "
+      f"compile misses={summ['compiles']['miss']}, watchdog flagged the "
+      "stall and dumped flight data")
+PY
+
 run_step "Zero-copy smoke (pooled batch assembly + copies-per-frame gate)" \
   python - <<'PY'
 import jax
