@@ -48,6 +48,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from .. import faults as _faults
 from ..buffer import WireTensor
 from ..obs import hooks as _hooks
 from ..pool import RowBatch, fence as _pool_fence
@@ -218,6 +219,14 @@ class JaxBackend(FilterBackend):
         # staging for non-contiguous host frames on the flat wire entry
         self._row_jit = None
         self._host_stager = None
+        # graceful degradation: a compile that fails on the configured
+        # device (device lost, sick PJRT link, injected chaos) retries on
+        # CPU and keeps serving — self._degraded carries the reason and
+        # is surfaced on /healthz as degraded-but-200 (docs/robustness.md)
+        self._degraded: Optional[str] = None
+        self._cpu_device = None
+        self._degraded_key: Optional[str] = None
+        self._degraded_fn = None
 
     # -- open/close ---------------------------------------------------------
 
@@ -280,6 +289,12 @@ class JaxBackend(FilterBackend):
         self._cache.clear()
         self._row_jit = None
         self._host_stager = None
+        if self._degraded_key is not None:
+            from ..obs.export import unregister_degraded
+
+            unregister_degraded(self._degraded_key, self._degraded_fn)
+            self._degraded_key = self._degraded_fn = None
+        self._degraded = None
 
     # -- spec discovery -----------------------------------------------------
 
@@ -375,8 +390,63 @@ class JaxBackend(FilterBackend):
         return flat_fn, wire
 
     def _compile(self, in_spec: TensorsSpec) -> TensorsSpec:
+        """Compile for ``in_spec`` — with graceful degradation: a compile
+        failing with a runtime error (device lost, wedged PJRT tunnel,
+        injected chaos) retries once pinned to CPU instead of taking the
+        stream down.  The degraded state is permanent for this backend
+        instance (a sick device link does not heal per-frame), reported
+        as a ``degraded`` /healthz reason and a ``cpu_fallback`` recovery
+        action.  Conf gate: ``[recovery] cpu_fallback`` (default on)."""
+        try:
+            return self._compile_impl(in_spec)
+        except (RuntimeError, OSError) as exc:
+            from ..conf import conf
+
+            if (self._degraded is not None
+                    or not conf.get_bool("recovery", "cpu_fallback", True)):
+                raise
+            try:
+                cpu = jax.devices("cpu")[0]
+            except Exception:  # noqa: BLE001 — no CPU PJRT: nothing to try
+                raise exc from None
+            # mark degraded FIRST: invoke() routes through the CPU device
+            # context from now on, so the jit executables compiled below
+            # keep dispatching to CPU on every later call
+            self._cpu_device = cpu
+            self._degraded = (
+                f"jax backend degraded to CPU after compile failure: "
+                f"{type(exc).__name__}: {exc}")
+            with jax.default_device(cpu):
+                out = self._compile_impl(in_spec)
+            self._register_degraded()
+            from ..obs import recovery as _recovery
+
+            _recovery.record(
+                "", "cpu_fallback", "ok",
+                target=getattr(self.model, "name", "") or self.name,
+                detail=repr(exc))
+            return out
+
+    def _register_degraded(self) -> None:
+        if self._degraded_key is not None:
+            return
+        from ..obs.export import register_degraded
+
+        model_name = getattr(self.model, "name", "")
+        suffix = model_name if isinstance(model_name, str) and model_name \
+            else f"{id(self):x}"
+        self._degraded_key = f"backend:{self.name}:{suffix}"
+        self._degraded_fn = lambda: self._degraded or ""
+        register_degraded(self._degraded_key, self._degraded_fn)
+
+    def _compile_impl(self, in_spec: TensorsSpec) -> TensorsSpec:
         from ..obs.device import cost_info, record_compile
 
+        if _faults.enabled:
+            # chaos point "backend_compile" (kind compile_raise): drives
+            # the degradation path above without a real sick device
+            _faults.maybe_compile(
+                f"{self.name}:{getattr(self.model, 'name', '')}")
         self._in_spec = in_spec
         self._expected = tuple(
             (tuple(t.shape), np.dtype(t.dtype)) for t in in_spec.tensors
@@ -471,6 +541,14 @@ class JaxBackend(FilterBackend):
     # -- invoke -------------------------------------------------------------
 
     def invoke(self, tensors: Tuple) -> Tuple:
+        if self._degraded is not None:
+            # degraded mode: host inputs place (and executables dispatch)
+            # on the CPU PJRT client, not the sick configured device
+            with jax.default_device(self._cpu_device):
+                return self._invoke_impl(tensors)
+        return self._invoke_impl(tensors)
+
+    def _invoke_impl(self, tensors: Tuple) -> Tuple:
         if self._compiled is None:
             self.reconfigure(TensorsSpec.from_arrays(tensors))
         else:

@@ -66,6 +66,8 @@ DEFAULTS: Dict[str, Dict[str, str]] = {
         "watchdog_stall_s": "5.0",          # source/queue stall window
         "watchdog_queue_depth": "1",        # min depth to call a queue wedged
         "watchdog_device_deadline_s": "30", # device completion deadline
+        "watchdog_recover": "false",        # escalate detection to recovery
+        "watchdog_recover_budget": "3",     # max recovery attempts per target
     },
     # Host staging-buffer pool (nnstreamer_tpu/pool): the zero-copy batch
     # assembly + wire staging path.  NNSTPU_POOL_* env vars map here.
@@ -90,6 +92,25 @@ DEFAULTS: Dict[str, Dict[str, str]] = {
         "quantum": "8",             # DRR per-round credit (cost units)
         "priorities": "",           # "clientA=10,clientB=2" strict/slot prio
         "max_waiting": "16",        # bounded slot-waiter room (DecodeServer)
+    },
+    # Chaos engine (nnstreamer_tpu/faults): seeded fault injection.  The
+    # short env spelling NNSTPU_FAULTS takes precedence over the
+    # NNSTPU_FAULTS_SPEC form mapped here.
+    "faults": {
+        "spec": "",                 # e.g. "seed=42;invoke_raise@f:every=5"
+        "seed": "0",                # default seed (a seed= clause wins)
+    },
+    # Self-healing (graph/pipeline.py restart policies + backend
+    # degradation).  NNSTPU_RECOVERY_* env vars map here.
+    "recovery": {
+        "policy": "",               # default per-node policy: restart |
+                                    # quarantine-passthrough | fail-pipeline
+                                    # ("" = fail-pipeline, legacy behavior)
+        "max_restarts": "5",        # restart-storm budget per node ...
+        "window_s": "30",           # ... within this sliding window
+        "backoff_ms": "50",         # first restart backoff (doubles)
+        "backoff_cap_ms": "2000",   # backoff ceiling
+        "cpu_fallback": "true",     # degrade jax compile failures to CPU
     },
 }
 

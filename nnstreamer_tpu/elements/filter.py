@@ -23,6 +23,7 @@ from __future__ import annotations
 import time
 from typing import Dict, Optional
 
+from .. import faults as _faults
 from ..backends.base import FilterBackend, get_backend
 from ..buffer import Frame
 from ..graph.node import NegotiationError, Node, Pad
@@ -260,6 +261,12 @@ class TensorFilter(Node):
         del pad
         from ..utils import profiling
 
+        if _faults.enabled:
+            # chaos point "backend_invoke": invoke_delay/device_stall
+            # sleep here, invoke_raise raises — an InjectedFault is then
+            # handled exactly like a real one (restart policy or
+            # post_error)
+            _faults.maybe_invoke(self.name)
         if profiling.enabled():
             t0 = time.perf_counter_ns()
             outs = self.backend.invoke(frame.tensors)

@@ -45,14 +45,17 @@ batch-polymorphic model; see ``QueryServer.__init__``).
 from __future__ import annotations
 
 import queue
+import random
 import socket
 import struct
 import threading
 import time
+import zlib
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from .. import faults as _faults
 from ..buffer import Frame
 from ..graph.node import NegotiationError, Node, Pad
 from ..graph.registry import register_element
@@ -91,6 +94,25 @@ class QueryUnavailableError(QueryError):
     code = "UNAVAILABLE"
 
 
+class QueryTimeoutError(QueryError):
+    """Client-side: no (complete) reply within ``request_timeout``.  When
+    raised mid-frame the socket's read position is undefined — the caller
+    must drop the connection, never reuse it (the retry path in
+    :class:`TensorQueryClient` does exactly that)."""
+
+    code = "TIMEOUT"
+
+
+class QuerySessionBrokenError(QueryError):
+    """A ``stateful=True`` client's connection died mid-stream.  Stateful
+    (decode-session) requests are NEVER retried — the server already
+    advanced its per-session state an unknown number of steps, and a
+    silent replay would corrupt the stream.  Reconnect and re-prefill to
+    rebuild the session instead."""
+
+    code = "SESSION"
+
+
 # wire code -> client-side exception; unknown/absent codes stay the
 # legacy RuntimeError so old servers interoperate with new clients
 ERROR_TYPES = {
@@ -108,19 +130,34 @@ PROBE_PTS = -2
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
     buf = bytearray()
     while len(buf) < n:
-        chunk = sock.recv(n - len(buf))
+        try:
+            chunk = sock.recv(n - len(buf))
+        except socket.timeout:
+            # a socket timeout (the client's request_timeout) is a TYPED
+            # failure; mid-read it additionally means a torn frame — the
+            # peer stalled partway through a message, and the stream
+            # position is now unknowable (the caller must drop the socket)
+            raise QueryTimeoutError(
+                "timed out waiting for peer"
+                + (f" mid-frame ({len(buf)}/{n} bytes of a read)"
+                   if buf else "")) from None
         if not chunk:
-            raise ConnectionError("peer closed mid-message")
+            # peer died mid-frame: a torn frame, not a clean close —
+            # distinguishable from idle EOF because bytes were expected
+            raise ConnectionError(
+                f"peer closed mid-message ({len(buf)}/{n} bytes of a read)")
         buf.extend(chunk)
     return bytes(buf)
 
 
 def send_tensors(sock: socket.socket, tensors, pts: int,
-                 trace: Optional[Tuple[int, int]] = None) -> None:
+                 trace: Optional[Tuple[int, int]] = None,
+                 fault_key: str = "nnsq") -> None:
     """``trace=(trace_id, span_id)`` sets :data:`FLAG_TRACE` and prepends
     the trace-context block.  Only send it to a peer that proved trace
     support (see the module docstring) — a strict version-1 peer rejects
-    the flagged header."""
+    the flagged header.  ``fault_key`` names this send site to the chaos
+    engine (``socket_drop``/``truncate``/``corrupt`` act here)."""
     ver = VERSION | (FLAG_TRACE if trace is not None else 0)
     parts = [MAGIC, struct.pack("<HHq", ver, len(tensors), pts)]
     if trace is not None:
@@ -136,7 +173,12 @@ def send_tensors(sock: socket.socket, tensors, pts: int,
         parts.append(struct.pack(f"<{a.ndim}I", *a.shape))
         parts.append(struct.pack("<Q", a.nbytes))
         parts.append(a.tobytes())
-    sock.sendall(b"".join(parts))
+    data = b"".join(parts)
+    if _faults.enabled:
+        # may corrupt the payload, send a torn half-frame, or drop the
+        # socket entirely (raising ConnectionError to this sender)
+        data = _faults.on_wire(sock, data, fault_key)
+    sock.sendall(data)
 
 
 def send_error(sock: socket.socket, msg: str, code: str = "") -> None:
@@ -322,6 +364,9 @@ class QueryServer:
         return be
 
     def start(self) -> "QueryServer":
+        # serverless front doors pick up NNSTPU_FAULTS the same way a
+        # Pipeline.start does (chaos runs cover the serving edge too)
+        _faults.ensure_configured()
         self._srv = socket.create_server((self.host, self.port))
         self.port = self._srv.getsockname()[1]
         self._running = True
@@ -391,7 +436,8 @@ class QueryServer:
                         reply_trace = wire_trace
                         if tok is not None:
                             reply_trace = (wire_trace[0], tok[0])
-                        send_tensors(conn, outs, pts, trace=reply_trace)
+                        send_tensors(conn, outs, pts, trace=reply_trace,
+                                     fault_key="nnsq.server")
                     finally:
                         if item is not None:
                             self.scheduler.release(item)
@@ -413,6 +459,8 @@ class QueryServer:
         """Unbatched invoke (breaker-gated when a scheduler is attached)."""
 
         def run():
+            if _faults.enabled:
+                _faults.maybe_invoke("query_server")
             with self._lock:
                 if not self._running:
                     raise RuntimeError("query server stopped")
@@ -616,6 +664,8 @@ class QueryServer:
                     chunk.append(part)
 
                 def run(chunk=chunk):
+                    if _faults.enabled:
+                        _faults.maybe_invoke("query_server")
                     with self._lock:
                         if not self._running:
                             raise RuntimeError("server stopping")
@@ -702,13 +752,49 @@ class TensorQueryClient(Node):
         port: int = 0,
         connect_timeout: float = 10.0,
         out_spec: Optional[TensorsSpec] = None,
+        request_timeout: Optional[float] = 60.0,
+        retries: int = 0,
+        retry_backoff_ms: float = 50.0,
+        retry_backoff_cap_ms: float = 2000.0,
+        retry_jitter: float = 0.25,
+        stateful: bool = False,
     ):
+        """``request_timeout`` bounds EVERY blocking read after connect
+        (the old behavior — block forever on a hung server — needs an
+        explicit ``request_timeout=None``); expiry raises the typed
+        :class:`QueryTimeoutError` and drops the socket (mid-frame read
+        position is unknowable).
+
+        ``retries=N`` re-sends a failed request up to N more times with
+        exponential backoff (doubling from ``retry_backoff_ms`` to the
+        cap, plus up to ``retry_jitter`` relative jitter) and a fresh
+        connection per attempt.  Retries apply ONLY to connection-level
+        failures (drop, torn frame, timeout) — typed server rejections
+        (``[OVERLOAD]``/``[EXPIRED]``/...) always surface to the caller.
+
+        ``stateful=True`` marks this link as a decode session
+        (:class:`nnstreamer_tpu.serving.DecodeServer`): a mid-stream
+        connection failure then raises :class:`QuerySessionBrokenError`
+        immediately, never retrying — the server's session state may
+        already have advanced, and a silent replay would corrupt it."""
         super().__init__(name)
         self.add_sink_pad("sink")
         self.add_src_pad("src")
         self.host, self.port = str(host), int(port)
         self.connect_timeout = float(connect_timeout)
         self.out_spec = out_spec  # optional static declaration
+        self.request_timeout = (float(request_timeout)
+                                if request_timeout else None)
+        self.retries = int(retries)
+        self.retry_backoff_ms = float(retry_backoff_ms)
+        self.retry_backoff_cap_ms = float(retry_backoff_cap_ms)
+        self.retry_jitter = float(retry_jitter)
+        self.stateful = bool(stateful)
+        self.retries_total = 0    # observability: re-sent requests
+        self.reconnects = 0       # sockets dropped and re-dialed
+        # deterministic per-element jitter stream (crc32: str hash() is
+        # process-salted, and reproducible chaos runs want stable jitter)
+        self._rng = random.Random(zlib.crc32(self.name.encode()))
         self._sock: Optional[socket.socket] = None
         self._interrupted = False
         # does the peer speak the FLAG_TRACE header? learned during the
@@ -725,7 +811,9 @@ class TensorQueryClient(Node):
             self._sock = socket.create_connection(
                 (self.host, self.port), timeout=self.connect_timeout
             )
-            self._sock.settimeout(None)
+            # bounded reads: a hung/wedged server surfaces as a typed
+            # QueryTimeoutError instead of parking this worker forever
+            self._sock.settimeout(self.request_timeout)
         return self._sock
 
     def start(self) -> None:
@@ -787,11 +875,42 @@ class TensorQueryClient(Node):
 
     def process(self, pad: Pad, frame: Frame):
         del pad
+        attempts = 1 if self.stateful else 1 + max(0, self.retries)
+        delay_s = self.retry_backoff_ms / 1e3
+        for attempt in range(attempts):
+            try:
+                return self._roundtrip(frame)
+            except (QueryTimeoutError, ConnectionError, OSError) as exc:
+                # the socket's stream position is unknowable after a torn
+                # frame or timeout: never reuse it
+                self._reset_socket()
+                self.reconnects += 1
+                if self._interrupted:
+                    raise
+                if self.stateful:
+                    raise QuerySessionBrokenError(
+                        f"{self.name}: decode session to "
+                        f"{self.host}:{self.port} broken mid-stream "
+                        f"({exc}); stateful requests are never retried — "
+                        "reconnect and re-prefill to rebuild the session"
+                    ) from exc
+                if attempt + 1 >= attempts:
+                    raise
+                self.retries_total += 1
+                # capped exponential backoff + jitter: a fleet of
+                # retrying clients must not re-dogpile a recovering server
+                time.sleep(delay_s *
+                           (1.0 + self.retry_jitter * self._rng.random()))
+                delay_s = min(delay_s * 2, self.retry_backoff_cap_ms / 1e3)
+
+    def _roundtrip(self, frame: Frame) -> Frame:
+        """One send/recv attempt on the current (or a fresh) socket."""
         sock = self._connect()
         ctx = (frame.meta.get(_spans.META_KEY)
                if self._trace_wire and _spans.enabled else None)
         if ctx is None:
-            send_tensors(sock, frame.tensors, frame.pts)
+            send_tensors(sock, frame.tensors, frame.pts,
+                         fault_key="nnsq.client")
             outs, pts = recv_tensors(sock)
             return frame.with_tensors(outs, pts=pts)
         # traced round trip: the rtt span rides the frame's trace, its id
@@ -801,7 +920,7 @@ class TensorQueryClient(Node):
         args = {"server": f"{self.host}:{self.port}"}
         try:
             send_tensors(sock, frame.tensors, frame.pts,
-                         trace=(ctx[0], tok[0]))
+                         trace=(ctx[0], tok[0]), fault_key="nnsq.client")
             outs, pts, reply_trace = recv_tensors_ex(sock)
             if reply_trace is not None:
                 args["server_span"] = f"{reply_trace[1]:x}"

@@ -20,6 +20,7 @@ from __future__ import annotations
 import threading
 from typing import List, Optional
 
+from .. import faults as _faults
 from ..buffer import Event
 from ..graph.node import Node, Pad
 from ..graph.registry import register_element
@@ -46,6 +47,7 @@ class Queue(Node):
             raise ValueError(f"unknown leaky mode {leaky!r}")
         self.leaky = str(leaky)
         self._q = None
+        self._worker_thread: Optional[threading.Thread] = None
         # cumulative leaky-mode drops; element-level (survives stop(),
         # unlike the backend queue's own counter) — feeds the drops tracer
         self.dropped = 0
@@ -80,11 +82,17 @@ class Queue(Node):
 
     def spawn_threads(self) -> List[threading.Thread]:
         self._ensure_queue()
-        return [threading.Thread(target=self._worker, name=f"queue:{self.name}")]
+        self._worker_thread = threading.Thread(
+            target=self._worker, name=f"queue:{self.name}")
+        return [self._worker_thread]
 
     def _worker(self) -> None:
         q = self._q  # stop() may null the attribute while we drain
         while True:
+            if _faults.enabled:
+                # chaos: a queue_wedge fault sleeps HERE — pushes pile up
+                # while pops stop, exactly the wedge the watchdog detects
+                _faults.maybe_queue_wedge(self.name)
             status, item = q.pop(_POLL_MS)
             if status == SHUTDOWN:
                 return
@@ -123,6 +131,37 @@ class Queue(Node):
             "dropped": self.dropped,
             "leaky": self.leaky,
         }
+
+    def recover(self):
+        """Supervised recovery (``Pipeline.recover_queue``): shed the
+        wedged backlog — frames drop with typed accounting, in-band
+        events (EOS/caps) are re-queued in order — and hand back a fresh
+        worker thread if the old one died.  Returns
+        ``(frames_drained, new_threads)``."""
+        q = self._q
+        drained = 0
+        if q is not None:
+            events = []
+            while True:
+                status, item = q.pop(0)
+                if status != OK:
+                    break
+                if isinstance(item, Event):
+                    events.append(item)
+                    continue
+                drained += 1
+                self.dropped += 1
+                if _hooks.enabled:
+                    _hooks.emit("queue_drop", self, "recovery")
+            for ev in events:
+                q.push(ev, leaky="no")
+        threads: List[threading.Thread] = []
+        t = self._worker_thread
+        if q is not None and (t is None or not t.is_alive()):
+            self._worker_thread = threading.Thread(
+                target=self._worker, name=f"queue:{self.name}")
+            threads.append(self._worker_thread)
+        return drained, threads
 
     def interrupt(self) -> None:
         if self._q is not None:

@@ -1,0 +1,193 @@
+"""Fault injection & self-healing: the chaos substrate.
+
+This package is the *test side* of the robustness story (the recovery
+side lives in the graph runtime, the watchdog, and the NNSQ client):
+
+- :mod:`.engine` — the deterministic, seeded :class:`ChaosEngine` and
+  the ``NNSTPU_FAULTS`` spec grammar;
+- this module — the process-global activation surface, mirroring the
+  hook bus (:mod:`nnstreamer_tpu.obs.hooks`): hot sites guard every
+  consultation with ``if faults.enabled:`` so a production build with no
+  chaos configured pays one module-attribute truth test.
+
+Activation:
+
+- ``NNSTPU_FAULTS="seed=42;invoke_raise@f:every=5"`` (or ini
+  ``[faults] spec`` / ``NNSTPU_FAULTS_SPEC``) — picked up by
+  ``Pipeline.start`` and the NNSQ servers via :func:`ensure_configured`;
+- programmatic: ``faults.install("invoke_delay:rate=0.1,ms=20", seed=7)``
+  / ``faults.deactivate()`` (tests).
+
+Call sites (the injection points):
+
+=================  =====================================================
+``nnsq_send``      :func:`nnstreamer_tpu.elements.query.send_tensors` —
+                   ``socket_drop`` (close before sending), ``truncate``
+                   (send a torn half-frame, then close), ``corrupt``
+                   (flip payload bytes)
+``backend_invoke`` ``TensorFilter.process`` and the QueryServer invoke
+                   closures — ``invoke_delay`` / ``device_stall``
+                   (sleep ``ms``), ``invoke_raise``
+                   (:class:`~.engine.InjectedFault`)
+``backend_compile`` ``JaxBackend._compile`` — ``compile_raise`` (drives
+                   the CPU graceful-degradation fallback)
+``queue_wedge``    the ``queue`` element's worker loop — sleep ``ms``
+                   without popping (depth builds; the watchdog's wedge
+                   detector is the intended observer)
+=================  =====================================================
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Optional
+
+from .engine import (  # noqa: F401
+    DEFAULT_MS,
+    KINDS,
+    POINT_OF,
+    ChaosEngine,
+    FaultRule,
+    InjectedFault,
+    parse_spec,
+)
+
+# The fast-path gate, one module-global truth test when chaos is off
+# (same discipline as obs.hooks.enabled).
+enabled = False
+
+_lock = threading.Lock()
+_engine: Optional[ChaosEngine] = None
+
+
+def engine() -> Optional[ChaosEngine]:
+    return _engine
+
+
+def install(spec: str, seed: Optional[int] = None) -> ChaosEngine:
+    """Activate a chaos engine for this process (replaces any previous
+    one); returns it so callers can read ``engine.log`` /
+    ``engine.stats()`` after the run."""
+    global _engine, enabled
+    eng = ChaosEngine(spec, seed)
+    with _lock:
+        _engine = eng
+        enabled = bool(eng.rules)
+    return eng
+
+
+def deactivate() -> None:
+    global _engine, enabled
+    with _lock:
+        _engine = None
+        enabled = False
+
+
+def configured_spec() -> str:
+    """The conf'd spec: short env ``NNSTPU_FAULTS`` wins over the mapped
+    ``[faults] spec`` forms (the ``NNSTPU_TRACERS`` precedence pattern)."""
+    spec = os.environ.get("NNSTPU_FAULTS")
+    if spec is not None:
+        return spec
+    from ..conf import conf
+
+    return conf.get("faults", "spec", "") or ""
+
+
+def ensure_configured() -> Optional[ChaosEngine]:
+    """Conf-driven activation, called from ``Pipeline.start`` and the
+    NNSQ servers: installs the configured spec once (idempotent for an
+    unchanged spec — counters and the log survive restarts of the same
+    chaos run).  An empty conf spec never tears down a programmatically
+    installed engine."""
+    spec = configured_spec()
+    if not spec:
+        return _engine
+    from ..conf import conf
+
+    seed = conf.get_int("faults", "seed", 0)
+    with _lock:
+        cur = _engine
+    if cur is not None and cur.spec == spec and cur.seed == (
+            parse_spec(spec, seed)[0]):
+        return cur
+    return install(spec, seed)
+
+
+# -- injection helpers (one per point; call only behind `if enabled:`) -----
+
+
+def maybe_invoke(name: str) -> None:
+    """``backend_invoke`` point: may sleep (``invoke_delay`` /
+    ``device_stall``) or raise :class:`InjectedFault` (``invoke_raise``)."""
+    eng = _engine
+    if eng is None:
+        return
+    rule = eng.decide("backend_invoke", name)
+    if rule is None:
+        return
+    if rule.kind == "invoke_raise":
+        raise InjectedFault(rule.kind, name, rule.opportunities)
+    eng.sleep(rule)
+
+
+def maybe_compile(name: str) -> None:
+    """``backend_compile`` point: ``compile_raise`` raises."""
+    eng = _engine
+    if eng is None:
+        return
+    rule = eng.decide("backend_compile", name)
+    if rule is not None:
+        raise InjectedFault(rule.kind, name, rule.opportunities)
+
+
+def maybe_queue_wedge(name: str) -> None:
+    """``queue_wedge`` point: sleep ``ms`` in the consumer loop so the
+    queue stops popping while pushes accumulate."""
+    eng = _engine
+    if eng is None:
+        return
+    rule = eng.decide("queue_wedge", name)
+    if rule is not None:
+        eng.sleep(rule)
+
+
+def on_wire(sock, data: bytes, name: str) -> bytes:
+    """``nnsq_send`` point, called with the fully assembled frame bytes:
+
+    - ``socket_drop``: close the socket, send nothing, raise
+      ``ConnectionError`` (the local sender sees the drop; the peer sees
+      a clean close);
+    - ``truncate``: send a torn half-frame, close, raise (the peer's
+      ``_recv_exact`` must detect the torn frame);
+    - ``corrupt``: flip one payload byte in the final quarter of the
+      frame (header fields survive; tensor values do not).
+    """
+    eng = _engine
+    if eng is None:
+        return data
+    rule = eng.decide("nnsq_send", name)
+    if rule is None:
+        return data
+    if rule.kind == "corrupt":
+        buf = bytearray(data)
+        buf[-max(1, len(buf) // 4)] ^= 0xFF
+        return bytes(buf)
+    try:
+        if rule.kind == "truncate" and len(data) > 1:
+            sock.sendall(data[: len(data) // 2])
+    finally:
+        try:
+            import socket as _socket
+
+            sock.shutdown(_socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            sock.close()
+        except OSError:
+            pass
+    raise ConnectionError(
+        f"injected {rule.kind} at {name!r} "
+        f"(opportunity {rule.opportunities})")

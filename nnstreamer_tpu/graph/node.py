@@ -165,6 +165,11 @@ class Node:
         self.pipeline = None  # set on add
         self._lock = threading.Lock()
         self._started = False
+        # supervised-recovery state (graph/pipeline.py restart policies):
+        # a quarantined node's process() is bypassed — frames pass through
+        # unchanged when specs allow, else drop (counted by the pipeline)
+        self._quarantined = False
+        self._quarantine_passthrough = False
 
     # -- pad management -----------------------------------------------------
 
@@ -262,7 +267,25 @@ class Node:
             self._handle_frame(pad, item)
 
     def _handle_frame(self, pad: Pad, frame: Frame) -> None:
-        result = self.process(pad, frame)
+        if self._quarantined:
+            # quarantine-passthrough restart policy: the node is sidelined
+            # after repeated faults — forward the raw frame when its in/out
+            # specs line up, else shed it (typed accounting either way)
+            if self._quarantine_passthrough:
+                self._emit(frame)
+            elif self.pipeline is not None:
+                self.pipeline._count_shed_frame(self)
+            return
+        try:
+            result = self.process(pad, frame)
+        except Exception as exc:
+            pl = self.pipeline
+            # a per-node restart policy may absorb the fault (restart or
+            # quarantine this node, drop the offending frame); only an
+            # unhandled fault propagates to post_error as before
+            if pl is not None and pl._node_fault(self, exc):
+                return
+            raise
         self._emit(result)
 
     def _emit(self, result: ProcessResult) -> None:
@@ -391,6 +414,10 @@ class SourceNode(Node):
         super().__init__(name)
         self.add_src_pad("src")
         self._stop_evt = threading.Event()
+        # bumped by Pipeline.restart_source: an abandoned (stuck) streaming
+        # thread that eventually unblocks sees a stale epoch and exits
+        # instead of double-pushing alongside its replacement
+        self._epoch = 0
 
     def frames(self) -> Iterable[Frame]:
         """Yield frames until exhausted.  Implementations should check

@@ -20,6 +20,7 @@ the negotiation pass always sees a DAG.
 from __future__ import annotations
 
 import threading
+import time
 import traceback
 from typing import Dict, List, Optional, Union
 
@@ -30,6 +31,58 @@ from .node import NegotiationError, Node, Pad, SourceNode
 
 class PipelineError(Exception):
     pass
+
+
+RESTART_MODES = ("restart", "quarantine-passthrough", "fail-pipeline")
+
+
+class RestartPolicy:
+    """Per-node supervision policy (the GStreamer world has no analog —
+    an element error is always fatal there; a streaming system that must
+    play through flaky sources needs supervision, Erlang-style):
+
+    - ``restart``: stop()+start() the faulting node, drop the offending
+      frame, and keep streaming — with capped exponential backoff and a
+      restart-storm budget (``max_restarts`` within ``window_s``; the
+      budget exhausting escalates to pipeline failure).
+    - ``quarantine-passthrough``: sideline the node — subsequent frames
+      bypass its ``process()`` (passing through unchanged when the
+      in/out specs line up, shed otherwise, both counted).
+    - ``fail-pipeline``: the legacy terminal behavior (default).
+    """
+
+    __slots__ = ("mode", "max_restarts", "window_s", "backoff_ms",
+                 "backoff_cap_ms")
+
+    def __init__(self, mode: str = "restart", max_restarts: int = 5,
+                 window_s: float = 30.0, backoff_ms: float = 50.0,
+                 backoff_cap_ms: float = 2000.0):
+        if mode not in RESTART_MODES:
+            raise ValueError(
+                f"unknown restart policy {mode!r} (known: {RESTART_MODES})")
+        self.mode = mode
+        self.max_restarts = int(max_restarts)
+        self.window_s = float(window_s)
+        self.backoff_ms = float(backoff_ms)
+        self.backoff_cap_ms = float(backoff_cap_ms)
+
+    @classmethod
+    def from_conf(cls) -> Optional["RestartPolicy"]:
+        """The conf'd default policy (``[recovery] policy`` /
+        ``NNSTPU_RECOVERY_POLICY``); None means fail-pipeline."""
+        from ..conf import conf
+
+        mode = (conf.get("recovery", "policy", "") or "").strip()
+        if not mode or mode == "fail-pipeline":
+            return None
+        return cls(
+            mode,
+            max_restarts=conf.get_int("recovery", "max_restarts", 5),
+            window_s=conf.get_float("recovery", "window_s", 30.0),
+            backoff_ms=conf.get_float("recovery", "backoff_ms", 50.0),
+            backoff_cap_ms=conf.get_float("recovery", "backoff_cap_ms",
+                                          2000.0),
+        )
 
 
 class Pipeline:
@@ -47,6 +100,13 @@ class Pipeline:
         self._lock = threading.Lock()
         self._xplane_tracing = False
         self._tracers: List = []  # attached obs tracers (GST_TRACERS analog)
+        # supervised recovery (restart policies + watchdog escalation)
+        self._restart_policies: Dict[str, RestartPolicy] = {}
+        self._conf_policy: Optional[RestartPolicy] = None
+        self._recovery_lock = threading.Lock()
+        self._restart_log: Dict[str, List[float]] = {}   # node -> timestamps
+        self._recovery_counts: Dict[str, int] = {}       # action -> count
+        self._shed_frames: Dict[str, int] = {}           # node -> frames shed
 
     # -- graph construction -------------------------------------------------
 
@@ -83,6 +143,225 @@ class Pipeline:
     def link_chain(self, *nodes: Union[Node, str]) -> None:
         for a, b in zip(nodes, nodes[1:]):
             self.link(a, b)
+
+    # -- supervised recovery ------------------------------------------------
+
+    def set_restart_policy(self, node: Union[Node, str] = "*",
+                           mode: str = "restart",
+                           max_restarts: int = 5, window_s: float = 30.0,
+                           backoff_ms: float = 50.0,
+                           backoff_cap_ms: float = 2000.0) -> RestartPolicy:
+        """Install a supervision policy for one node (``"*"`` = every
+        node without a specific one).  See :class:`RestartPolicy`."""
+        name = node.name if isinstance(node, Node) else str(node)
+        pol = RestartPolicy(mode, max_restarts=max_restarts,
+                            window_s=window_s, backoff_ms=backoff_ms,
+                            backoff_cap_ms=backoff_cap_ms)
+        self._restart_policies[name] = pol
+        return pol
+
+    def restart_policy_for(self, name: str) -> Optional[RestartPolicy]:
+        """Node-specific policy, else the ``"*"`` default, else the
+        conf'd ``[recovery] policy`` (resolved at start); None means
+        fail-pipeline."""
+        pol = self._restart_policies.get(name)
+        if pol is None:
+            pol = self._restart_policies.get("*")
+        return pol if pol is not None else self._conf_policy
+
+    def _bump(self, action: str) -> None:
+        with self._recovery_lock:
+            self._recovery_counts[action] = \
+                self._recovery_counts.get(action, 0) + 1
+
+    def _count_shed_frame(self, node: Node) -> None:
+        """One frame shed by recovery (restart drop / quarantine shed /
+        queue drain) — the typed-loss side of the frame-accounting
+        ledger the chaos soak balances."""
+        with self._recovery_lock:
+            self._shed_frames[node.name] = \
+                self._shed_frames.get(node.name, 0) + 1
+
+    @staticmethod
+    def _specs_passthrough(node: Node) -> bool:
+        """Quarantine passthrough is only sound when the frames this node
+        would have produced have the same spec as the ones it receives."""
+        sinks = [p.spec for p in node.sink_pads.values() if p.peer is not None]
+        srcs = [p.spec for p in node.src_pads.values() if p.peer is not None]
+        return (len(sinks) == 1 and bool(srcs)
+                and all(s == sinks[0] for s in srcs))
+
+    def _restart_budget_ok(self, node: Node,
+                           pol: RestartPolicy) -> Optional[int]:
+        """Charge one restart against the node's storm budget; returns the
+        restart ordinal (for backoff) or None when the budget is spent."""
+        now = time.monotonic()
+        with self._recovery_lock:
+            log = self._restart_log.setdefault(node.name, [])
+            log[:] = [t for t in log if now - t <= pol.window_s]
+            if len(log) >= pol.max_restarts:
+                return None
+            log.append(now)
+            return len(log)
+
+    def _attempt_restart(self, node: Node, exc: BaseException,
+                         pol: RestartPolicy, action: str) -> bool:
+        from ..obs import recovery as _recovery
+
+        n = self._restart_budget_ok(node, pol)
+        if n is None:
+            # restart storm: stop resuscitating, escalate to pipeline
+            # failure (the caller falls through to post_error)
+            _recovery.record(self.name, action, "storm", node.name,
+                             repr(exc))
+            return False
+        backoff_s = min(pol.backoff_cap_ms,
+                        pol.backoff_ms * (2 ** (n - 1))) / 1e3
+        if backoff_s > 0:
+            time.sleep(backoff_s)
+        try:
+            node.stop()
+            node.start()
+            # restore negotiated state: re-run the commit phase against
+            # the current pad specs — a fresh-started filter must
+            # re-install its fused wrapper and recompile for the stream
+            # it is actually on, not rediscover it from raw frames
+            # (fusion folds pre-transforms INTO the filter, so the raw
+            # spec alone would mis-reconcile)
+            in_specs = {p.name: p.spec for p in node.sink_pads.values()
+                        if p.peer is not None and p.spec is not None}
+            if in_specs:
+                node.configure(in_specs)
+        except Exception as rexc:  # noqa: BLE001 — restart itself failed
+            _recovery.record(self.name, action, "error", node.name,
+                             repr(rexc))
+            return False
+        self._bump(action)
+        _recovery.record(self.name, action, "ok", node.name, repr(exc))
+        return True
+
+    def _node_fault(self, node: Node, exc: BaseException) -> bool:
+        """A node's ``process()`` raised: consult its restart policy.
+        True = handled (frame dropped, node restarted or quarantined);
+        False = propagate to ``post_error`` as before."""
+        if self.state != "PLAYING":
+            return False
+        pol = self.restart_policy_for(node.name)
+        if pol is None or pol.mode == "fail-pipeline":
+            return False
+        from ..obs import recovery as _recovery
+
+        if pol.mode == "quarantine-passthrough":
+            node._quarantine_passthrough = self._specs_passthrough(node)
+            node._quarantined = True
+            self._bump("quarantine")
+            self._count_shed_frame(node)  # the offending frame is shed
+            _recovery.record(self.name, "quarantine", "ok", node.name,
+                             repr(exc))
+            return True
+        if not self._attempt_restart(node, exc, pol, "restart_node"):
+            return False
+        self._count_shed_frame(node)
+        return True
+
+    def _source_fault(self, node: SourceNode, exc: BaseException) -> bool:
+        """A source's ``frames()`` raised: only ``restart`` applies (a
+        quarantined source is just a dead stream).  Restarting re-enters
+        ``frames()`` from scratch — right for live sources; a finite data
+        source replays (document, don't surprise)."""
+        pol = self.restart_policy_for(node.name)
+        if pol is None or pol.mode != "restart":
+            return False
+        return self._attempt_restart(node, exc, pol, "restart_source")
+
+    def restart_source(self, name: str) -> bool:
+        """Watchdog escalation: replace a stalled source's streaming
+        thread.  The stuck thread is joined briefly, then abandoned with
+        a bumped epoch (it exits on unblock instead of double-pushing);
+        the source restarts and streams on a fresh thread."""
+        from ..obs import recovery as _recovery
+
+        node = self.nodes.get(name)
+        if not isinstance(node, SourceNode) or self.state != "PLAYING":
+            return False
+        node._epoch += 1
+        node.request_stop()
+        interrupt = getattr(node, "interrupt", None)
+        if interrupt is not None:
+            try:
+                interrupt()
+            except Exception:  # noqa: BLE001
+                pass
+        for t in [t for t in self.threads if t.name == f"src:{name}"]:
+            t.join(timeout=2.0)
+            self.threads.remove(t)
+        node._stop_evt.clear()
+        try:
+            node.stop()
+            node.start()
+        except Exception as exc:  # noqa: BLE001
+            _recovery.record(self.name, "restart_source", "error", name,
+                             repr(exc))
+            return False
+        self._bump("restart_source")
+        if _hooks.enabled:
+            _hooks.emit("source_spawn", self, node)
+        t = threading.Thread(
+            target=self._source_loop, args=(node,), name=f"src:{name}",
+            daemon=True,
+        )
+        self.threads.append(t)
+        t.start()
+        _recovery.record(self.name, "restart_source", "ok", name)
+        return True
+
+    def recover_queue(self, name: str) -> int:
+        """Watchdog escalation: drain a wedged queue (shed its backlog
+        with typed accounting, preserving in-band events) and respawn its
+        worker if the thread died.  Returns frames drained, -1 when the
+        node cannot recover."""
+        from ..obs import recovery as _recovery
+
+        node = self.nodes.get(name)
+        rec = getattr(node, "recover", None)
+        if rec is None:
+            _recovery.record(self.name, "drain_queue", "error", name,
+                             "node has no recover()")
+            return -1
+        try:
+            drained, new_threads = rec()
+        except Exception as exc:  # noqa: BLE001
+            _recovery.record(self.name, "drain_queue", "error", name,
+                             repr(exc))
+            return -1
+        for t in new_threads:
+            t.daemon = True
+            self.threads.append(t)
+            t.start()
+        with self._recovery_lock:
+            if drained:
+                self._shed_frames[name] = \
+                    self._shed_frames.get(name, 0) + drained
+        self._bump("drain_queue")
+        _recovery.record(self.name, "drain_queue", "ok", name,
+                         f"drained={drained}")
+        return drained
+
+    def recovery_stats(self) -> dict:
+        """Self-healing ledger: actions taken, frames shed per node (the
+        typed-loss side of delivered + shed == offered), quarantined
+        nodes."""
+        with self._recovery_lock:
+            out: dict = {}
+            if self._recovery_counts:
+                out["actions"] = dict(self._recovery_counts)
+            if self._shed_frames:
+                out["shed_frames"] = dict(self._shed_frames)
+                out["shed_total"] = sum(self._shed_frames.values())
+        quarantined = [n.name for n in self.nodes.values() if n._quarantined]
+        if quarantined:
+            out["quarantined"] = quarantined
+        return out
 
     # -- negotiation --------------------------------------------------------
 
@@ -140,6 +419,20 @@ class Pipeline:
         self._done.clear()
         self._error = None
         self._eos_leaves.clear()
+        self._conf_policy = RestartPolicy.from_conf()
+        with self._recovery_lock:
+            self._restart_log.clear()
+            self._recovery_counts.clear()
+            self._shed_frames.clear()
+        for node in self.nodes.values():
+            node._quarantined = False
+            node._quarantine_passthrough = False
+        # conf-driven chaos activation (NNSTPU_FAULTS), same posture as
+        # the tracers below: a bad spec must fail loudly at start, not
+        # silently run without its faults
+        from ..faults import ensure_configured as _faults_configure
+
+        _faults_configure()
         fuse_undos = []
         if self.auto_fuse:
             from .optimize import fuse_transforms
@@ -212,19 +505,31 @@ class Pipeline:
         return self
 
     def _source_loop(self, node: SourceNode) -> None:
-        try:
-            for frame in node.frames():
-                if node.stopped or self.state != "PLAYING":
-                    break
-                if _hooks.enabled:
-                    # pre-chain: the latency tracer stamps frame identity
-                    # here, before the first pad push
-                    _hooks.emit("source_push", self, node, frame)
-                node.push(frame)
-            for pad in node.src_pads.values():
-                pad.push(Event.eos())
-        except BaseException as exc:  # noqa: BLE001 - report any node failure
-            self.post_error(node, exc)
+        epoch = node._epoch
+        while True:
+            try:
+                for frame in node.frames():
+                    if (node.stopped or node._epoch != epoch
+                            or self.state != "PLAYING"):
+                        break
+                    if _hooks.enabled:
+                        # pre-chain: the latency tracer stamps frame
+                        # identity here, before the first pad push
+                        _hooks.emit("source_push", self, node, frame)
+                    node.push(frame)
+                if node._epoch != epoch:
+                    return  # superseded by restart_source: not our EOS
+                for pad in node.src_pads.values():
+                    pad.push(Event.eos())
+                return
+            except BaseException as exc:  # noqa: BLE001 - any node failure
+                if node._epoch != epoch:
+                    return  # a replacement thread owns this source now
+                if (self.state == "PLAYING" and not node.stopped
+                        and self._source_fault(node, exc)):
+                    continue  # restarted: re-enter frames() fresh
+                self.post_error(node, exc)
+                return
 
     def post_error(self, node: Node, exc: BaseException) -> None:
         with self._lock:
@@ -232,6 +537,14 @@ class Pipeline:
             if first:
                 self._error = exc
                 self._error_node = node.name if node else None
+        if first and self.state == "PLAYING":
+            # flip to ERROR so every source loop (they poll the state per
+            # frame) stops feeding a dead graph; stop() still runs the
+            # full STOPPED teardown from here (threads joined, nodes
+            # stopped, tracers detached)
+            self.state = "ERROR"
+            if _hooks.enabled:
+                _hooks.emit("state_change", self, "PLAYING", "ERROR")
         if _hooks.enabled:
             _hooks.emit("error", self, node, exc)
         traceback.print_exception(type(exc), exc, exc.__traceback__)
@@ -263,12 +576,17 @@ class Pipeline:
         return finished
 
     def stop(self) -> None:
-        if self.state != "PLAYING":
+        if self.state not in ("PLAYING", "ERROR"):
             self.state = "STOPPED"
             return
+        # an errored pipeline (post_error flipped PLAYING → ERROR) takes
+        # the FULL teardown: source threads are joined and every node runs
+        # its STOPPED transition — a graph that died early must not leak
+        # streaming threads behind the PipelineError its waiter sees
+        prev = self.state
         self.state = "STOPPED"
         if _hooks.enabled:
-            _hooks.emit("state_change", self, "PLAYING", "STOPPED")
+            _hooks.emit("state_change", self, prev, "STOPPED")
         # dot dump on EVERY transition (tracers are still connected here,
         # so the STOPPED dump carries final frame counts / queue depths)
         self._dump_dot("STOPPED")
@@ -412,6 +730,9 @@ class Pipeline:
         out = {k: v for k, v in all_stats.items() if k in self.nodes}
         if self._tracers:
             out["tracers"] = {t.name: t.summary() for t in self._tracers}
+        rec = self.recovery_stats()
+        if rec:
+            out["recovery"] = rec
         return out
 
     def flight_snapshot(self) -> list:

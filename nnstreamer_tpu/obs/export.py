@@ -88,6 +88,43 @@ def unregister_health(name: str, fn: Optional[Callable] = None) -> None:
             _health_providers.pop(name, None)
 
 
+_degraded_lock = threading.Lock()
+_degraded_providers: Dict[str, Callable[[], str]] = {}
+
+
+def register_degraded(name: str, fn: Callable[[], str]) -> Callable:
+    """Register a *degraded* provider: a callable returning a reason
+    string ("" = fine).  Degradation is service-continuity with reduced
+    capability (e.g. a filter backend that fell back to CPU after a
+    device loss) — ``/healthz`` stays **200** but its body carries the
+    reasons, so operators see the reduced state without probes declaring
+    an outage."""
+    with _degraded_lock:
+        _degraded_providers[name] = fn
+    return fn
+
+
+def unregister_degraded(name: str, fn: Optional[Callable] = None) -> None:
+    with _degraded_lock:
+        if fn is None or _degraded_providers.get(name) is fn:
+            _degraded_providers.pop(name, None)
+
+
+def degraded_snapshot() -> Dict[str, str]:
+    """{provider: reason} for every provider currently degraded."""
+    with _degraded_lock:
+        providers = dict(_degraded_providers)
+    out: Dict[str, str] = {}
+    for name, fn in providers.items():
+        try:
+            reason = fn()
+        except Exception as exc:  # noqa: BLE001
+            reason = f"degraded provider raised: {exc!r}"
+        if reason:
+            out[name] = reason
+    return out
+
+
 def health_snapshot() -> Tuple[bool, Dict[str, str]]:
     """(overall healthy, {provider: reason for each unhealthy one}).  A
     raising provider counts as unhealthy — a broken watchdog must not
@@ -189,7 +226,18 @@ class MetricsServer:
                 elif path == "/healthz":
                     healthy, failures = health_snapshot()
                     if healthy:
-                        self._reply(b"ok\n", "text/plain; charset=utf-8")
+                        degraded = degraded_snapshot()
+                        if degraded:
+                            # degraded-but-serving: 200 (no outage), the
+                            # body names what was given up
+                            body = "ok (degraded)\n" + "".join(
+                                f"{name}: {reason}\n"
+                                for name, reason in sorted(degraded.items()))
+                            self._reply(body.encode("utf-8"),
+                                        "text/plain; charset=utf-8")
+                        else:
+                            self._reply(b"ok\n",
+                                        "text/plain; charset=utf-8")
                     else:
                         body = "unhealthy\n" + "".join(
                             f"{name}: {reason}\n"

@@ -50,6 +50,16 @@ must stay allocation-light):
 ``health``         ``(pipeline, healthy, reason)`` — the pipeline
                    watchdog flipped health state (``reason`` names the
                    stalled source / wedged queue / overdue dispatch).
+``fault``          ``(point, kind, target)`` — the chaos engine
+                   (:mod:`nnstreamer_tpu.faults`) injected a fault at
+                   an instrumented point.
+``recovery``       ``(pipeline_name, action, target, result)`` — a
+                   self-healing action ran (node restart, quarantine,
+                   watchdog escalation, backend CPU fallback);
+                   ``result`` is ``ok``/``error``/``storm``/
+                   ``escalate``.  The first argument is the pipeline
+                   NAME (string, may be empty for backend-level
+                   actions), not the object.
 =================  ====================================================
 
 Timestamps passed through hooks are ``time.perf_counter_ns()`` — every
@@ -82,6 +92,8 @@ HOOKS = (
     "device_dispatch",
     "compile",
     "health",
+    "fault",
+    "recovery",
 )
 
 # The fast-path gate: True iff at least one callback is connected anywhere.

@@ -25,7 +25,13 @@ first-class, observable state:
   black-box readout ``post_error`` produces, for hangs instead of
   crashes;
 - recovery (frames moving again) flips everything back and fires the
-  hook again, so flapping is visible too.
+  hook again, so flapping is visible too;
+- with ``recover=True`` (conf ``[obs] watchdog_recover``) detection
+  escalates to **self-healing**: restart the stalled source, drain the
+  wedged queue (+ respawn a dead worker), trip the circuit breakers for
+  an overdue device — each attempt budget-capped per target and counted
+  in ``nnstpu_recovery_total{action,result}`` (see
+  ``docs/robustness.md``).
 
 A posted pipeline error also marks the pipeline unhealthy — a crashed
 graph should never answer ``/healthz`` with 200.
@@ -57,12 +63,28 @@ class PipelineWatchdog(Tracer):
                  interval_s: Optional[float] = None,
                  stall_s: Optional[float] = None,
                  queue_depth: Optional[int] = None,
-                 device_deadline_s: Optional[float] = None):
+                 device_deadline_s: Optional[float] = None,
+                 recover: Optional[bool] = None,
+                 recover_budget: Optional[int] = None):
+        """``recover=True`` (or conf ``[obs] watchdog_recover``) escalates
+        detection into recovery: a stalled source is restarted
+        (:meth:`Pipeline.restart_source`), a wedged queue is drained +
+        its worker respawned (:meth:`Pipeline.recover_queue`), and an
+        overdue device dispatch trips every live circuit breaker
+        (:func:`nnstreamer_tpu.sched.breaker.trip_all`) so the serving
+        edge sheds typed errors instead of queueing behind the wedge.
+        At most ``recover_budget`` attempts per (kind, target) while
+        unhealthy — budgets reset when health recovers, so a flapping
+        target can be rescued again but never restart-stormed."""
         super().__init__(registry)
         self._interval = interval_s
         self._stall = stall_s
         self._depth_threshold = queue_depth
         self._device_deadline = device_deadline_s
+        self._recover = recover
+        self._recover_budget = recover_budget
+        self._recover_attempts: Dict[tuple, int] = {}
+        self._recoveries = 0
         self._lock = threading.Lock()
         self._src_last: Dict[str, int] = {}     # source -> last push ts_ns
         self._q_state: Dict[str, List[int]] = {}  # queue -> [depth, last_pop]
@@ -102,6 +124,18 @@ class PipelineWatchdog(Tracer):
         if self._device_deadline is None:
             self._device_deadline = self._conf_float(
                 "watchdog_device_deadline_s", DEFAULT_DEVICE_DEADLINE_S)
+        if self._recover is None:
+            try:
+                self._recover = conf.get_bool("obs", "watchdog_recover",
+                                              False)
+            except ValueError:
+                self._recover = False
+        if self._recover_budget is None:
+            try:
+                self._recover_budget = conf.get_int(
+                    "obs", "watchdog_recover_budget", 3)
+            except ValueError:
+                self._recover_budget = 3
         self._gauge = self._registry.gauge(
             "nnstpu_health",
             "Pipeline health as judged by the watchdog (1 healthy, "
@@ -225,8 +259,16 @@ class PipelineWatchdog(Tracer):
                 continue
             if reasons:
                 self._flip(reasons)
+                if self._recover:
+                    try:
+                        self._attempt_recovery(reasons)
+                    except Exception:  # noqa: BLE001 — the monitor survives
+                        import logging
+
+                        logging.getLogger("nnstreamer_tpu.obs").exception(
+                            "watchdog recovery failed")
             else:
-                self._recover()
+                self._recovered()
 
     def _flip(self, reasons: List[str], dump: bool = True) -> None:
         with self._lock:
@@ -256,13 +298,45 @@ class PipelineWatchdog(Tracer):
             # same black-box readout post_error writes, for hangs
             self._pipeline._dump_flight("stall")
 
-    def _recover(self) -> None:
+    def _attempt_recovery(self, reasons: List[str]) -> None:
+        """Escalation: one recovery action per unhealthy reason, budget-
+        capped per (kind, target).  Outcomes land on the shared
+        ``nnstpu_recovery_total`` counter via the pipeline's recovery
+        methods; the breaker-trip path records its own."""
+        from . import recovery as _recovery
+
+        for r in reasons:
+            kind, _, rest = r.partition(":")
+            target = rest.partition(":")[0]
+            key = (kind, target)
+            with self._lock:
+                attempts = self._recover_attempts.get(key, 0)
+                if attempts >= self._recover_budget:
+                    continue
+                self._recover_attempts[key] = attempts + 1
+                self._recoveries += 1
+            if kind == "stalled_source":
+                self._pipeline.restart_source(target)
+            elif kind == "wedged_queue":
+                self._pipeline.recover_queue(target)
+            elif kind == "overdue_device":
+                from ..sched.breaker import trip_all
+
+                n = trip_all(reason=r)
+                _recovery.record(self._pipeline.name, "breaker_trip",
+                                 "ok" if n else "error", target,
+                                 f"tripped={n}")
+
+    def _recovered(self) -> None:
         with self._lock:
             if self._healthy:
                 return
             self._healthy = True
             self._reasons = []
             self._transitions += 1
+            # fresh budgets: a later re-wedge of the same target may be
+            # rescued again (flap accounting stays in _transitions)
+            self._recover_attempts.clear()
         from . import hooks as _hooks
 
         self._gauge.set(1, pipeline=self._pipeline.name)
@@ -287,6 +361,8 @@ class PipelineWatchdog(Tracer):
                 "transitions": self._transitions,
                 "sources": len(self._src_last),
                 "queues": len(self._q_state),
+                "recover": bool(self._recover),
+                "recoveries": self._recoveries,
             }
 
 

@@ -22,9 +22,33 @@ from __future__ import annotations
 
 import threading
 import time
+import weakref
 from typing import Callable
 
 from .admission import CODE_UNAVAILABLE
+
+# Every live breaker, weakly held: the watchdog's overdue-device
+# escalation trips them all (fail fast at the serving edge while the
+# device is sick) without plumbing a reference through every server.
+_ALL: "weakref.WeakSet" = weakref.WeakSet()
+_ALL_LOCK = threading.Lock()
+
+
+def all_breakers():
+    """Snapshot of live breakers (weak registry)."""
+    with _ALL_LOCK:
+        return list(_ALL)
+
+
+def trip_all(reason: str = "forced") -> int:
+    """Force-open every live breaker; returns how many tripped.  The
+    watchdog calls this when a device dispatch blows its deadline — new
+    requests shed typed UNAVAILABLE instead of queueing behind a wedge,
+    and the normal half-open probe path discovers recovery."""
+    breakers = all_breakers()
+    for b in breakers:
+        b.trip(reason)
+    return len(breakers)
 
 CLOSED, OPEN, HALF_OPEN = "closed", "open", "half_open"
 # numeric encoding for the state gauge (Prometheus can't label strings)
@@ -60,6 +84,9 @@ class CircuitBreaker:
         self._probes = 0          # in-flight half-open probes
         self.trips = 0            # closed/half-open -> open transitions
         self.rejected = 0         # allow() refusals
+        self.forced_trips = 0     # trip() calls (watchdog escalation)
+        with _ALL_LOCK:
+            _ALL.add(self)
 
     @property
     def state(self) -> str:
@@ -116,6 +143,21 @@ class CircuitBreaker:
                 self._opened_at = self._clock()
                 self.trips += 1
 
+    def trip(self, reason: str = "forced") -> None:
+        """Force the breaker open NOW (watchdog escalation for an overdue
+        device): requests shed until the reset timeout's half-open probe
+        confirms the backend is answering again.  Idempotent while open."""
+        del reason  # recorded by the caller (obs.recovery)
+        with self._lock:
+            self.forced_trips += 1
+            if self._state == OPEN:
+                self._opened_at = self._clock()  # restart the timeout
+                return
+            self._state = OPEN
+            self._opened_at = self._clock()
+            self._probes = 0
+            self.trips += 1
+
     def call(self, fn: Callable[[], object]):
         """Run ``fn`` under the breaker: gate, invoke, record outcome."""
         self.allow()
@@ -134,6 +176,7 @@ class CircuitBreaker:
                 "state": self._state,
                 "consecutive_failures": self._failures,
                 "trips": self.trips,
+                "forced_trips": self.forced_trips,
                 "rejected": self.rejected,
                 "failure_threshold": self.failure_threshold,
                 "reset_timeout_s": self.reset_timeout_s,

@@ -590,6 +590,10 @@ class DecodeServer:
         self._conns_lock = threading.Lock()
 
     def start(self) -> "DecodeServer":
+        from . import faults as _faults
+
+        # chaos runs cover this front door too (NNSTPU_FAULTS)
+        _faults.ensure_configured()
         self._srv = socket.create_server((self.host, self.port))
         self.port = self._srv.getsockname()[1]
         self._running = True
@@ -744,8 +748,15 @@ class DecodeServer:
                         pass
                     return
                 except (ValueError, RuntimeError, TimeoutError) as exc:
+                    # a dead/failed engine is a typed UNAVAILABLE (the
+                    # stock client raises QueryUnavailableError and its
+                    # stateful mode fails fast instead of replaying);
+                    # geometry mistakes stay plain-text errors
+                    code = ("UNAVAILABLE"
+                            if isinstance(exc, RuntimeError)
+                            and not isinstance(exc, ValueError) else "")
                     try:
-                        send_error(conn, f"decode server: {exc}")
+                        send_error(conn, f"decode server: {exc}", code=code)
                     except OSError:
                         return
                     if isinstance(exc, (RuntimeError, TimeoutError)):

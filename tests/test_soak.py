@@ -10,7 +10,7 @@ come out of both branches exactly once, in order, with correct values.
 
 import numpy as np
 
-from nnstreamer_tpu import Pipeline
+from nnstreamer_tpu import Pipeline, faults
 from nnstreamer_tpu.backends.jax_backend import JaxModel
 from nnstreamer_tpu.buffer import Frame
 from nnstreamer_tpu.elements.dynbatch import DynBatch, DynUnbatch
@@ -76,3 +76,65 @@ def test_soak_mixed_topology_with_renegotiation():
     for i in range(total):
         assert got_a[i] == golden(i), (i, got_a[i], golden(i))
         assert got_b[i] == golden(i), (i, got_b[i], golden(i))
+
+
+def test_chaos_soak_seeded_fault_injection():
+    """Chaos soak: a seeded fault mix (raising + delayed invokes) over N
+    frames with a restart policy on the filter.  The pipeline must end
+    healthy, the frame ledger must balance exactly (delivered + typed
+    sheds == offered, zero silent losses), recovery actions must match
+    injected raises one-for-one, and the identical seed must reproduce
+    the identical injection sequence."""
+    n = 400
+    spec = "seed=1234;invoke_raise@f:rate=0.03;invoke_delay@f:rate=0.02,ms=1"
+    eng = faults.install(spec)
+    try:
+        got = []
+        p = Pipeline(name="chaos_soak")
+        src = p.add(DataSrc(data=[
+            Frame.of(np.full(4, float(i), np.float32), pts=i)
+            for i in range(n)]))
+        q = p.add(Queue(max_size_buffers=64, name="qsoak"))
+        filt = p.add(TensorFilter(framework="custom",
+                                  model=lambda x: x * 2.0, name="f"))
+        sink = p.add(TensorSink(name="out"))
+        sink.connect(
+            "new-data",
+            lambda fr: got.append((fr.pts,
+                                   float(np.asarray(fr.tensor(0))[0]))))
+        p.link_chain(src, q, filt, sink)
+        p.set_restart_policy("f", mode="restart", backoff_ms=1,
+                             backoff_cap_ms=4, max_restarts=1000,
+                             window_s=300.0)
+        p.run(timeout=600)
+
+        raises = eng.injections.get("invoke_raise", 0)
+        delays = eng.injections.get("invoke_delay", 0)
+        assert raises > 0 and delays > 0, eng.stats()  # the seed did inject
+
+        # pipeline ended healthy: clean EOS, no posted error
+        assert p.state == "STOPPED" and p._error is None
+
+        # frame accounting balances: delivered + typed sheds == offered
+        rec = p.recovery_stats()
+        assert rec["actions"]["restart_node"] == raises  # recovery == faults
+        assert rec["shed_total"] == raises
+        assert len(got) + rec["shed_total"] == n
+
+        # delivered frames are correct and in order (no silent corruption)
+        shed_pts = {pts for pts in range(n)} - {pts for pts, _ in got}
+        assert len(shed_pts) == raises
+        assert [pts for pts, _ in got] == sorted(pts for pts, _ in got)
+        for pts, val in got:
+            assert val == 2.0 * pts, (pts, val)
+
+        # replay: a fresh engine from the same spec+seed, driven by the
+        # same opportunity stream (one decide per offered frame), makes
+        # byte-identical decisions
+        replay = faults.ChaosEngine(spec)
+        for _ in range(n):
+            replay.decide("backend_invoke", "f")
+        assert replay.log == eng.log
+        assert replay.injections == eng.injections
+    finally:
+        faults.deactivate()

@@ -421,6 +421,93 @@ print(f"scheduling smoke OK: {st['dispatched']} scheduled dispatches, "
       "nnstpu_sched_*")
 PY
 
+run_step "Chaos smoke (injected faults + self-healing + retrying client)" \
+  env NNSTPU_FAULTS="seed=7;socket_drop@server:every=4,count=3;queue_wedge@cq:after=1,ms=1500" \
+  python - <<'PY'
+import time
+import urllib.error
+import urllib.request
+
+import jax
+jax.config.update('jax_platforms', 'cpu')
+import numpy as np
+
+from nnstreamer_tpu import Pipeline, faults
+from nnstreamer_tpu.buffer import Frame
+from nnstreamer_tpu.elements.query import QueryServer, TensorQueryClient
+from nnstreamer_tpu.elements.queue import Queue
+from nnstreamer_tpu.elements.sink import TensorSink
+from nnstreamer_tpu.elements.testsrc import DataSrc
+from nnstreamer_tpu.obs import export
+from nnstreamer_tpu.obs.watchdog import PipelineWatchdog
+from nnstreamer_tpu.spec import TensorSpec, TensorsSpec
+
+VEC4 = TensorsSpec.of(TensorSpec(dtype=np.float32, shape=(4,)))
+
+# -- 1: the server's reply socket is dropped mid-stream (a killed worker,
+# as the client sees it); the retrying client must ride through to success
+with QueryServer(framework="custom", model=lambda x: x * 2.0) as srv:
+    cli = TensorQueryClient(host="127.0.0.1", port=srv.port, out_spec=VEC4,
+                            request_timeout=30.0, retries=3,
+                            retry_backoff_ms=10, name="chaos_cli")
+    cli.start()
+    for i in range(12):
+        out = cli.process(
+            None, Frame.of(np.full(4, float(i), np.float32), pts=i))
+        np.testing.assert_allclose(np.asarray(out.tensor(0)), 2.0 * i)
+eng = faults.engine()
+drops = eng.injections.get("socket_drop", 0)
+assert drops == 3, eng.stats()
+assert cli.retries_total == drops, (cli.retries_total, drops)
+
+# -- 2: a queue wedges under NNSTPU_FAULTS; the recovering watchdog must
+# flag it (503), drain it, and /healthz must return to 200 in the window
+server = export.ensure_server(0)
+n = 60
+got = []
+p = Pipeline(name="chaos_ci")
+src = p.add(DataSrc(data=[Frame.of(np.full(4, float(i), np.float32), pts=i)
+                          for i in range(n)]))
+q = p.add(Queue(max_size_buffers=200, name="cq"))
+sink = p.add(TensorSink(name="out"))
+sink.connect("new-data", lambda fr: got.append(fr.pts))
+p.link_chain(src, q, sink)
+p.attach_tracer(PipelineWatchdog(interval_s=0.05, stall_s=0.2,
+                                 recover=True))
+p.start()
+
+
+def healthz():
+    try:
+        with urllib.request.urlopen(
+                f"http://{server.host}:{server.port}/healthz",
+                timeout=10) as r:
+            return r.status
+    except urllib.error.HTTPError as e:
+        return e.code
+
+
+deadline = time.time() + 30
+while time.time() < deadline and healthz() != 503:
+    time.sleep(0.02)
+assert healthz() == 503, "watchdog never flagged the wedged queue"
+assert p.wait(timeout=60), "pipeline did not reach EOS after recovery"
+deadline = time.time() + 10
+while time.time() < deadline and healthz() != 200:
+    time.sleep(0.05)
+code = healthz()
+rec = p.recovery_stats()
+p.stop()
+export.shutdown_server()
+assert code == 200, f"/healthz stuck at {code} after recovery"
+assert rec["actions"].get("drain_queue", 0) >= 1, rec
+assert len(got) + rec.get("shed_total", 0) == n, (len(got), rec)
+print(f"chaos smoke OK: {drops} injected socket drops all retried to "
+      f"success; watchdog drained the wedged queue (shed "
+      f"{rec['shed_total']} typed), ledger balances "
+      f"{len(got)}+{rec['shed_total']}=={n}, /healthz back to 200")
+PY
+
 run_step "Bench smoke (final JSON line parses, rc=0)" \
   bash -c '
     env BENCH_FRAMES=10 BENCH_QUANT_FRAMES=4 BENCH_BASELINE_FRAMES=3 \
