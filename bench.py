@@ -45,6 +45,28 @@ import traceback
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
+
+def _apply_mesh_flag(argv):
+    """``--mesh[=SPEC]`` (default auto): bench the mesh-sharded dispatch
+    lane — exports NNSTPU_MESH for the whole run and, on a CPU host,
+    forces an 8-device virtual mesh so the sweep is runnable without a
+    chip.  Must run before any jax backend initializes."""
+    mesh = None
+    for arg in list(argv):
+        if arg == "--mesh" or arg.startswith("--mesh="):
+            mesh = arg.partition("=")[2] or "auto"
+            argv.remove(arg)
+    if mesh is None:
+        return
+    os.environ["NNSTPU_MESH"] = mesh
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+
+
+_apply_mesh_flag(sys.argv)
+
 import numpy as np  # noqa: E402
 
 NORMALIZE = "typecast:float32,add:-127.5,div:127.5"
@@ -383,10 +405,11 @@ def run_dynbatch_fps(frames, max_batch=8, upload=False, poly_model=None,
     backend = get_backend("jax")
     # linear dynbatch chain: coalesced upload buffers are single-use
     backend.open(poly_model, custom="donate=1" if upload else "")
+    ndev = backend.mesh_devices() if hasattr(backend, "mesh_devices") else 1
     b = 1
-    while b <= max_batch:  # prime every bucket's executable (LRU-cached)
-        backend.reconfigure(TensorsSpec.of(
-            TensorSpec(dtype=frame_dtype, shape=(b,) + frame_shape)
+    while b <= max_batch:  # prime every bucket's executable (LRU-cached);
+        backend.reconfigure(TensorsSpec.of(  # mesh buckets are ndev × pow-2
+            TensorSpec(dtype=frame_dtype, shape=(b * ndev,) + frame_shape)
         ))
         b <<= 1
 
@@ -1682,6 +1705,18 @@ def main(standalone=False):
         errors.append("no accelerator registered; CPU-only measurements")
     rep.platform = platform
     log(f"# jax platform: {platform or 'cpu-fallback'}")
+    try:
+        from nnstreamer_tpu.parallel.mesh import dispatch_mesh_devices
+
+        mesh_ndev = dispatch_mesh_devices()
+    except Exception:  # noqa: BLE001 — mesh introspection never sinks a run
+        mesh_ndev = 1
+    if mesh_ndev > 1:
+        # --mesh / NNSTPU_MESH: every jax leg below dispatches batch-axis
+        # sharded over this many chips; per-shard batch = batch / chips
+        results["mesh_devices"] = mesh_ndev
+        log(f"# mesh-sharded dispatch: {mesh_ndev} chips "
+            f"(NNSTPU_MESH={os.environ.get('NNSTPU_MESH', '')!r})")
     cpu_shrunk = []
     if platform in (None, "cpu"):
         # CPU-fallback legs prove plumbing, not perf (the notes say so in
@@ -1798,8 +1833,15 @@ def main(standalone=False):
         results["config1_dynbatch_max"] = maxb
         results["config1_dynbatch_invokes"] = d_batches
         results["config1_dynbatch_frames"] = d_frames
+        if mesh_ndev > 1:
+            # mesh lane: max_batch is PER SHARD — one invoke spans up to
+            # maxb × chips rows across the whole mesh
+            results["config1_dynbatch_per_shard"] = maxb
+            results["config1_dynbatch_mesh_span"] = maxb * mesh_ndev
         log(f"# config1 dynbatch fps: {d_fps:.2f} "
-            f"({d_batches} invokes / {d_frames} frames)")
+            f"({d_batches} invokes / {d_frames} frames"
+            + (f", {mesh_ndev} chips × {maxb}/shard" if mesh_ndev > 1
+               else "") + ")")
 
     # -- config #1du: dynbatch + upload overlap — coalesced batches cross
     #    the wire in the dynbatch worker while the queue worker dispatches

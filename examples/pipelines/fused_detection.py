@@ -78,11 +78,21 @@ def main():
          int(r[2] * SIZE), int(r[3] * SIZE)): (int(r[4]), float(r[5]))
         for r in det if r[5] >= DETECTION_THRESHOLD
     }
-    ok = len(ref) == len(dev) and all(
-        (o.x, o.y, o.width, o.height) in dev
-        and dev[(o.x, o.y, o.width, o.height)][0] == o.class_id
-        for o in ref
-    )
+
+    def match(o):
+        # the fused-XLA and numpy decodes are both float32 pipelines read
+        # through int() truncation: a coordinate landing within a ULP of
+        # an integer boundary may round apart by one pixel between them,
+        # so boxes match within ±1px per coordinate (classes exactly)
+        for key, (cls, _prob) in dev.items():
+            if cls == o.class_id and all(
+                abs(a - b) <= 1
+                for a, b in zip(key, (o.x, o.y, o.width, o.height))
+            ):
+                return True
+        return False
+
+    ok = len(ref) == len(dev) and all(match(o) for o in ref)
     print(f"golden={'OK' if ok else 'MISMATCH'} ({len(ref)} detections)")
     if not ok:
         raise SystemExit(1)

@@ -32,6 +32,12 @@ Model resolution accepts:
 ``jax-sharded`` compiles the same function with ``NamedSharding`` over a
 device mesh: the batch dim shards across cores (ICI), params replicate —
 the TPU-native replacement for "one interpreter per element" concurrency.
+With the process-wide dispatch mesh (conf ``[mesh]`` / ``NNSTPU_MESH=dp:8``,
+``parallel/mesh.py``) the PLAIN ``jax`` backend shards too: every geometry
+whose leading dim divides the mesh compiles batch-axis-sharded executables
+keyed by (geometry, mesh) in the LRU cache, so one dynbatch invoke spreads
+``ndev ×`` the batch at roughly single-chip latency
+(docs/performance.md "Mesh-sharded dispatch").
 """
 
 from __future__ import annotations
@@ -178,6 +184,30 @@ def parse_custom(custom: str) -> dict:
 DEFAULT_COMPILE_CACHE = 8
 
 
+def flat_wire_shape(shape: Tuple[int, ...]) -> Tuple[int, ...]:
+    """Host-wire shape for a single-device input: rank ≥ 2 tensors flatten
+    to 1-D so the transfer skips tiled-layout padding; reshaped back on
+    device.  (Module-level: ``tensor_upload`` uses this as its default
+    wire rule when no backend is discoverable downstream.)"""
+    if len(shape) < 2:
+        return tuple(shape)
+    n = 1
+    for d in shape:
+        n *= d
+    return (n,)
+
+
+def batched_wire_shape(shape: Tuple[int, ...]) -> Tuple[int, ...]:
+    """Mesh wire shape: keep the (sharded) batch dim, flatten the rest —
+    the wire layout stays cheap and the batch still shards over the mesh."""
+    if len(shape) < 3:
+        return tuple(shape)
+    n = 1
+    for d in shape[1:]:
+        n *= d
+    return (shape[0], n)
+
+
 @register_backend("jax")
 class JaxBackend(FilterBackend):
     device_resident = True
@@ -227,6 +257,16 @@ class JaxBackend(FilterBackend):
         self._cpu_device = None
         self._degraded_key: Optional[str] = None
         self._degraded_fn = None
+        # mesh-sharded dispatch (parallel/mesh.py dispatch_mesh, conf
+        # [mesh] / NNSTPU_MESH): when a dispatch mesh is configured, every
+        # shardable geometry compiles with the batch axis NamedSharding'd
+        # over it — set per compile, consumed by _jit/wire_input_sharding;
+        # the compiled entries' in_shardings are kept so invoke() can
+        # re-place committed device inputs from a different placement
+        self._mesh = None
+        self._mesh_axis = "dp"
+        self._in_shardings = None
+        self._wire_in_shardings = None
 
     # -- open/close ---------------------------------------------------------
 
@@ -355,25 +395,68 @@ class JaxBackend(FilterBackend):
     def _spec_key(spec: TensorsSpec) -> tuple:
         return tuple((np.dtype(t.dtype).str, tuple(t.shape)) for t in spec.tensors)
 
-    @staticmethod
-    def _wire_shape(shape: Tuple[int, ...]) -> Tuple[int, ...]:
-        """Host-wire shape for an input: rank ≥ 2 tensors flatten to 1-D so
-        the transfer skips tiled-layout padding; reshaped back on device.
-        (Static: ``tensor_upload`` reuses this as its default wire rule.)"""
-        if len(shape) < 2:
-            return tuple(shape)
-        n = 1
-        for d in shape:
-            n *= d
-        return (n,)
+    # -- mesh-sharded dispatch ----------------------------------------------
+
+    def _mesh_config(self):
+        """``(mesh, axis)`` this backend shards dispatch over, or ``(None,
+        axis)``.  The base backend follows the process-wide dispatch mesh
+        (conf ``[mesh]`` / ``NNSTPU_MESH`` — parallel/mesh.py); the
+        ``jax-sharded`` subclass overrides with its ``custom=`` mesh.  A
+        degraded backend never shards (the fallback CPU client has one
+        device)."""
+        if self._degraded is not None:
+            return None, "dp"
+        from ..parallel.mesh import dispatch_mesh, dispatch_mesh_axis
+
+        return dispatch_mesh(), dispatch_mesh_axis()
+
+    def mesh_devices(self) -> int:
+        """Device count of this backend's dispatch mesh (1 = unsharded) —
+        the batch elements and the query server size their buckets in
+        per-shard multiples of this (``residency.consumer_mesh_devices``)."""
+        mesh, _ = self._mesh_config()
+        return int(mesh.devices.size) if mesh is not None else 1
+
+    def _shard_this_compile(self, in_spec: TensorsSpec, mesh) -> bool:
+        """Shard only geometries whose every leading dim divides the mesh
+        evenly: the hot-path batchers emit ndev-multiples by construction,
+        and an odd drift shape (bucket 1 on an 8-mesh, rank-0 scalars)
+        falls back to a single-device executable instead of an uneven
+        sharding — correctness is never conditional on the mesh."""
+        ndev = int(mesh.devices.size)
+        for t in in_spec.tensors:
+            if t.rank < 1 or not t.shape or t.shape[0] is None:
+                return False
+            if t.shape[0] % ndev != 0 or t.shape[0] == 0:
+                return False
+        return True
+
+    def _wire_shape(self, shape: Tuple[int, ...]) -> Tuple[int, ...]:
+        """Host-wire shape for an input (``tensor_upload`` queries this as
+        the consumer's wire rule): fully flat for single-device dispatch,
+        batch-dim-preserving when a mesh is configured so the wire payload
+        still shards over the batch axis."""
+        mesh, _ = self._mesh_config()
+        if mesh is not None:
+            return batched_wire_shape(shape)
+        return flat_wire_shape(shape)
 
     def wire_input_sharding(self, idx: int = 0):
         """Sharding a ``tensor_upload`` stage should device_put with (None
-        for the single-device backend; the sharded subclass returns the
-        mesh batch sharding so uploads land pre-distributed instead of
-        being re-scattered inside the jitted dispatch)."""
-        del idx
-        return None
+        for single-device dispatch; with a mesh the batch sharding is
+        returned so uploads land pre-distributed instead of being
+        re-scattered inside the jitted dispatch)."""
+        if self._mesh is None or self._in_spec is None:
+            return None
+        from ..parallel.mesh import batch_sharding
+
+        if self._wire_shapes is not None and idx < len(self._wire_shapes):
+            rank = len(self._wire_shapes[idx])
+        elif idx < len(self._in_spec.tensors):
+            rank = len(self._in_spec.tensors[idx].shape)
+        else:
+            return None
+        return batch_sharding(self._mesh, rank, self._mesh_axis)
 
     def _make_flat_entry(self, in_spec: TensorsSpec):
         """(fn over wire-shaped inputs, wire shapes), or (None, None) when
@@ -451,12 +534,24 @@ class JaxBackend(FilterBackend):
         self._expected = tuple(
             (tuple(t.shape), np.dtype(t.dtype)) for t in in_spec.tensors
         )
-        key = self._spec_key(in_spec)
+        # resolve the dispatch mesh for THIS geometry: the executable cache
+        # keys by (geometry, mesh) so a mesh flip (or an unshardable drift
+        # shape next to a sharded bucket) can never serve the wrong
+        # executable, and compile accounting stays truthful per pair
+        mesh, axis = self._mesh_config()
+        if mesh is not None and not self._shard_this_compile(in_spec, mesh):
+            mesh = None
+        self._mesh = mesh
+        self._mesh_axis = axis
+        from ..parallel.mesh import mesh_cache_key
+
+        key = (self._spec_key(in_spec), mesh_cache_key(mesh))
         hit = self._cache.get(key)
         if hit is not None:
             self._cache.move_to_end(key)
             (self._compiled, self._flat_compiled, self._wire_shapes,
-             self._out_spec, self._single_output) = hit
+             self._out_spec, self._single_output, self._in_shardings,
+             self._wire_in_shardings) = hit
             record_compile(self, key, "hit")
             return self._out_spec
         t0 = time.perf_counter_ns()
@@ -478,6 +573,7 @@ class JaxBackend(FilterBackend):
         else:
             self._flat_compiled = None
             self._wire_shapes = None
+            self._wire_in_shardings = None
         jitted = self._jit(self._effective_fn)
         if flat_fn is None or self.expect_device_input:
             # AOT-lower for early error surfacing + warm cache, but keep the
@@ -493,7 +589,8 @@ class JaxBackend(FilterBackend):
         self._out_spec = out_spec
         self._cache[key] = (
             jitted, self._flat_compiled, self._wire_shapes, out_spec,
-            self._single_output,
+            self._single_output, self._in_shardings,
+            self._wire_in_shardings,
         )
         while len(self._cache) > self._cache_size:
             evicted_key, _ = self._cache.popitem(last=False)  # evict LRU
@@ -502,19 +599,67 @@ class JaxBackend(FilterBackend):
                        cost_info(aot) if aot is not None else {})
         return out_spec
 
+    def _mesh_place(self, tensors: Tuple, wire: bool = False) -> Tuple:
+        """Re-place device-resident inputs whose committed sharding differs
+        from the compiled executable's ``in_shardings``: this jax version
+        raises ("Sharding passed to pjit does not match...") instead of
+        auto-resharding a committed array, and a device hop (an upstream
+        filter's replicated stack, a foreign single-device put) is exactly
+        that case.  The device→device reshard runs over ICI — host arrays
+        and matching shardings pass through untouched."""
+        shardings = self._wire_in_shardings if wire else self._in_shardings
+        if shardings is None:
+            return tensors
+        placed = list(tensors)
+        for i, t in enumerate(placed):
+            if i >= len(shardings) or not isinstance(t, jax.Array):
+                continue
+            want = shardings[i]
+            try:
+                mismatch = not t.sharding.is_equivalent_to(want, t.ndim)
+            except Exception:  # noqa: BLE001 — version-dependent API
+                mismatch = t.sharding != want
+            if mismatch:
+                placed[i] = jax.device_put(t, want)
+        return tuple(placed)
+
     def _jit(self, fn, wire: bool = False):
-        if wire and self._donate_wire and jax.default_backend() != "cpu":
+        kwargs = {}
+        n = len(self._in_spec.tensors) if self._in_spec is not None else 0
+        if wire and self._donate_wire and jax.default_backend() != "cpu" and n:
             # Donate the wire-entry inputs (opt-in, see open()): the
             # frame's transfer buffer is single-use on a linear chain, so
             # XLA may reuse its HBM for intermediates/outputs instead of
             # allocating beside it — one less live buffer per in-flight
             # frame (the allocate_in_invoke discipline,
             # tensor_filter.c:366-378).  CPU's PJRT doesn't implement
-            # donation and would warn per call.
-            n = len(self._in_spec.tensors) if self._in_spec is not None else 0
-            if n:
-                return jax.jit(fn, donate_argnums=tuple(range(n)))
-        return jax.jit(fn)
+            # donation and would warn per call.  Donation composes with
+            # sharding: XLA frees each donated SHARD's buffer per device.
+            kwargs["donate_argnums"] = tuple(range(n))
+        shardings = None
+        if self._mesh is not None and self._in_spec is not None:
+            # batch-axis data parallelism: one executable spans the mesh,
+            # inputs shard on their leading dim (host inputs are scattered
+            # by the jit dispatch; pre-sharded uploads land untouched),
+            # params replicate by closure capture, XLA inserts the
+            # collectives (over ICI on real hardware)
+            from ..parallel.mesh import batch_sharding
+
+            ranks = [
+                len(self._wire_shape(tuple(t.shape))) if wire
+                else len(t.shape)
+                for t in self._in_spec.tensors
+            ]
+            shardings = tuple(
+                batch_sharding(self._mesh, r, self._mesh_axis)
+                for r in ranks
+            )
+            kwargs["in_shardings"] = shardings
+        if wire:
+            self._wire_in_shardings = shardings
+        else:
+            self._in_shardings = shardings
+        return jax.jit(fn, **kwargs)
 
     def reconfigure_fused(self, raw_spec: TensorsSpec) -> TensorsSpec:
         """Compile against the raw stream spec (the fused program's inputs);
@@ -614,6 +759,11 @@ class JaxBackend(FilterBackend):
             if len(xs) == len(expected) and all(
                 tuple(x.shape) == tuple(w) for x, w in zip(xs, expected)
             ):
+                if self._mesh is not None:
+                    # a wire payload put before the mesh executable existed
+                    # (or by a foreign producer) may be committed elsewhere
+                    xs = self._mesh_place(
+                        xs, wire=self._flat_compiled is not None)
                 out = (
                     self._flat_compiled(*xs)
                     if self._flat_compiled is not None
@@ -659,6 +809,12 @@ class JaxBackend(FilterBackend):
                 if isinstance(a, np.ndarray):
                     _pool_fence(a, head)
         else:
+            if self._mesh is not None:
+                # device-resident inputs from a different placement (an
+                # upstream filter's replicated stack, a single-device put)
+                # reshard over ICI instead of tripping pjit's committed-
+                # sharding check
+                tensors = self._mesh_place(tensors)
             out = self._compiled(*tensors)
             head = out[0] if isinstance(out, (tuple, list)) else out
             for t in tensors:
@@ -710,60 +866,38 @@ class JaxShardedBackend(JaxBackend):
     """Batch-sharded variant: ``custom="devices=8,axis=dp"`` shards the
     leading dim of every input over a 1-D mesh; params are replicated by
     closure capture; XLA inserts the collectives (over ICI on real hardware).
-    """
+
+    With the process-wide dispatch mesh (conf ``[mesh]`` / ``NNSTPU_MESH``)
+    the base backend shards too; this subclass remains as the explicit
+    per-filter spelling — its ``custom=`` mesh wins over the global one,
+    it shards every geometry (no divisibility fallback), and its wire rule
+    is always batch-preserving."""
 
     RESERVED_CUSTOM_KEYS = JaxBackend.RESERVED_CUSTOM_KEYS | {"devices", "axis"}
 
     def __init__(self):
         super().__init__()
-        self._mesh = None
         self._custom = {}
 
     def open(self, model, custom: str = "") -> None:
         super().open(model, custom)
         self._custom = parse_custom(custom)
 
-    @staticmethod
-    def _wire_shape(shape: Tuple[int, ...]) -> Tuple[int, ...]:
-        """Keep the (sharded) batch dim; flatten the rest, so the wire
-        layout is still cheap and the batch still shards over the mesh."""
-        if len(shape) < 3:
-            return tuple(shape)
-        n = 1
-        for d in shape[1:]:
-            n *= d
-        return (shape[0], n)
-
-    def wire_input_sharding(self, idx: int = 0):
-        if self._mesh is None or self._in_spec is None:
-            return None
-        from ..parallel.mesh import batch_sharding
-
-        axis = self._custom.get("axis", "dp")
-        if self._wire_shapes is not None and idx < len(self._wire_shapes):
-            rank = len(self._wire_shapes[idx])
-        else:
-            rank = len(self._in_spec.tensors[idx].shape)
-        return batch_sharding(self._mesh, rank, axis)
-
-    def _jit(self, fn, wire: bool = False):
-        from ..parallel.mesh import batch_sharding, make_mesh
+    def _mesh_config(self):
+        if self._degraded is not None:
+            return None, "dp"
+        from ..parallel.mesh import make_mesh
 
         n = int(self._custom.get("devices", len(jax.devices())))
         axis = self._custom.get("axis", "dp")
-        self._mesh = make_mesh((n,), (axis,))
-        in_spec = self._in_spec
-        ranks = [
-            len(self._wire_shape(tuple(t.shape))) if wire else len(t.shape)
-            for t in in_spec.tensors
-        ]
-        in_shardings = tuple(
-            batch_sharding(self._mesh, r, axis) for r in ranks
-        )
-        kwargs = {}
-        if wire and self._donate_wire and jax.default_backend() != "cpu":
-            # same opt-in wire-input donation as the base backend (review
-            # r5: the override silently dropped it on the sharded path the
-            # bench enables it on)
-            kwargs["donate_argnums"] = tuple(range(len(ranks)))
-        return jax.jit(fn, in_shardings=in_shardings, **kwargs)
+        if (self._mesh is None or self._mesh.devices.size != n
+                or self._mesh.axis_names != (axis,)):
+            return make_mesh((n,), (axis,)), axis
+        return self._mesh, axis
+
+    def _shard_this_compile(self, in_spec: TensorsSpec, mesh) -> bool:
+        del in_spec, mesh
+        return True  # explicit opt-in: the user asked for this mesh
+
+    def _wire_shape(self, shape: Tuple[int, ...]) -> Tuple[int, ...]:
+        return batched_wire_shape(shape)

@@ -79,6 +79,13 @@ DEFAULTS: Dict[str, Dict[str, str]] = {
                                     # CPU fallback above this (0=off; opt-in
                                     # — see BENCH_NOTES zero-copy sweep)
     },
+    # Mesh-sharded dispatch (parallel/mesh.py dispatch_mesh): batch-axis
+    # data parallelism over all chips.  The short env spelling NNSTPU_MESH
+    # takes precedence over the NNSTPU_MESH_SPEC form mapped here.
+    "mesh": {
+        "spec": "",                 # "" = off; "auto" | "dp:8" | "8" — see
+                                    # parallel.mesh.parse_mesh_spec
+    },
     # Serving QoS (nnstreamer_tpu/sched): NNSTPU_SCHED_* env vars map here.
     # An empty policy disables scheduling entirely (legacy FIFO dispatch).
     "sched": {

@@ -50,6 +50,7 @@ class TensorBatch(Node):
         self._n = 0
         self._pool = pool  # default shared pool unless injected (tests)
         self._per_stream = False  # skip host concat (pool.skip_host_concat)
+        self._mesh_dev = 1  # downstream dispatch-mesh width (configure)
 
     def _pool_or_default(self):
         if self._pool is None:
@@ -74,12 +75,19 @@ class TensorBatch(Node):
         # payload/platform-aware host-concat decision: on the CPU fallback
         # with large rows, hand the filter a RowBatch (per-stream invoke)
         # instead of coalescing — the consumer's platform decides, so a
-        # real accelerator always gets the batched transfer
-        from ..graph.residency import consumer_platform
+        # real accelerator always gets the batched transfer.  A
+        # mesh-sharded consumer also always gets it: the pooled (N, *row)
+        # buffer is exactly the per-shard slot layout its batch-axis
+        # NamedSharding scatters (N divisible by the mesh shards evenly;
+        # otherwise the backend falls back to a single-device executable),
+        # and a per-row RowBatch invoke would defeat the sharding.
+        from ..graph.residency import consumer_mesh_devices, consumer_platform
         from ..pool import skip_host_concat
 
-        self._per_stream = first.is_fixed and skip_host_concat(
-            first.nbytes, consumer_platform(self)
+        self._mesh_dev = consumer_mesh_devices(self)
+        self._per_stream = (
+            self._mesh_dev == 1 and first.is_fixed
+            and skip_host_concat(first.nbytes, consumer_platform(self))
         )
         return {"src": TensorsSpec(tensors=(out,), rate=spec.rate)}
 

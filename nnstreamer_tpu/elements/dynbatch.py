@@ -18,7 +18,11 @@ Mechanics:
   bucket — the backend's bounded LRU executable cache makes bucket flips
   cheap after first sight, and per-frame signature checks are skipped via
   the polymorphic (batch=None) negotiated spec, exactly the drift path
-  the jax backend already handles.
+  the jax backend already handles.  Under mesh-sharded dispatch
+  (``NNSTPU_MESH`` — ``residency.consumer_mesh_devices``) ``max_batch``
+  is the PER-SHARD cap: up to ``max_batch × ndev`` rows coalesce and
+  buckets are ``ndev × pow-2`` (:func:`mesh_bucket`), so every emitted
+  batch divides the mesh and one invoke spans all chips.
 - Frame timing/meta ride in ``meta["dynbatch"]``; ``tensor_dynunbatch``
   splits the batched result back into the original frames (padding rows
   dropped), preserving per-frame pts/duration.
@@ -53,6 +57,17 @@ def _bucket(n: int, max_batch: int) -> int:
     return min(b, max_batch)
 
 
+def mesh_bucket(n: int, max_batch: int, ndev: int = 1) -> int:
+    """Batch-size bucket for ``n`` queued rows dispatching over an
+    ``ndev``-device mesh: the power-of-2 ladder applies PER SHARD, so the
+    emitted batch is ``ndev × bucket(ceil(n/ndev))`` — always divisible by
+    the mesh, and the executable set stays bounded to {ndev × pow-2
+    buckets ≤ ndev × max_batch}.  ``ndev=1`` is the classic ladder."""
+    if ndev <= 1:
+        return _bucket(n, max_batch)
+    return ndev * _bucket(-(-n // ndev), max_batch)
+
+
 @register_element("tensor_dynbatch")
 class DynBatch(Node):
     def __init__(
@@ -78,6 +93,7 @@ class DynBatch(Node):
         self.frames_in = 0
         self._pool = None  # shared staging pool, resolved lazily
         self._skip_concat = False  # pool.skip_host_concat at configure
+        self._mesh_dev = 1  # downstream dispatch-mesh width (configure)
 
     def configure(self, in_specs: Dict[str, TensorsSpec]) -> Dict[str, TensorsSpec]:
         spec = in_specs["sink"]
@@ -93,10 +109,14 @@ class DynBatch(Node):
         # the CPU fallback with large frames, coalescing costs more host
         # memcpy than the dispatch amortization saves — emit batch-1 views
         # (zero concat) instead of stacking the pile-up
-        from ..graph.residency import consumer_platform
+        from ..graph.residency import consumer_mesh_devices, consumer_platform
         from ..pool import skip_host_concat
 
-        self._skip_concat = skip_host_concat(
+        # mesh-sharded consumer: buckets grow in per-shard multiples so one
+        # invoke spreads the pile-up across every chip, and the per-stream
+        # RowBatch escape is off — per-row invoke would defeat the sharding
+        self._mesh_dev = consumer_mesh_devices(self)
+        self._skip_concat = self._mesh_dev == 1 and skip_host_concat(
             sum(t.nbytes for t in spec.tensors), consumer_platform(self)
         )
         # batch dim None → downstream pads skip per-frame sig checks and the
@@ -132,7 +152,7 @@ class DynBatch(Node):
                 self._emit_one(f)
             return
         n = len(frames)
-        b = _bucket(n, self.max_batch)
+        b = mesh_bucket(n, self.max_batch, self._mesh_dev)
         pad_rows = b - n
         stacked = []
         copied = 0
@@ -200,6 +220,10 @@ class DynBatch(Node):
     def _worker(self) -> None:
         q = self._q
         pending: List[Frame] = []
+        # per-mesh dispatch sizing: max_batch is the PER-SHARD cap, so an
+        # ndev-wide consumer coalesces up to max_batch × ndev rows per
+        # invoke (the whole point of serving the pool from all chips)
+        max_pending = self.max_batch * max(1, self._mesh_dev)
         while True:
             status, item = q.pop(_POLL_MS)
             if status == SHUTDOWN:
@@ -216,7 +240,7 @@ class DynBatch(Node):
                     continue
                 pending.append(item)
                 # coalesce whatever else is already waiting (never block)
-                while len(pending) < self.max_batch:
+                while len(pending) < max_pending:
                     status, nxt = q.pop(0)
                     if status != OK:
                         break

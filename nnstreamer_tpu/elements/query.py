@@ -67,6 +67,17 @@ VERSION = 1
 VER_MASK = 0x00FF   # low byte: protocol version
 FLAG_TRACE = 0x0100  # high-byte flag: trace-context block follows the header
 _TRACE_BLOCK = struct.Struct("<QQI")  # trace_id, span_id, reserved
+
+
+def _mesh_ndev() -> int:
+    """Dispatch-mesh width for serving stats (1 = unsharded dispatch);
+    never raises — stats() must work with no jax backend at all."""
+    try:
+        from ..parallel.mesh import dispatch_mesh_devices
+
+        return dispatch_mesh_devices()
+    except Exception:  # noqa: BLE001
+        return 1
 ERR_SENTINEL = 0xFFFF
 
 
@@ -643,17 +654,23 @@ class QueryServer:
             # the serving hot path), whereas chunking keeps the executable
             # set bounded to {pow-2 buckets <= max_batch} — verifiable
             # live via the nnstpu_compile_total{result="miss"} counter.
-            from .dynbatch import _bucket
+            # With a dispatch mesh, max_batch is PER SHARD: chunks grow to
+            # max_batch × ndev and buckets stay ndev-divisible
+            # (mesh_bucket), so one sub-dispatch spans every chip.
+            from ..parallel.mesh import dispatch_mesh_devices
+            from .dynbatch import mesh_bucket
 
+            ndev = dispatch_mesh_devices()
+            eff_max = self.max_batch * ndev
             cat = [
                 np.concatenate([np.asarray(g.tensors[i]) for g in group],
                                axis=0)
                 for i in range(n_tensors)
             ]
             out_parts: Optional[list] = None
-            for start in range(0, total, self.max_batch):
-                n = min(self.max_batch, total - start)
-                b = _bucket(n, self.max_batch)
+            for start in range(0, total, eff_max):
+                n = min(eff_max, total - start)
+                b = mesh_bucket(n, self.max_batch, ndev)
                 chunk = []
                 for i in range(n_tensors):
                     part = cat[i][start:start + n]
@@ -678,7 +695,7 @@ class QueryServer:
                     out_parts = [[] for _ in outs]
                 for j, o in enumerate(outs):
                     out_parts[j].append(np.asarray(o)[:n])
-            if total > self.max_batch:
+            if total > eff_max:
                 self.batched_splits += 1
             full = [np.concatenate(ps, axis=0) if len(ps) > 1 else ps[0]
                     for ps in out_parts]
@@ -703,6 +720,7 @@ class QueryServer:
             "batched_frames": self.batched_frames,
             "batched_splits": self.batched_splits,
             "max_batch": self.max_batch,
+            "mesh_devices": _mesh_ndev(),
             "spec_backends": len(self._backends),
         }
         if self.scheduler is not None:

@@ -59,16 +59,16 @@ class TensorUpload(Node):
         return downstream_backend(self)
 
     def _downstream_wire_rule(self):
-        """The wire layout is the *consumer's* contract: the base jax
-        backend flattens rank ≥ 2 fully, the sharded backend keeps the
-        leading (batch) dim so the mesh sharding still applies.  Ask the
-        first filter downstream (hopping queue/upload plumbing) for its
-        rule; default to the base backend's."""
-        from ..backends.jax_backend import JaxBackend
+        """The wire layout is the *consumer's* contract: the jax backend
+        flattens rank ≥ 2 fully for single-device dispatch but keeps the
+        leading (batch) dim when a mesh is configured so the sharding
+        still applies.  Ask the first filter downstream (hopping
+        queue/upload plumbing) for its rule; default to the flat rule."""
+        from ..backends.jax_backend import flat_wire_shape
 
         self._backend = self._downstream_backend()
         rule = getattr(self._backend, "_wire_shape", None)
-        return rule if callable(rule) else JaxBackend._wire_shape
+        return rule if callable(rule) else flat_wire_shape
 
     def _sharding_for(self, idx: int):
         """Mesh sharding for tensor ``idx`` (sharded consumers): resolved

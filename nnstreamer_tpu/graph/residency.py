@@ -88,6 +88,36 @@ def consumer_platform(node: Node, max_hops: int = 4):
     return jax.default_backend()
 
 
+def consumer_mesh_devices(node: Node, max_hops: int = 4) -> int:
+    """Device count of the dispatch mesh the downstream filter backend will
+    shard over (1 = unsharded dispatch).  The device-mesh placement mode:
+    conf ``[mesh]`` / ``NNSTPU_MESH=dp:8`` (auto-detected from
+    ``jax.devices()``; CPU-testable via
+    ``XLA_FLAGS=--xla_force_host_platform_device_count=8``) turns the jax
+    backend's dispatch into a batch-axis ``NamedSharding`` over all chips,
+    and this walk hands that geometry to the batch elements and the query
+    server so they size buckets in per-shard multiples — one dynbatch
+    invoke then spreads ndev× the batch at roughly single-chip latency."""
+    backend = downstream_backend(node, max_hops)
+    get = getattr(backend, "mesh_devices", None)
+    if not callable(get):
+        return 1
+    try:
+        return max(1, int(get()))
+    except Exception:  # noqa: BLE001 — a sick backend must not kill config
+        return 1
+
+
+def dispatch_mesh():
+    """The process-wide dispatch mesh (None = mesh mode off).  Re-exported
+    from ``parallel.mesh`` so graph-layer callers have one placement
+    import; see :func:`consumer_mesh_devices` for the negotiation-time
+    walk."""
+    from ..parallel.mesh import dispatch_mesh as _dm
+
+    return _dm()
+
+
 def chain_device_resident(node: Node, direction: str, max_hops: int = 4) -> bool:
     """Walk the up- or downstream chain a few hops from ``node``: a
     device_resident filter with only residency-*preserving* elements between

@@ -191,6 +191,39 @@ def _device_label(d) -> str:
     return f"{plat}:{getattr(d, 'id', 0)}"
 
 
+def _head_device_label(head) -> str:
+    """``platform:ordinal`` of a single-device array's placement ("host"
+    for numpy and other non-device outputs)."""
+    try:
+        devs = head.devices()
+        for d in devs:
+            return _device_label(d)
+    except Exception:  # noqa: BLE001 — not a device array
+        pass
+    return "host"
+
+
+def _mesh_shards(head):
+    """``[(device_label, ordinal, per-shard array)]`` for a mesh-sharded
+    output (ordinal-sorted), or None for single-device / non-jax heads.
+    Duck-typed on ``sharding.device_set`` + ``addressable_shards`` so the
+    CPU-mesh test harness exercises the same path as a real v5e-8."""
+    try:
+        if len(head.sharding.device_set) <= 1:
+            return None
+        shards = head.addressable_shards
+        out = [
+            (_device_label(s.device), getattr(s.device, "id", i), s.data)
+            for i, s in enumerate(shards)
+        ]
+    except Exception:  # noqa: BLE001 — not a sharded device array
+        return None
+    if len(out) <= 1:
+        return None
+    out.sort(key=lambda e: e[1])
+    return out
+
+
 def device_memory_snapshot(devices=None) -> Dict[str, Dict[str, int]]:
     """Per-device ``memory_stats()`` snapshot ({"tpu:0": {bytes_in_use:
     ...}}), for /metrics collectors and error flight dumps.  Devices
@@ -265,6 +298,7 @@ class DeviceTracer(Tracer):
         self._running = False
         self._lock = threading.Lock()
         self._by_element: Dict[str, List[int]] = {}  # name -> [count, ns]
+        self._by_device: Dict[str, List[int]] = {}  # label -> [count, ns]
         self._sent = 0
         self._completed = 0
         self._dropped = 0
@@ -283,8 +317,9 @@ class DeviceTracer(Tracer):
         self._hist = self._registry.histogram(
             "nnstpu_device_exec_seconds",
             "True device execution time per dispatch, enqueue to "
-            "completion (seconds)",
-            labelnames=("pipeline", "element"),
+            "completion (seconds; one series per mesh device when the "
+            "dispatch spans a sharded output)",
+            labelnames=("pipeline", "element", "device"),
             buckets=DEVICE_EXEC_BUCKETS_S,
         )
         self._dispatches = self._registry.counter(
@@ -376,28 +411,40 @@ class DeviceTracer(Tracer):
                     return
                 pid, name, head, t0, trace_id, parent, fid = self._q.popleft()
             try:
-                try:
-                    import jax
+                shards = _mesh_shards(head)
+                if shards is not None:
+                    dur = self._reap_sharded(
+                        shards, name, t0, trace_id, parent, fid,
+                        pipeline_name)
+                else:
+                    try:
+                        import jax
 
-                    jax.block_until_ready(head)
-                except ImportError:  # pragma: no cover
-                    bur = getattr(head, "block_until_ready", None)
-                    if bur is not None:
-                        bur()
-                t_done = now_ns()
-                dur = max(0, t_done - t0)
-                sid = next(spans._ids)
-                # both records land on THIS thread: the device track
-                spans._recorder.append((
-                    spans.PH_FLOW_END, t0, 0,
-                    threading.current_thread().name, "device", "device",
-                    trace_id, fid, 0, None))
-                spans._recorder.append((
-                    spans.PH_COMPLETE, t0, dur,
-                    threading.current_thread().name, "device_exec", "device",
-                    trace_id, sid, parent, {"element": name}))
-                self._hist.observe(dur / 1e9, pipeline=pipeline_name,
-                                   element=name)
+                        jax.block_until_ready(head)
+                    except ImportError:  # pragma: no cover
+                        bur = getattr(head, "block_until_ready", None)
+                        if bur is not None:
+                            bur()
+                    t_done = now_ns()
+                    dur = max(0, t_done - t0)
+                    label = _head_device_label(head)
+                    sid = next(spans._ids)
+                    # both records land on THIS thread: the device track
+                    spans._recorder.append((
+                        spans.PH_FLOW_END, t0, 0,
+                        threading.current_thread().name, "device", "device",
+                        trace_id, fid, 0, None))
+                    spans._recorder.append((
+                        spans.PH_COMPLETE, t0, dur,
+                        threading.current_thread().name, "device_exec",
+                        "device", trace_id, sid, parent,
+                        {"element": name, "device": label}))
+                    self._hist.observe(dur / 1e9, pipeline=pipeline_name,
+                                       element=name, device=label)
+                    with self._lock:
+                        d = self._by_device.setdefault(label, [0, 0])
+                        d[0] += 1
+                        d[1] += dur
                 self._dispatches.inc(1, pipeline=pipeline_name, element=name)
                 with self._lock:
                     self._completed += 1
@@ -413,12 +460,54 @@ class DeviceTracer(Tracer):
                 with _inflight_lock:
                     _inflight.pop(pid, None)
 
+    def _reap_sharded(self, shards, name, t0, trace_id, parent, fid,
+                      pipeline_name) -> int:
+        """Per-mesh-device completion for a sharded dispatch: each shard's
+        readiness is observed individually and recorded on its OWN
+        ``device:<platform>:<ordinal>`` Perfetto track (the recorder keys
+        rows by the tid string, not the OS thread, so one reaper thread
+        fans out to ndev rows) with a per-device
+        ``nnstpu_device_exec_seconds{device=...}`` observation — shard
+        skew shows up as differing span lengths side by side.  Returns the
+        whole-dispatch duration (= the slowest shard observed)."""
+        flow_done = False
+        dur = 0
+        for label, _ordinal, data in shards:
+            wait = getattr(data, "block_until_ready", None)
+            if wait is not None:
+                wait()
+            t_done = now_ns()
+            shard_dur = max(0, t_done - t0)
+            dur = max(dur, shard_dur)
+            track = f"device:{label}"
+            if not flow_done:
+                # the host dispatch span's flow arrow lands on the first
+                # shard's track (one arrow per dispatch, ndev spans)
+                spans._recorder.append((
+                    spans.PH_FLOW_END, t0, 0, track, "device", "device",
+                    trace_id, fid, 0, None))
+                flow_done = True
+            sid = next(spans._ids)
+            spans._recorder.append((
+                spans.PH_COMPLETE, t0, shard_dur, track, "device_exec",
+                "device", trace_id, sid, parent,
+                {"element": name, "device": label}))
+            self._hist.observe(shard_dur / 1e9, pipeline=pipeline_name,
+                               element=name, device=label)
+            with self._lock:
+                d = self._by_device.setdefault(label, [0, 0])
+                d[0] += 1
+                d[1] += shard_dur
+        return dur
+
     def summary(self) -> dict:
         with self._cv:
             inflight = len(self._q)
         with self._lock:
             per = {name: {"count": c[0], "device_ns": c[1]}
                    for name, c in self._by_element.items()}
+            per_dev = {label: {"count": c[0], "device_ns": c[1]}
+                       for label, c in self._by_device.items()}
             total_ns = sum(c[1] for c in self._by_element.values())
             out = {
                 "dispatches": self._sent,
@@ -427,6 +516,7 @@ class DeviceTracer(Tracer):
                 "inflight": inflight,
                 "device_ns": total_ns,
                 "by_element": per,
+                "by_device": per_dev,
                 "compiles": dict(self._compiles),
             }
             if self._last_compile:

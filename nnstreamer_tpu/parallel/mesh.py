@@ -10,10 +10,51 @@ offload per frame.  Here parallel invocation is first-class: a
 
 from __future__ import annotations
 
+import inspect
 from typing import Optional, Sequence, Tuple
 
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+try:  # jax >= 0.8 exports shard_map top-level; older releases under
+    from jax import shard_map as _shard_map  # experimental
+except ImportError:  # pragma: no cover — version-dependent
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+# the replication-check kwarg renamed check_rep → check_vma across jax
+# versions; resolve once at import
+_CHECK_KW = (
+    "check_vma"
+    if "check_vma" in inspect.signature(_shard_map).parameters
+    else "check_rep"
+)
+
+
+def shard_map(fn, mesh: Mesh, in_specs, out_specs,
+              check_vma: Optional[bool] = None):
+    """Version-portable :func:`jax.shard_map`: one import site for the
+    top-level vs ``jax.experimental`` move and the ``check_rep`` →
+    ``check_vma`` kwarg rename, so every ``parallel/`` module (and the
+    transformer model's ring-attention path) works across the jax
+    versions this repo meets in the wild."""
+    kwargs = {} if check_vma is None else {_CHECK_KW: check_vma}
+    return _shard_map(fn, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, **kwargs)
+
+
+def distributed_initialized() -> bool:
+    """Has ``jax.distributed.initialize`` already run in this process?
+    (``jax.distributed.is_initialized`` only exists on newer jax; older
+    releases expose the same fact through the global client state.)"""
+    probe = getattr(jax.distributed, "is_initialized", None)
+    if probe is not None:
+        return bool(probe())
+    try:  # pragma: no cover — version-dependent fallback
+        from jax._src.distributed import global_state
+
+        return global_state.client is not None
+    except Exception:  # noqa: BLE001 — no distributed support at all
+        return False
 
 
 def make_mesh(
@@ -55,7 +96,7 @@ def init_distributed(
     never had.  Returns the process count.  Idempotent: a second call is a
     no-op.
     """
-    if jax.distributed.is_initialized():
+    if distributed_initialized():
         return jax.process_count()  # already joined: no-op
     jax.distributed.initialize(
         coordinator_address=coordinator_address,
@@ -110,3 +151,109 @@ def batch_sharding(mesh: Mesh, rank: int, axis: str = "dp") -> NamedSharding:
 
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
+
+
+# -- the dispatch mesh (global data-parallel placement mode) ------------------
+#
+# ``NNSTPU_MESH=dp:8`` (short env spelling) / ini ``[mesh] spec`` turns on
+# mesh-sharded dispatch through the whole hot path: the jax filter backend
+# compiles batch-axis-sharded executables, the batch elements size their
+# buckets in per-shard multiples, and tensor_upload pre-shards the wire.
+# Spec grammar: ``auto`` (all devices, axis "dp"), ``<axis>:<n>``,
+# ``<axis>`` (all devices on that axis), or a bare ``<n>``; empty / ``off``
+# / ``0`` / ``1`` disable.  A request for more devices than the platform
+# has clamps down (auto-detection from ``jax.devices()``) — CPU hosts get
+# a real multi-device mesh only under
+# ``XLA_FLAGS=--xla_force_host_platform_device_count=N``.
+
+_dispatch_mesh_cache: Optional[Tuple[str, Optional[Mesh], str]] = None
+
+
+def parse_mesh_spec(spec: str) -> Tuple[str, int]:
+    """``(axis, ndev)`` out of a mesh spec string; ndev 0 = all devices,
+    ndev 1 = disabled."""
+    s = (spec or "").strip().lower()
+    if s in ("", "off", "none", "false", "0", "1"):
+        return ("dp", 1)
+    if s == "auto":
+        return ("dp", 0)
+    axis, sep, n = s.partition(":")
+    if not sep:
+        if axis.isdigit():
+            return ("dp", int(axis))
+        return (axis, 0)
+    if not n.isdigit():
+        raise ValueError(f"mesh spec {spec!r}: device count must be an "
+                         f"integer, got {n!r}")
+    return (axis or "dp", int(n))
+
+
+def configured_mesh_spec() -> str:
+    """The active mesh spec string: ``NNSTPU_MESH`` (short spelling) over
+    ini ``[mesh] spec`` (env form ``NNSTPU_MESH_SPEC``) over disabled."""
+    import os
+
+    val = os.environ.get("NNSTPU_MESH")
+    if val is not None:
+        return val
+    from ..conf import conf
+
+    return conf.get("mesh", "spec", "") or ""
+
+
+def dispatch_mesh() -> Optional[Mesh]:
+    """The process-wide data-parallel dispatch mesh, or None when mesh
+    mode is off (the default) or fewer than 2 devices are usable.  Built
+    once per spec string and cached — the hot path asks per compile, not
+    per frame.  :func:`reset_dispatch_mesh` drops the cache (tests,
+    mid-process reconfiguration)."""
+    global _dispatch_mesh_cache
+    spec = configured_mesh_spec()
+    cached = _dispatch_mesh_cache
+    if cached is not None and cached[0] == spec:
+        return cached[1]
+    axis, ndev = parse_mesh_spec(spec)
+    mesh = None
+    if ndev != 1:
+        devices = jax.devices()
+        if ndev == 0 or ndev > len(devices):
+            ndev = len(devices)  # auto-detect / clamp to what exists
+        if ndev > 1:
+            mesh = make_mesh((ndev,), (axis,), devices=devices[:ndev])
+    _dispatch_mesh_cache = (spec, mesh, axis)
+    return mesh
+
+
+def dispatch_mesh_axis() -> str:
+    """Batch axis name of the active dispatch mesh ("dp" when off)."""
+    mesh = dispatch_mesh()
+    if mesh is None:
+        return "dp"
+    return _dispatch_mesh_cache[2]
+
+
+def dispatch_mesh_devices() -> int:
+    """Device count of the active dispatch mesh (1 when mesh mode is off
+    — every batch-sizing call site can multiply by this unconditionally)."""
+    mesh = dispatch_mesh()
+    return int(mesh.devices.size) if mesh is not None else 1
+
+
+def mesh_cache_key(mesh: Optional[Mesh]) -> Optional[tuple]:
+    """Hashable identity of a mesh for executable-cache keying: axis
+    layout + the concrete device list (platform, ordinal) — two meshes
+    over different chips must never share an executable."""
+    if mesh is None:
+        return None
+    return (
+        tuple(mesh.axis_names),
+        tuple(mesh.shape[a] for a in mesh.axis_names),
+        tuple((getattr(d, "platform", "device"), getattr(d, "id", i))
+              for i, d in enumerate(mesh.devices.flat)),
+    )
+
+
+def reset_dispatch_mesh() -> None:
+    """Forget the cached dispatch mesh so the next use re-reads conf."""
+    global _dispatch_mesh_cache
+    _dispatch_mesh_cache = None

@@ -25,10 +25,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
-try:  # jax >= 0.8 top-level; older releases under experimental
-    from jax import shard_map
-except ImportError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map
+from .mesh import shard_map
 
 
 def stack_stage_params(per_stage_params):
@@ -66,19 +63,11 @@ def gpipe_apply(
     )
 
     # The scan carry starts replicated (zeros) but becomes device-varying
-    # after the first tick; relax the varying-axes check (kwarg renamed
-    # check_rep → check_vma across jax versions).
-    import inspect
-
-    check_kw = (
-        "check_vma"
-        if "check_vma" in inspect.signature(shard_map).parameters
-        else "check_rep"
-    )
-
+    # after the first tick; relax the varying-axes check (the compat
+    # wrapper maps check_vma onto check_rep for older jax).
     @functools.partial(
         shard_map, mesh=mesh, in_specs=in_specs, out_specs=P(axis),
-        **{check_kw: False},
+        check_vma=False,
     )
     def run(params_local, xs_all):
         # leading stage dim is 1 on-device: drop it

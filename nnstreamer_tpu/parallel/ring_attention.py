@@ -119,7 +119,9 @@ def ring_attention(
         return jnp.transpose(out, (0, 2, 1, 3))  # (B, Tq, H, D)
 
     spec = P(None, axis, None, None)
-    return jax.shard_map(
+    from .mesh import shard_map
+
+    return shard_map(
         shard_fn,
         mesh=mesh,
         in_specs=(spec, spec, spec),

@@ -48,7 +48,9 @@ def ulysses_attention(
         return heads_to_seq(out)
 
     spec = P(None, axis, None, None)
-    return jax.shard_map(
+    from .mesh import shard_map
+
+    return shard_map(
         shard_fn,
         mesh=mesh,
         in_specs=(spec, spec, spec),

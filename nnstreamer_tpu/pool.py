@@ -235,8 +235,12 @@ class BufferPool:
     def recycle(self, arr: PooledArray) -> None:
         """Explicit early return for an exclusively-owned lease (staging
         loops).  The GC finalizer is the safe default — only call this
-        when no view of ``arr`` can still be read by anyone else.
-        Idempotent (a finalizer fires at most once)."""
+        when no view of ``arr`` can still be read by anyone else.  A
+        mesh-sharded ``device_put`` counts as such a reader for as long
+        as its array lives: the CPU client may zero-copy alias the host
+        memory per shard, which no fence wait can make re-writable (the
+        GC path is safe — jax's keepalive pins the source).  Idempotent
+        (a finalizer fires at most once)."""
         fin = getattr(arr, "_pool_finalizer", None)
         if fin is not None:
             fin()
@@ -288,14 +292,22 @@ class BufferPool:
         # the in-flight array is held WEAKLY: jax's runtime keeps the host
         # source (and so the lease shim) pinned while it reads, and a dead
         # head means that pin was released — whereas a strong ref here
-        # would circularly pin the head's own inputs and leak the class
+        # would circularly pin the head's own inputs and leak the class.
+        # A MESH-SHARDED put is the exception: its per-shard committed
+        # arrays each read the host buffer on their own schedule and the
+        # global head wrapper can die while shard transfers are still in
+        # flight, so every shard must pin the fence individually.  Shard
+        # ``.data`` objects are fresh wrappers (a weakref to one dies
+        # immediately) — they are held strongly, bounded by fence lifetime
+        # exactly like any other non-weakref-able reader.
+        shard_readers = _shard_readers(inflight)
         try:
             inflight = weakref.ref(inflight)
         except TypeError:
             pass  # not weakref-able: hold it (bounded by fence lifetime)
         with self._lock:
             self._fences.setdefault(id(raw), []).append(
-                (weakref.ref(raw), inflight)
+                (weakref.ref(raw), inflight, shard_readers)
             )
 
     def _wait_fences(self, raw: np.ndarray) -> None:
@@ -303,21 +315,25 @@ class BufferPool:
             fences = self._fences.pop(id(raw), None)
         if not fences:
             return
-        for wr, head in fences:
+        for wr, head, shard_readers in fences:
             if wr() is not raw:
                 continue  # stale id-reuse entry: not this buffer
+            readers = list(shard_readers) if shard_readers else []
             if isinstance(head, weakref.ref):
                 head = head()
-                if head is None:
-                    continue  # reader gone: its pin was already released
-            wait = getattr(head, "block_until_ready", None)
-            if wait is None:
-                continue
-            try:
-                wait()
-            except Exception:
-                # a failed computation released its inputs either way
-                pass
+                # a dead head with no per-shard readers means the single
+                # reader's pin was already released
+            if head is not None:
+                readers.append(head)
+            for reader in readers:
+                wait = getattr(reader, "block_until_ready", None)
+                if wait is None:
+                    continue
+                try:
+                    wait()
+                except Exception:
+                    # a failed computation released its inputs either way
+                    pass
 
     # -- introspection ------------------------------------------------------
 
@@ -346,6 +362,25 @@ class BufferPool:
             leased, free = self._leased_bytes, self._free_bytes
         m["leased"].set(leased)
         m["free"].set(free)
+
+
+def _shard_readers(inflight: Any) -> Optional[list]:
+    """Per-shard committed arrays of a multi-device (mesh-sharded) array,
+    or None for single-device / non-jax readers.  Duck-typed on
+    ``sharding.device_set`` + ``addressable_shards`` so a fake put in
+    tests exercises the same path as a real ``NamedSharding`` put."""
+    try:
+        sharding = inflight.sharding
+        if len(sharding.device_set) <= 1:
+            return None
+        shards = inflight.addressable_shards
+    except Exception:  # noqa: BLE001 — not a sharded device array
+        return None
+    try:
+        readers = [s.data for s in shards]
+    except Exception:  # noqa: BLE001
+        return None
+    return readers if len(readers) > 1 else None
 
 
 # -- default pool ------------------------------------------------------------
@@ -573,14 +608,25 @@ class WireStager:
         """Register the device array issued from the last staged buffer of
         ``idx`` (its readiness gates the slot's next reuse — and, via the
         pool fence, any rewrite after the buffer returns to the pool on
-        ``reset()``/GC)."""
+        ``reset()``/GC).
+
+        A MESH-SHARDED put never gates a rewrite: the CPU client may
+        zero-copy ALIAS an aligned host buffer per shard, so readiness
+        does not mean the memory is re-writable — the slot is abandoned
+        to the pool instead (jax's keepalive holds an aliased buffer
+        until the device array drops; a copied one recycles through the
+        normal fence discipline), and the next stage() leases afresh."""
         slot = self._slots.get(idx)
         if slot is not None and "last" in slot:
             k = slot["last"]
-            slot["busy"][k] = inflight
             buf = slot["bufs"][k]
             if buf is not None:
                 fence(buf, inflight)
+            if _shard_readers(inflight) is not None:
+                slot["bufs"][k] = None
+                slot["busy"][k] = None
+            else:
+                slot["busy"][k] = inflight
 
     def reset(self) -> None:
         """Forget all slots (renegotiation): buffers return to the pool via

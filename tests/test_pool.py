@@ -180,6 +180,128 @@ class TestFence:
         assert b.pool_fresh and inflight.waits == 0
 
 
+class _FakeShard:
+    def __init__(self, data, device=None):
+        self.data = data
+        self.device = device
+
+
+class _FakeSharding:
+    def __init__(self, n):
+        self.device_set = frozenset(range(n))
+
+
+class FakeShardedPut:
+    """A mesh-sharded ``device_put`` result: one global head wrapper over
+    N per-shard committed arrays (each with its own readiness)."""
+
+    def __init__(self, n):
+        self.sharding = _FakeSharding(n)
+        self._shards = [_FakeShard(FakeInflight()) for _ in range(n)]
+
+    @property
+    def addressable_shards(self):
+        return list(self._shards)
+
+    def shard_waits(self):
+        return [s.data.waits for s in self._shards]
+
+
+class TestShardedFence:
+    """Regression (mesh-sharded dispatch): the fence must pin EVERY
+    per-shard committed array of a multi-device put, not just the global
+    head — the head wrapper can be dropped while shard transfers are
+    still reading the pooled buffer, and a weak head ref alone would
+    treat that as "reader gone" and let the recycled memory be rewritten
+    under the in-flight shard transfer."""
+
+    def test_every_shard_pins_the_lease(self):
+        pool = BufferPool(max_per_class=4, max_bytes=1 << 20)
+        a = pool.lease((8,), np.float32)
+        put = FakeShardedPut(8)
+        assert fence(a, put) is True
+        shards = put._shards  # keep shard handles to inspect waits
+        del put  # the global head dies; shard transfers still in flight
+        pool.recycle(a)
+        del a
+        b = pool.lease((8,), np.float32)  # rewrite imminent
+        assert not b.pool_fresh
+        assert [s.data.waits for s in shards] == [1] * 8
+
+    def test_stager_abandons_slot_on_sharded_put(self):
+        """WireStager must never rewrite a slot whose last transfer was a
+        mesh-sharded put: readiness does not imply the (possibly aliased)
+        memory is re-writable, so the slot is abandoned to the pool and
+        the next stage() leases a fresh buffer."""
+        pool = BufferPool(max_per_class=4, max_bytes=1 << 20)
+        stager = WireStager(pool=pool, depth=1)
+        src = np.arange(8, dtype=np.float32)[::2]  # strided: forces staging
+        buf1 = stager.stage(0, src, (4,))
+        put = FakeShardedPut(4)
+        stager.track(0, put)
+        buf2 = stager.stage(0, src + 1.0, (4,))
+        assert buf2 is not buf1  # fresh lease, not an in-place rewrite
+        # and the sharded shards were never "waited into" reusability
+        np.testing.assert_array_equal(np.asarray(buf1), [0, 2, 4, 6])
+
+    def test_stager_single_device_slot_reuse_intact(self):
+        """The ping-pong fast path survives: a single-device transfer
+        still gates slot reuse on readiness and reuses the same memory."""
+        pool = BufferPool(max_per_class=4, max_bytes=1 << 20)
+        stager = WireStager(pool=pool, depth=1)
+        src = np.arange(8, dtype=np.float32)[::2]
+        buf1 = stager.stage(0, src, (4,))
+        inflight = FakeInflight()
+        stager.track(0, inflight)
+        buf2 = stager.stage(0, src, (4,))
+        assert buf2 is buf1 and inflight.waits == 1
+
+    def test_single_device_put_keeps_weak_head_semantics(self):
+        """A 1-device sharding is NOT expanded: the head stays a weak ref
+        and a dead head (pin already released) never blocks the lease."""
+        pool = BufferPool(max_per_class=4, max_bytes=1 << 20)
+        a = pool.lease((8,), np.float32)
+        put = FakeShardedPut(1)
+        shard = put._shards[0]
+        assert fence(a, put) is True
+        del put  # weakref-able head dies → reader gone
+        pool.recycle(a)
+        del a
+        b = pool.lease((8,), np.float32)
+        assert not b.pool_fresh
+        assert shard.data.waits == 0  # never expanded, never waited
+
+    def test_real_sharded_put_fences_all_devices(self):
+        """The live-fire version: a real jax NamedSharding put over the
+        forced-host 8-device mesh round-trips through the fence path on
+        the GC discipline.  (NOT explicit recycle(): the CPU client may
+        zero-copy ALIAS an aligned host buffer per shard, in which case
+        jax's keepalive holds the lease and the buffer simply never
+        recycles while the put lives — recycle() would bypass exactly
+        that protection, which is why its contract forbids calling it
+        with a live sharded reader.)"""
+        import gc
+
+        import jax
+
+        from nnstreamer_tpu.parallel.mesh import batch_sharding, make_mesh
+
+        mesh = make_mesh((8,), ("dp",))
+        for _ in range(10):  # the copy-vs-alias choice is allocator-timing
+            pool = BufferPool(max_per_class=4, max_bytes=1 << 20)
+            a = pool.lease((16, 4), np.float32)
+            a[:] = np.arange(64, dtype=np.float32).reshape(16, 4)
+            put = jax.device_put(np.asarray(a), batch_sharding(mesh, 2))
+            assert len(put.sharding.device_set) == 8
+            assert fence(a, put) is True
+            expect = np.asarray(a).copy()
+            del a  # GC path: recycles only once every reader allows it
+            gc.collect()
+            b = pool.lease((16, 4), np.float32)
+            b[:] = 0.0  # rewrite (fresh, or fence-waited recycled memory)
+            np.testing.assert_array_equal(np.asarray(put), expect)
+
+
 class TestRowBatch:
     def test_geometry_and_rows(self):
         rows = [np.arange(4, dtype=np.float32) + i for i in range(3)]

@@ -395,9 +395,17 @@ class TestNnsqTracePropagation:
         assert cli._trace_wire is True
         frame_traces = {f.meta[spans.META_KEY][0] for f in got}
         assert len(frame_traces) == 4
-        snap = spans.snapshot()
+        # the server records nnsq_serve on its connection thread AFTER
+        # sending the reply, so the final frame's span can land a moment
+        # after the client's sink fired — poll briefly before judging
+        deadline = time.time() + 5
+        while True:
+            snap = spans.snapshot()
+            serve = {r[6] for r in x_spans(snap) if r[4] == "nnsq_serve"}
+            if serve >= frame_traces or time.time() > deadline:
+                break
+            time.sleep(0.01)
         rtt = {r[6] for r in x_spans(snap) if r[4] == "nnsq_rtt"}
-        serve = {r[6] for r in x_spans(snap) if r[4] == "nnsq_serve"}
         assert rtt == frame_traces
         assert serve >= frame_traces, (
             "server-side spans must attach to the client's per-frame traces")

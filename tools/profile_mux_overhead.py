@@ -27,8 +27,11 @@ This tool isolates where the per-stream cost lands:
   without this column device compute hides inside whichever element
   blocks first.
 
-Usage: ``python tools/profile_mux_overhead.py [TOTAL_FRAMES] [SWEEP...]``
-e.g. ``python tools/profile_mux_overhead.py 2000 1 2 4 8``.
+Usage: ``python tools/profile_mux_overhead.py [--mesh[=SPEC]]
+[TOTAL_FRAMES] [SWEEP...]`` e.g. ``python tools/profile_mux_overhead.py
+2000 1 2 4 8``.  ``--mesh`` (default spec ``dp:8``) sweeps the
+mesh-sharded dispatch lane over a forced 8-device host mesh and adds
+chips-used / per-shard-batch columns.
 ``NNSTPU_POOL_ENABLED=false NNSTPU_POOL_CONCAT_THRESHOLD=0`` reproduces
 the pre-pool behavior for an A/B.  Appends nothing; copy the table +
 verdict into BENCH_NOTES.md.
@@ -40,6 +43,22 @@ import time
 from collections import defaultdict
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# --mesh[=SPEC] (default dp:8): sweep the mesh-sharded dispatch lane —
+# must export NNSTPU_MESH and the forced host device count BEFORE jax
+# initializes its CPU client
+MESH = None
+for _arg in list(sys.argv):
+    if _arg == "--mesh" or _arg.startswith("--mesh="):
+        MESH = _arg.partition("=")[2] or "dp:8"
+        sys.argv.remove(_arg)
+if MESH is not None:
+    os.environ["NNSTPU_MESH"] = MESH
+    _flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in _flags:
+        os.environ["XLA_FLAGS"] = (
+            _flags + " --xla_force_host_platform_device_count=8").strip()
+
 import jax
 
 jax.config.update("jax_platforms", "cpu")
@@ -177,6 +196,11 @@ def run_mux(streams, frames_per_stream, attribute=False):
     dsum = dev.summary()
     copies.dev_us_per_frame = dsum["device_ns"] / 1e3 / max(1, total_in)
     copies.dev_dispatches = dsum["completed"]
+    # mesh columns: chips the LAST compiled executable actually spanned
+    # (an indivisible leading dim falls back to 1) and the per-shard rows
+    mesh = getattr(filt.backend, "_mesh", None)
+    copies.chips = int(mesh.devices.size) if mesh is not None else 1
+    copies.per_shard = max(1, streams) / copies.chips
     return fps, wall, attr, copies
 
 
@@ -184,15 +208,19 @@ def main():
     ncpu = os.cpu_count()
     print(f"mux overhead sweep: total={TOTAL} frames, host cpus={ncpu}, "
           f"threads-per-config = streams sources + 1/elt + sinks")
+    if MESH is not None:
+        print(f"mesh-sharded dispatch: NNSTPU_MESH={MESH!r} over "
+              f"{len(jax.devices())} host devices")
     run_mux(1, 50)
     base_fps, _, _, base_cp = run_mux(1, TOTAL)
     print(f"\n{'streams':>7} {'agg fps':>10} {'us/frame':>10} "
           f"{'vs 1-stream':>11} {'copy KB/fr':>11} {'allocs/fr':>10} "
-          f"{'dev us/fr':>10}")
+          f"{'dev us/fr':>10} {'chips':>6} {'b/shard':>8}")
     print(f"{1:>7} {base_fps:>10.0f} {1e6 / base_fps:>10.1f} {'1.00x':>11} "
           f"{base_cp.per_frame / 1024:>11.1f} "
           f"{base_cp.allocs_per_frame:>10.3f} "
-          f"{base_cp.dev_us_per_frame:>10.1f}")
+          f"{base_cp.dev_us_per_frame:>10.1f} "
+          f"{base_cp.chips:>6} {base_cp.per_shard:>8.2f}")
     results = {1: base_fps}
     for s in [s for s in SWEEP if s != 1]:
         run_mux(s, 40)  # warm the s-wide executable
@@ -200,7 +228,8 @@ def main():
         results[s] = fps
         print(f"{s:>7} {fps:>10.0f} {1e6 / fps:>10.1f} "
               f"{fps / base_fps:>10.2f}x {cp.per_frame / 1024:>11.1f} "
-              f"{cp.allocs_per_frame:>10.3f} {cp.dev_us_per_frame:>10.1f}")
+              f"{cp.allocs_per_frame:>10.3f} {cp.dev_us_per_frame:>10.1f} "
+              f"{cp.chips:>6} {cp.per_shard:>8.2f}")
 
     # attribution pass at the widest sweep point
     widest = max(SWEEP)

@@ -508,6 +508,83 @@ print(f"chaos smoke OK: {drops} injected socket drops all retried to "
       f"{len(got)}+{rec['shed_total']}=={n}, /healthz back to 200")
 PY
 
+run_step "Mesh smoke (8-device host mesh: equivalence + per-chip spans)" \
+  env NNSTPU_MESH=dp:8 XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+      JAX_PLATFORMS=cpu \
+  python - <<'PY'
+import time
+
+import jax
+jax.config.update('jax_platforms', 'cpu')
+import numpy as np
+
+from nnstreamer_tpu import Pipeline
+from nnstreamer_tpu.backends.jax_backend import JaxBackend, JaxModel
+from nnstreamer_tpu.elements.dynbatch import DynBatch, DynUnbatch
+from nnstreamer_tpu.elements.filter import TensorFilter
+from nnstreamer_tpu.elements.sink import TensorSink
+from nnstreamer_tpu.elements.testsrc import DataSrc
+from nnstreamer_tpu.obs import spans
+from nnstreamer_tpu.obs.device import DeviceTracer
+from nnstreamer_tpu.obs.metrics import MetricsRegistry
+from nnstreamer_tpu.parallel.mesh import dispatch_mesh_devices
+from nnstreamer_tpu.spec import TensorsSpec
+
+assert len(jax.devices()) == 8, jax.devices()
+assert dispatch_mesh_devices() == 8
+
+# -- sharded vs single-device equivalence on the raw backend ------------
+w = (np.arange(16, dtype=np.float32).reshape(4, 4) / 7.0)
+model = JaxModel(apply=lambda p, x: x @ p["w"] + 0.5, params={"w": w})
+x = np.random.default_rng(7).standard_normal((16, 4)).astype(np.float32)
+sharded = JaxBackend(); sharded.open(model)
+sharded.reconfigure(TensorsSpec.from_arrays((x,)))
+assert sharded._mesh is not None, "mesh did not activate"
+(out,) = sharded.invoke((x,))
+assert len(out.sharding.device_set) == 8, out.sharding
+np.testing.assert_allclose(np.asarray(out), x @ w + 0.5, rtol=1e-5)
+
+# -- dynbatch e2e over the mesh with the device lane attached -----------
+got = []
+mdl = JaxModel(apply=lambda p, x: x * 3.0, input_spec=None)
+p = Pipeline(name="ci_mesh")
+src = p.add(DataSrc(data=[np.full((4,), i, np.float32)
+                          for i in range(24)], name="s"))
+db = p.add(DynBatch(max_batch=8, name="db"))
+filt = p.add(TensorFilter(framework="jax", model=mdl, name="f"))
+un = p.add(DynUnbatch(name="un"))
+p.link_chain(src, db, filt, un,
+             p.add(TensorSink(callback=got.append, name="out")))
+reg = MetricsRegistry()
+dev = p.attach_tracer(DeviceTracer(registry=reg))
+p.run(timeout=120)
+assert len(got) == 24, len(got)
+vals = sorted(float(f.tensors[0][0]) for f in got)
+np.testing.assert_allclose(vals, [i * 3.0 for i in range(24)], rtol=1e-6)
+deadline = time.time() + 30
+while time.time() < deadline:
+    s = dev.summary()
+    if s["dispatches"] and s["completed"] == s["dispatches"]:
+        break
+    time.sleep(0.05)
+summ = dev.summary()
+assert summ["compiles"]["miss"] >= 1, summ
+
+# nnstpu_device_exec spans on >= 2 device tracks (per-chip rows)
+doc = spans.chrome_trace(p.flight_snapshot())
+rows = {e["tid"]: e["args"]["name"] for e in doc["traceEvents"]
+        if e.get("ph") == "M" and e["name"] == "thread_name"}
+tracks = {rows[e["tid"]] for e in doc["traceEvents"]
+          if e.get("ph") == "X" and e["name"] == "device_exec"}
+dev_tracks = sorted(t for t in tracks if t.startswith("device:cpu:"))
+assert len(dev_tracks) >= 2, tracks
+assert len(summ["by_device"]) == 8, summ["by_device"]
+print(f"mesh smoke OK: sharded backend matched single-device to 1e-5, "
+      f"24 dynbatch frames exact over 8 chips, device_exec spans on "
+      f"{len(dev_tracks)} device tracks ({dev_tracks[0]}..{dev_tracks[-1]}), "
+      f"compile misses={summ['compiles']['miss']} (no per-frame churn)")
+PY
+
 run_step "Bench smoke (final JSON line parses, rc=0)" \
   bash -c '
     env BENCH_FRAMES=10 BENCH_QUANT_FRAMES=4 BENCH_BASELINE_FRAMES=3 \
