@@ -107,6 +107,26 @@ DEFAULTS: Dict[str, Dict[str, str]] = {
         "spec": "",                 # e.g. "seed=42;invoke_raise@f:every=5"
         "seed": "0",                # default seed (a seed= clause wins)
     },
+    # Fleet serving tier (nnstreamer_tpu/fleet): NNSQ router + worker
+    # membership.  NNSTPU_FLEET_* env vars map here.
+    "fleet": {
+        "heartbeat_s": "0.5",       # membership probe interval
+        "probe_timeout_s": "2.0",   # per-probe deadline
+        "suspect_misses": "2",      # missed probes before SUSPECT (no new
+                                    # dispatch; in-flight work completes)
+        "death_misses": "6",        # missed probes before DOWN (ejected)
+        "breaker_failures": "3",    # data-path failures to quarantine a
+                                    # flapping worker (per-worker breaker)
+        "breaker_reset_s": "2.0",   # quarantine -> half-open probe delay
+        "route_retries": "3",       # extra workers tried per request
+        "retry_backoff_ms": "20",   # first re-route backoff (doubles)
+        "retry_backoff_cap_ms": "500",
+        "connect_timeout_s": "5",   # router -> worker dial deadline
+        "request_timeout_s": "30",  # router -> worker reply deadline
+        "drain_deadline_s": "10",   # session-drain wait before force-break
+        "repo_addr": "",            # host:port of a TensorRepoServer; ""
+                                    # keeps tensor_repo process-local
+    },
     # Self-healing (graph/pipeline.py restart policies + backend
     # degradation).  NNSTPU_RECOVERY_* env vars map here.
     "recovery": {

@@ -115,11 +115,13 @@ class QueryTimeoutError(QueryError):
 
 
 class QuerySessionBrokenError(QueryError):
-    """A ``stateful=True`` client's connection died mid-stream.  Stateful
-    (decode-session) requests are NEVER retried — the server already
-    advanced its per-session state an unknown number of steps, and a
-    silent replay would corrupt the stream.  Reconnect and re-prefill to
-    rebuild the session instead."""
+    """A ``stateful=True`` decode session died mid-stream.  Raised
+    client-side when the connection tears, and ALSO sent as the typed
+    ``[SESSION]`` wire code by the fleet router / a draining server when
+    it must terminate a live session.  Stateful requests are NEVER
+    retried or re-routed — the server already advanced its per-session
+    state an unknown number of steps, and a silent replay would corrupt
+    the stream.  Reconnect and re-prefill to rebuild the session."""
 
     code = "SESSION"
 
@@ -130,6 +132,7 @@ ERROR_TYPES = {
     "OVERLOAD": QueryOverloadError,
     "EXPIRED": QueryExpiredError,
     "UNAVAILABLE": QueryUnavailableError,
+    "SESSION": QuerySessionBrokenError,
 }
 # pts of the client's negotiation probe frame.  DISTINCT from NONE_TS (-1):
 # unstamped stream frames are legitimate, and a stateful server (the
@@ -336,6 +339,12 @@ class QueryServer:
         self._srv: Optional[socket.socket] = None
         self._accept_thread: Optional[threading.Thread] = None
         self._running = False
+        self._draining = False
+        # live connections and their per-connection send locks: drain()
+        # must be able to send a typed goodbye on an IDLE connection
+        # without interleaving bytes with a concurrent reply
+        self._conns: "Dict[socket.socket, QueryServer._ConnState]" = {}
+        self._conns_lock = threading.Lock()
         self.batch = int(batch)
         if self.batch == 1 or self.batch < 0:
             raise ValueError("batch must be 0 (off) or >= 2")
@@ -405,6 +414,15 @@ class QueryServer:
             threading.Thread(target=self._serve, args=(conn,),
                              daemon=True, name="query-server-conn").start()
 
+    class _ConnState:
+        """Per-connection send lock + in-flight flag for drain()."""
+
+        __slots__ = ("lock", "busy")
+
+        def __init__(self):
+            self.lock = threading.Lock()
+            self.busy = False
+
     def _serve(self, conn: socket.socket) -> None:
         from ..sched import BreakerOpenError, OverloadError
 
@@ -413,58 +431,95 @@ class QueryServer:
             client, tenant = f"{peer[0]}:{peer[1]}", str(peer[0])
         except (OSError, IndexError):
             client = tenant = "unknown"
-        with conn:
-            while self._running:
-                try:
-                    tensors, pts, wire_trace = recv_tensors_ex(conn)
-                except (ConnectionError, OSError):
-                    return
-                # a flagged request attaches this serve span to the
-                # CLIENT's trace (the span id travels back in the reply);
-                # replies echo the flag only when the request carried it,
-                # so plain-v1 clients never see the bit
-                tok = (_spans.span_begin(wire_trace[0], wire_trace[1])
-                       if wire_trace is not None and _spans.enabled else None)
-                item = None
-                try:
+        state = self._ConnState()
+        with self._conns_lock:
+            self._conns[conn] = state
+        try:
+            with conn:
+                self._serve_loop(conn, state, client, tenant,
+                                 OverloadError, BreakerOpenError)
+        finally:
+            with self._conns_lock:
+                self._conns.pop(conn, None)
+
+    def _serve_loop(self, conn, state, client, tenant,
+                    OverloadError, BreakerOpenError) -> None:
+        while self._running:
+            try:
+                tensors, pts, wire_trace = recv_tensors_ex(conn)
+            except (ConnectionError, OSError):
+                return
+            with state.lock:
+                if self._draining:
+                    # a request racing the drain: typed goodbye, not a
+                    # silently dropped socket (the client re-routes)
                     try:
-                        if self.scheduler is not None:
-                            t0 = tensors[0] if tensors else None
-                            cost = (int(np.asarray(t0).shape[0])
-                                    if t0 is not None
-                                    and np.asarray(t0).ndim >= 1 else 1)
-                            # may raise OverloadError: shed with a typed
-                            # frame, keep the connection serving
-                            item = self.scheduler.admit(
-                                client, tenant=tenant, cost=max(1, cost))
-                        if self.batch:
-                            outs = self._invoke_batched(
-                                tensors, item,
-                                trace=((wire_trace[0], tok[0])
-                                       if tok is not None else None))
-                        else:
-                            outs = self._invoke_direct(tensors)
-                        reply_trace = wire_trace
-                        if tok is not None:
-                            reply_trace = (wire_trace[0], tok[0])
+                        send_error(conn, "server draining",
+                                   code="UNAVAILABLE")
+                    except OSError:
+                        pass
+                    return
+                state.busy = True
+            # a flagged request attaches this serve span to the
+            # CLIENT's trace (the span id travels back in the reply);
+            # replies echo the flag only when the request carried it,
+            # so plain-v1 clients never see the bit
+            tok = (_spans.span_begin(wire_trace[0], wire_trace[1])
+                   if wire_trace is not None and _spans.enabled else None)
+            item = None
+            try:
+                try:
+                    if self.scheduler is not None:
+                        t0 = tensors[0] if tensors else None
+                        cost = (int(np.asarray(t0).shape[0])
+                                if t0 is not None
+                                and np.asarray(t0).ndim >= 1 else 1)
+                        # may raise OverloadError: shed with a typed
+                        # frame, keep the connection serving
+                        item = self.scheduler.admit(
+                            client, tenant=tenant, cost=max(1, cost))
+                    if self.batch:
+                        outs = self._invoke_batched(
+                            tensors, item,
+                            trace=((wire_trace[0], tok[0])
+                                   if tok is not None else None))
+                    else:
+                        outs = self._invoke_direct(tensors)
+                    reply_trace = wire_trace
+                    if tok is not None:
+                        reply_trace = (wire_trace[0], tok[0])
+                    with state.lock:
                         send_tensors(conn, outs, pts, trace=reply_trace,
                                      fault_key="nnsq.server")
-                    finally:
-                        if item is not None:
-                            self.scheduler.release(item)
-                        if tok is not None:
-                            _spans.span_end(tok, "nnsq_serve", "query",
-                                            args={"client": client})
-                except (OverloadError, BreakerOpenError) as exc:
-                    try:
+                finally:
+                    if item is not None:
+                        self.scheduler.release(item)
+                    if tok is not None:
+                        _spans.span_end(tok, "nnsq_serve", "query",
+                                        args={"client": client})
+            except (OverloadError, BreakerOpenError) as exc:
+                try:
+                    with state.lock:
                         send_error(conn, str(exc), code=exc.code)
-                    except OSError:
-                        return
-                except Exception as exc:  # noqa: BLE001 — report, keep serving
-                    try:
+                except OSError:
+                    return
+            except Exception as exc:  # noqa: BLE001 — report, keep serving
+                try:
+                    with state.lock:
                         send_error(conn, repr(exc))
+                except OSError:
+                    return
+            finally:
+                state.busy = False
+            if self._draining:
+                # the in-flight dispatch drained; now say goodbye typed
+                with state.lock:
+                    try:
+                        send_error(conn, "server draining",
+                                   code="UNAVAILABLE")
                     except OSError:
-                        return
+                        pass
+                return
 
     def _invoke_direct(self, tensors):
         """Unbatched invoke (breaker-gated when a scheduler is attached)."""
@@ -472,11 +527,20 @@ class QueryServer:
         def run():
             if _faults.enabled:
                 _faults.maybe_invoke("query_server")
+            t0 = _spans.now_ns() if _spans.enabled else 0
             with self._lock:
                 if not self._running:
                     raise RuntimeError("query server stopped")
                 spec = TensorsSpec.from_arrays(tensors)
-                return self._backend_for(spec).invoke(tensors)
+                outs = self._backend_for(spec).invoke(tensors)
+            if t0:
+                # the device leg of the router → worker → device hop:
+                # rides the serving thread's current trace (the serve
+                # span is on this thread's span stack)
+                _spans.record_span(
+                    "device_invoke", t0, _spans.now_ns() - t0, cat="device",
+                    args={"framework": self._framework})
+            return outs
 
         if self.scheduler is not None:
             return self.scheduler.invoke(run)
@@ -683,11 +747,22 @@ class QueryServer:
                 def run(chunk=chunk):
                     if _faults.enabled:
                         _faults.maybe_invoke("query_server")
+                    t0 = _spans.now_ns() if _spans.enabled else 0
                     with self._lock:
                         if not self._running:
                             raise RuntimeError("server stopping")
                         spec = TensorsSpec.from_arrays(chunk)
-                        return self._backend_for(spec).invoke(chunk)
+                        outs_ = self._backend_for(spec).invoke(chunk)
+                    if t0:
+                        # device leg on the dispatcher thread: ride the
+                        # first member's wire trace (the group coalesced
+                        # many client traces into one invoke)
+                        _spans.record_span(
+                            "device_invoke", t0, _spans.now_ns() - t0,
+                            cat="device", trace=group[0].trace,
+                            args={"framework": self._framework,
+                                  "rows": int(chunk[0].shape[0])})
+                    return outs_
 
                 outs = sch.invoke(run) if sch is not None else run()
                 self.batched_invokes += 1
@@ -727,10 +802,77 @@ class QueryServer:
             out["sched"] = self.scheduler.stats()
         return out
 
+    def _close_listener(self) -> None:
+        """shutdown + close: close() alone leaves the accept thread
+        blocked in the syscall and CPython then defers the real fd
+        release — a restart on the same port would see EADDRINUSE."""
+        if self._srv is None:
+            return
+        try:
+            self._srv.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        self._srv.close()
+
+    def drain(self, timeout: float = 10.0) -> bool:
+        """Graceful shutdown (the SIGTERM path): stop accepting, let
+        in-flight dispatches finish and deliver their replies, and send a
+        typed ``[UNAVAILABLE]`` error frame to idle connections before
+        closing them — a client blocked in ``recv`` sees a typed
+        rejection it can re-route on, never a torn socket.  Returns True
+        when every connection closed before the deadline; always ends in
+        :meth:`stop`."""
+        self._draining = True
+        self._close_listener()  # accept loop exits; no new connections
+        with self._conns_lock:
+            conns = list(self._conns.items())
+        for conn, st in conns:
+            with st.lock:
+                if st.busy:
+                    continue  # in-flight: its serve loop says goodbye
+                try:
+                    send_error(conn, "server draining", code="UNAVAILABLE")
+                except OSError:
+                    pass
+                try:
+                    conn.shutdown(socket.SHUT_RDWR)  # wake its recv
+                except OSError:
+                    pass
+        deadline = time.monotonic() + float(timeout)
+        while time.monotonic() < deadline:
+            with self._conns_lock:
+                if not self._conns:
+                    break
+            time.sleep(0.01)
+        with self._conns_lock:
+            clean = not self._conns
+        self.stop()
+        return clean
+
+    def kill(self) -> None:
+        """Crash simulation (chaos ``worker_kill``): tear down every
+        socket mid-flight with no courtesy error frames — peers see torn
+        connections exactly as they would from a SIGKILLed process."""
+        self._running = False
+        self._close_listener()
+        with self._conns_lock:
+            conns = list(self._conns)
+        for conn in conns:
+            try:
+                conn.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                conn.close()
+            except OSError:
+                pass
+        # wake batched waiters (their conns are already dead, so the
+        # wake-up error never reaches a peer) and release backends
+        self.stop()
+
     def stop(self) -> None:
         self._running = False
-        if self._srv is not None:
-            self._srv.close()
+        self._close_listener()
         if self._rq is not None:
             # wake every queued waiter: connection threads block on their
             # event and would otherwise hang past the dispatcher's exit

@@ -110,6 +110,35 @@ class TensorRepo:
             s.eos = True
             s.cond.notify_all()
 
+    def prepare(self, idx: int) -> None:
+        """Sink-side start: reset the slot for a fresh run (keeping
+        checkpoint-restored contents) and clear any stale EOS."""
+        s = self.slot(idx)
+        with s.cond:
+            if not s.restored:  # keep checkpoint-restored contents
+                s.frame = None
+                s.spec = None
+            s.eos = False
+            s.cond.notify_all()
+
+    def reopen(self, idx: int) -> None:
+        """Src-side start: un-poison EOS left by a previous run's
+        interrupt; keep any pending frame (a producer may legitimately
+        have published already)."""
+        s = self.slot(idx)
+        with s.cond:
+            s.eos = False
+            s.cond.notify_all()
+
+    def take_restored(self, idx: int) -> bool:
+        """Consume the checkpoint-restored flag (the src skips its zero
+        bootstrap frame exactly once per restore)."""
+        s = self.slot(idx)
+        with s.cond:
+            was = s.restored
+            s.restored = False
+            return was
+
     def clear(self, idx: int) -> None:
         """Reset a slot for a fresh run (the reference removes repo data on
         element stop); EOS from a previous run must not poison the next."""
@@ -132,6 +161,31 @@ class TensorRepo:
 # The process-global repository (matches the reference's global `_repo`).
 GLOBAL_REPO = TensorRepo()
 
+_remote_lock = threading.Lock()
+_remote_repos: Dict[str, object] = {}
+
+
+def configured_repo():
+    """The default repo for elements constructed without ``repo=``: the
+    process-global one, unless ``[fleet] repo_addr``
+    (``NNSTPU_FLEET_REPO_ADDR``) points at a
+    :class:`nnstreamer_tpu.fleet.repo.TensorRepoServer` — then a shared
+    :class:`~nnstreamer_tpu.fleet.repo.RemoteTensorRepo`, so recurrence
+    composed across worker processes flows through one mailbox."""
+    from ..conf import conf
+
+    addr = (conf.get("fleet", "repo_addr", "") or "").strip()
+    if not addr:
+        return GLOBAL_REPO
+    with _remote_lock:
+        repo = _remote_repos.get(addr)
+        if repo is None:
+            from ..fleet.repo import RemoteTensorRepo
+
+            repo = RemoteTensorRepo.from_addr(addr)
+            _remote_repos[addr] = repo
+        return repo
+
 
 @register_element("tensor_reposink")
 class TensorRepoSink(SinkTerminal):
@@ -145,7 +199,7 @@ class TensorRepoSink(SinkTerminal):
         super().__init__(name)
         del signal_rate  # accepted for launch-string parity
         self.slot_index = int(slot_index)
-        self.repo = repo or GLOBAL_REPO
+        self.repo = repo or configured_repo()
         self._spec: Optional[TensorsSpec] = None
 
     def set_slot(self, idx: int) -> None:
@@ -157,13 +211,7 @@ class TensorRepoSink(SinkTerminal):
 
     def start(self) -> None:
         super().start()
-        s = self.repo.slot(self.slot_index)
-        with s.cond:
-            if not s.restored:  # keep checkpoint-restored contents
-                s.frame = None
-                s.spec = None
-            s.eos = False
-            s.cond.notify_all()
+        self.repo.prepare(self.slot_index)
         self.dropped = 0
 
     def process(self, pad: Pad, frame: Frame):
@@ -209,7 +257,7 @@ class TensorRepoSrc(SourceNode):
     ):
         super().__init__(name)
         self.slot_index = int(slot_index)
-        self.repo = repo or GLOBAL_REPO
+        self.repo = repo or configured_repo()
         if isinstance(caps, TensorsSpec):
             self._spec = caps
         elif caps:
@@ -224,10 +272,7 @@ class TensorRepoSrc(SourceNode):
         super().start()
         # Un-poison EOS left by a previous run's interrupt(); keep any
         # pending frame (a producer may legitimately have published already).
-        s = self.repo.slot(self.slot_index)
-        with s.cond:
-            s.eos = False
-            s.cond.notify_all()
+        self.repo.reopen(self.slot_index)
 
     def output_spec(self) -> TensorsSpec:
         return self._spec.fixate() if not self._spec.is_fixed else self._spec
@@ -244,11 +289,7 @@ class TensorRepoSrc(SourceNode):
         # — unless a checkpoint restored this slot, in which case the
         # restored frame takes the bootstrap's place (resume must not inject
         # a zero frame the uninterrupted run never saw).
-        s = self.repo.slot(self.slot_index)
-        with s.cond:
-            was_restored = s.restored
-            s.restored = False
-        if not was_restored:
+        if not self.repo.take_restored(self.slot_index):
             yield self._dummy_frame()
         my_spec = self.output_spec()
         while not self.stopped:

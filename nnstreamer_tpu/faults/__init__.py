@@ -34,6 +34,13 @@ Call sites (the injection points):
 ``queue_wedge``    the ``queue`` element's worker loop — sleep ``ms``
                    without popping (depth builds; the watchdog's wedge
                    detector is the intended observer)
+``fleet``          a fleet chaos supervisor's per-(tick, worker)
+                   consultation (:func:`maybe_fleet`) — ``worker_kill``
+                   (SIGKILL/abrupt socket teardown), ``worker_hang``
+                   (block the worker's dispatch for ``ms``),
+                   ``partition`` (health + data paths unreachable for
+                   ``ms``); the router/membership tier is the intended
+                   survivor (``nnstreamer_tpu/fleet``)
 =================  =====================================================
 """
 
@@ -140,6 +147,19 @@ def maybe_compile(name: str) -> None:
     rule = eng.decide("backend_compile", name)
     if rule is not None:
         raise InjectedFault(rule.kind, name, rule.opportunities)
+
+
+def maybe_fleet(name: str):
+    """``fleet`` point: one opportunity for the named worker; returns the
+    firing :class:`FaultRule` (the caller applies ``rule.kind`` —
+    ``worker_kill`` / ``worker_hang`` / ``partition`` — to the worker,
+    with ``rule.ms`` as the hang/partition duration) or None.  Unlike
+    the in-process points, the *application* lives with the caller: a
+    fleet supervisor owns the process handles the engine cannot."""
+    eng = _engine
+    if eng is None:
+        return None
+    return eng.decide("fleet", name)
 
 
 def maybe_queue_wedge(name: str) -> None:

@@ -21,6 +21,7 @@ Spec grammar (``NNSTPU_FAULTS`` / ini ``[faults] spec``)::
              invoke_delay | invoke_raise | device_stall (point backend_invoke)
              compile_raise                              (point backend_compile)
              queue_wedge                                (point queue_wedge)
+             worker_kill | worker_hang | partition      (point fleet)
     params : rate=P    Bernoulli per opportunity (0 < P <= 1)
              every=N   deterministic: every Nth opportunity
              after=N   arm only after N opportunities (alone: fire ONCE)
@@ -61,6 +62,12 @@ POINT_OF = {
     "device_stall": "backend_invoke",
     "compile_raise": "backend_compile",
     "queue_wedge": "queue_wedge",
+    # fleet scope (nnstreamer_tpu/fleet): consulted per (tick, worker)
+    # by a fleet chaos supervisor — kill a worker process, hang its
+    # dispatch for ms, or partition it (health + data paths) for ms
+    "worker_kill": "fleet",
+    "worker_hang": "fleet",
+    "partition": "fleet",
 }
 
 KINDS = frozenset(POINT_OF)

@@ -1,0 +1,46 @@
+"""Fault-tolerant fleet serving: N worker processes behind one NNSQ door.
+
+The NNStreamer papers' signature capability is stream offloading between
+devices ("among-device AI", arXiv 2101.06371); this package is its
+production-scale analog — QueryServer/DecodeServer scaled beyond one
+process, built robustness-first on the primitives the single-process
+stack already proved under chaos:
+
+- :mod:`.router` — the NNSQ-speaking front door: load-balances
+  stateless query traffic with transparent re-route-and-retry across
+  worker failures, pins stateful decode sessions sticky (typed
+  ``[SESSION]`` fail-fast, never replayed), meters cluster-wide
+  admission via a front-door :class:`~nnstreamer_tpu.sched.Scheduler`,
+  and records ``nnsq_route`` spans so one request renders as client →
+  router → worker → device in the Perfetto export;
+- :mod:`.membership` — heartbeats against each worker's ``/healthz``
+  JSON (healthy / degraded-deprioritized / unhealthy), suspect-vs-dead
+  disambiguation (a heartbeat partition never tears sessions or
+  duplicates dispatch), per-worker circuit breakers quarantining
+  flappers, ejection and probe-driven revival;
+- :mod:`.worker` — one worker's servers + lifecycle: graceful SIGTERM
+  drain (in-flight finishes, idle peers get typed ``[UNAVAILABLE]``,
+  sessions run to a deadline), abrupt ``kill`` and ``restart`` for
+  chaos/churn;
+- :mod:`.repo` — ``tensor_repo`` over the wire, so cross-pipeline
+  recurrence survives process boundaries (``[fleet] repo_addr``);
+- :mod:`.chaos` — applies the faults engine's seeded fleet-scope kinds
+  (``worker_kill`` / ``worker_hang`` / ``partition``) to live workers.
+
+``python -m nnstreamer_tpu.fleet worker|router`` runs either role as a
+process (see :mod:`.__main__`); ``docs/fleet.md`` has the topology and
+the stateless/stateful failover matrix.
+"""
+
+from .membership import (  # noqa: F401
+    DEGRADED,
+    DOWN,
+    SUSPECT,
+    UNHEALTHY,
+    UP,
+    Membership,
+    NoWorkerAvailable,
+    WorkerInfo,
+)
+from .router import Router  # noqa: F401
+from .worker import BUILTIN_MODELS, FleetWorker  # noqa: F401

@@ -1,0 +1,180 @@
+"""``python -m nnstreamer_tpu.fleet worker|router`` — fleet processes.
+
+Worker (one per chip or host)::
+
+    python -m nnstreamer_tpu.fleet worker --port 0 --health-port 0 \\
+        --framework custom --model x2 [--batch 4] \\
+        [--decode capacity=4,t_max=32,d_in=4,n_out=4,d_model=16,\\
+n_heads=2,n_layers=1 --decode-port 0]
+
+Router (the front door)::
+
+    python -m nnstreamer_tpu.fleet router --port 0 \\
+        --workers 127.0.0.1:7001/9001,127.0.0.1:7002/9002 [--stateful]
+
+Each process prints ONE JSON line describing its bound ports (a
+supervisor parses it), then serves until signalled:
+
+- ``SIGTERM`` → graceful drain: in-flight dispatches finish, idle
+  connections get typed ``[UNAVAILABLE]`` goodbyes, live decode
+  sessions run to the drain deadline — then exit 0;
+- ``SIGINT``  → plain stop.
+
+Worker specs for ``--workers`` are ``host:query_port[/health_port]``;
+the health port feeds membership's ``/healthz`` heartbeats.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+import threading
+
+
+def _parse_kv_ints(spec: str) -> dict:
+    out = {}
+    for part in (spec or "").split(","):
+        part = part.strip()
+        if not part:
+            continue
+        k, _, v = part.partition("=")
+        out[k.strip()] = int(v)
+    return out
+
+
+def _serve_until_signal(drain, stop) -> int:
+    """Park the main thread; SIGTERM drains, SIGINT stops."""
+    done = threading.Event()
+    rc = {"code": 0}
+
+    def on_term(signum, frame):
+        del signum, frame
+        threading.Thread(target=lambda: (drain(), done.set()),
+                         daemon=True).start()
+
+    def on_int(signum, frame):
+        del signum, frame
+        threading.Thread(target=lambda: (stop(), done.set()),
+                         daemon=True).start()
+
+    signal.signal(signal.SIGTERM, on_term)
+    signal.signal(signal.SIGINT, on_int)
+    done.wait()
+    return rc["code"]
+
+
+def _cmd_worker(args) -> int:
+    from .worker import FleetWorker
+
+    engine = None
+    if args.decode:
+        engine = _parse_kv_ints(args.decode)
+    worker = FleetWorker(
+        name=args.name, host=args.host, port=args.port,
+        framework=args.framework, model=args.model, custom=args.custom,
+        batch=args.batch, max_batch=args.max_batch, engine=engine,
+        decode_port=args.decode_port if engine else None,
+        health_port=args.health_port,
+        drain_timeout_s=args.drain_timeout).start()
+    print(json.dumps({
+        "role": "worker", "name": worker.name, "pid": os.getpid(),
+        "port": worker.query_port, "decode_port": worker.decode_port,
+        "health_port": worker.health_port,
+    }), flush=True)
+    return _serve_until_signal(worker.drain, worker.stop)
+
+
+def _cmd_router(args) -> int:
+    from .membership import Membership
+    from .router import Router
+
+    membership = Membership()
+    for spec in args.workers.split(","):
+        spec = spec.strip()
+        if not spec:
+            continue
+        addr, _, health = spec.partition("/")
+        host, _, port = addr.rpartition(":")
+        membership.add(host or "127.0.0.1", int(port),
+                       health_addr=f"{host or '127.0.0.1'}:{health}"
+                       if health else None)
+    membership.start()
+    router = Router(membership, host=args.host, port=args.port,
+                    stateful=args.stateful, name=args.name).start()
+    health_port = None
+    metrics = None
+    if args.health_port is not None:
+        from ..obs.export import MetricsServer
+
+        metrics = MetricsServer(port=args.health_port).start()
+        health_port = metrics.port
+    print(json.dumps({
+        "role": "router", "name": router.name, "pid": os.getpid(),
+        "port": router.port, "stateful": router.stateful,
+        "health_port": health_port,
+        "workers": [w.id for w in membership.workers()],
+    }), flush=True)
+
+    def stop():
+        router.stop()
+        membership.stop()
+        if metrics is not None:
+            metrics.stop()
+
+    return _serve_until_signal(stop, stop)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m nnstreamer_tpu.fleet", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    sub = ap.add_subparsers(dest="role", required=True)
+
+    w = sub.add_parser("worker", help="one QueryServer/DecodeServer process")
+    w.add_argument("--name", default=f"worker-{os.getpid()}")
+    w.add_argument("--host", default="127.0.0.1")
+    w.add_argument("--port", type=int, default=0)
+    w.add_argument("--health-port", type=int, default=0)
+    w.add_argument("--framework", default="custom")
+    w.add_argument("--model", default="x2",
+                   help="builtin model name (custom framework) or a "
+                        "model path for other frameworks")
+    w.add_argument("--custom", default="")
+    w.add_argument("--batch", type=int, default=0)
+    w.add_argument("--max-batch", type=int, default=64)
+    w.add_argument("--decode", default="",
+                   help="ContinuousBatcher kwargs 'capacity=4,t_max=32,...' "
+                        "— turns on the stateful DecodeServer surface")
+    w.add_argument("--decode-port", type=int, default=0)
+    w.add_argument("--drain-timeout", type=float, default=10.0)
+    w.set_defaults(fn=_cmd_worker)
+
+    r = sub.add_parser("router", help="the NNSQ fleet front door")
+    r.add_argument("--name", default="router")
+    r.add_argument("--host", default="127.0.0.1")
+    r.add_argument("--port", type=int, default=0)
+    r.add_argument("--health-port", type=int, default=None)
+    r.add_argument("--workers", required=True,
+                   help="host:query_port[/health_port],...")
+    r.add_argument("--stateful", action="store_true",
+                   help="front a DecodeServer fleet (sticky sessions)")
+    r.set_defaults(fn=_cmd_router)
+
+    for sp in (w, r):
+        sp.add_argument("--platform", default=None, metavar="NAME",
+                        help="pin the jax platform (e.g. cpu) before any "
+                             "backend initializes")
+
+    args = ap.parse_args(argv)
+    if args.platform:
+        import jax
+
+        jax.config.update("jax_platforms", args.platform)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

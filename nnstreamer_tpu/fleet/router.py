@@ -1,0 +1,659 @@
+"""The NNSQ fleet router: one front door, N worker processes.
+
+Clients speak the stock ``NNSQ`` wire protocol
+(:mod:`nnstreamer_tpu.elements.query`) to the router exactly as they
+would to a single ``QueryServer``/``DecodeServer`` — the fleet is
+invisible until something fails:
+
+- **stateless** traffic (``stateful=False``, the QueryServer surface)
+  is load-balanced per request across the membership's eligible
+  workers.  A forward that hits a dead, killed, or partitioned worker
+  is transparently re-routed and retried (bounded attempts, capped
+  exponential backoff) — the client sees its reply, never the failure.
+  Typed worker rejections are fleet-aware: ``[OVERLOAD]`` /
+  ``[UNAVAILABLE]`` from one worker (it is shedding or draining) send
+  the request to the next worker, and only when the whole fleet refuses
+  does the typed error surface; ``[EXPIRED]`` surfaces immediately (the
+  deadline already passed — a second worker cannot un-expire it).
+- **stateful** decode sessions (``stateful=True``, the DecodeServer
+  surface) are pinned sticky: the first real frame on a client
+  connection picks a worker and every subsequent frame rides the same
+  dedicated backend connection — the session id IS the connection, the
+  same contract the DecodeServer applies.  A mid-session worker failure
+  is NEVER replayed: the client gets the typed ``[SESSION]`` wire code
+  (:class:`~nnstreamer_tpu.elements.query.QuerySessionBrokenError`)
+  immediately and rebuilds by reconnecting (re-prefill), because the
+  dead worker's per-slot state is unrecoverable by definition.
+  Negotiation probes (``PROBE_PTS``) never pin — they are stateless by
+  contract and ride the re-routing path.
+
+**Cluster-wide admission**: pass (or conf-activate, ``NNSTPU_SCHED_*``)
+a :class:`nnstreamer_tpu.sched.Scheduler` and its per-tenant token
+buckets / bounded queues meter the WHOLE fleet's intake at the front
+door — the ``sched/`` tenancy model extended across workers, where it
+actually bounds aggregate load instead of per-process slices.
+
+**Rebalance** (:meth:`Router.drain_worker`): stop new work via
+membership draining, wait for the worker's live sessions to finish (up
+to the deadline), force-break stragglers with ``[SESSION]``, eject.
+
+With span tracing active the router records an ``nnsq_route`` span on
+the client's wire trace and forwards its span id as the worker-side
+parent, so one request renders as the full hop — client ``nnsq_rtt`` →
+router ``nnsq_route`` → worker ``nnsq_serve`` → ``device_invoke`` — in
+the Perfetto export.
+"""
+
+from __future__ import annotations
+
+import random
+import socket
+import threading
+import time
+import zlib
+from typing import Dict, List, Optional, Set, Tuple
+
+from .. import faults as _faults
+from ..elements.query import (
+    PROBE_PTS,
+    QueryError,
+    QueryExpiredError,
+    QueryOverloadError,
+    QueryTimeoutError,
+    QueryUnavailableError,
+    recv_tensors_ex,
+    send_error,
+    send_tensors,
+)
+from ..obs import spans as _spans
+from .membership import Membership, NoWorkerAvailable, WorkerInfo
+
+
+class _WorkerLink:
+    """Pooled connections from the router to ONE worker.  A socket is
+    checked out per forward and returned only after a clean round trip —
+    any transport error drops it (the stream position is unknowable)."""
+
+    MAX_IDLE = 4
+
+    def __init__(self, worker: WorkerInfo, connect_timeout: float,
+                 request_timeout: float):
+        self.worker = worker
+        self.connect_timeout = connect_timeout
+        self.request_timeout = request_timeout
+        self._idle: List[socket.socket] = []
+        self._lock = threading.Lock()
+
+    def get(self) -> socket.socket:
+        if self.worker.block_data:
+            # chaos partition: the dial would never complete — surface
+            # the same ConnectionError a refused connect would
+            raise ConnectionError(f"{self.worker.id}: partitioned")
+        with self._lock:
+            if self._idle:
+                return self._idle.pop()
+        sock = socket.create_connection(
+            self.worker.addr, timeout=self.connect_timeout)
+        sock.settimeout(self.request_timeout)
+        return sock
+
+    def put(self, sock: socket.socket) -> None:
+        with self._lock:
+            if len(self._idle) < self.MAX_IDLE:
+                self._idle.append(sock)
+                return
+        try:
+            sock.close()
+        except OSError:
+            pass
+
+    def drop(self, sock: socket.socket) -> None:
+        try:
+            sock.close()
+        except OSError:
+            pass
+
+    def close_all(self) -> None:
+        with self._lock:
+            idle, self._idle = self._idle, []
+        for sock in idle:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+
+class _Session:
+    """One pinned stateful session: client conn + dedicated worker sock."""
+
+    __slots__ = ("worker", "sock", "client", "lock", "broken", "steps")
+
+    def __init__(self, worker: WorkerInfo, sock: socket.socket, client):
+        self.worker = worker
+        self.sock = sock
+        self.client = client
+        self.lock = threading.Lock()
+        self.broken = False
+        self.steps = 0
+
+
+class Router:
+    """NNSQ front door over a :class:`~.membership.Membership` roster."""
+
+    def __init__(self, membership: Membership, host: str = "127.0.0.1",
+                 port: int = 0, stateful: bool = False, scheduler=None,
+                 route_retries: Optional[int] = None,
+                 retry_backoff_ms: Optional[float] = None,
+                 retry_backoff_cap_ms: Optional[float] = None,
+                 connect_timeout: Optional[float] = None,
+                 request_timeout: Optional[float] = None,
+                 drain_deadline_s: Optional[float] = None,
+                 name: str = "router"):
+        from ..conf import conf
+
+        def _f(key, arg, default):
+            return float(arg) if arg is not None else \
+                conf.get_float("fleet", key, default)
+
+        self.membership = membership
+        self.host, self.port = host, int(port)
+        self.stateful = bool(stateful)
+        self.name = str(name)
+        self.route_retries = (int(route_retries) if route_retries is not None
+                              else conf.get_int("fleet", "route_retries", 3))
+        self.retry_backoff_ms = _f("retry_backoff_ms", retry_backoff_ms, 20.0)
+        self.retry_backoff_cap_ms = _f(
+            "retry_backoff_cap_ms", retry_backoff_cap_ms, 500.0)
+        self.connect_timeout = _f("connect_timeout_s", connect_timeout, 5.0)
+        self.request_timeout = _f("request_timeout_s", request_timeout, 30.0)
+        self.drain_deadline_s = _f("drain_deadline_s", drain_deadline_s, 10.0)
+        self._own_sched = False
+        if scheduler is None:
+            from ..sched import configured_scheduler
+
+            scheduler = configured_scheduler(self.name)
+            self._own_sched = scheduler is not None
+        self.scheduler = scheduler
+        self._srv: Optional[socket.socket] = None
+        self._accept_thread: Optional[threading.Thread] = None
+        self._running = False
+        self._links: Dict[str, _WorkerLink] = {}
+        self._links_lock = threading.Lock()
+        self._sessions: Dict[str, Set[_Session]] = {}
+        self._sessions_lock = threading.Lock()
+        # deterministic jitter stream (chaos replays want stable backoff)
+        self._rng = random.Random(zlib.crc32(self.name.encode()))
+        # the recovery ledger: offered == delivered + sum(shed.values())
+        self._ledger_lock = threading.Lock()
+        self.offered = 0
+        self.delivered = 0
+        self.shed: Dict[str, int] = {}
+        self.rerouted = 0          # transport-failure re-dispatches
+        self.sessions_opened = 0
+        self.sessions_broken = 0
+        self._stats_key: Optional[str] = None
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> "Router":
+        _faults.ensure_configured()  # chaos runs cover the front door too
+        self._srv = socket.create_server((self.host, self.port))
+        self.port = self._srv.getsockname()[1]
+        self._running = True
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, daemon=True,
+            name=f"fleet-router:{self.name}")
+        self._accept_thread.start()
+        from ..obs.export import register_stats
+
+        self._stats_key = f"fleet:{self.name}"
+        register_stats(self._stats_key, self.stats)
+        return self
+
+    def stop(self) -> None:
+        self._running = False
+        if self._srv is not None:
+            self._srv.close()
+        with self._links_lock:
+            links = list(self._links.values())
+        for link in links:
+            link.close_all()
+        with self._sessions_lock:
+            sessions = [s for group in self._sessions.values()
+                        for s in group]
+        for sess in sessions:
+            for sock in (sess.sock, sess.client):
+                try:
+                    sock.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
+        if self._stats_key is not None:
+            from ..obs.export import unregister_stats
+
+            unregister_stats(self._stats_key, self.stats)
+            self._stats_key = None
+        if self._own_sched and self.scheduler is not None:
+            self.scheduler.close()
+
+    def __enter__(self) -> "Router":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # -- accept / serve ------------------------------------------------------
+
+    def _accept_loop(self) -> None:
+        while self._running:
+            try:
+                conn, _ = self._srv.accept()
+            except OSError:
+                return
+            threading.Thread(
+                target=self._serve, args=(conn,), daemon=True,
+                name=f"fleet-router-conn:{self.name}").start()
+
+    def _serve(self, conn: socket.socket) -> None:
+        try:
+            peer = conn.getpeername()
+            client, tenant = f"{peer[0]}:{peer[1]}", str(peer[0])
+        except (OSError, IndexError):
+            client = tenant = "unknown"
+        with conn:
+            if self.stateful:
+                self._serve_stateful(conn, client)
+            else:
+                self._serve_stateless(conn, client, tenant)
+
+    def _count_shed(self, reason: str) -> None:
+        with self._ledger_lock:
+            self.shed[reason] = self.shed.get(reason, 0) + 1
+
+    def _serve_stateless(self, conn, client: str, tenant: str) -> None:
+        from ..sched import BreakerOpenError, OverloadError
+
+        import numpy as np
+
+        while self._running:
+            try:
+                tensors, pts, wtrace = recv_tensors_ex(conn)
+            except (ConnectionError, OSError):
+                return
+            with self._ledger_lock:
+                self.offered += 1
+            # route span: child of the client's rtt span when the wire
+            # carried a trace; otherwise a fresh trace (the hop is still
+            # recorded).  The reply echoes the flag ONLY when the
+            # request carried it — plain-v1 clients never see the bit.
+            tok = None
+            if _spans.enabled:
+                tok = (_spans.span_begin(wtrace[0], wtrace[1])
+                       if wtrace is not None
+                       else _spans.span_begin(_spans.new_trace_id(), 0))
+            # token layout: (span_id, t0, trace_id, parent, prev)
+            fwd_trace = (tok[2], tok[0]) if tok is not None else None
+            item = None
+            worker_id = ""
+            try:
+                try:
+                    if self.scheduler is not None:
+                        t0 = tensors[0] if tensors else None
+                        cost = (int(np.asarray(t0).shape[0])
+                                if t0 is not None
+                                and np.asarray(t0).ndim >= 1 else 1)
+                        # cluster-wide admission: the whole fleet's
+                        # intake is metered here, per tenant
+                        item = self.scheduler.admit(
+                            client, tenant=tenant, cost=max(1, cost))
+                    outs, opts, w = self._forward(tensors, pts, fwd_trace)
+                    worker_id = w.id
+                    reply_trace = ((wtrace[0], tok[0])
+                                   if tok is not None and wtrace is not None
+                                   else None)
+                    send_tensors(conn, outs, opts, trace=reply_trace,
+                                 fault_key="nnsq.router")
+                    with self._ledger_lock:
+                        self.delivered += 1
+                finally:
+                    if item is not None:
+                        self.scheduler.release(item)
+                    if tok is not None:
+                        _spans.span_end(
+                            tok, "nnsq_route", "fleet",
+                            args={"client": client, "worker": worker_id})
+            except (OverloadError, BreakerOpenError) as exc:
+                self._count_shed(getattr(exc, "reason", "admission"))
+                try:
+                    send_error(conn, str(exc), code=exc.code)
+                except OSError:
+                    return
+            except QueryError as exc:
+                # typed fleet verdict (worker rejection after exhausting
+                # alternatives, or no worker at all)
+                self._count_shed(exc.code.lower() or "error")
+                try:
+                    send_error(conn, str(exc), code=exc.code)
+                except OSError:
+                    return
+            except Exception as exc:  # noqa: BLE001 — report, keep serving
+                self._count_shed("error")
+                try:
+                    send_error(conn, repr(exc))
+                except OSError:
+                    return
+
+    # -- stateless forwarding ------------------------------------------------
+
+    def _link(self, w: WorkerInfo) -> _WorkerLink:
+        with self._links_lock:
+            link = self._links.get(w.id)
+            if link is None or link.worker is not w:
+                # new or revived worker object: fresh pool
+                link = _WorkerLink(w, self.connect_timeout,
+                                   self.request_timeout)
+                self._links[w.id] = link
+            return link
+
+    def _forward(self, tensors, pts,
+                 trace: Optional[Tuple[int, int]]
+                 ) -> Tuple[tuple, int, WorkerInfo]:
+        """One stateless request against the fleet: pick, forward, and on
+        transport failure re-route to the next eligible worker (bounded,
+        with capped backoff).  Typed worker rejections try the next
+        worker too (the fleet absorbs one worker's shedding) and only
+        surface when every candidate refused; ``[EXPIRED]`` surfaces
+        immediately.  Returns ``(outs, pts, worker)``."""
+        tried: Set[str] = set()
+        last_typed: Optional[QueryError] = None
+        delay_s = self.retry_backoff_ms / 1e3
+        attempts = 1 + max(0, self.route_retries)
+        for attempt in range(attempts):
+            try:
+                w = self.membership.pick(exclude=tried)
+            except NoWorkerAvailable as exc:
+                if last_typed is not None:
+                    raise last_typed
+                raise QueryUnavailableError(
+                    f"{self.name}: {exc} (attempt {attempt + 1})") from exc
+            link = self._link(w)
+            try:
+                sock = link.get()
+            except (ConnectionError, OSError):
+                self.membership.report_failure(w)
+                tried.add(w.id)
+                with self._ledger_lock:
+                    self.rerouted += 1
+                continue
+            try:
+                send_tensors(sock, tensors, pts, trace=trace,
+                             fault_key="nnsq.router")
+                outs, opts, _rtrace = recv_tensors_ex(sock)
+            except (QueryTimeoutError, ConnectionError, OSError):
+                # transport failure: the worker is gone or unreachable —
+                # drop the socket (stream position unknowable), mark the
+                # failure, and re-route.  Stateless requests are safe to
+                # re-dispatch by contract.
+                link.drop(sock)
+                self.membership.report_failure(w)
+                tried.add(w.id)
+                with self._ledger_lock:
+                    self.rerouted += 1
+                if attempt + 1 < attempts:
+                    # capped exponential backoff + deterministic jitter:
+                    # a re-routing fleet must not dogpile the survivors
+                    time.sleep(delay_s *
+                               (1.0 + 0.25 * self._rng.random()))
+                    delay_s = min(delay_s * 2,
+                                  self.retry_backoff_cap_ms / 1e3)
+                continue
+            except (QueryOverloadError, QueryUnavailableError) as exc:
+                # typed rejection: the worker is shedding/draining but
+                # the connection is fine.  Another worker may have room.
+                link.put(sock)
+                self.membership.report_success(w)
+                if isinstance(exc, QueryExpiredError):
+                    raise  # a second worker cannot un-expire a deadline
+                last_typed = exc
+                tried.add(w.id)
+                continue
+            except QueryError:
+                link.put(sock)
+                self.membership.report_success(w)
+                raise
+            else:
+                link.put(sock)
+                self.membership.report_success(w)
+                return outs, opts, w
+        if last_typed is not None:
+            raise last_typed
+        raise QueryUnavailableError(
+            f"{self.name}: no worker answered after {attempts} attempts "
+            f"({sorted(tried)} failed)")
+
+    # -- stateful (sticky) serving ------------------------------------------
+
+    def _register_session(self, sess: _Session) -> None:
+        with self._sessions_lock:
+            self._sessions.setdefault(sess.worker.id, set()).add(sess)
+        with self._ledger_lock:
+            self.sessions_opened += 1
+
+    def _unregister_session(self, sess: _Session) -> None:
+        with self._sessions_lock:
+            group = self._sessions.get(sess.worker.id)
+            if group is not None:
+                group.discard(sess)
+
+    def session_count(self, worker_id: Optional[str] = None) -> int:
+        with self._sessions_lock:
+            if worker_id is not None:
+                return len(self._sessions.get(worker_id, ()))
+            return sum(len(g) for g in self._sessions.values())
+
+    def _serve_stateful(self, conn, client: str) -> None:
+        sess: Optional[_Session] = None
+        try:
+            while self._running:
+                try:
+                    tensors, pts, wtrace = recv_tensors_ex(conn)
+                except (ConnectionError, OSError):
+                    return
+                tok = None
+                if _spans.enabled:
+                    tok = (_spans.span_begin(wtrace[0], wtrace[1])
+                           if wtrace is not None
+                           else _spans.span_begin(_spans.new_trace_id(), 0))
+                fwd_trace = (tok[2], tok[0]) if tok is not None else None
+                reply_trace = ((wtrace[0], tok[0])
+                               if tok is not None and wtrace is not None
+                               else None)
+                worker_id = sess.worker.id if sess is not None else ""
+                try:
+                    try:
+                        if pts == PROBE_PTS and sess is None:
+                            # negotiation probes are stateless by the
+                            # DecodeServer contract: never pin, freely
+                            # re-routed
+                            outs, opts, w = self._forward(
+                                tensors, pts, fwd_trace)
+                            worker_id = w.id
+                            send_tensors(conn, outs, opts,
+                                         trace=reply_trace,
+                                         fault_key="nnsq.router")
+                            continue
+                        if sess is None:
+                            sess = self._open_session(conn, client)
+                            worker_id = sess.worker.id
+                        self._session_step(sess, tensors, pts, fwd_trace,
+                                           reply_trace)
+                    finally:
+                        if tok is not None:
+                            _spans.span_end(
+                                tok, "nnsq_route", "fleet",
+                                args={"client": client,
+                                      "worker": worker_id,
+                                      "stateful": True})
+                except _SessionOver:
+                    return
+                except QueryError as exc:
+                    with sess.lock if sess is not None \
+                            else threading.Lock():
+                        try:
+                            send_error(conn, str(exc), code=exc.code)
+                        except OSError:
+                            return
+                    if sess is not None:
+                        # any typed verdict on a pinned session ends it:
+                        # the worker-side session died with its conn
+                        return
+                except Exception as exc:  # noqa: BLE001
+                    try:
+                        send_error(conn, repr(exc))
+                    except OSError:
+                        return
+        finally:
+            if sess is not None:
+                self._unregister_session(sess)
+                try:
+                    sess.sock.close()
+                except OSError:
+                    pass
+
+    def _open_session(self, conn, client: str) -> _Session:
+        """Pin this client connection to a worker (sticky): dedicated
+        backend connection, registered for drain accounting."""
+        try:
+            w = self.membership.pick()
+        except NoWorkerAvailable as exc:
+            raise QueryUnavailableError(
+                f"{self.name}: no worker for a new decode session "
+                f"({exc})") from exc
+        try:
+            link = self._link(w)
+            sock = socket.create_connection(
+                w.addr, timeout=self.connect_timeout)
+            sock.settimeout(self.request_timeout)
+            del link
+        except (ConnectionError, OSError) as exc:
+            self.membership.report_failure(w)
+            raise QueryUnavailableError(
+                f"{self.name}: worker {w.id} refused the session "
+                f"({exc})") from exc
+        self.membership.report_success(w)
+        sess = _Session(w, sock, conn)
+        self._register_session(sess)
+        return sess
+
+    def _session_step(self, sess: _Session, tensors, pts, fwd_trace,
+                      reply_trace) -> None:
+        """Forward one frame on the pinned connection.  NO replay on
+        failure — the worker's session state already advanced an unknown
+        number of steps; the client gets the typed ``[SESSION]`` code
+        and rebuilds."""
+        try:
+            send_tensors(sess.sock, tensors, pts, trace=fwd_trace,
+                         fault_key="nnsq.router")
+            outs, opts, _rt = recv_tensors_ex(sess.sock)
+        except (QueryTimeoutError, ConnectionError, OSError) as exc:
+            self.membership.report_failure(sess.worker)
+            with self._ledger_lock:
+                self.sessions_broken += 1
+            with sess.lock:
+                if not sess.broken:
+                    sess.broken = True
+                    try:
+                        send_error(
+                            sess.client,
+                            f"decode session on worker {sess.worker.id} "
+                            f"broken mid-stream ({exc}); stateful requests "
+                            "are never replayed — reconnect and re-prefill",
+                            code="SESSION")
+                    except OSError:
+                        pass
+            raise _SessionOver() from exc
+        with sess.lock:
+            if sess.broken:
+                raise _SessionOver()
+            send_tensors(sess.client, outs, opts, trace=reply_trace,
+                         fault_key="nnsq.router")
+        sess.steps += 1
+        self.membership.report_success(sess.worker)
+
+    # -- rebalance -----------------------------------------------------------
+
+    def break_sessions(self, worker_id: str, msg: str,
+                       code: str = "SESSION") -> int:
+        """Terminate every live session pinned to ``worker_id`` with a
+        typed error frame (never a torn socket).  Returns how many."""
+        with self._sessions_lock:
+            sessions = list(self._sessions.get(worker_id, ()))
+        n = 0
+        for sess in sessions:
+            with sess.lock:
+                if sess.broken:
+                    continue
+                sess.broken = True
+                n += 1
+                try:
+                    send_error(sess.client, msg, code=code)
+                except OSError:
+                    pass
+            for sock in (sess.sock, sess.client):
+                try:
+                    sock.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
+        with self._ledger_lock:
+            self.sessions_broken += n
+        return n
+
+    def drain_worker(self, worker_id: str,
+                     deadline_s: Optional[float] = None) -> int:
+        """Planned removal: stop new work (membership drain), wait for
+        the worker's live sessions to finish up to ``deadline_s``, break
+        stragglers with the typed ``[SESSION]`` code, then eject.
+        Returns the number of force-broken sessions (0 = clean drain)."""
+        deadline_s = (self.drain_deadline_s if deadline_s is None
+                      else float(deadline_s))
+        self.membership.drain(worker_id)
+        deadline = time.monotonic() + deadline_s
+        while time.monotonic() < deadline and self.session_count(worker_id):
+            time.sleep(0.02)
+        broken = 0
+        if self.session_count(worker_id):
+            broken = self.break_sessions(
+                worker_id,
+                f"worker {worker_id} drained: session terminated "
+                "(reconnect and re-prefill elsewhere)")
+        self.membership.eject(worker_id)
+        return broken
+
+    # -- observability -------------------------------------------------------
+
+    def stats(self) -> dict:
+        with self._ledger_lock:
+            out = {
+                "name": self.name,
+                "running": self._running,
+                "stateful": self.stateful,
+                "offered": self.offered,
+                "delivered": self.delivered,
+                "shed": dict(self.shed),
+                "shed_total": sum(self.shed.values()),
+                "rerouted": self.rerouted,
+                "sessions_opened": self.sessions_opened,
+                "sessions_broken": self.sessions_broken,
+            }
+        out["sessions_active"] = self.session_count()
+        with self._sessions_lock:
+            out["sessions_by_worker"] = {
+                wid: len(group) for wid, group in self._sessions.items()
+                if group}
+        out["membership"] = self.membership.stats()
+        if self.scheduler is not None:
+            out["sched"] = self.scheduler.stats()
+        return out
+
+
+class _SessionOver(Exception):
+    """Internal: the pinned session ended (typed error already sent)."""

@@ -1,0 +1,259 @@
+"""One fleet worker: a QueryServer (and optionally a DecodeServer) plus
+lifecycle — graceful drain on SIGTERM, abrupt kill for chaos, restart
+for churn soaks — behind a single handle.
+
+Two deployment shapes share this class:
+
+- **subprocess** (``python -m nnstreamer_tpu.fleet worker``): one worker
+  per process, one process per chip or host.  ``health_port`` starts a
+  :class:`~nnstreamer_tpu.obs.export.MetricsServer` whose ``/healthz``
+  (JSON status + reasons) is what fleet membership probes; a SIGTERM
+  drains both servers — in-flight dispatches finish, idle connections
+  get typed ``[UNAVAILABLE]`` goodbyes, live decode sessions get the
+  drain deadline — and the process exits 0.
+- **in-process** (tests, chaos soaks): many workers inside one test
+  process, each with its own servers on distinct ports.  Membership
+  probes them through :meth:`probe` instead of HTTP (process-global
+  health providers would cross-talk), and the chaos harness drives
+  :meth:`kill` / :meth:`hang` / :meth:`restart`.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, Optional
+
+from ..elements.query import QueryServer
+
+# models servable by name from the worker CLI (framework "custom");
+# tiny on purpose — the fleet smoke needs workers, not accuracy
+BUILTIN_MODELS: Dict[str, Callable] = {
+    "x2": lambda x: x * 2.0,
+    "x3": lambda x: x * 3.0,
+    "sum": lambda x: x.reshape(-1).sum()[None],
+}
+
+
+def resolve_model(model):
+    """A CLI ``--model`` name -> callable; callables pass through."""
+    if isinstance(model, str):
+        try:
+            return BUILTIN_MODELS[model]
+        except KeyError:
+            raise ValueError(
+                f"unknown builtin model {model!r} "
+                f"(known: {sorted(BUILTIN_MODELS)})") from None
+    return model
+
+
+class FleetWorker:
+    """The servers of one worker plus drain/kill/restart lifecycle."""
+
+    def __init__(self, name: str = "worker", host: str = "127.0.0.1",
+                 port: int = 0, framework: str = "custom", model="x2",
+                 custom: str = "", batch: int = 0,
+                 batch_window_ms: float = 2.0, max_batch: int = 64,
+                 scheduler=None, engine=None, decode_port: Optional[int] = None,
+                 health_port: Optional[int] = None,
+                 drain_timeout_s: float = 10.0):
+        """``engine`` turns on the stateful surface: either a live
+        :class:`~nnstreamer_tpu.serving.ContinuousBatcher` or a kwargs
+        dict to build one (the CLI path), served by a DecodeServer on
+        ``decode_port``.  ``health_port`` (subprocess mode) starts the
+        metrics/health endpoint and registers this worker's drain state
+        as a health provider."""
+        self.name = name
+        self.host = host
+        self._q_kwargs = dict(
+            framework=framework, model=resolve_model(model), custom=custom,
+            host=host, port=int(port), batch=batch,
+            batch_window_ms=batch_window_ms, max_batch=max_batch,
+            scheduler=scheduler)
+        self._engine_cfg = engine
+        self._decode_port = decode_port
+        self._health_port = health_port
+        self.drain_timeout_s = float(drain_timeout_s)
+        self.query_server: Optional[QueryServer] = None
+        self.decode_server = None
+        self.engine = None
+        self.metrics_server = None
+        self.degraded_reason = ""  # tests / operators: deprioritize me
+        self._killed = False
+        self._draining = False
+        self._lock = threading.Lock()
+        self.restarts = 0
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> "FleetWorker":
+        self._killed = False
+        self._draining = False
+        self.query_server = QueryServer(**self._q_kwargs).start()
+        self._q_kwargs["port"] = self.query_server.port  # pin for restart
+        if self._engine_cfg is not None:
+            from ..serving import ContinuousBatcher, DecodeServer
+
+            if isinstance(self._engine_cfg, ContinuousBatcher):
+                self.engine = self._engine_cfg
+            else:
+                self.engine = ContinuousBatcher(**dict(self._engine_cfg))
+            self.decode_server = DecodeServer(
+                self.engine, host=self.host,
+                port=int(self._decode_port or 0)).start()
+            self._decode_port = self.decode_server.port
+        if self._health_port is not None:
+            from ..obs.export import (
+                MetricsServer,
+                register_degraded,
+                register_health,
+                register_stats,
+            )
+
+            self.metrics_server = MetricsServer(
+                port=int(self._health_port)).start()
+            self._health_port = self.metrics_server.port
+            register_health(f"worker:{self.name}", self._health_provider)
+            register_degraded(f"worker:{self.name}", lambda:
+                              self.degraded_reason)
+            register_stats(f"worker:{self.name}", self.stats)
+        return self
+
+    def _health_provider(self):
+        if self._draining:
+            return False, "draining"
+        return True, ""
+
+    @property
+    def query_port(self) -> int:
+        return self.query_server.port
+
+    @property
+    def decode_port(self) -> Optional[int]:
+        return self._decode_port if self.decode_server is not None else None
+
+    @property
+    def health_port(self) -> Optional[int]:
+        return self._health_port if self.metrics_server is not None else None
+
+    # -- membership probe (in-process fleets) --------------------------------
+
+    def probe(self, _info=None) -> str:
+        """The :class:`~.membership.Membership` probe contract: a status
+        string, raising = unreachable (a killed worker's endpoint)."""
+        if self._killed:
+            raise ConnectionError(f"{self.name}: killed")
+        if self._draining:
+            return "unhealthy"
+        if self.degraded_reason:
+            return f"degraded:{self.degraded_reason}"
+        return "ok"
+
+    # -- shutdown paths ------------------------------------------------------
+
+    def drain(self, timeout: Optional[float] = None) -> bool:
+        """Graceful removal (the SIGTERM path): both servers drain —
+        in-flight work finishes, idle peers get typed goodbyes, live
+        decode sessions run to the deadline."""
+        timeout = self.drain_timeout_s if timeout is None else float(timeout)
+        with self._lock:
+            if self._draining:
+                return True
+            self._draining = True
+        clean = True
+        if self.query_server is not None:
+            clean = self.query_server.drain(timeout) and clean
+        if self.decode_server is not None:
+            clean = self.decode_server.drain(timeout) and clean
+        if self.engine is not None:
+            self.engine.stop()
+        self._teardown_obs()
+        return clean
+
+    def kill(self) -> None:
+        """Chaos ``worker_kill``: abrupt socket teardown, no goodbyes —
+        peers see exactly what a SIGKILL would give them."""
+        self._killed = True
+        if self.query_server is not None:
+            self.query_server.kill()
+        if self.decode_server is not None:
+            self.decode_server.kill()
+        if self.engine is not None:
+            # the engine thread dies with the "process" (kept from
+            # leaking OS threads across a long chaos soak)
+            self.engine.stop()
+        self._teardown_obs()
+
+    def hang(self, ms: float) -> None:
+        """Chaos ``worker_hang``: hold the query server's backend lock
+        for ``ms`` so every dispatch wedges (the router's request
+        timeout is the intended observer).  Returns immediately."""
+        qs = self.query_server
+        if qs is None:
+            return
+
+        def hold():
+            with qs._lock:
+                time.sleep(ms / 1e3)
+
+        threading.Thread(target=hold, daemon=True,
+                         name=f"hang:{self.name}").start()
+
+    def restart(self) -> "FleetWorker":
+        """Churn: bring the worker back on the SAME ports (kill/restart
+        cycles must converge through the membership revival path)."""
+        self.restarts += 1
+        if self._engine_cfg is not None and not isinstance(
+                self._engine_cfg, dict):
+            # a live engine object died with the kill; rebuild needs a
+            # config dict
+            raise RuntimeError(
+                f"{self.name}: restart needs engine= as a kwargs dict")
+        return self.start()
+
+    def stop(self) -> None:
+        """Plain teardown (tests): no goodbyes, no crash semantics."""
+        self._killed = True
+        if self.query_server is not None:
+            self.query_server.stop()
+        if self.decode_server is not None:
+            self.decode_server.stop()
+        if self.engine is not None:
+            self.engine.stop()
+        self._teardown_obs()
+
+    def _teardown_obs(self) -> None:
+        if self.metrics_server is not None:
+            from ..obs.export import (
+                unregister_degraded,
+                unregister_health,
+                unregister_stats,
+            )
+
+            unregister_health(f"worker:{self.name}")
+            unregister_degraded(f"worker:{self.name}")
+            unregister_stats(f"worker:{self.name}")
+            self.metrics_server.stop()
+            self.metrics_server = None
+
+    def __enter__(self) -> "FleetWorker":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    def stats(self) -> dict:
+        out = {
+            "name": self.name,
+            "draining": self._draining,
+            "killed": self._killed,
+            "restarts": self.restarts,
+            "degraded_reason": self.degraded_reason,
+        }
+        if self.query_server is not None:
+            out["query"] = self.query_server.stats()
+        if self.decode_server is not None:
+            out["decode"] = self.decode_server.stats()
+        if self.engine is not None:
+            out["engine"] = self.engine.stats()
+        return out

@@ -12,9 +12,11 @@ daemon thread — at ``/metrics``; activation is conf-driven from
 ``nnstpu_serving_*`` gauges, refreshed at scrape time via a registry
 collector — pull-style, no background poller.
 
-Beyond ``/metrics`` the server answers ``/healthz`` (liveness probe:
-``200 ok`` — or ``503`` with reasons once any registered health
-provider, e.g. a pipeline watchdog, reports unhealthy) and
+Beyond ``/metrics`` the server answers ``/healthz`` (liveness probe: a
+JSON ``{"status": "ok"|"degraded"|"unhealthy", ...}`` document carrying
+every provider's reason — ``degraded`` stays 200, ``unhealthy`` turns
+503 once any registered health provider, e.g. a pipeline watchdog,
+reports unhealthy; fleet membership parses this body) and
 ``/stats.json`` — every registered stats provider (pipelines via
 ``Pipeline.start``, schedulers via
 :class:`nnstreamer_tpu.sched.Scheduler`) merged into one JSON document,
@@ -142,6 +144,20 @@ def health_snapshot() -> Tuple[bool, Dict[str, str]]:
     return (not failures), failures
 
 
+def health_document() -> dict:
+    """The structured health verdict served at ``/healthz`` (and merged
+    into ``/stats.json`` under ``"health"``): ``status`` is ``"ok"``,
+    ``"degraded"`` (serving with reduced capability — e.g. a cpu-fallback
+    backend; still HTTP 200) or ``"unhealthy"`` (503), with the
+    per-provider *reasons* alongside so fleet membership and human
+    operators see WHY a worker is deprioritized, not just the flag."""
+    healthy, failures = health_snapshot()
+    degraded = degraded_snapshot()
+    status = ("unhealthy" if not healthy
+              else "degraded" if degraded else "ok")
+    return {"status": status, "failures": failures, "degraded": degraded}
+
+
 def _fmt(value: float) -> str:
     """Prometheus number rendering: integral values without the '.0'."""
     if value != value:  # NaN
@@ -224,30 +240,21 @@ class MetricsServer:
                     self._reply(render_text(registry).encode("utf-8"),
                                 CONTENT_TYPE)
                 elif path == "/healthz":
-                    healthy, failures = health_snapshot()
-                    if healthy:
-                        degraded = degraded_snapshot()
-                        if degraded:
-                            # degraded-but-serving: 200 (no outage), the
-                            # body names what was given up
-                            body = "ok (degraded)\n" + "".join(
-                                f"{name}: {reason}\n"
-                                for name, reason in sorted(degraded.items()))
-                            self._reply(body.encode("utf-8"),
-                                        "text/plain; charset=utf-8")
-                        else:
-                            self._reply(b"ok\n",
-                                        "text/plain; charset=utf-8")
-                    else:
-                        body = "unhealthy\n" + "".join(
-                            f"{name}: {reason}\n"
-                            for name, reason in sorted(failures.items()))
-                        self._reply(body.encode("utf-8"),
-                                    "text/plain; charset=utf-8", status=503)
+                    # JSON body: status + per-provider reasons, so fleet
+                    # membership (and operators) read WHY — degraded is
+                    # still 200 (serving, reduced capability), unhealthy
+                    # is 503 (probes should pull the worker)
+                    doc = health_document()
+                    body = json.dumps(doc, sort_keys=True).encode("utf-8")
+                    self._reply(body, "application/json; charset=utf-8",
+                                status=200 if doc["status"] != "unhealthy"
+                                else 503)
                 elif path == "/stats.json":
                     # default=str: stats() snapshots may carry numpy
                     # scalars / deadline floats json can't serialize
-                    body = json.dumps(stats_snapshot(), default=str,
+                    doc = stats_snapshot()
+                    doc["health"] = health_document()
+                    body = json.dumps(doc, default=str,
                                       sort_keys=True).encode("utf-8")
                     self._reply(body, "application/json; charset=utf-8")
                 else:

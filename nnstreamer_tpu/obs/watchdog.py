@@ -353,8 +353,10 @@ class PipelineWatchdog(Tracer):
             return self._healthy, "; ".join(self._reasons)
 
     def summary(self) -> dict:
+        from .export import degraded_snapshot
+
         with self._lock:
-            return {
+            out = {
                 "healthy": self._healthy,
                 "reasons": list(self._reasons),
                 "checks": self._checks,
@@ -364,6 +366,13 @@ class PipelineWatchdog(Tracer):
                 "recover": bool(self._recover),
                 "recoveries": self._recoveries,
             }
+        # degraded-but-serving reasons (e.g. a cpu-fallback backend) ride
+        # the watchdog's summary too: stats.json readers see WHY a worker
+        # is deprioritized without scraping /healthz separately
+        degraded = degraded_snapshot()
+        if degraded:
+            out["degraded"] = degraded
+        return out
 
 
 from .tracers import TRACERS  # noqa: E402

@@ -46,6 +46,7 @@ from __future__ import annotations
 import queue
 import socket
 import threading
+import time
 from typing import Dict, Optional
 
 import numpy as np
@@ -575,6 +576,7 @@ class DecodeServer:
         self._srv: Optional[socket.socket] = None
         self._accept: Optional[threading.Thread] = None
         self._running = False
+        self._draining = False
         self.connections = 0  # observability
         self._own_sched = False
         if scheduler is None:
@@ -585,9 +587,18 @@ class DecodeServer:
         self.scheduler = scheduler
         # live client sockets: stop() must shut these down too — an idle
         # client's _serve thread is parked in recv, and only unblocking it
-        # releases the session's capacity slot (review r5)
-        self._conns: set = set()
+        # releases the session's capacity slot (review r5).  Each maps to
+        # a per-connection state (send lock + has-session flag) so
+        # drain() can send typed goodbyes without interleaving a reply.
+        self._conns: Dict[socket.socket, "DecodeServer._ConnState"] = {}
         self._conns_lock = threading.Lock()
+
+    class _ConnState:
+        __slots__ = ("lock", "sess")
+
+        def __init__(self):
+            self.lock = threading.Lock()
+            self.sess = False  # this connection holds a decode session
 
     def start(self) -> "DecodeServer":
         from . import faults as _faults
@@ -622,6 +633,94 @@ class DecodeServer:
             self._accept.join(timeout=10)
         if self._own_sched and self.scheduler is not None:
             # conf-activated scheduler: this server owns its collector
+            self.scheduler.close()
+
+    def drain(self, timeout: float = 10.0) -> bool:
+        """Graceful shutdown (the SIGTERM path): stop accepting, reject
+        NEW session joins with a typed ``[UNAVAILABLE]``, close idle
+        probe-only connections with the same typed goodbye, and let live
+        decode sessions keep stepping until they close — up to the
+        deadline, after which the stragglers are terminated with the
+        typed ``[SESSION]`` wire code (never a torn socket).  Returns
+        True when every session ended before the deadline; always ends
+        in :meth:`stop`."""
+        from .elements.query import send_error
+
+        self._draining = True
+        if self._srv is not None:
+            try:
+                # close() alone does not wake a blocked accept
+                self._srv.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            self._srv.close()
+        with self._conns_lock:
+            conns = list(self._conns.items())
+        for conn, st in conns:
+            if st.sess:
+                continue  # live session: it finishes (or hits the deadline)
+            with st.lock:
+                if st.sess:
+                    continue
+                try:
+                    send_error(conn, "decode server draining",
+                               code="UNAVAILABLE")
+                except OSError:
+                    pass
+                try:
+                    conn.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
+        deadline = time.monotonic() + float(timeout)
+        while time.monotonic() < deadline:
+            with self._conns_lock:
+                if not any(st.sess for st in self._conns.values()):
+                    break
+            time.sleep(0.02)
+        with self._conns_lock:
+            stragglers = [(c, st) for c, st in self._conns.items() if st.sess]
+        for conn, st in stragglers:
+            with st.lock:
+                try:
+                    send_error(
+                        conn, "decode server drained: session terminated "
+                        "(reconnect and re-prefill elsewhere)",
+                        code="SESSION")
+                except OSError:
+                    pass
+                try:
+                    conn.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
+        self.stop()
+        return not stragglers
+
+    def kill(self) -> None:
+        """Crash simulation (chaos ``worker_kill``): tear every socket
+        down mid-flight, no courtesy frames — stateful clients see a
+        broken session, exactly like a SIGKILLed worker."""
+        self._running = False
+        if self._srv is not None:
+            try:
+                # close() alone does not wake a blocked accept
+                self._srv.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            self._srv.close()
+        with self._conns_lock:
+            conns = list(self._conns)
+        for conn in conns:
+            try:
+                conn.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                conn.close()
+            except OSError:
+                pass
+        if self._accept is not None:
+            self._accept.join(timeout=10)
+        if self._own_sched and self.scheduler is not None:
             self.scheduler.close()
 
     def stats(self) -> dict:
@@ -668,7 +767,7 @@ class DecodeServer:
                 return  # stop() closed the listener
             self.connections += 1
             with self._conns_lock:
-                self._conns.add(conn)
+                self._conns[conn] = self._ConnState()
             threading.Thread(target=self._serve, args=(conn,),
                              daemon=True).start()
 
@@ -686,6 +785,8 @@ class DecodeServer:
             client = f"{peer[0]}:{peer[1]}"
         except (OSError, IndexError):
             client = "unknown"
+        with self._conns_lock:
+            state = self._conns.get(conn) or self._ConnState()
         sess: Optional[DecodeSession] = None
         try:
             while self._running:
@@ -715,12 +816,21 @@ class DecodeServer:
                                 f"decode server expects ({self.engine.d_in},)"
                                 f" steps or (T, {self.engine.d_in}) prompts,"
                                 f" got {shp}")
-                        send_tensors(
-                            conn,
-                            (np.zeros((self.engine.n_out,), np.float32),),
-                            pts, trace=wtrace)
+                        with state.lock:
+                            send_tensors(
+                                conn,
+                                (np.zeros((self.engine.n_out,), np.float32),),
+                                pts, trace=wtrace)
                         continue
                     if sess is None:
+                        if self._draining:
+                            # no NEW sessions on a draining server: typed
+                            # rejection so the client (or router) can
+                            # re-route the join elsewhere
+                            with state.lock:
+                                send_error(conn, "decode server draining",
+                                           code="UNAVAILABLE")
+                            return
                         # lazy join: a probe-only connection never holds a
                         # capacity slot
                         if self.scheduler is not None:
@@ -728,6 +838,8 @@ class DecodeServer:
                         else:
                             sess = self.engine.open_session(
                                 timeout=self.session_timeout)
+                        with state.lock:
+                            state.sess = True
                     if tensors[0].ndim == 2:
                         # rank-2 frame = a whole prompt: ONE compiled
                         # prefill pass builds the slot's KV state (an
@@ -737,13 +849,15 @@ class DecodeServer:
                     else:
                         sess.feed(tensors[0])
                     y = sess.get(timeout=self.session_timeout)
-                    send_tensors(conn, (y,), pts, trace=wtrace)
+                    with state.lock:
+                        send_tensors(conn, (y,), pts, trace=wtrace)
                 except OverloadError as exc:
                     # shed join: typed wire rejection, never a parked
                     # connection (the client raises QueryOverloadError)
                     try:
-                        send_error(conn, f"decode server: {exc}",
-                                   code=exc.code)
+                        with state.lock:
+                            send_error(conn, f"decode server: {exc}",
+                                       code=exc.code)
                     except OSError:
                         pass
                     return
@@ -756,7 +870,9 @@ class DecodeServer:
                             if isinstance(exc, RuntimeError)
                             and not isinstance(exc, ValueError) else "")
                     try:
-                        send_error(conn, f"decode server: {exc}", code=code)
+                        with state.lock:
+                            send_error(conn, f"decode server: {exc}",
+                                       code=code)
                     except OSError:
                         return
                     if isinstance(exc, (RuntimeError, TimeoutError)):
@@ -765,7 +881,7 @@ class DecodeServer:
             if sess is not None:
                 sess.close()
             with self._conns_lock:
-                self._conns.discard(conn)
+                self._conns.pop(conn, None)
             try:
                 conn.close()
             except OSError:

@@ -1,0 +1,919 @@
+"""Fleet tier: NNSQ router failover, membership, graceful drain, the
+remote tensor_repo, and the seeded fleet chaos e2e (ISSUE 8 acceptance).
+
+Workers here are in-process (one FleetWorker = one QueryServer/
+DecodeServer pair on its own ports) so the tier-1 suite stays fast and
+deterministic; the CI fleet smoke exercises the same machinery as real
+subprocesses with SIGKILL/SIGTERM.
+"""
+
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu import faults
+from nnstreamer_tpu.elements.query import (
+    PROBE_PTS,
+    QueryError,
+    QueryServer,
+    QuerySessionBrokenError,
+    QueryUnavailableError,
+    recv_tensors,
+    send_tensors,
+)
+from nnstreamer_tpu.fleet import (
+    DEGRADED,
+    DOWN,
+    SUSPECT,
+    UP,
+    FleetWorker,
+    Membership,
+    Router,
+)
+from nnstreamer_tpu.fleet.chaos import FleetChaos, InProcHandle
+
+VEC = (4,)
+
+
+def _wait_for(fn, timeout=15.0, interval=0.01):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if fn():
+            return True
+        time.sleep(interval)
+    return fn()
+
+
+def _counting_model(counts, name, factor=2.0, delay_s=0.0):
+    def fn(x):
+        # the custom backend infers its output spec with a zero dummy
+        # forward at reconfigure time — only count REAL dispatches, so
+        # duplicate-dispatch assertions stay exact
+        if np.any(np.asarray(x)):
+            counts[name] = counts.get(name, 0) + 1
+            if delay_s:
+                time.sleep(delay_s)
+        return x * factor
+
+    return fn
+
+
+class RawClient:
+    """Minimal NNSQ client socket (no pipeline machinery)."""
+
+    def __init__(self, port, host="127.0.0.1", timeout=15.0):
+        self.sock = socket.create_connection((host, port), timeout=timeout)
+        self.sock.settimeout(timeout)
+
+    def request(self, arrays, pts=0, trace=None):
+        send_tensors(self.sock, arrays, pts, trace=trace)
+        return recv_tensors(self.sock)
+
+    def recv(self):
+        return recv_tensors(self.sock)
+
+    def close(self):
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+class _Fleet:
+    """N in-process workers + membership (manual sweeps) + router."""
+
+    def __init__(self, n=3, stateful=False, counts=None, router_kwargs=None,
+                 worker_kwargs=None, membership_kwargs=None, prefix="w"):
+        self.counts = counts if counts is not None else {}
+        self.workers = []
+        self.infos = {}
+        mk = dict(heartbeat_s=30.0, suspect_misses=2, death_misses=4,
+                  breaker_failures=2, breaker_reset_s=0.2)
+        mk.update(membership_kwargs or {})
+        self.membership = Membership(**mk)
+        for i in range(n):
+            name = f"{prefix}{i}"
+            wk = dict(name=name,
+                      model=_counting_model(self.counts, name))
+            wk.update(worker_kwargs or {})
+            w = FleetWorker(**wk).start()
+            self.workers.append(w)
+            self.infos[name] = self.membership.add(
+                "127.0.0.1", w.query_port, probe=w.probe, worker_id=name)
+        rk = dict(route_retries=4, retry_backoff_ms=1,
+                  retry_backoff_cap_ms=5, request_timeout=15.0)
+        rk.update(router_kwargs or {})
+        self.router = Router(self.membership, port=0, stateful=stateful,
+                             **rk).start()
+
+    def sweep(self, n=1):
+        for _ in range(n):
+            self.membership.sweep()
+
+    def close(self):
+        self.router.stop()
+        self.membership.stop()
+        for w in self.workers:
+            try:
+                w.stop()
+            except Exception:  # noqa: BLE001 — already killed is fine
+                pass
+
+
+@pytest.fixture
+def fleet():
+    f = _Fleet(n=3)
+    yield f
+    f.close()
+
+
+# -- stateless failover ------------------------------------------------------
+
+
+class TestStatelessFailover:
+    def test_round_robin_spreads_and_results_exact(self, fleet):
+        c = RawClient(fleet.router.port)
+        try:
+            for i in range(12):
+                outs, pts = c.request((np.full(VEC, float(i), np.float32),),
+                                      pts=i)
+                assert pts == i
+                np.testing.assert_allclose(outs[0], np.full(VEC, 2.0 * i))
+        finally:
+            c.close()
+        # every worker took a share (round robin over 3 UP workers)
+        assert all(fleet.counts.get(f"w{i}", 0) >= 1 for i in range(3)), \
+            fleet.counts
+        # the ledger increments AFTER the reply bytes go out: poll past
+        # that sliver instead of racing the serve thread
+        assert _wait_for(
+            lambda: fleet.router.stats()["delivered"] == 12, 5)
+        st = fleet.router.stats()
+        assert st["offered"] == st["delivered"] == 12
+        assert st["shed_total"] == 0
+
+    def test_worker_kill_transparent_reroute(self, fleet):
+        fleet.workers[0].kill()  # membership has NOT noticed (no sweep)
+        c = RawClient(fleet.router.port)
+        try:
+            for i in range(6):
+                outs, _ = c.request((np.full(VEC, float(i), np.float32),))
+                np.testing.assert_allclose(outs[0], np.full(VEC, 2.0 * i))
+        finally:
+            c.close()
+        assert _wait_for(
+            lambda: fleet.router.stats()["delivered"] == 6, 5)
+        st = fleet.router.stats()
+        assert st["shed_total"] == 0
+        assert st["rerouted"] >= 1  # at least one forward hit the corpse
+        assert fleet.counts.get("w0", 0) == 0
+
+    def test_kill_mid_coalesced_group_rerouted_never_lost(self):
+        """A worker dying with a half-assembled batch group: every
+        member of the partial batch is re-dispatched elsewhere (or
+        typed-shed) — never silently lost."""
+        counts = {}
+        # w0 coalesces with a LONG window so the group is guaranteed
+        # to be pending when the kill lands
+        f = _Fleet(n=1, counts=counts,
+                   worker_kwargs=dict(batch=4, batch_window_ms=400.0))
+        try:
+            spare = FleetWorker(name="spare",
+                                model=_counting_model(counts, "spare"))
+            spare.start()
+            f.workers.append(spare)
+            results, errors = [], []
+
+            def one(i):
+                c = RawClient(f.router.port)
+                try:
+                    outs, _ = c.request(
+                        (np.full((1, 4), float(i + 1), np.float32),))
+                    results.append((i, float(outs[0][0, 0])))
+                except QueryError as exc:
+                    errors.append(exc)
+                finally:
+                    c.close()
+
+            ths = [threading.Thread(target=one, args=(i,)) for i in range(2)]
+            for t in ths:
+                t.start()
+            # both requests are sitting in w0's batch window now
+            assert _wait_for(lambda: f.router.stats()["offered"] == 2, 5)
+            time.sleep(0.05)
+            f.membership.add("127.0.0.1", spare.query_port,
+                             probe=spare.probe, worker_id="spare")
+            f.workers[0].kill()
+            for t in ths:
+                t.join(timeout=20)
+            assert not errors, errors
+            assert sorted(results) == [(0, 2.0), (1, 4.0)]
+            assert counts.get("spare", 0) == 2  # re-dispatched, not lost
+            assert f.router.stats()["rerouted"] >= 2
+        finally:
+            f.close()
+
+    def test_kill_mid_group_no_spare_typed_shed(self):
+        """Same partial-batch death with nowhere to go: the client gets
+        a typed [UNAVAILABLE], never silence."""
+        f = _Fleet(n=1, worker_kwargs=dict(batch=4, batch_window_ms=400.0))
+        try:
+            c = RawClient(f.router.port)
+            got = {}
+
+            def one():
+                try:
+                    got["out"] = c.request(
+                        (np.full((1, 4), 5.0, np.float32),))
+                except Exception as exc:  # noqa: BLE001
+                    got["exc"] = exc
+
+            t = threading.Thread(target=one)
+            t.start()
+            assert _wait_for(lambda: f.router.stats()["offered"] == 1, 5)
+            time.sleep(0.05)
+            f.workers[0].kill()
+            t.join(timeout=20)
+            c.close()
+            assert isinstance(got.get("exc"), QueryUnavailableError), got
+            st = f.router.stats()
+            assert st["offered"] == 1 and st["delivered"] == 0
+            assert st["shed_total"] == 1  # ledger: typed shed, not lost
+        finally:
+            f.close()
+
+    def test_typed_worker_rejection_tries_next_worker(self, fleet):
+        # w0 sheds typed [UNAVAILABLE] (draining flag) but keeps its
+        # socket open: the router must absorb it with another worker
+        fleet.workers[0].query_server._draining = True
+        c = RawClient(fleet.router.port)
+        try:
+            for i in range(6):
+                outs, _ = c.request((np.full(VEC, float(i), np.float32),))
+                np.testing.assert_allclose(outs[0], np.full(VEC, 2.0 * i))
+        finally:
+            c.close()
+        assert _wait_for(
+            lambda: fleet.router.stats()["delivered"] == 6, 5)
+        assert fleet.router.stats()["shed_total"] == 0
+        assert fleet.counts.get("w0", 0) == 0
+
+    def test_fleet_exhausted_typed_unavailable(self, fleet):
+        for w in fleet.workers:
+            w.kill()
+        fleet.sweep(4)  # death_misses=4: everyone DOWN
+        c = RawClient(fleet.router.port)
+        try:
+            with pytest.raises(QueryUnavailableError):
+                c.request((np.zeros(VEC, np.float32),))
+        finally:
+            c.close()
+        st = fleet.router.stats()
+        assert st["shed"].get("unavailable") == 1
+        assert st["offered"] == st["delivered"] + st["shed_total"]
+
+
+# -- membership --------------------------------------------------------------
+
+
+class TestMembership:
+    def test_heartbeat_loss_vs_death_no_duplicate_dispatch(self):
+        """Partition ≠ crash: a worker that merely misses heartbeats is
+        SUSPECT (no new dispatch, nothing torn down) and an in-flight
+        request on its live data path completes exactly once — no
+        duplicate dispatch before, during, or after the heal."""
+        counts = {}
+        # slow model: the partition must land mid-request
+        f = _Fleet(n=1, counts=counts, worker_kwargs=dict(
+            model=_counting_model(counts, "w0", delay_s=0.4)))
+        try:
+            info = f.infos["w0"]
+            got = {}
+
+            def one():
+                c = RawClient(f.router.port)
+                try:
+                    got["out"] = float(c.request(
+                        (np.full(VEC, 3.0, np.float32),))[0][0][0])
+                finally:
+                    c.close()
+
+            t = threading.Thread(target=one)
+            t.start()
+            assert _wait_for(lambda: counts.get("w0", 0) == 1, 5)
+            info.block_health = True   # heartbeat channel cut, data alive
+            f.sweep(2)                 # suspect_misses=2
+            assert info.state == SUSPECT
+            t.join(timeout=15)
+            assert got["out"] == 6.0   # in-flight completed through it
+            # suspect: NEW dispatches refused typed (no other worker)
+            c = RawClient(f.router.port)
+            with pytest.raises(QueryUnavailableError):
+                c.request((np.zeros(VEC, np.float32),))
+            c.close()
+            # heal: one good probe restores rotation, nothing replayed
+            info.block_health = False
+            f.sweep()
+            assert info.state == UP and info.misses == 0
+            c = RawClient(f.router.port)
+            outs, _ = c.request((np.full(VEC, 4.0, np.float32),))
+            assert float(outs[0][0]) == 8.0
+            c.close()
+            # exactly one invoke per delivered request: no duplicates
+            assert counts["w0"] == 2
+        finally:
+            f.close()
+
+    def test_missed_heartbeats_escalate_to_down(self, fleet):
+        info = fleet.infos["w1"]
+        info.block_health = True
+        fleet.sweep(2)
+        assert info.state == SUSPECT
+        fleet.sweep(2)  # death_misses=4
+        assert info.state == DOWN
+        # revival: the probe answers again -> UP with a fresh breaker
+        info.block_health = False
+        fleet.sweep()
+        assert info.state == UP and info.revivals == 1
+
+    def test_degraded_worker_deprioritized_not_dropped(self, fleet):
+        fleet.workers[0].degraded_reason = "cpu-fallback"
+        fleet.sweep()
+        info = fleet.infos["w0"]
+        assert info.state == DEGRADED
+        assert info.degraded_reason == "cpu-fallback"  # the WHY travels
+        c = RawClient(fleet.router.port)
+        try:
+            for i in range(8):
+                c.request((np.full(VEC, float(i), np.float32),))
+            # fully-healthy workers absorb everything first
+            assert fleet.counts.get("w0", 0) == 0, fleet.counts
+            # ...but a degraded worker still serves when it is all we have
+            fleet.workers[1].kill()
+            fleet.workers[2].kill()
+            fleet.sweep(4)
+            outs, _ = c.request((np.full(VEC, 9.0, np.float32),))
+            assert float(outs[0][0]) == 18.0
+            assert fleet.counts.get("w0", 0) == 1
+        finally:
+            c.close()
+
+    def test_flapping_worker_quarantined_by_breaker(self, fleet):
+        # the query server dies but the probe keeps answering "ok"
+        # (a flapper: health green, data path refusing)
+        fleet.workers[0].query_server.kill()
+        c = RawClient(fleet.router.port)
+        try:
+            for i in range(8):
+                outs, _ = c.request((np.full(VEC, float(i), np.float32),))
+                np.testing.assert_allclose(outs[0], np.full(VEC, 2.0 * i))
+        finally:
+            c.close()
+        info = fleet.infos["w0"]
+        assert info.state == UP  # health channel never flagged it...
+        assert info.breaker.stats()["state"] == "open"  # ...the breaker did
+        assert info.failures >= 2
+        # quarantine lifts through the half-open probe once it serves again
+        fleet.workers[0].query_server = QueryServer(
+            framework="custom",
+            model=_counting_model(fleet.counts, "w0"),
+            port=fleet.workers[0].query_port).start()
+        assert _wait_for(
+            lambda: info.breaker.stats()["state"] != "open", 5)
+
+        def recovered():
+            cc = RawClient(fleet.router.port)
+            try:
+                cc.request((np.ones(VEC, np.float32),))
+            finally:
+                cc.close()
+            return fleet.counts.get("w0", 0) >= 1
+
+        assert _wait_for(recovered, 10, interval=0.05)
+
+
+# -- graceful drain (satellite: SIGTERM path for single-process servers) ----
+
+
+class TestGracefulDrain:
+    def test_queryserver_drain_idle_gets_typed_unavailable(self):
+        """A client blocked in recv on an idle connection sees the typed
+        [UNAVAILABLE] goodbye, never a torn socket."""
+        srv = QueryServer(framework="custom", model=lambda x: x * 2.0)
+        srv.start()
+        c = RawClient(srv.port)
+        outs, _ = c.request((np.full(VEC, 1.0, np.float32),))
+        assert float(outs[0][0]) == 2.0
+        got = {}
+
+        def blocked_recv():
+            try:
+                got["out"] = c.recv()
+            except Exception as exc:  # noqa: BLE001
+                got["exc"] = exc
+
+        t = threading.Thread(target=blocked_recv)
+        t.start()
+        time.sleep(0.1)  # the client is parked in recv now
+        assert srv.drain(timeout=5.0)
+        t.join(timeout=10)
+        c.close()
+        assert isinstance(got.get("exc"), QueryUnavailableError), got
+
+    def test_queryserver_drain_finishes_inflight_dispatch(self):
+        srv = QueryServer(framework="custom",
+                          model=lambda x: (time.sleep(0.3), x * 2.0)[1])
+        srv.start()
+        c = RawClient(srv.port)
+        got = {}
+
+        def one():
+            try:
+                got["out"] = c.request((np.full(VEC, 5.0, np.float32),))
+                got["next"] = c.recv()  # the post-reply goodbye
+            except Exception as exc:  # noqa: BLE001
+                got["exc"] = exc
+
+        t = threading.Thread(target=one)
+        t.start()
+        time.sleep(0.1)  # request is mid-dispatch
+        assert srv.drain(timeout=5.0)
+        t.join(timeout=10)
+        c.close()
+        # the in-flight dispatch DRAINED: real reply delivered first,
+        # then the typed goodbye
+        assert float(got["out"][0][0][0]) == 10.0, got
+        assert isinstance(got.get("exc"), QueryUnavailableError), got
+
+    def test_decodeserver_drain_rejects_new_sessions_finishes_live(
+            self, decode_fleet_engine):
+        from nnstreamer_tpu.serving import DecodeServer
+
+        eng = decode_fleet_engine()
+        srv = DecodeServer(eng, port=0).start()
+        s1 = RawClient(srv.port)
+        step = np.zeros((eng.d_in,), np.float32)
+        s1.request((step,))  # live session
+        # a NEW session while draining: typed [UNAVAILABLE] (flag first,
+        # so the join rejection is exercised without the listener race)
+        srv._draining = True
+        s2 = RawClient(srv.port)
+        with pytest.raises(QueryUnavailableError):
+            s2.request((step,))
+        s2.close()
+        srv._draining = False
+        done = {}
+
+        def drainer():
+            done["clean"] = srv.drain(timeout=5.0)
+
+        t = threading.Thread(target=drainer)
+        t.start()
+        time.sleep(0.15)
+        # the live session keeps stepping through the drain...
+        outs, _ = s1.request((step,))
+        assert outs[0].shape == (eng.n_out,)
+        # ...and its close completes the drain cleanly
+        s1.close()
+        t.join(timeout=10)
+        assert done["clean"] is True
+        eng.stop()
+
+    def test_decodeserver_drain_deadline_breaks_session_typed(
+            self, decode_fleet_engine):
+        from nnstreamer_tpu.serving import DecodeServer
+
+        eng = decode_fleet_engine()
+        srv = DecodeServer(eng, port=0).start()
+        s1 = RawClient(srv.port)
+        step = np.zeros((eng.d_in,), np.float32)
+        s1.request((step,))
+        assert srv.drain(timeout=0.2) is False  # the session out-waited it
+        # the goodbye frame is already buffered: the idle client reads a
+        # typed [SESSION] termination, never a torn socket
+        with pytest.raises(QuerySessionBrokenError):
+            s1.recv()
+        s1.close()
+        eng.stop()
+
+
+# -- sticky sessions + rebalance --------------------------------------------
+
+
+@pytest.fixture(scope="class")
+def decode_fleet_engine():
+    """Factory for tiny ContinuousBatchers (compile cost amortized by
+    jax's jit cache across instances of the same geometry)."""
+    from nnstreamer_tpu.serving import ContinuousBatcher
+
+    def make(**over):
+        cfg = dict(capacity=2, t_max=8, d_in=4, n_out=4, d_model=16,
+                   n_heads=2, n_layers=1)
+        cfg.update(over)
+        return ContinuousBatcher(**cfg)
+
+    return make
+
+
+ENGINE_CFG = dict(capacity=2, t_max=8, d_in=4, n_out=4, d_model=16,
+                  n_heads=2, n_layers=1)
+
+
+class TestStickySessions:
+    @pytest.fixture(scope="class")
+    def decode_fleet(self):
+        workers = []
+        m = Membership(heartbeat_s=30.0, suspect_misses=2, death_misses=4,
+                       breaker_failures=2, breaker_reset_s=0.2)
+        for i in range(2):
+            w = FleetWorker(name=f"d{i}", engine=dict(ENGINE_CFG))
+            w.start()
+            workers.append(w)
+            # the stateful router routes to the DECODE port
+            m.add("127.0.0.1", w.decode_port, probe=w.probe,
+                  worker_id=w.name)
+        r = Router(m, port=0, stateful=True, route_retries=2,
+                   retry_backoff_ms=1, request_timeout=15.0).start()
+        yield workers, m, r
+        r.stop()
+        m.stop()
+        for w in workers:
+            try:
+                w.stop()
+            except Exception:  # noqa: BLE001
+                pass
+
+    def _step(self, client, d_in=4):
+        return client.request((np.zeros((d_in,), np.float32),))
+
+    def test_session_sticky_and_exact(self, decode_fleet):
+        workers, m, r = decode_fleet
+        s1 = RawClient(r.port)
+        # probes never pin a session
+        outs, pts = s1.request((np.zeros((4,), np.float32),), pts=PROBE_PTS)
+        assert pts == PROBE_PTS and r.session_count() == 0
+        for _ in range(3):
+            outs, _ = self._step(s1)
+            assert outs[0].shape == (4,)
+        assert r.session_count() == 1
+        pinned = [wid for wid in ("d0", "d1") if r.session_count(wid)]
+        assert len(pinned) == 1  # sticky: every step on ONE worker
+        s1.close()
+        assert _wait_for(lambda: r.session_count() == 0, 5)
+
+    def test_drain_worker_rebalance(self, decode_fleet):
+        """Planned removal: new sessions avoid the draining worker,
+        existing ones finish, the worker is ejected after."""
+        workers, m, r = decode_fleet
+        s1 = RawClient(r.port)
+        self._step(s1)
+        pinned = next(wid for wid in ("d0", "d1") if r.session_count(wid))
+        other = "d1" if pinned == "d0" else "d0"
+        drained = {}
+
+        def drain():
+            drained["broken"] = r.drain_worker(pinned, deadline_s=5.0)
+
+        t = threading.Thread(target=drain)
+        t.start()
+        assert _wait_for(lambda: m.get(pinned).draining, 5)
+        # NEW session while draining: lands on the OTHER worker
+        s2 = RawClient(r.port)
+        self._step(s2)
+        assert r.session_count(other) == 1
+        # the existing session still steps on the draining worker
+        outs, _ = self._step(s1)
+        assert outs[0].shape == (4,)
+        s1.close()  # EOS -> the drain completes without force-breaking
+        t.join(timeout=10)
+        assert drained["broken"] == 0
+        assert m.get(pinned).state == DOWN
+        s2.close()
+        # restore for the other tests: revive via probe
+        m.get(pinned).draining = False
+        m.sweep()
+
+    def test_worker_kill_breaks_session_typed_fail_fast(self, decode_fleet):
+        workers, m, r = decode_fleet
+        s1 = RawClient(r.port)
+        self._step(s1)
+        pinned = next(wid for wid in ("d0", "d1") if r.session_count(wid))
+        w = next(w for w in workers if w.name == pinned)
+        w.kill()
+        # the next step fails FAST with the typed [SESSION] code —
+        # never replayed, never silently re-routed
+        with pytest.raises(QuerySessionBrokenError):
+            self._step(s1)
+        s1.close()
+        assert r.sessions_broken >= 1
+        # a fresh session immediately lands on the survivor
+        s2 = RawClient(r.port)
+        outs, _ = self._step(s2)
+        assert outs[0].shape == (4,)
+        s2.close()
+
+
+# -- remote tensor_repo ------------------------------------------------------
+
+
+class TestRemoteRepo:
+    def test_roundtrip_and_blocking_handoff(self):
+        from nnstreamer_tpu.buffer import Frame
+        from nnstreamer_tpu.fleet.repo import (
+            RemoteTensorRepo,
+            TensorRepoServer,
+        )
+
+        with TensorRepoServer(port=0) as srv:
+            repo = RemoteTensorRepo("127.0.0.1", srv.port)
+            f0 = Frame.of(np.arange(4, dtype=np.float32), pts=11)
+            assert repo.set_buffer(3, f0) is True
+            got, spec, eos = repo.get_buffer(3, timeout=1.0)
+            assert not eos and got.pts == 11
+            np.testing.assert_array_equal(got.tensor(0), f0.tensor(0))
+            assert spec is not None
+            # empty poll: times out without blocking forever
+            got, _, eos = repo.get_buffer(3, timeout=0.05)
+            assert got is None and not eos
+            # the single-frame mailbox still backpressures over the wire
+            assert repo.set_buffer(3, f0) is True
+            published = {}
+
+            def second_set():
+                published["ok"] = repo.set_buffer(
+                    3, Frame.of(np.zeros(4, np.float32), pts=12))
+
+            t = threading.Thread(target=second_set)
+            t.start()
+            time.sleep(0.1)
+            assert "ok" not in published  # blocked on the unconsumed frame
+            got, _, _ = repo.get_buffer(3, timeout=1.0)
+            assert got.pts == 11
+            t.join(timeout=10)
+            assert published["ok"] is True
+            # EOS propagates
+            repo.set_eos(3)
+            got, _, _ = repo.get_buffer(3, timeout=1.0)  # pending frame first
+            assert got.pts == 12
+            got, _, eos = repo.get_buffer(3, timeout=1.0)
+            assert eos
+            repo.close()
+
+    def test_cross_pipeline_recurrence_survives_process_boundary(self):
+        """reposink in one pipeline, reposrc in another, mailbox on the
+        wire — the fleet shape where the two ends live in different
+        worker processes."""
+        from nnstreamer_tpu import Pipeline
+        from nnstreamer_tpu.elements.repo import TensorRepoSink, TensorRepoSrc
+        from nnstreamer_tpu.elements.sink import TensorSink
+        from nnstreamer_tpu.elements.testsrc import DataSrc
+        from nnstreamer_tpu.buffer import Frame
+        from nnstreamer_tpu.fleet.repo import (
+            RemoteTensorRepo,
+            TensorRepoServer,
+        )
+
+        n = 8
+        with TensorRepoServer(port=0) as srv:
+            repo_a = RemoteTensorRepo("127.0.0.1", srv.port)
+            repo_b = RemoteTensorRepo("127.0.0.1", srv.port)
+            got = []
+            from nnstreamer_tpu.spec import TensorSpec, TensorsSpec
+
+            caps = TensorsSpec(tensors=(
+                TensorSpec.from_dims_string("4:1:1:1", "float32"),))
+            pb = Pipeline(name="fleet_repo_consumer")
+            src = pb.add(TensorRepoSrc(slot_index=9, caps=caps,
+                                       repo=repo_b))
+            sink = pb.add(TensorSink(name="out"))
+            sink.connect("new-data",
+                         lambda f: got.append(float(np.asarray(f.tensor(0))[0])))
+            pb.link(src, sink)
+            pb.start()
+
+            pa = Pipeline(name="fleet_repo_producer")
+            data = pa.add(DataSrc(data=[
+                Frame.of(np.full(4, float(i), np.float32), pts=i)
+                for i in range(n)]))
+            rs = pa.add(TensorRepoSink(slot_index=9, repo=repo_a))
+            pa.link(data, rs)
+            pa.run(timeout=60)  # drain() publishes EOS into the slot
+            assert pb.wait(timeout=60)
+            pb.stop()
+            # bootstrap zero frame + the n published frames, in order
+            assert got == [0.0] + [float(i) for i in range(n)]
+            repo_a.close()
+            repo_b.close()
+
+    def test_conf_activation(self, monkeypatch):
+        from nnstreamer_tpu.elements import repo as repo_mod
+        from nnstreamer_tpu.fleet.repo import (
+            RemoteTensorRepo,
+            TensorRepoServer,
+        )
+
+        assert repo_mod.configured_repo() is repo_mod.GLOBAL_REPO
+        with TensorRepoServer(port=0) as srv:
+            monkeypatch.setenv("NNSTPU_FLEET_REPO_ADDR",
+                               f"127.0.0.1:{srv.port}")
+            r1 = repo_mod.configured_repo()
+            assert isinstance(r1, RemoteTensorRepo)
+            assert repo_mod.configured_repo() is r1  # process-shared
+            sink = repo_mod.TensorRepoSink(slot_index=1)
+            assert sink.repo is r1  # elements pick it up by default
+
+
+# -- the seeded fleet chaos e2e (acceptance) --------------------------------
+
+
+class TestFleetChaosE2E:
+    @pytest.fixture(autouse=True)
+    def _clean(self):
+        yield
+        from nnstreamer_tpu.obs import spans
+
+        faults.deactivate()
+        spans.reset()
+
+    def test_seeded_kill_partition_schedule(self):
+        """ISSUE 8 acceptance: a seeded worker_kill + partition schedule
+        against a 3-worker stateless fleet (+ a 2-worker decode fleet
+        with a kill): every stateless request completes via re-route,
+        stateful sessions on killed workers fail fast typed, the ledger
+        balances exactly, the schedule replays from the seed, and the
+        Perfetto export shows the router → worker → device hop."""
+        from nnstreamer_tpu.obs import spans
+
+        spec = ("seed=11;worker_kill@q1:after=3;"
+                "partition@q2:after=6,ms=300;worker_kill@d0:after=4")
+        eng = faults.install(spec)
+        spans.enable()
+        counts = {}
+        f = _Fleet(n=3, counts=counts, prefix="q", membership_kwargs=dict(
+            suspect_misses=2, death_misses=3))
+        qinfos = f.infos
+        dworkers = []
+        dm = Membership(heartbeat_s=0.05, suspect_misses=2, death_misses=3,
+                        breaker_failures=2, breaker_reset_s=0.2)
+        for i in range(2):
+            w = FleetWorker(name=f"d{i}", engine=dict(ENGINE_CFG)).start()
+            dworkers.append(w)
+            dm.add("127.0.0.1", w.decode_port, probe=w.probe,
+                   worker_id=w.name)
+        dm.start()
+        dr = Router(dm, port=0, stateful=True, route_retries=2,
+                    retry_backoff_ms=1, request_timeout=15.0).start()
+        f.membership.heartbeat_s = 0.05
+        f.membership.start()
+
+        handles = {}
+        for w in f.workers:
+            handles[w.name] = InProcHandle(w, qinfos[w.name])
+        for w in dworkers:
+            handles[w.name] = InProcHandle(w, dm.get(w.name))
+        chaos = FleetChaos(handles)
+
+        stateless = {"offered": 0, "delivered": 0, "typed": 0,
+                     "untyped": []}
+        lock = threading.Lock()
+
+        def q_client(tid):
+            for i in range(25):
+                with lock:
+                    stateless["offered"] += 1
+                c = RawClient(f.router.port)
+                try:
+                    outs, _ = c.request(
+                        (np.full(VEC, float(i), np.float32),))
+                    assert float(outs[0][0]) == 2.0 * i
+                    with lock:
+                        stateless["delivered"] += 1
+                except QueryError:
+                    with lock:
+                        stateless["typed"] += 1
+                except Exception as exc:  # noqa: BLE001
+                    with lock:
+                        stateless["untyped"].append(repr(exc))
+                finally:
+                    c.close()
+                time.sleep(0.01)
+
+        decode = {"steps": 0, "delivered": 0, "typed": 0, "untyped": []}
+
+        def d_client():
+            c = None
+            for i in range(40):
+                with lock:
+                    decode["steps"] += 1
+                try:
+                    if c is None:
+                        c = RawClient(dr.port)
+                    outs, _ = c.request((np.zeros((4,), np.float32),))
+                    assert outs[0].shape == (4,)
+                    with lock:
+                        decode["delivered"] += 1
+                except QueryError:
+                    # typed fail-fast (SESSION on the killed worker /
+                    # UNAVAILABLE while rebuilding): reconnect, re-prefill
+                    with lock:
+                        decode["typed"] += 1
+                    if c is not None:
+                        c.close()
+                        c = None
+                except (ConnectionError, OSError):
+                    # the torn socket after the typed frame: same rebuild
+                    with lock:
+                        decode["typed"] += 1
+                    if c is not None:
+                        c.close()
+                        c = None
+                except Exception as exc:  # noqa: BLE001
+                    with lock:
+                        decode["untyped"].append(repr(exc))
+                time.sleep(0.015)
+            if c is not None:
+                c.close()
+
+        ths = ([threading.Thread(target=q_client, args=(t,))
+                for t in range(3)]
+               + [threading.Thread(target=d_client) for _ in range(2)])
+        for t in ths:
+            t.start()
+        # the seeded schedule: 10 ticks, consults recorded for replay
+        for _ in range(10):
+            chaos.tick()
+            time.sleep(0.06)
+        for t in ths:
+            t.join(timeout=60)
+
+        applied = dict((k, [w for w, kk in chaos.applied if kk == k])
+                       for k in ("worker_kill", "partition"))
+        # seeded schedule: q1 kill (tick 4), d0 kill (tick 5), q2
+        # partition (tick 7) — deterministic from the seed
+        assert applied["worker_kill"] == ["q1", "d0"], chaos.applied
+        assert applied["partition"] == ["q2"], chaos.applied
+
+        # --- zero stateless loss: every request delivered, none typed,
+        # none untyped (q0 survives throughout)
+        assert stateless["untyped"] == []
+        assert stateless["typed"] == 0
+        assert stateless["delivered"] == stateless["offered"] == 75
+
+        # --- stateful: every step accounted, failures all typed
+        assert decode["untyped"] == []
+        assert decode["delivered"] + decode["typed"] == decode["steps"]
+        assert decode["typed"] >= 1  # the d0 kill was felt, typed
+
+        # --- the router ledger balances exactly (delivered counts a
+        # hair after the reply bytes: poll past the sliver)
+        def balanced():
+            st = f.router.stats()
+            return (st["offered"] == st["delivered"] + st["shed_total"]
+                    and st["offered"] >= 75)
+
+        assert _wait_for(balanced, 5), f.router.stats()
+
+        # --- replay: same spec + same consult order = identical schedule
+        replay = faults.ChaosEngine(spec)
+        for name in chaos.consults:
+            replay.decide("fleet", name)
+        assert replay.log == eng.log
+        assert replay.injections == eng.injections
+
+        # --- Perfetto: one traced request renders router → worker →
+        # device (nnsq_route → nnsq_serve → device_invoke)
+        trace_id = spans.new_trace_id()
+        c = RawClient(f.router.port)
+        outs, _ = c.request((np.full(VEC, 1.0, np.float32),),
+                            trace=(trace_id, 0))
+        c.close()
+        def trace_events():
+            doc = spans.chrome_trace()
+            return {e["name"]: e for e in doc["traceEvents"]
+                    if e.get("ph") == "X"
+                    and e.get("args", {}).get("trace_id") == f"{trace_id:x}"}
+
+        # the router ends its span AFTER relaying the reply: poll the
+        # snapshot briefly instead of racing it
+        assert _wait_for(
+            lambda: {"nnsq_route", "nnsq_serve",
+                     "device_invoke"} <= set(trace_events()), 5)
+        by_name = trace_events()
+        route, serve, dev = (by_name["nnsq_route"], by_name["nnsq_serve"],
+                             by_name["device_invoke"])
+        assert serve["args"]["parent_id"] == route["args"]["span_id"]
+        assert dev["args"]["parent_id"] == serve["args"]["span_id"]
+
+        spans.disable()
+        faults.deactivate()
+        dr.stop()
+        dm.stop()
+        for w in dworkers:
+            try:
+                w.stop()
+            except Exception:  # noqa: BLE001
+                pass
+        f.close()

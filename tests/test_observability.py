@@ -467,10 +467,43 @@ class TestHealthAndStatsEndpoints:
             return resp.status, resp.headers["Content-Type"], resp.read()
 
     def test_healthz(self):
+        import json as _json
+
         with MetricsServer(port=0, registry=MetricsRegistry()) as srv:
             status, ctype, body = self._get(srv, "/healthz")
-        assert status == 200 and body == b"ok\n"
-        assert ctype.startswith("text/plain")
+        assert status == 200
+        assert ctype.startswith("application/json")
+        doc = _json.loads(body)
+        assert doc["status"] == "ok"
+        assert doc["failures"] == {} and doc["degraded"] == {}
+
+    def test_healthz_degraded_carries_reason(self):
+        """Satellite (fleet PR): a degraded-but-serving worker answers
+        200 with the WHY in the JSON body — membership and operators see
+        the reason, not just a flag — and /stats.json mirrors it under
+        'health'."""
+        import json as _json
+
+        from nnstreamer_tpu.obs.export import (
+            register_degraded,
+            unregister_degraded,
+        )
+
+        fn = lambda: "jax:f: compile failed; pinned to CPU"  # noqa: E731
+        register_degraded("jax:f", fn)
+        try:
+            with MetricsServer(port=0, registry=MetricsRegistry()) as srv:
+                status, ctype, body = self._get(srv, "/healthz")
+                s_status, _, s_body = self._get(srv, "/stats.json")
+            assert status == 200  # degraded is NOT an outage
+            doc = _json.loads(body)
+            assert doc["status"] == "degraded"
+            assert "pinned to CPU" in doc["degraded"]["jax:f"]
+            stats = _json.loads(s_body)
+            assert stats["health"]["status"] == "degraded"
+            assert "pinned to CPU" in stats["health"]["degraded"]["jax:f"]
+        finally:
+            unregister_degraded("jax:f", fn)
 
     def test_stats_json_merges_providers(self):
         import json as _json

@@ -8,6 +8,9 @@ and the upload wire-rule while both branches are busy.  Every frame must
 come out of both branches exactly once, in order, with correct values.
 """
 
+import threading
+import time
+
 import numpy as np
 
 from nnstreamer_tpu import Pipeline, faults
@@ -138,3 +141,186 @@ def test_chaos_soak_seeded_fault_injection():
         assert replay.injections == eng.injections
     finally:
         faults.deactivate()
+
+
+def test_fleet_chaos_soak_worker_churn():
+    """Fleet soak: seeded worker churn (kill → restart, partition → heal)
+    under continuous stateless query traffic AND stateful decode
+    sessions through the two routers.  Every client-side outcome is
+    typed — delivered + typed-shed == offered EXACTLY, zero silent
+    losses, zero untyped errors — and the identical seed driven over the
+    identical consult order replays the identical churn schedule."""
+    import socket as _socket
+
+    from nnstreamer_tpu.elements.query import (
+        QueryError,
+        recv_tensors,
+        send_tensors,
+    )
+    from nnstreamer_tpu.fleet import FleetWorker, Membership, Router
+    from nnstreamer_tpu.fleet.chaos import FleetChaos, InProcHandle
+    from nnstreamer_tpu.serving import ContinuousBatcher
+
+    spec = ("seed=77;worker_kill@q:rate=0.08;partition@q:rate=0.06,ms=200;"
+            "worker_kill@d1:after=6")
+    eng = faults.install(spec)
+    workers, infos = {}, {}
+    qm = Membership(heartbeat_s=0.04, suspect_misses=2, death_misses=3,
+                    breaker_failures=2, breaker_reset_s=0.15)
+    for i in range(3):
+        w = FleetWorker(name=f"q{i}", model=lambda x: x * 2.0).start()
+        workers[w.name] = w
+        infos[w.name] = qm.add("127.0.0.1", w.query_port, probe=w.probe,
+                               worker_id=w.name)
+    dm = Membership(heartbeat_s=0.04, suspect_misses=2, death_misses=3,
+                    breaker_failures=2, breaker_reset_s=0.15)
+    engine_cfg = dict(capacity=2, t_max=8, d_in=4, n_out=4, d_model=16,
+                      n_heads=2, n_layers=1)
+    for i in range(2):
+        w = FleetWorker(name=f"d{i}", engine=dict(engine_cfg)).start()
+        workers[w.name] = w
+        infos[w.name] = dm.add("127.0.0.1", w.decode_port, probe=w.probe,
+                               worker_id=w.name)
+    qm.start()
+    dm.start()
+    qr = Router(qm, port=0, route_retries=4, retry_backoff_ms=1,
+                retry_backoff_cap_ms=10, request_timeout=15.0).start()
+    dr = Router(dm, port=0, stateful=True, route_retries=2,
+                retry_backoff_ms=1, request_timeout=15.0).start()
+    chaos = FleetChaos({n: InProcHandle(workers[n], infos[n])
+                        for n in workers})
+    stop = threading.Event()
+    ledger = {"offered": 0, "delivered": 0, "typed": 0, "untyped": []}
+    lock = threading.Lock()
+
+    def q_request(val):
+        s = _socket.create_connection(("127.0.0.1", qr.port), timeout=15)
+        s.settimeout(15)
+        try:
+            send_tensors(s, (np.full(4, val, np.float32),), 0)
+            outs, _ = recv_tensors(s)
+            return float(np.asarray(outs[0])[0])
+        finally:
+            s.close()
+
+    def q_client():
+        i = 0
+        while not stop.is_set():
+            i += 1
+            with lock:
+                ledger["offered"] += 1
+            try:
+                assert q_request(float(i)) == 2.0 * i
+                with lock:
+                    ledger["delivered"] += 1
+            except QueryError:
+                with lock:
+                    ledger["typed"] += 1
+            except Exception as exc:  # noqa: BLE001
+                with lock:
+                    ledger["untyped"].append(repr(exc))
+            time.sleep(0.008)
+
+    dledger = {"steps": 0, "delivered": 0, "typed": 0, "untyped": []}
+
+    def d_client():
+        s = None
+        while not stop.is_set():
+            with lock:
+                dledger["steps"] += 1
+            try:
+                if s is None:
+                    s = _socket.create_connection(
+                        ("127.0.0.1", dr.port), timeout=15)
+                    s.settimeout(15)
+                send_tensors(s, (np.zeros(4, np.float32),), 0)
+                outs, _ = recv_tensors(s)
+                assert np.asarray(outs[0]).shape == (4,)
+                with lock:
+                    dledger["delivered"] += 1
+            except (QueryError, ConnectionError, OSError):
+                # typed session break / the torn socket right after it:
+                # rebuild the session (stateful is never replayed)
+                with lock:
+                    dledger["typed"] += 1
+                if s is not None:
+                    s.close()
+                    s = None
+            except Exception as exc:  # noqa: BLE001
+                with lock:
+                    dledger["untyped"].append(repr(exc))
+            time.sleep(0.01)
+        if s is not None:
+            s.close()
+
+    ths = ([threading.Thread(target=q_client) for _ in range(3)]
+           + [threading.Thread(target=d_client) for _ in range(2)])
+    try:
+        for t in ths:
+            t.start()
+        # 30 seeded churn ticks; killed query workers restart 5 ticks
+        # later (the churn: death -> membership DOWN -> restart ->
+        # probe revival)
+        killed_at = {}
+        for tick in range(30):
+            chaos.tick()
+            for name, w in workers.items():
+                if w._killed and name.startswith("q") \
+                        and name not in killed_at:
+                    killed_at[name] = tick
+            for name, t0 in list(killed_at.items()):
+                if tick - t0 >= 5:
+                    workers[name].restart()
+                    del killed_at[name]
+            time.sleep(0.05)
+        # churn epilogue: anything still down comes back before the
+        # final burst (the soak ends on a healed fleet)
+        for name, w in workers.items():
+            if w._killed and name.startswith("q"):
+                w.restart()
+        time.sleep(0.3)  # let membership converge before the final burst
+        # final burst on a stable fleet: proves the tier healed
+        for i in range(5):
+            assert q_request(1000.0 + i) == 2.0 * (1000.0 + i)
+    finally:
+        stop.set()
+        for t in ths:
+            t.join(timeout=30)
+
+    kills = [w for w, k in chaos.applied if k == "worker_kill"]
+    assert kills, chaos.applied  # the seed did churn workers
+
+    # every outcome typed; the ledger balances EXACTLY
+    assert ledger["untyped"] == []
+    assert ledger["offered"] == ledger["delivered"] + ledger["typed"]
+    assert ledger["delivered"] > 0
+    assert dledger["untyped"] == []
+    assert dledger["steps"] == dledger["delivered"] + dledger["typed"]
+    # the routers' own ledgers balance too (delivered counts a hair
+    # after the reply bytes: give the serve threads that sliver)
+    for r in (qr, dr):
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            st = r.stats()
+            if st["offered"] == st["delivered"] + st["shed_total"]:
+                break
+            time.sleep(0.02)
+        assert st["offered"] == st["delivered"] + st["shed_total"], st
+
+    # replay: identical seed + identical consult order = identical log
+    replay = faults.ChaosEngine(spec)
+    for name in chaos.consults:
+        replay.decide("fleet", name)
+    assert replay.log == eng.log
+    assert replay.injections == eng.injections
+
+    qr.stop()
+    dr.stop()
+    qm.stop()
+    dm.stop()
+    for w in workers.values():
+        try:
+            w.stop()
+        except Exception:  # noqa: BLE001
+            pass
+    faults.deactivate()

@@ -585,6 +585,187 @@ print(f"mesh smoke OK: sharded backend matched single-device to 1e-5, "
       f"compile misses={summ['compiles']['miss']} (no per-frame churn)")
 PY
 
+run_step "Fleet smoke (router + 3 workers: kill -9, SIGTERM drain, /healthz convergence)" \
+  python - <<'PY'
+import jax
+jax.config.update('jax_platforms', 'cpu')
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+
+import numpy as np
+
+from nnstreamer_tpu.elements.query import (
+    QueryError, QuerySessionBrokenError, QueryUnavailableError,
+    recv_tensors, send_tensors)
+
+DECODE = "capacity=2,t_max=8,d_in=4,n_out=4,d_model=16,n_heads=2,n_layers=1"
+
+
+def spawn(args):
+    p = subprocess.Popen(
+        [sys.executable, "-m", "nnstreamer_tpu.fleet"] + args
+        + ["--platform", "cpu"],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True)
+    line = p.stdout.readline()  # the JSON ports line
+    return p, json.loads(line)
+
+
+procs = []
+try:
+    workers = []
+    for i in range(3):
+        p, info = spawn(["worker", "--name", f"w{i}", "--port", "0",
+                         "--health-port", "0", "--model", "x2",
+                         "--decode", DECODE, "--decode-port", "0",
+                         "--drain-timeout", "5"])
+        procs.append(p)
+        workers.append(info)
+    qspec = ",".join(f"127.0.0.1:{w['port']}/{w['health_port']}"
+                     for w in workers)
+    dspec = ",".join(f"127.0.0.1:{w['decode_port']}/{w['health_port']}"
+                     for w in workers)
+    qr_p, qr = spawn(["router", "--name", "qrouter", "--port", "0",
+                      "--health-port", "0", "--workers", qspec])
+    procs.append(qr_p)
+    dr_p, dr = spawn(["router", "--name", "drouter", "--port", "0",
+                      "--health-port", "0", "--stateful",
+                      "--workers", dspec])
+    procs.append(dr_p)
+
+    def q_request(val):
+        s = socket.create_connection(("127.0.0.1", qr["port"]), timeout=20)
+        s.settimeout(20)
+        try:
+            send_tensors(s, (np.full(4, val, np.float32),), 0)
+            outs, _ = recv_tensors(s)
+            return float(np.asarray(outs[0])[0])
+        finally:
+            s.close()
+
+    stateless = {"n": 0, "errors": []}
+    stop = threading.Event()
+
+    def q_client():
+        i = 0
+        while not stop.is_set():
+            i += 1
+            try:
+                assert q_request(float(i)) == 2.0 * i
+                stateless["n"] += 1
+            except Exception as exc:  # noqa: BLE001
+                stateless["errors"].append(repr(exc))
+            time.sleep(0.01)
+
+    decode = {"delivered": 0, "typed": 0, "untyped": [], "rebuilt": 0}
+
+    def d_client():
+        s = None
+        while not stop.is_set():
+            try:
+                if s is None:
+                    s = socket.create_connection(
+                        ("127.0.0.1", dr["port"]), timeout=20)
+                    s.settimeout(20)
+                send_tensors(s, (np.zeros(4, np.float32),), 0)
+                outs, _ = recv_tensors(s)
+                assert np.asarray(outs[0]).shape == (4,)
+                decode["delivered"] += 1
+            except (QuerySessionBrokenError, QueryUnavailableError,
+                    QueryError):
+                decode["typed"] += 1
+                if s is not None:
+                    s.close(); s = None
+                decode["rebuilt"] += 1
+            except (ConnectionError, OSError):
+                decode["typed"] += 1  # torn socket right after the typed frame
+                if s is not None:
+                    s.close(); s = None
+            except Exception as exc:  # noqa: BLE001
+                decode["untyped"].append(repr(exc))
+            time.sleep(0.02)
+        if s is not None:
+            s.close()
+
+    ths = [threading.Thread(target=q_client) for _ in range(3)] \
+        + [threading.Thread(target=d_client) for _ in range(2)]
+    for t in ths:
+        t.start()
+    time.sleep(1.0)                       # traffic established
+    # kill -9 a worker that is HOSTING a live decode session (so the
+    # stateful fail-fast contract is actually exercised), SIGTERM-drain
+    # one of the others
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{dr['health_port']}/stats.json",
+            timeout=10) as r:
+        by_worker = json.load(r)["fleet:drouter"]["sessions_by_worker"]
+    victim = sorted(by_worker)[0]            # worker id == "host:port"
+    vi = next(i for i, w in enumerate(workers)
+              if victim.endswith(f":{w['decode_port']}"))
+    di = next(i for i in range(3) if i != vi)
+    os.kill(workers[vi]["pid"], signal.SIGKILL)   # crash mid-stream
+    time.sleep(0.6)
+    os.kill(workers[di]["pid"], signal.SIGTERM)   # drain mid-stream
+    time.sleep(2.5)                       # ride through the churn
+    stop.set()
+    for t in ths:
+        t.join(timeout=30)
+
+    assert stateless["errors"] == [], \
+        f"stateless errors surfaced: {stateless['errors'][:3]}"
+    assert stateless["n"] >= 50, stateless
+    assert decode["untyped"] == [], decode
+    assert decode["typed"] >= 1, decode   # the kill was felt, typed only
+    assert decode["delivered"] >= 10, decode
+
+    # /healthz convergence: the survivor answers 200-json, the killed and
+    # drained workers are down in the router's membership view
+    si = next(i for i in range(3) if i not in (vi, di))
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{workers[si]['health_port']}/healthz",
+            timeout=10) as r:
+        doc = json.loads(r.read())
+        assert r.status == 200 and doc["status"] == "ok", doc
+
+    def converged():
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{qr['health_port']}/stats.json",
+                timeout=10) as r:
+            st = json.load(r)["fleet:qrouter"]
+        states = {k: v["state"] for k, v in st["membership"]["workers"].items()}
+        up = [k for k, v in states.items() if v == "up"]
+        gone = [k for k, v in states.items()
+                if v in ("down", "suspect", "unhealthy")]
+        ok = len(up) == 1 and len(gone) == 2 \
+            and st["offered"] == st["delivered"] + st["shed_total"]
+        return ok, states, st
+
+    deadline = time.time() + 20
+    ok, states, st = converged()
+    while time.time() < deadline and not ok:
+        time.sleep(0.2)
+        ok, states, st = converged()
+    assert ok, (states, st["offered"], st["delivered"], st["shed_total"])
+    print(f"fleet smoke OK: {stateless['n']} stateless requests with zero "
+          f"errors through a kill -9 + SIGTERM drain; decode sessions "
+          f"broke typed only ({decode['typed']} typed, "
+          f"{decode['delivered']} steps delivered); router ledger "
+          f"{st['offered']}=={st['delivered']}+{st['shed_total']}; "
+          f"membership converged {states}")
+finally:
+    for p in procs:
+        try:
+            p.kill()
+        except OSError:
+            pass
+PY
+
 run_step "Bench smoke (final JSON line parses, rc=0)" \
   bash -c '
     env BENCH_FRAMES=10 BENCH_QUANT_FRAMES=4 BENCH_BASELINE_FRAMES=3 \
