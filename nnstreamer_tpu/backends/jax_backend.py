@@ -556,6 +556,7 @@ class JaxBackend(FilterBackend):
             return self._out_spec
         t0 = time.perf_counter_ns()
         aot = None  # whichever entry AOT-compiles carries cost_analysis()
+        result = "miss"
         structs = _as_shape_structs(in_spec)
         flat_fn, wire_shapes = self._make_flat_entry(in_spec)
         if flat_fn is not None:
@@ -569,7 +570,8 @@ class JaxBackend(FilterBackend):
                 # Pre-warm the flat entry (frames arrive from host); the
                 # shaped twin compiles lazily if a device-resident frame
                 # ever shows up.
-                aot = self._flat_compiled.lower(*flat_structs).compile()
+                aot, result = self._aot_compile(
+                    self._flat_compiled, flat_structs, key, "flat")
         else:
             self._flat_compiled = None
             self._wire_shapes = None
@@ -581,7 +583,7 @@ class JaxBackend(FilterBackend):
             # path overlaps host→device transfers with compute, which the
             # AOT executable's __call__ does not (measured ~2× on a
             # tunneled chip).
-            aot = jitted.lower(*structs).compile()
+            aot, result = self._aot_compile(jitted, structs, key, "shaped")
         self._compiled = jitted
         outs = jax.eval_shape(self._effective_fn, *structs)
         self._single_output = not isinstance(outs, (tuple, list))
@@ -595,9 +597,79 @@ class JaxBackend(FilterBackend):
         while len(self._cache) > self._cache_size:
             evicted_key, _ = self._cache.popitem(last=False)  # evict LRU
             record_compile(self, evicted_key, "evict")
-        record_compile(self, key, "miss", time.perf_counter_ns() - t0,
+        record_compile(self, key, result, time.perf_counter_ns() - t0,
                        cost_info(aot) if aot is not None else {})
         return out_spec
+
+    def _aot_compile(self, jitted, structs, lru_key, entry: str):
+        """AOT-lower + compile one executable entry, consulting/feeding
+        the persistent on-disk cache when ``[compile] cache_dir`` is set.
+        Returns ``(compiled, result)`` where ``result`` is ``"miss"`` (a
+        genuinely fresh compile, persisted for the next process) or
+        ``"persist_hit"`` (this exact (geometry, mesh, jax/jaxlib version,
+        platform, fn-fingerprint) entry was compiled before on this
+        machine; the reconstruct runs through jax's XLA binary cache —
+        wired at ``<cache_dir>/xla`` — so the recorded duration is disk
+        I/O, not a compile).  Persistence failures always degrade to a
+        plain compile — the cache may never take a stream down."""
+        from . import exec_cache
+
+        lowered = jitted.lower(*structs)
+        cache = exec_cache.configured_cache()
+        if cache is None:
+            return lowered.compile(), "miss"
+        try:
+            fp = exec_cache.fingerprint_lowered(lowered)
+            pkey = cache.make_key(lru_key[0], lru_key[1], fp, entry)
+            found = cache.lookup(pkey)
+        except Exception:  # noqa: BLE001 — persistence is best-effort
+            return lowered.compile(), "miss"
+        if found is not None:
+            kind, payload = found
+            try:
+                return lowered.compile(), "persist_hit"
+            except Exception:  # noqa: BLE001 — reconstruct fallback
+                if kind != "export" or payload is None:
+                    raise
+                # the lowered module no longer compiles here (rare: a
+                # jax-internal lowering drift within one version) but the
+                # serialized jax.export module still deserializes — serve
+                # the AOT artifact instead of failing the stream
+                call = exec_cache.deserialize_entry(payload)
+                return jax.jit(call).lower(*structs).compile(), "persist_hit"
+        compiled = lowered.compile()
+        payload = None
+        if self._mesh is None:
+            # jax.export of a NamedSharding'd program bakes the device
+            # assignment; mesh entries persist as meta witnesses instead
+            # (the XLA binary cache still carries their bits)
+            payload = exec_cache.serialize_entry(
+                getattr(jitted, "__wrapped__", jitted), structs)
+        cache.store(pkey, payload)
+        return compiled, "miss"
+
+    # -- compile-ahead warmup ------------------------------------------------
+
+    def ensure_cache_capacity(self, n: int) -> None:
+        """Grow the executable LRU so a warmed bucket ladder is not
+        evicted by its own warmup (never shrinks a user-set size)."""
+        self._cache_size = max(self._cache_size, int(n))
+
+    def warm_compile(self, in_spec: TensorsSpec) -> TensorsSpec:
+        """Compile ``in_spec`` into the executable cache without leaving
+        the backend pointed at it: the previously active spec (if any) is
+        re-selected afterwards via its LRU entry, so warmup can walk a
+        bucket ladder while the negotiated executable stays hot.  Not for
+        fused filters — ``TensorFilter.warm_spec`` owns the wrapper
+        rebuild discipline there."""
+        active = self._in_spec
+        if not in_spec.tensors_fixed:
+            in_spec = in_spec.fixate()
+        out = self._compile(in_spec)
+        if (active is not None and active.tensors_fixed
+                and self._spec_key(active) != self._spec_key(in_spec)):
+            self._compile(active)  # LRU hit: restores the hot entry
+        return out
 
     def _mesh_place(self, tensors: Tuple, wire: bool = False) -> Tuple:
         """Re-place device-resident inputs whose committed sharding differs

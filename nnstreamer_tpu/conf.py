@@ -79,6 +79,21 @@ DEFAULTS: Dict[str, Dict[str, str]] = {
                                     # CPU fallback above this (0=off; opt-in
                                     # — see BENCH_NOTES zero-copy sweep)
     },
+    # Compile-ahead serving (backends/exec_cache.py + graph/warmup.py +
+    # ops/autotune.py): persistent executable/autotune caches and the AOT
+    # warmup phase.  NNSTPU_COMPILE_* env vars map here.
+    "compile": {
+        "cache_dir": "",            # persistent executable + autotune cache
+                                    # root ("" = persistence off); jax's own
+                                    # XLA binary cache lands in <dir>/xla
+        "warmup": "false",          # AOT warmup phase in Pipeline.start:
+                                    # compile every negotiated (spec, bucket)
+                                    # geometry before PLAYING
+        "warmup_workers": "4",      # parallel compile workers for warmup
+        "warmup_timeout_s": "600",  # whole-phase deadline (0 = unbounded)
+        "autotune": "true",         # consult the persistent Pallas autotune
+                                    # cache for kernel block configs
+    },
     # Mesh-sharded dispatch (parallel/mesh.py dispatch_mesh): batch-axis
     # data parallelism over all chips.  The short env spelling NNSTPU_MESH
     # takes precedence over the NNSTPU_MESH_SPEC form mapped here.

@@ -123,6 +123,49 @@ class DynBatch(Node):
         # jax backend treats each new bucket as spec drift (LRU-cached)
         return {"src": TensorsSpec(tensors=out, rate=spec.rate)}
 
+    def warmup_plan(self):
+        """Compile-ahead: one thunk per ``ndev × pow-2`` bucket this
+        element can emit, aimed at the downstream filter (hopping
+        queue/upload plumbing).  With warmup on, every bucket executable
+        exists before PLAYING — a pile-up's first flip to a new bucket
+        never pays a compile on the request path."""
+        from ..graph.residency import downstream_filter_node
+
+        spec = self.sink_pads["sink"].spec
+        if spec is None or not spec.tensors_fixed:
+            return []
+        filt = downstream_filter_node(self)
+        warm = getattr(filt, "warm_spec", None)
+        if warm is None:
+            return []
+        ndev = max(1, self._mesh_dev)
+        if self._skip_concat:
+            # over-threshold CPU regime: every emission is a batch-1
+            # view, so bucket 1 is the only runtime geometry
+            buckets = [1]
+        else:
+            buckets = []
+            b = 1
+            while b <= self.max_batch:
+                buckets.append(b * ndev)
+                b <<= 1
+        ensure = getattr(filt.backend, "ensure_cache_capacity", None)
+        if ensure is not None:
+            # the ladder plus the negotiated entry must coexist in the
+            # backend LRU, or warmup would evict its own work
+            ensure(len(buckets) + 1)
+        items = []
+        for bb in buckets:
+            bspec = TensorsSpec(
+                tensors=tuple(
+                    TensorSpec(dtype=t.dtype, shape=(bb,) + tuple(t.shape))
+                    for t in spec.tensors
+                ),
+                rate=spec.rate,
+            )
+            items.append((f"bucket{bb}", lambda s=bspec: warm(s)))
+        return items
+
     def _ensure_queue(self):
         if self._q is None:
             self._q = make_frame_queue(self.max_size)

@@ -255,6 +255,35 @@ class TensorFilter(Node):
         self._fusion_dirty = False
         return spec_cur
 
+    # -- compile-ahead warmup ------------------------------------------------
+
+    def warm_spec(self, spec: TensorsSpec) -> None:
+        """AOT-compile one runtime geometry into the backend's executable
+        cache without disturbing the active (negotiated) entry — the
+        warmup planner's per-bucket thunk (``graph/warmup.py``; upstream
+        ``tensor_dynbatch`` enumerates the buckets).  Fused filters take
+        the drift-reinstall path: the fused wrapper bakes per-spec
+        geometry, so each bucket compiles with ITS wrapper, and the
+        negotiated wrapper is re-installed afterwards — exactly the
+        discipline the runtime drift hook follows."""
+        be = self.backend
+        # serialize with the dispatch path: Node._dispatch invokes under
+        # this lock, so a frame never observes the transient bucket-spec
+        # backend state between a warm compile and the active restore
+        # (explicit pipeline.warmup() runs while PLAYING)
+        with self._lock:
+            if self._fused_pre or self._fused_post:
+                active = self.sink_pads["sink"].spec
+                self._install_fusion(spec)
+                be.reconfigure_fused(spec)
+                if active is not None:
+                    self._install_fusion(active)
+                    be.reconfigure_fused(active)
+                return
+            warm = getattr(be, "warm_compile", None)
+            if warm is not None:
+                warm(spec)
+
     # -- hot loop -----------------------------------------------------------
 
     def process(self, pad: Pad, frame: Frame):

@@ -785,6 +785,44 @@ class QueryServer:
                 g.error = exc
                 g.event.set()
 
+    def warmup(self, row_spec: TensorsSpec) -> dict:
+        """Compile-ahead for the serving path: pre-build (and AOT-compile)
+        the per-spec backends for every sub-dispatch geometry this server
+        can emit for ``row_spec`` — the spec of ONE request row (no
+        leading batch dim).  With cross-client batching on, that is the
+        full ``ndev × pow-2`` bucket ladder up to ``max_batch × ndev``
+        (exactly the chunk sizes ``_dispatch_group`` produces); unbatched
+        servers warm ``row_spec`` itself.  Combined with the persistent
+        executable cache, a restarted worker's first request then serves
+        with zero compile misses.  Returns the warmup report
+        (``graph/warmup.py`` — progress rides the ``warmup`` hook and
+        ``nnstpu_warmup_seconds{pipeline="query_server"}``)."""
+        from ..graph.warmup import execute
+
+        def warm(spec: TensorsSpec):
+            with self._lock:
+                if not self._running:
+                    raise RuntimeError("query server stopped")
+                self._backend_for(spec)
+
+        items = []
+        if self.batch:
+            from ..parallel.mesh import dispatch_mesh_devices
+
+            ndev = dispatch_mesh_devices()
+            b = 1
+            while b <= self.max_batch:
+                bb = b * ndev
+                spec = TensorsSpec(tensors=tuple(
+                    TensorSpec(dtype=t.dtype, shape=(bb,) + tuple(t.shape))
+                    for t in row_spec.tensors))
+                items.append(("query_server", f"bucket{bb}",
+                              lambda s=spec: warm(s)))
+                b <<= 1
+        else:
+            items.append(("query_server", "spec", lambda: warm(row_spec)))
+        return execute(items, name="query_server")
+
     def stats(self) -> dict:
         """Server observability snapshot (merged into the obs exposition
         via ``register_engine``-style collectors; thread-safe)."""

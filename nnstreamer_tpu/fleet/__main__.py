@@ -72,13 +72,28 @@ def _cmd_worker(args) -> int:
     engine = None
     if args.decode:
         engine = _parse_kv_ints(args.decode)
+    warmup_spec = None
+    if args.warmup_spec:
+        # "float32:4" / "float32:8x8" — the spec of ONE request row; the
+        # worker warms the whole sub-dispatch bucket ladder around it and
+        # reports "warming" to membership until done
+        import numpy as np
+
+        from ..spec import TensorSpec, TensorsSpec
+
+        dt, _, dims = args.warmup_spec.partition(":")
+        shape = tuple(int(d) for d in dims.split("x") if d)
+        warmup_spec = TensorsSpec.of(
+            TensorSpec(dtype=np.dtype(dt), shape=shape))
     worker = FleetWorker(
         name=args.name, host=args.host, port=args.port,
         framework=args.framework, model=args.model, custom=args.custom,
         batch=args.batch, max_batch=args.max_batch, engine=engine,
         decode_port=args.decode_port if engine else None,
         health_port=args.health_port,
-        drain_timeout_s=args.drain_timeout).start()
+        drain_timeout_s=args.drain_timeout,
+        warmup_spec=warmup_spec,
+        warmup_engine=args.warmup_engine).start()
     print(json.dumps({
         "role": "worker", "name": worker.name, "pid": os.getpid(),
         "port": worker.query_port, "decode_port": worker.decode_port,
@@ -150,6 +165,14 @@ def main(argv=None) -> int:
                         "— turns on the stateful DecodeServer surface")
     w.add_argument("--decode-port", type=int, default=0)
     w.add_argument("--drain-timeout", type=float, default=10.0)
+    w.add_argument("--warmup-spec", default="", metavar="DTYPE:DIMS",
+                   help="compile-ahead one request-row spec, e.g. "
+                        "'float32:4' or 'uint8:224x224x3' — the worker "
+                        "warms its sub-dispatch bucket ladder before "
+                        "reporting ready to membership")
+    w.add_argument("--warmup-engine", action="store_true",
+                   help="also AOT-compile the decode engine's prefill "
+                        "length buckets during warmup")
     w.set_defaults(fn=_cmd_worker)
 
     r = sub.add_parser("router", help="the NNSQ fleet front door")

@@ -45,9 +45,14 @@ DEGRADED = "degraded"
 UNHEALTHY = "unhealthy"
 SUSPECT = "suspect"
 DOWN = "down"
+# compile-ahead warmup in progress: suspend-dispatch, NOT unhealthy —
+# the worker is alive and converging; routing to it would serve requests
+# into cold executables (exactly what warmup exists to prevent)
+WARMING = "warming"
 
 # numeric encoding for the state gauge (Prometheus can't label strings)
-STATE_CODES = {UP: 0, DEGRADED: 1, UNHEALTHY: 2, SUSPECT: 3, DOWN: 4}
+STATE_CODES = {UP: 0, DEGRADED: 1, UNHEALTHY: 2, SUSPECT: 3, DOWN: 4,
+               WARMING: 5}
 
 
 class NoWorkerAvailable(RuntimeError):
@@ -134,6 +139,11 @@ def _http_probe(worker: WorkerInfo, timeout_s: float) -> str:
                 f"{k}: {v}" for k, v in sorted(
                     (doc.get("degraded") or {}).items()))
             return f"degraded:{reasons}"
+        if status == "warming":
+            reasons = "; ".join(
+                f"{k}: {v}" for k, v in sorted(
+                    (doc.get("warming") or {}).items()))
+            return f"warming:{reasons}"
         return status
     except (ValueError, AttributeError):
         return "ok"  # pre-JSON peer: 200 means serving
@@ -284,6 +294,12 @@ class Membership:
         if status.startswith("degraded"):
             w.state = DEGRADED
             w.degraded_reason = status.partition(":")[2]
+        elif status.startswith("warming"):
+            # compile-ahead still running: suspend NEW dispatch (pick()
+            # only serves the UP/DEGRADED tiers) without calling the
+            # worker unhealthy — it reports ready when warmup completes
+            w.state = WARMING
+            w.degraded_reason = status.partition(":")[2]
         elif status in ("unhealthy", UNHEALTHY):
             w.state = UNHEALTHY
         else:
@@ -295,9 +311,9 @@ class Membership:
     def pick(self, exclude=()) -> WorkerInfo:
         """Choose a worker for one dispatch (or one new session):
         round-robin over UP workers, falling back to DEGRADED ones only
-        when no UP worker is eligible; SUSPECT / UNHEALTHY / DOWN /
-        draining workers and open per-worker breakers never receive new
-        work.  Raises :class:`NoWorkerAvailable`."""
+        when no UP worker is eligible; WARMING / SUSPECT / UNHEALTHY /
+        DOWN / draining workers and open per-worker breakers never
+        receive new work.  Raises :class:`NoWorkerAvailable`."""
         with self._lock:
             members = list(self._workers.values())
             self._rr += 1

@@ -56,13 +56,24 @@ class FleetWorker:
                  batch_window_ms: float = 2.0, max_batch: int = 64,
                  scheduler=None, engine=None, decode_port: Optional[int] = None,
                  health_port: Optional[int] = None,
-                 drain_timeout_s: float = 10.0):
+                 drain_timeout_s: float = 10.0,
+                 warmup_spec=None, warmup_engine: bool = False):
         """``engine`` turns on the stateful surface: either a live
         :class:`~nnstreamer_tpu.serving.ContinuousBatcher` or a kwargs
         dict to build one (the CLI path), served by a DecodeServer on
         ``decode_port``.  ``health_port`` (subprocess mode) starts the
         metrics/health endpoint and registers this worker's drain state
-        as a health provider."""
+        as a health provider.
+
+        ``warmup_spec`` (a :class:`~nnstreamer_tpu.spec.TensorsSpec` of
+        one request ROW) turns on compile-ahead: after the servers come
+        up, a warmup thread drives :meth:`QueryServer.warmup` over the
+        sub-dispatch bucket ladder (plus :meth:`ContinuousBatcher.
+        warmup_prefill` when ``warmup_engine``), and the worker reports
+        ``warming`` to membership — suspend-dispatch, not unhealthy —
+        until it finishes.  A restarting worker loads the persistent
+        executable cache during this phase, so it rejoins the fleet with
+        zero compile misses AND zero cold traffic."""
         self.name = name
         self.host = host
         self._q_kwargs = dict(
@@ -79,6 +90,11 @@ class FleetWorker:
         self.engine = None
         self.metrics_server = None
         self.degraded_reason = ""  # tests / operators: deprioritize me
+        self._warmup_spec = warmup_spec
+        self._warmup_engine = bool(warmup_engine)
+        self._warming = False
+        self._warmup_thread: Optional[threading.Thread] = None
+        self.warmup_report: Optional[dict] = None
         self._killed = False
         self._draining = False
         self._lock = threading.Lock()
@@ -108,6 +124,7 @@ class FleetWorker:
                 register_degraded,
                 register_health,
                 register_stats,
+                register_warming,
             )
 
             self.metrics_server = MetricsServer(
@@ -116,8 +133,38 @@ class FleetWorker:
             register_health(f"worker:{self.name}", self._health_provider)
             register_degraded(f"worker:{self.name}", lambda:
                               self.degraded_reason)
+            register_warming(f"worker:{self.name}", lambda:
+                             "compile-ahead warmup" if self._warming else "")
             register_stats(f"worker:{self.name}", self.stats)
+        if self._warmup_spec is not None or (
+                self.engine is not None and self._warmup_engine):
+            # compile-ahead off the serving path: the worker reports
+            # "warming" to membership until every bucket executable is
+            # built (persist-hits on a restart), THEN becomes routable
+            self._warming = True
+            self._warmup_thread = threading.Thread(
+                target=self._warm, name=f"warmup:{self.name}", daemon=True)
+            self._warmup_thread.start()
         return self
+
+    def _warm(self) -> None:
+        report = {}
+        try:
+            if self._warmup_spec is not None and self.query_server is not None:
+                report["query"] = self.query_server.warmup(self._warmup_spec)
+            if self.engine is not None and self._warmup_engine:
+                report["prefill"] = self.engine.warmup_prefill()
+        except Exception as exc:  # noqa: BLE001 — a failed warmup must not
+            # keep a servable worker out of the fleet forever; it serves
+            # with lazy compiles instead (degraded-visible, not dead)
+            import logging
+
+            logging.getLogger("nnstreamer_tpu.fleet").exception(
+                "worker %s warmup failed", self.name)
+            report["error"] = repr(exc)
+        finally:
+            self.warmup_report = report
+            self._warming = False
 
     def _health_provider(self):
         if self._draining:
@@ -145,6 +192,8 @@ class FleetWorker:
             raise ConnectionError(f"{self.name}: killed")
         if self._draining:
             return "unhealthy"
+        if self._warming:
+            return "warming:compile-ahead warmup"
         if self.degraded_reason:
             return f"degraded:{self.degraded_reason}"
         return "ok"
@@ -228,10 +277,12 @@ class FleetWorker:
                 unregister_degraded,
                 unregister_health,
                 unregister_stats,
+                unregister_warming,
             )
 
             unregister_health(f"worker:{self.name}")
             unregister_degraded(f"worker:{self.name}")
+            unregister_warming(f"worker:{self.name}")
             unregister_stats(f"worker:{self.name}")
             self.metrics_server.stop()
             self.metrics_server = None
@@ -246,6 +297,7 @@ class FleetWorker:
         out = {
             "name": self.name,
             "draining": self._draining,
+            "warming": self._warming,
             "killed": self._killed,
             "restarts": self.restarts,
             "degraded_reason": self.degraded_reason,

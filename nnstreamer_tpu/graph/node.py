@@ -225,6 +225,16 @@ class Node:
         del pad_name
         return ANY
 
+    def warmup_plan(self):
+        """Compile-ahead work for this node (``graph/warmup.py``): a list
+        of ``(label, thunk)`` pairs, each thunk AOT-compiling one
+        geometry this node will dispatch at runtime.  Called after
+        negotiation, before PLAYING.  Default: nothing (a plain filter's
+        negotiated spec already compiled during negotiation); elements
+        that widen the executable set at runtime (``tensor_dynbatch``'s
+        bucket ladder) override."""
+        return []
+
     def configure(self, in_specs: Dict[str, TensorsSpec]) -> Dict[str, TensorsSpec]:
         """Commit negotiated input specs; return fixed specs per src pad.
 

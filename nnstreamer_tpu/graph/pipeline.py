@@ -107,6 +107,8 @@ class Pipeline:
         self._restart_log: Dict[str, List[float]] = {}   # node -> timestamps
         self._recovery_counts: Dict[str, int] = {}       # action -> count
         self._shed_frames: Dict[str, int] = {}           # node -> frames shed
+        # compile-ahead warmup (graph/warmup.py): report of the last run
+        self.warmup_report: Optional[dict] = None
 
     # -- graph construction -------------------------------------------------
 
@@ -468,7 +470,23 @@ class Pipeline:
             for node in self.nodes.values():
                 node.start()
                 started.append(node)
-            self.negotiate()
+            # every compile before PLAYING is warmup-phase: negotiation
+            # compiles and the explicit warmup walk both land on the
+            # "warmup" Perfetto track and the phase="warmup" series of
+            # nnstpu_compile_seconds — never inside the first frame's
+            # trace (obs/device.py set_compile_phase)
+            from ..obs.device import set_compile_phase
+            from .warmup import run_warmup
+
+            set_compile_phase("warmup")
+            try:
+                self.negotiate()
+                # compile-ahead: AOT-compile every negotiated (spec,
+                # bucket) geometry — dynbatch ladders, mesh buckets —
+                # before PLAYING ([compile] warmup / NNSTPU_COMPILE_WARMUP)
+                run_warmup(self)
+            finally:
+                set_compile_phase(None)
         except BaseException:
             for node in started:
                 try:
@@ -695,6 +713,20 @@ class Pipeline:
         from ..obs.export import register_stats
 
         register_stats(self.name, self.stats)
+
+    def warmup(self) -> dict:
+        """Explicit compile-ahead warmup: compile every element's bucket
+        ladder now (``run_warmup`` does this implicitly at start when
+        ``[compile] warmup`` is on).  Needs negotiated specs, so the
+        pipeline must be PLAYING; the report is also kept on
+        :attr:`warmup_report`."""
+        from .warmup import collect_plan, execute
+
+        if self.state != "PLAYING":
+            raise PipelineError(
+                "warmup() needs a started pipeline (negotiated specs)")
+        self.warmup_report = execute(collect_plan(self), pipeline=self)
+        return self.warmup_report
 
     def attach_tracer(self, tracer):
         """Attach a tracer (name or instance) to this pipeline — the

@@ -50,13 +50,12 @@ def hop_plumbing(pad, direction: str, transparent, max_hops: int = 4):
     return pad
 
 
-def downstream_backend(node: Node, max_hops: int = 4):
-    """The first filter backend downstream of ``node``, hopping over
-    queue/upload plumbing (None when the chain ends, branches, or lands on
-    a non-filter).  Shared by ``tensor_upload`` (wire-rule/sharding
-    discovery) and the batch elements (the host-concat threshold is
-    platform-aware: it needs the CONSUMER's platform, not the producer's).
-    """
+def downstream_filter_node(node: Node, max_hops: int = 4):
+    """The first backend-carrying node downstream of ``node``, hopping
+    over queue/upload plumbing (None when the chain ends, branches, or
+    lands on a non-filter).  The node (not just its backend) is what the
+    warmup planner needs: ``TensorFilter.warm_spec`` owns the fused-
+    wrapper rebuild discipline a bucket compile must follow."""
     from ..elements.queue import Queue
     from ..elements.upload import TensorUpload
 
@@ -67,7 +66,20 @@ def downstream_backend(node: Node, max_hops: int = 4):
         next(iter(pads.values())).peer, "down", (Queue, TensorUpload),
         max_hops,
     )
-    return getattr(pad.node, "backend", None) if pad is not None else None
+    if pad is None or getattr(pad.node, "backend", None) is None:
+        return None
+    return pad.node
+
+
+def downstream_backend(node: Node, max_hops: int = 4):
+    """The first filter backend downstream of ``node``, hopping over
+    queue/upload plumbing (None when the chain ends, branches, or lands on
+    a non-filter).  Shared by ``tensor_upload`` (wire-rule/sharding
+    discovery) and the batch elements (the host-concat threshold is
+    platform-aware: it needs the CONSUMER's platform, not the producer's).
+    """
+    filt = downstream_filter_node(node, max_hops)
+    return getattr(filt, "backend", None) if filt is not None else None
 
 
 def consumer_platform(node: Node, max_hops: int = 4):

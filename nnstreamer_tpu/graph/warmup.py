@@ -1,0 +1,188 @@
+"""AOT warmup: compile every negotiated (spec, bucket) geometry before
+PLAYING.
+
+NNStreamer's caps negotiation hands us the full geometry set at pipeline
+start — nothing about the request path needs to compile.  This module is
+the phase that cashes that in (the TVM discipline from PAPERS.md: search
+and compile offline, serve from the cache):
+
+- :func:`run_warmup` runs inside ``Pipeline.start`` after negotiation and
+  before the PLAYING transition.  It walks every node's
+  :meth:`~nnstreamer_tpu.graph.node.Node.warmup_plan` — ``tensor_dynbatch``
+  contributes its full ``ndev × pow-2`` bucket ladder, a plain
+  ``tensor_filter``'s negotiated spec already compiled during negotiation
+  — and drives the returned compile thunks through a small worker pool
+  (parallel across nodes, sequential within one node: a backend's
+  executable cache is not a concurrent structure).
+- every warmed executable lands in the backend's LRU **and** the
+  persistent on-disk cache (``[compile] cache_dir`` —
+  ``backends/exec_cache.py``), so the next process start reconstructs
+  instead of compiling.
+- progress is observable: the ``warmup`` hook fires per executable and
+  once at phase end, ``nnstpu_warmup_seconds{pipeline}`` records the
+  phase wall time, and the whole phase (plus each compile inside it)
+  renders on a dedicated ``warmup`` Perfetto track — compile spans
+  triggered here never pollute the first frame's trace
+  (``obs/device.py`` ``set_compile_phase``).
+
+Activation: conf ``[compile] warmup`` / ``NNSTPU_COMPILE_WARMUP=1``
+(default off: a short-lived test pipeline should not pay for bucket
+ladders it will never hit), or explicitly via ``pipeline.warmup()``.
+Fleet workers run the same machinery per worker and only report ready to
+membership after it completes (``fleet/worker.py``).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Callable, List, Optional, Tuple
+
+from ..obs import hooks as _hooks
+from ..obs import spans as _spans
+
+# one warmup work item: (node_name, label, compile thunk)
+WarmupItem = Tuple[str, str, Callable[[], object]]
+
+
+def configured() -> bool:
+    from ..conf import conf
+
+    return conf.get_bool("compile", "warmup", False)
+
+
+def configured_workers() -> int:
+    from ..conf import conf
+
+    try:
+        n = conf.get_int("compile", "warmup_workers", 4)
+    except ValueError:
+        return 4
+    return max(1, n)
+
+
+def configured_timeout_s() -> float:
+    from ..conf import conf
+
+    try:
+        return conf.get_float("compile", "warmup_timeout_s", 600.0)
+    except ValueError:
+        return 600.0
+
+
+def collect_plan(pipeline) -> List[WarmupItem]:
+    """Every node's warmup plan, flattened.  A node whose plan itself
+    raises is skipped with a warning — planning must not take a healthy
+    start down (the compiles it would have scheduled happen lazily on
+    the first frame instead, exactly the pre-warmup behavior)."""
+    items: List[WarmupItem] = []
+    for node in pipeline.nodes.values():
+        plan = getattr(node, "warmup_plan", None)
+        if plan is None:
+            continue
+        try:
+            for label, thunk in plan():
+                items.append((node.name, label, thunk))
+        except Exception as exc:  # noqa: BLE001
+            import warnings
+
+            warnings.warn(
+                f"warmup plan for {node.name!r} failed: {exc!r}; its "
+                "geometries will compile lazily", stacklevel=2)
+    return items
+
+
+def execute(items: List[WarmupItem], pipeline=None,
+            workers: Optional[int] = None,
+            timeout_s: Optional[float] = None,
+            name: str = "") -> dict:
+    """Drive the compile thunks: parallel across nodes, sequential within
+    one node.  Raises the first compile error (a geometry the pipeline
+    WILL dispatch failing to compile is a start failure, same contract as
+    negotiation).  Returns the warmup report."""
+    from ..obs.device import COMPILE_BUCKETS_S, set_compile_phase
+    from ..obs.metrics import REGISTRY
+
+    pname = name or (pipeline.name if pipeline is not None else "")
+    t_phase = time.perf_counter_ns()
+    total = len(items)
+    done_lock = threading.Lock()
+    done = [0]
+    report = {"pipeline": pname, "items": total, "compiled": [],
+              "seconds": 0.0}
+
+    # group per node: a filter backend's executable cache mutates under
+    # warm_compile, so one node's ladder must not race itself
+    groups: "dict[str, List[WarmupItem]]" = {}
+    for item in items:
+        groups.setdefault(item[0], []).append(item)
+
+    def run_group(group: List[WarmupItem]) -> List[Tuple[str, str, int]]:
+        set_compile_phase("warmup")
+        out = []
+        try:
+            for node_name, label, thunk in group:
+                t0 = time.perf_counter_ns()
+                thunk()
+                dur = time.perf_counter_ns() - t0
+                with done_lock:
+                    done[0] += 1
+                    n_done = done[0]
+                out.append((node_name, label, dur))
+                if _spans.enabled:
+                    # per-executable child span on the warmup track
+                    _spans._recorder.append((
+                        _spans.PH_COMPLETE, t0, dur, "warmup",
+                        f"warm:{node_name}:{label}", "warmup", 0,
+                        next(_spans._ids), 0,
+                        {"node": node_name, "label": label}))
+                if _hooks.enabled:
+                    _hooks.emit("warmup", pipeline, node_name, label,
+                                n_done, total, dur)
+        finally:
+            set_compile_phase(None)
+        return out
+
+    if groups:
+        n_workers = min(workers or configured_workers(), len(groups))
+        deadline = timeout_s if timeout_s is not None \
+            else configured_timeout_s()
+        with ThreadPoolExecutor(
+                max_workers=n_workers,
+                thread_name_prefix="warmup") as pool:
+            futs = [pool.submit(run_group, g) for g in groups.values()]
+            for fut in futs:
+                # a compile error (or a wedged compile past the phase
+                # deadline) propagates: start() fails loudly, exactly as
+                # a negotiation-time compile failure would
+                res = fut.result(timeout=deadline or None)
+                report["compiled"].extend(
+                    {"node": n, "label": lb, "seconds": d / 1e9}
+                    for n, lb, d in res)
+    phase_ns = time.perf_counter_ns() - t_phase
+    report["seconds"] = phase_ns / 1e9
+    REGISTRY.histogram(
+        "nnstpu_warmup_seconds",
+        "Compile-ahead warmup phase wall time (seconds)",
+        labelnames=("pipeline",), buckets=COMPILE_BUCKETS_S,
+    ).observe(phase_ns / 1e9, pipeline=pname)
+    if _spans.enabled:
+        _spans._recorder.append((
+            _spans.PH_COMPLETE, t_phase, phase_ns, "warmup", "warmup",
+            "warmup", 0, next(_spans._ids), 0,
+            {"pipeline": pname, "executables": total}))
+    if _hooks.enabled:
+        _hooks.emit("warmup", pipeline, "", "", total, total, phase_ns)
+    return report
+
+
+def run_warmup(pipeline, force: bool = False) -> Optional[dict]:
+    """The ``Pipeline.start`` entry point: no-op unless ``[compile]
+    warmup`` is on (or ``force``); otherwise collect + execute and stash
+    the report on ``pipeline.warmup_report``."""
+    if not force and not configured():
+        return None
+    report = execute(collect_plan(pipeline), pipeline=pipeline)
+    pipeline.warmup_report = report
+    return report

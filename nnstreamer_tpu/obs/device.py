@@ -93,16 +93,35 @@ def configured_probe_capacity() -> int:
 
 # -- compile accounting ------------------------------------------------------
 
+# Compile-phase attribution (thread-local): the warmup phase marks its
+# threads so compile spans land on the dedicated "warmup" Perfetto track
+# (not inside the first frame's trace) and nnstpu_compile_seconds splits
+# by phase={warmup,serving}.
+_phase_tls = threading.local()
+
+
+def set_compile_phase(phase: Optional[str]) -> None:
+    """Mark the calling thread's compiles as ``phase`` ("warmup") or
+    restore the default ("serving") with None."""
+    _phase_tls.phase = phase
+
+
+def compile_phase() -> str:
+    return getattr(_phase_tls, "phase", None) or "serving"
+
+
 def _compile_metrics(registry: MetricsRegistry):
     return (
         registry.counter(
             "nnstpu_compile_total",
-            "Backend executable-cache events (hit/miss/evict)",
+            "Backend executable-cache events (hit/miss/persist_hit/evict)",
             labelnames=("result",),
         ),
         registry.histogram(
             "nnstpu_compile_seconds",
-            "Wall time spent compiling backend executables (seconds)",
+            "Wall time spent building backend executables (seconds; "
+            "persist_hit reconstructs included), split by compile phase",
+            labelnames=("phase",),
             buckets=COMPILE_BUCKETS_S,
         ),
         registry.counter(
@@ -147,22 +166,32 @@ def record_compile(backend, key, result: str, dur_ns: int = 0,
     ``compile`` hook for attached tracers.  Never raises — compile
     accounting must not take a compile down."""
     try:
+        phase = compile_phase()
         counters, hist, flops_c, bytes_c = _compile_metrics(
             registry if registry is not None else REGISTRY)
         counters.inc(1, result=result)
-        if result == "miss":
-            hist.observe(dur_ns / 1e9)
+        if result in ("miss", "persist_hit"):
+            hist.observe(dur_ns / 1e9, phase=phase)
             if info:
                 if info.get("flops"):
                     flops_c.inc(info["flops"])
                 if info.get("bytes"):
                     bytes_c.inc(info["bytes"])
-        if spans.enabled and result == "miss":
-            args = {"key": repr(key), "backend": type(backend).__name__}
+        if spans.enabled and result in ("miss", "persist_hit"):
+            args = {"key": repr(key), "backend": type(backend).__name__,
+                    "result": result, "phase": phase}
             if info:
                 args.update(info)
-            spans.record_span("compile", now_ns() - dur_ns, dur_ns,
-                              cat="compile", trace=(0, 0), args=args)
+            if phase == "warmup":
+                # warmup-phase compiles land on the dedicated "warmup"
+                # Perfetto track, never inside the first frame's trace
+                # (the recorder keys rows by tid string, not OS thread)
+                spans._recorder.append((
+                    spans.PH_COMPLETE, now_ns() - dur_ns, dur_ns, "warmup",
+                    "compile", "compile", 0, next(spans._ids), 0, args))
+            else:
+                spans.record_span("compile", now_ns() - dur_ns, dur_ns,
+                                  cat="compile", trace=(0, 0), args=args)
         if _hooks.enabled:
             _hooks.emit("compile", backend, key, result, dur_ns, info or {})
     except Exception:  # noqa: BLE001
@@ -302,7 +331,8 @@ class DeviceTracer(Tracer):
         self._sent = 0
         self._completed = 0
         self._dropped = 0
-        self._compiles: Dict[str, int] = {"hit": 0, "miss": 0, "evict": 0}
+        self._compiles: Dict[str, int] = {
+            "hit": 0, "miss": 0, "persist_hit": 0, "evict": 0}
         self._last_compile: Optional[dict] = None
         self._mem_handle = None
 

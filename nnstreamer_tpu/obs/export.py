@@ -90,6 +90,42 @@ def unregister_health(name: str, fn: Optional[Callable] = None) -> None:
             _health_providers.pop(name, None)
 
 
+_warming_lock = threading.Lock()
+_warming_providers: Dict[str, Callable[[], str]] = {}
+
+
+def register_warming(name: str, fn: Callable[[], str]) -> Callable:
+    """Register a *warming* provider: a callable returning a reason
+    string ("" = done).  Warming is the compile-ahead phase — the worker
+    is healthy and will serve shortly, but fleet membership must not
+    route traffic yet (suspend-dispatch, not unhealthy: /healthz stays
+    200 and the body carries ``status: "warming"``)."""
+    with _warming_lock:
+        _warming_providers[name] = fn
+    return fn
+
+
+def unregister_warming(name: str, fn: Optional[Callable] = None) -> None:
+    with _warming_lock:
+        if fn is None or _warming_providers.get(name) is fn:
+            _warming_providers.pop(name, None)
+
+
+def warming_snapshot() -> Dict[str, str]:
+    """{provider: reason} for every provider still warming up."""
+    with _warming_lock:
+        providers = dict(_warming_providers)
+    out: Dict[str, str] = {}
+    for name, fn in providers.items():
+        try:
+            reason = fn()
+        except Exception as exc:  # noqa: BLE001
+            reason = f"warming provider raised: {exc!r}"
+        if reason:
+            out[name] = reason
+    return out
+
+
 _degraded_lock = threading.Lock()
 _degraded_providers: Dict[str, Callable[[], str]] = {}
 
@@ -147,15 +183,24 @@ def health_snapshot() -> Tuple[bool, Dict[str, str]]:
 def health_document() -> dict:
     """The structured health verdict served at ``/healthz`` (and merged
     into ``/stats.json`` under ``"health"``): ``status`` is ``"ok"``,
-    ``"degraded"`` (serving with reduced capability — e.g. a cpu-fallback
-    backend; still HTTP 200) or ``"unhealthy"`` (503), with the
-    per-provider *reasons* alongside so fleet membership and human
-    operators see WHY a worker is deprioritized, not just the flag."""
+    ``"warming"`` (compile-ahead in progress — healthy, suspend dispatch;
+    still HTTP 200), ``"degraded"`` (serving with reduced capability —
+    e.g. a cpu-fallback backend; still HTTP 200) or ``"unhealthy"``
+    (503), with the per-provider *reasons* alongside so fleet membership
+    and human operators see WHY a worker is deprioritized, not just the
+    flag."""
     healthy, failures = health_snapshot()
     degraded = degraded_snapshot()
+    warming = warming_snapshot()
     status = ("unhealthy" if not healthy
+              else "warming" if warming
               else "degraded" if degraded else "ok")
-    return {"status": status, "failures": failures, "degraded": degraded}
+    doc = {"status": status, "failures": failures, "degraded": degraded}
+    if warming:
+        # compile-ahead still running: membership suspends NEW dispatch
+        # (not an outage — /healthz stays 200)
+        doc["warming"] = warming
+    return doc
 
 
 def _fmt(value: float) -> str:

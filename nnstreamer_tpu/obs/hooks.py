@@ -60,6 +60,14 @@ must stay allocation-light):
                    ``escalate``.  The first argument is the pipeline
                    NAME (string, may be empty for backend-level
                    actions), not the object.
+``warmup``         ``(pipeline, node_name, label, done, total,
+                   dur_ns)`` — compile-ahead warmup progress
+                   (:mod:`nnstreamer_tpu.graph.warmup`): one emission
+                   per warmed executable (``label`` names the
+                   geometry), plus a final ``label=""`` emission when
+                   the phase completes (``dur_ns`` then carries the
+                   whole-phase wall time).  ``pipeline`` may be None
+                   for serverless warmups (QueryServer, fleet worker).
 =================  ====================================================
 
 Timestamps passed through hooks are ``time.perf_counter_ns()`` — every
@@ -94,6 +102,7 @@ HOOKS = (
     "health",
     "fault",
     "recovery",
+    "warmup",
 )
 
 # The fast-path gate: True iff at least one callback is connected anywhere.

@@ -140,8 +140,12 @@ def int8_matmul(
     VMEM (fine for classifier-head sizes; block over K before reusing this
     for giant matmuls).
 
-    Default tiles are adaptive: the whole M dim in one block when it fits
-    a VMEM budget (classifier heads have small M — one pass over the
+    Default tiles are adaptive: a persistent autotune winner for this
+    exact ``(m, k, n)`` on this platform when one exists
+    (:mod:`nnstreamer_tpu.ops.autotune` — the benched 7.1× int8 tile
+    split survives process restarts; consulted at TRACE time, zero
+    per-dispatch cost), else the whole M dim in one block when it fits a
+    VMEM budget (classifier heads have small M — one pass over the
     weight stream, no re-fetch per row block), N in 256-lane stripes.
     """
     if interpret is None:
@@ -149,6 +153,10 @@ def int8_matmul(
     m, k = x_q.shape
     k2, n = w_q.shape
     assert k == k2, (x_q.shape, w_q.shape)
+    if block_m is None and block_n is None:
+        from .autotune import cached_int8_blocks
+
+        block_m, block_n = cached_int8_blocks(m, k, n)
     if block_m is None:
         if m <= 256:
             # whole-M single block, rounded up to the int8 sublane tile

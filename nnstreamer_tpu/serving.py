@@ -223,10 +223,17 @@ class ContinuousBatcher:
         devices: Optional[int] = None,
         axis: str = "dp",
     ):
+        from .backends import exec_cache
         from .models import transformer
 
         if capacity < 1:
             raise ValueError("capacity must be >= 1")
+        # compile-ahead: with [compile] cache_dir set, the step/prefill
+        # compiles below land in jax's persistent binary cache, so a
+        # restarted decode worker reconstructs instead of compiling
+        root = exec_cache.cache_dir()
+        if root:
+            exec_cache.wire_jax_compilation_cache(root)
         self.capacity = int(capacity)
         self.d_in, self.n_out, self.t_max = d_in, n_out, t_max
         self.window = window
@@ -415,6 +422,34 @@ class ContinuousBatcher:
                 del self._active[sess.slot]
                 self._free.append(sess.slot)
                 self._cv.notify_all()
+
+    def warmup_prefill(self, max_len: Optional[int] = None) -> dict:
+        """Compile-ahead for the prefill path: AOT-compile every prompt
+        length bucket (the power-of-two ladder :meth:`DecodeSession.
+        prefill` pads to, capped at ``t_max``) so a session's first
+        prompt never pays a compile on the request path.  The decode
+        step itself already compiles in ``__init__``.  With ``[compile]
+        cache_dir`` set, the compiles land in jax's persistent binary
+        cache, so a restarted worker reconstructs instead of compiling.
+        Returns the warmup report (``graph/warmup.py``)."""
+        from .graph.warmup import execute
+
+        cap = min(int(max_len) if max_len else self.t_max, self.t_max)
+        buckets = []
+        tb = 1
+        while tb < cap:
+            buckets.append(tb)
+            tb <<= 1
+        buckets.append(cap)  # the terminal bucket is t_max itself
+
+        def warm(tb: int):
+            y, cache, pos = self._prefill_fn(tb)(
+                np.zeros((tb, self.d_in), np.float32), tb)
+            jax.block_until_ready(y)
+
+        items = [("decode_engine", f"prefill_t{tb}",
+                  lambda t=tb: warm(t)) for tb in buckets]
+        return execute(items, name="decode_engine")
 
     def _prefill_fn(self, tb: int):
         """Jitted prefill for bucket length ``tb`` (compiled once)."""

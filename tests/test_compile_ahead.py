@@ -1,0 +1,574 @@
+"""Compile-ahead serving: AOT warmup, persistent executable & autotune
+caches, zero cold-start.
+
+Covers the three layers of the compile-ahead lane plus its satellites:
+
+- persistent executable cache round trips (``result="persist_hit"``) and
+  every invalidation edge — jax version bump, fn fingerprint change,
+  platform change, corrupted/truncated cache files — falls back to a
+  clean recompile (never a crash, never a stale executable);
+- the AOT warmup phase in ``Pipeline.start`` (dynbatch bucket ladder,
+  warmup hook progress, ``nnstpu_warmup_seconds``, the ``warmup`` span
+  track, fused-filter discipline) and warmup-vs-serving compile-phase
+  attribution;
+- ``QueryServer.warmup`` / ``ContinuousBatcher.warmup_prefill`` /
+  fleet-worker warming (membership suspend-dispatch, not unhealthy);
+- the persistent Pallas autotune cache steering ``int8_matmul``.
+"""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from nnstreamer_tpu import Pipeline
+from nnstreamer_tpu.backends import exec_cache
+from nnstreamer_tpu.backends.jax_backend import JaxBackend, JaxModel
+from nnstreamer_tpu.elements.dynbatch import DynBatch, DynUnbatch
+from nnstreamer_tpu.elements.filter import TensorFilter
+from nnstreamer_tpu.elements.sink import TensorSink
+from nnstreamer_tpu.elements.testsrc import DataSrc
+from nnstreamer_tpu.obs import hooks as obs_hooks
+from nnstreamer_tpu.obs import spans as obs_spans
+from nnstreamer_tpu.spec import TensorSpec, TensorsSpec
+
+
+def poly_model(scale=2.0, d=8):
+    return JaxModel(
+        apply=lambda p, x: x * scale,
+        input_spec=TensorsSpec.of(
+            TensorSpec(dtype=np.float32, shape=(None, d))),
+        name="poly",
+    )
+
+
+def fixed_spec(batch, d=8):
+    return TensorsSpec.of(TensorSpec(dtype=np.float32, shape=(batch, d)))
+
+
+class CompileLog:
+    """Recording callback on the ``compile`` hook."""
+
+    def __init__(self):
+        self.events = []
+
+    def __call__(self, backend, key, result, dur_ns, info):
+        self.events.append(result)
+
+    def count(self, result):
+        return self.events.count(result)
+
+
+@pytest.fixture
+def compile_log():
+    log = CompileLog()
+    obs_hooks.connect("compile", log)
+    yield log
+    obs_hooks.disconnect("compile", log)
+
+
+@pytest.fixture
+def cache_dir(tmp_path, monkeypatch):
+    d = tmp_path / "ca_cache"
+    monkeypatch.setenv("NNSTPU_COMPILE_CACHE_DIR", str(d))
+    return d
+
+
+def compile_once(model=None, spec=None):
+    be = JaxBackend()
+    be.open(model if model is not None else poly_model())
+    out = be.reconfigure(spec if spec is not None else fixed_spec(4))
+    be.close()
+    return out
+
+
+# -- persistent executable cache ---------------------------------------------
+
+class TestPersistentExecCache:
+    def test_roundtrip_persist_hit(self, cache_dir, compile_log):
+        compile_once()
+        assert compile_log.events == ["miss"]
+        # entries landed on disk (meta + export payload)
+        names = os.listdir(cache_dir / "exec")
+        assert any(n.endswith(".json") for n in names)
+        assert any(n.endswith(".exp") for n in names)
+        # a FRESH backend (fresh process analog) reconstructs from disk
+        compile_once()
+        assert compile_log.events == ["miss", "persist_hit"]
+
+    def test_persist_hit_serves_correct_results(self, cache_dir):
+        compile_once()
+        be = JaxBackend()
+        be.open(poly_model(scale=2.0))
+        be.reconfigure(fixed_spec(4))
+        out = be.invoke((np.ones((4, 8), np.float32),))
+        np.testing.assert_allclose(np.asarray(out[0]), 2.0)
+        be.close()
+
+    def test_disabled_without_cache_dir(self, tmp_path, monkeypatch,
+                                        compile_log):
+        monkeypatch.delenv("NNSTPU_COMPILE_CACHE_DIR", raising=False)
+        compile_once()
+        compile_once()
+        assert compile_log.events == ["miss", "miss"]
+
+    def test_jax_version_bump_invalidates(self, cache_dir, compile_log,
+                                          monkeypatch):
+        compile_once()
+        monkeypatch.setattr(exec_cache, "versions",
+                            lambda: ("99.99.99", "99.99.99"))
+        compile_once()
+        assert compile_log.events == ["miss", "miss"]
+
+    def test_platform_change_invalidates(self, cache_dir, compile_log,
+                                         monkeypatch):
+        compile_once()
+        monkeypatch.setattr(exec_cache, "platform", lambda: "tpu-fake")
+        compile_once()
+        assert compile_log.events == ["miss", "miss"]
+
+    def test_fn_fingerprint_change_invalidates(self, cache_dir, compile_log):
+        compile_once(model=poly_model(scale=2.0))
+        # same geometry, different program: must NOT serve the stale entry
+        compile_once(model=poly_model(scale=3.0))
+        assert compile_log.events == ["miss", "miss"]
+
+    def test_corrupted_payload_recompiles(self, cache_dir, compile_log):
+        compile_once()
+        for name in os.listdir(cache_dir / "exec"):
+            if name.endswith(".exp"):
+                path = cache_dir / "exec" / name
+                path.write_bytes(path.read_bytes()[: 10])  # truncate
+        compile_once()  # never a crash, never a stale executable
+        assert compile_log.events == ["miss", "miss"]
+        # the recompile re-stored a clean entry: third process hits again
+        compile_once()
+        assert compile_log.events[-1] == "persist_hit"
+
+    def test_corrupted_meta_recompiles(self, cache_dir, compile_log):
+        compile_once()
+        for name in os.listdir(cache_dir / "exec"):
+            if name.endswith(".json"):
+                (cache_dir / "exec" / name).write_bytes(b"{not json!")
+        compile_once()
+        assert compile_log.events == ["miss", "miss"]
+
+    def test_mesh_entries_persist_as_witnesses(self, cache_dir, compile_log,
+                                               monkeypatch):
+        # a sharded geometry stores a meta witness (no jax.export payload)
+        # and still reports persist_hit on reconstruct — the XLA binary
+        # cache carries the bits
+        monkeypatch.setenv("NNSTPU_MESH", "dp:8")
+        from nnstreamer_tpu.parallel import mesh as pmesh
+
+        pmesh.reset_dispatch_mesh()
+        try:
+            compile_once(spec=fixed_spec(8))
+            compile_once(spec=fixed_spec(8))
+        finally:
+            monkeypatch.delenv("NNSTPU_MESH")
+            pmesh.reset_dispatch_mesh()
+        assert compile_log.events == ["miss", "persist_hit"]
+
+
+# -- AOT warmup phase --------------------------------------------------------
+
+def build_dyn_pipeline(got, max_batch=8, model=None, name="warm"):
+    p = Pipeline(name=name)
+    src = p.add(DataSrc(data=[np.ones(8, np.float32) for _ in range(5)]))
+    db = p.add(DynBatch(max_batch=max_batch))
+    f = p.add(TensorFilter(framework="jax",
+                           model=model if model is not None else poly_model()))
+    ub = p.add(DynUnbatch())
+    sink = p.add(TensorSink(callback=lambda fr: got.append(
+        np.asarray(fr.tensors[0]))))
+    p.link_chain(src, db, f, ub, sink)
+    return p, f
+
+
+class TestWarmupPhase:
+    def test_off_by_default(self, monkeypatch):
+        monkeypatch.delenv("NNSTPU_COMPILE_WARMUP", raising=False)
+        got = []
+        p, f = build_dyn_pipeline(got)
+        p.run(timeout=60)
+        assert p.warmup_report is None
+
+    def test_warms_full_bucket_ladder(self, monkeypatch, compile_log):
+        monkeypatch.setenv("NNSTPU_COMPILE_WARMUP", "1")
+        got = []
+        p, f = build_dyn_pipeline(got, max_batch=8)
+        warm_events = []
+        obs_hooks.connect(
+            "warmup", lambda *a: warm_events.append(a))
+        try:
+            p.start()
+            # the ladder {1,2,4,8} exists in the executable LRU before
+            # any frame dispatched
+            report = p.warmup_report
+            labels = {c["label"] for c in report["compiled"]}
+            assert labels == {"bucket1", "bucket2", "bucket4", "bucket8"}
+            assert len(f.backend._cache) == 4
+            p.wait(60)
+        finally:
+            p.stop()
+        assert len(got) == 5 and all(np.allclose(g, 2.0) for g in got)
+        # hook progress: one emission per item + the phase-final one
+        per_item = [e for e in warm_events if e[2] != ""]
+        final = [e for e in warm_events if e[2] == ""]
+        assert len(per_item) == 4 and len(final) == 1
+        assert final[0][4] == 4  # total
+        # once warmed, serving never missed: compile misses all happened
+        # during start (warmup), none after
+        assert compile_log.count("miss") == 4
+
+    def test_warmup_seconds_metric(self, monkeypatch):
+        monkeypatch.setenv("NNSTPU_COMPILE_WARMUP", "1")
+        from nnstreamer_tpu.obs.metrics import REGISTRY
+
+        got = []
+        p, _ = build_dyn_pipeline(got, name="warm_metric")
+        p.run(timeout=60)
+        hist = REGISTRY.get("nnstpu_warmup_seconds")
+        assert hist is not None
+        child = hist.labels(pipeline="warm_metric")
+        assert child.count >= 1
+
+    def test_warmup_spans_on_warmup_track(self, monkeypatch):
+        monkeypatch.setenv("NNSTPU_COMPILE_WARMUP", "1")
+        monkeypatch.setenv("NNSTPU_TRACERS", "spans")
+        got = []
+        p, _ = build_dyn_pipeline(got, name="warm_spans")
+        p.run(timeout=60)
+        doc = obs_spans.chrome_trace(obs_spans.snapshot(),
+                                     process_name="warm_spans")
+        events = doc["traceEvents"]
+        rows = {e["tid"]: e["args"]["name"] for e in events
+                if e.get("ph") == "M" and e["name"] == "thread_name"}
+        warm_tids = {tid for tid, nm in rows.items() if nm == "warmup"}
+        assert warm_tids, rows
+        # compile spans triggered during warmup land on the warmup track,
+        # not inside the first frame's trace
+        compile_spans = [e for e in events
+                         if e.get("ph") == "X" and e["name"] == "compile"]
+        assert compile_spans
+        assert all(e["tid"] in warm_tids for e in compile_spans)
+        # per-bucket child spans + the whole-phase span share the track
+        warm_spans = [e for e in events if e.get("ph") == "X"
+                      and str(e["name"]).startswith("warm")]
+        assert len(warm_spans) >= 5  # 4 buckets + the phase span
+
+    def test_compile_seconds_phase_label(self, monkeypatch):
+        monkeypatch.setenv("NNSTPU_COMPILE_WARMUP", "1")
+        from nnstreamer_tpu.obs.metrics import REGISTRY
+
+        def snap():
+            hist = REGISTRY.get("nnstpu_compile_seconds")
+            if hist is None:
+                return {}
+            return {labels: child.count for labels, child in
+                    hist.children()}
+
+        before = snap()
+        got = []
+        p, f = build_dyn_pipeline(got, name="warm_phase")
+        p.start()
+        try:
+            after_start = snap()
+            warm_delta = (after_start.get(("warmup",), 0)
+                          - before.get(("warmup",), 0))
+            assert warm_delta == 4
+            p.wait(60)
+            # a drift compile ON the request path (post-start, stream
+            # idle) lands on the serving series — what the
+            # zero-cold-start gate watches
+            f.backend.invoke((np.ones((3, 8), np.float32),))
+            after_drift = snap()
+            assert (after_drift.get(("serving",), 0)
+                    - after_start.get(("serving",), 0)) == 1
+        finally:
+            p.stop()
+
+    def test_explicit_pipeline_warmup(self, monkeypatch):
+        monkeypatch.delenv("NNSTPU_COMPILE_WARMUP", raising=False)
+        got = []
+        p, f = build_dyn_pipeline(got, max_batch=4, name="warm_explicit")
+        p.start()
+        try:
+            report = p.warmup()
+            labels = {c["label"] for c in report["compiled"]}
+            assert labels == {"bucket1", "bucket2", "bucket4"}
+            p.wait(60)
+        finally:
+            p.stop()
+
+    def test_fused_filter_warmup_stays_correct(self, monkeypatch):
+        """Bucket warmup through a FUSED filter compiles per-bucket fused
+        programs and restores the negotiated wrapper — frames of every
+        bucket size still produce transform+model results."""
+        monkeypatch.setenv("NNSTPU_COMPILE_WARMUP", "1")
+        from nnstreamer_tpu.elements.transform import TensorTransform
+
+        got = []
+        p = Pipeline(name="warm_fused")
+        src = p.add(DataSrc(data=[np.full(8, i, np.float32)
+                                  for i in range(5)]))
+        db = p.add(DynBatch(max_batch=4))
+        tr = p.add(TensorTransform(mode="arithmetic", option="add:1.0",
+                                   acceleration=True))
+        f = p.add(TensorFilter(framework="jax", model=poly_model()))
+        ub = p.add(DynUnbatch())
+        sink = p.add(TensorSink(callback=lambda fr: got.append(
+            np.asarray(fr.tensors[0]))))
+        p.link_chain(src, db, tr, f, ub, sink)
+        p.run(timeout=60)
+        assert len(got) == 5
+        for i, g in enumerate(got):
+            np.testing.assert_allclose(g, (i + 1) * 2.0)  # (x+1)*2 fused
+
+    def test_warm_restart_zero_misses(self, cache_dir, monkeypatch,
+                                      compile_log):
+        """The acceptance gate, in-process twin of the CI smoke: warmed
+        pipeline, 'restarted process' (fresh backends), first frame
+        serves with result in {hit, persist_hit} only."""
+        monkeypatch.setenv("NNSTPU_COMPILE_WARMUP", "1")
+        got = []
+        p, _ = build_dyn_pipeline(got, max_batch=4, name="gate1")
+        p.run(timeout=60)
+        assert compile_log.count("miss") == 3
+        compile_log.events.clear()
+        got2 = []
+        p2, _ = build_dyn_pipeline(got2, max_batch=4, name="gate2")
+        p2.run(timeout=60)
+        assert len(got2) == 5
+        assert compile_log.count("miss") == 0
+        assert compile_log.count("persist_hit") == 3
+        assert set(compile_log.events) <= {"hit", "persist_hit"}
+
+
+# -- serving surfaces --------------------------------------------------------
+
+class TestServingWarmup:
+    def test_query_server_bucket_ladder(self, compile_log):
+        from nnstreamer_tpu.elements.query import QueryServer
+
+        srv = QueryServer(framework="jax", model=lambda x: x * 2.0,
+                          batch=2, max_batch=8).start()
+        try:
+            report = srv.warmup(
+                TensorsSpec.of(TensorSpec(dtype=np.float32, shape=(4,))))
+            labels = {c["label"] for c in report["compiled"]}
+            assert labels == {"bucket1", "bucket2", "bucket4", "bucket8"}
+            assert len(srv._backends) == 4
+        finally:
+            srv.stop()
+
+    def test_query_server_unbatched_warms_given_spec(self):
+        from nnstreamer_tpu.elements.query import QueryServer
+
+        srv = QueryServer(framework="jax", model=lambda x: x + 1.0).start()
+        try:
+            report = srv.warmup(fixed_spec(2))
+            assert {c["label"] for c in report["compiled"]} == {"spec"}
+            assert len(srv._backends) == 1
+        finally:
+            srv.stop()
+
+    def test_prefill_bucket_ladder(self):
+        from nnstreamer_tpu.serving import ContinuousBatcher
+
+        eng = ContinuousBatcher(capacity=2, t_max=8, d_in=4, n_out=4,
+                                d_model=16, n_heads=2, n_layers=1)
+        try:
+            report = eng.warmup_prefill()
+            assert sorted(eng._prefill_fns) == [1, 2, 4, 8]
+            assert len(report["compiled"]) == 4
+            # a session prefill after warmup reuses the warmed fns
+            with eng.open_session() as sess:
+                sess.prefill(np.ones((3, 4), np.float32))
+                out = sess.get(timeout=30)
+            assert out.shape == (4,)
+            assert sorted(eng._prefill_fns) == [1, 2, 4, 8]  # no new bucket
+        finally:
+            eng.stop()
+
+    def test_prefill_ladder_caps_at_non_pow2_t_max(self):
+        from nnstreamer_tpu.serving import ContinuousBatcher
+
+        eng = ContinuousBatcher(capacity=1, t_max=6, d_in=4, n_out=4,
+                                d_model=16, n_heads=2, n_layers=1)
+        try:
+            eng.warmup_prefill()
+            assert sorted(eng._prefill_fns) == [1, 2, 4, 6]
+        finally:
+            eng.stop()
+
+
+class TestFleetWarming:
+    def test_worker_warms_then_joins(self):
+        from nnstreamer_tpu.fleet.membership import (
+            UP,
+            WARMING,
+            Membership,
+            NoWorkerAvailable,
+        )
+        from nnstreamer_tpu.fleet.worker import FleetWorker
+
+        w = FleetWorker(name="warmw", framework="jax",
+                        model=lambda x: x * 2.0, batch=2, max_batch=4,
+                        warmup_spec=TensorsSpec.of(
+                            TensorSpec(dtype=np.float32, shape=(4,))))
+        w.start()
+        try:
+            m = Membership(heartbeat_s=30)
+            info = m.add("127.0.0.1", w.query_port,
+                         probe=lambda wi: w.probe(wi), worker_id="warmw")
+            m.sweep()
+            if info.state == WARMING:
+                # suspend-dispatch, not unhealthy: pick() refuses while
+                # the only worker warms — no traffic into cold executables
+                with pytest.raises(NoWorkerAvailable):
+                    m.pick()
+            deadline = time.time() + 60
+            while time.time() < deadline and w._warming:
+                time.sleep(0.02)
+            assert not w._warming
+            m.sweep()
+            assert info.state == UP
+            assert m.pick() is info
+            assert len(w.query_server._backends) == 3  # buckets {1,2,4}
+        finally:
+            w.stop()
+
+    def test_healthz_reports_warming(self):
+        """Subprocess-mode surface: /healthz carries status=warming (200)
+        and the HTTP prober maps it to the WARMING state."""
+        import json
+        import urllib.request
+
+        from nnstreamer_tpu.fleet.membership import WARMING, Membership
+        from nnstreamer_tpu.fleet.worker import FleetWorker
+
+        w = FleetWorker(name="warmh", framework="jax",
+                        model=lambda x: x * 3.0, batch=2, max_batch=64,
+                        health_port=0,
+                        warmup_spec=TensorsSpec.of(
+                            TensorSpec(dtype=np.float32, shape=(64,))))
+        w.start()
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{w.health_port}/healthz",
+                    timeout=10) as resp:
+                doc = json.loads(resp.read().decode())
+            if doc["status"] == "warming":  # 200, reasons alongside
+                assert resp.status == 200
+                assert "worker:warmh" in doc["warming"]
+                m = Membership(heartbeat_s=30)
+                info = m.add("127.0.0.1", w.query_port,
+                             health_addr=f"127.0.0.1:{w.health_port}",
+                             worker_id="warmh")
+                m.sweep()
+                assert info.state == WARMING
+            deadline = time.time() + 60
+            while time.time() < deadline and w._warming:
+                time.sleep(0.02)
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{w.health_port}/healthz",
+                    timeout=10) as resp:
+                doc = json.loads(resp.read().decode())
+            assert doc["status"] == "ok"
+        finally:
+            w.stop()
+
+
+# -- persistent Pallas autotune cache ----------------------------------------
+
+class TestAutotuneCache:
+    @pytest.fixture(autouse=True)
+    def _fresh_tables(self):
+        from nnstreamer_tpu.ops import autotune
+
+        autotune.refresh()
+        yield
+        autotune.refresh()
+
+    def test_record_and_best_roundtrip(self, cache_dir):
+        from nnstreamer_tpu.ops import autotune
+
+        key = autotune.make_key(((64, 128), (128, 256)), "int8")
+        assert autotune.best(autotune.INT8_KERNEL, key) is None
+        assert autotune.record(autotune.INT8_KERNEL, key,
+                               {"block_m": None, "block_n": 256},
+                               metric_ms=0.5)
+        autotune.refresh()  # fresh-process analog: reload from disk
+        entry = autotune.best(autotune.INT8_KERNEL, key)
+        assert entry["block_n"] == 256 and entry["ms"] == 0.5
+        assert autotune.cached_int8_blocks(64, 128, 256) == (None, 256)
+
+    def test_platform_keyed(self, cache_dir):
+        from nnstreamer_tpu.ops import autotune
+
+        key = autotune.make_key(((8, 16), (16, 32)), "int8",
+                                platform="tpu")
+        autotune.record(autotune.INT8_KERNEL, key, {"block_m": 128,
+                                                    "block_n": 512})
+        # this process runs on cpu: a TPU winner must not steer it
+        assert autotune.cached_int8_blocks(8, 16, 32) == (None, None)
+
+    def test_disabled_without_cache_dir(self, monkeypatch):
+        from nnstreamer_tpu.ops import autotune
+
+        monkeypatch.delenv("NNSTPU_COMPILE_CACHE_DIR", raising=False)
+        assert not autotune.enabled()
+        assert autotune.cached_int8_blocks(64, 128, 256) == (None, None)
+        assert not autotune.record(autotune.INT8_KERNEL, "k", {})
+
+    def test_corrupt_table_falls_back(self, cache_dir):
+        from nnstreamer_tpu.ops import autotune
+
+        path = os.path.join(str(cache_dir), "autotune",
+                            f"{autotune.INT8_KERNEL}.json")
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "w") as f:
+            f.write("{broken json")
+        assert autotune.cached_int8_blocks(64, 128, 256) == (None, None)
+        # and record() rewrites it whole
+        key = autotune.make_key(((64, 128), (128, 256)), "int8")
+        assert autotune.record(autotune.INT8_KERNEL, key, {"block_n": 128})
+        autotune.refresh()
+        assert autotune.best(autotune.INT8_KERNEL, key) is not None
+
+    def test_int8_matmul_uses_cached_blocks(self, cache_dir, rng):
+        """A cached winner steers the kernel's default tiles without
+        changing the numerics."""
+        from nnstreamer_tpu.ops import autotune
+        from nnstreamer_tpu.ops.pallas_kernels import int8_matmul
+        from nnstreamer_tpu.ops.quant import (
+            quantize_activations,
+            quantize_weight,
+        )
+
+        m, k, n = 8, 16, 128
+        a = rng.standard_normal((m, k)).astype(np.float32)
+        w = rng.standard_normal((k, n)).astype(np.float32)
+        qw = quantize_weight(jnp.asarray(w), axis=-1)
+        aq, ascale = quantize_activations(jnp.asarray(a))
+        ref = np.asarray(int8_matmul(aq, qw.q, ascale,
+                                     qw.scale.reshape(1, -1),
+                                     block_m=32, block_n=128))
+        autotune.record(autotune.INT8_KERNEL,
+                        autotune.make_key(((m, k), (k, n)), "int8"),
+                        {"block_m": 32, "block_n": 128})
+        out = np.asarray(int8_matmul(aq, qw.q, ascale,
+                                     qw.scale.reshape(1, -1)))
+        np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+
+    def test_autotune_refuses_interpret_mode(self):
+        from nnstreamer_tpu.ops import autotune
+
+        assert jax.default_backend() == "cpu"
+        assert autotune.autotune_int8_matmul(8, 16, 32) is None

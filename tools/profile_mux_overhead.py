@@ -27,11 +27,14 @@ This tool isolates where the per-stream cost lands:
   without this column device compute hides inside whichever element
   blocks first.
 
-Usage: ``python tools/profile_mux_overhead.py [--mesh[=SPEC]]
+Usage: ``python tools/profile_mux_overhead.py [--mesh[=SPEC]] [--ttff]
 [TOTAL_FRAMES] [SWEEP...]`` e.g. ``python tools/profile_mux_overhead.py
 2000 1 2 4 8``.  ``--mesh`` (default spec ``dp:8``) sweeps the
 mesh-sharded dispatch lane over a forced 8-device host mesh and adds
-chips-used / per-shard-batch columns.
+chips-used / per-shard-batch columns.  ``--ttff`` prints cold-vs-warm
+time-to-first-frame columns instead of the sweep: two fresh processes
+against one persistent executable cache (``[compile] cache_dir`` +
+warmup), the warm row gated on zero compile misses.
 ``NNSTPU_POOL_ENABLED=false NNSTPU_POOL_CONCAT_THRESHOLD=0`` reproduces
 the pre-pool behavior for an A/B.  Appends nothing; copy the table +
 verdict into BENCH_NOTES.md.
@@ -42,7 +45,22 @@ import threading
 import time
 from collections import defaultdict
 
+_T0 = time.perf_counter()  # process start for the --ttff-child probe
+
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# --ttff: cold-vs-warm time-to-first-frame columns (process start →
+# first sink frame) — the compile-ahead lane's proof, run as two fresh
+# child processes against one persistent executable cache.
+TTFF = False
+TTFF_CHILD = False
+for _arg in list(sys.argv):
+    if _arg == "--ttff":
+        TTFF = True
+        sys.argv.remove(_arg)
+    elif _arg == "--ttff-child":
+        TTFF_CHILD = True
+        sys.argv.remove(_arg)
 
 # --mesh[=SPEC] (default dp:8): sweep the mesh-sharded dispatch lane —
 # must export NNSTPU_MESH and the forced host device count BEFORE jax
@@ -189,6 +207,7 @@ def run_mux(streams, frames_per_stream, attribute=False):
             hooks.disconnect("dispatch_exit", attr)
     done = state["count"] - max(1, streams)  # exclude the clock-start frame(s)
     fps = done / (time.perf_counter() - state["t0"])
+    copies.t_first = state["t0"]  # absolute first-frame ts (--ttff-child)
     total_in = streams * frames_per_stream
     copies.per_frame = copies.nbytes / max(1, total_in)
     copies.allocs_per_frame = copies.allocs / max(1, total_in)
@@ -204,7 +223,66 @@ def run_mux(streams, frames_per_stream, attribute=False):
     return fps, wall, attr, copies
 
 
+def ttff_child() -> None:
+    """One cold/warm probe leg: 4-stream mux pipeline, JSON line out
+    (``ttff_s`` = process start → first sink frame)."""
+    import json
+
+    from nnstreamer_tpu.obs.metrics import REGISTRY
+
+    _, _, _, cp = run_mux(4, 8)
+    c = REGISTRY.get("nnstpu_compile_total")
+    compiles = ({k[0]: int(v.value) for k, v in dict(c.children()).items()}
+                if c else {})
+    print(json.dumps({"ttff_s": round(cp.t_first - _T0, 4),
+                      "compiles": compiles}))
+
+
+def ttff_sweep() -> None:
+    """Cold-vs-warm TTFF columns: the same pipeline in two fresh
+    processes against one persistent executable cache ([compile]
+    cache_dir).  The warm row must show zero compile misses."""
+    import json
+    import shutil
+    import subprocess
+    import tempfile
+
+    cache = tempfile.mkdtemp(prefix="nns_mux_ttff_")
+    try:
+        env = dict(os.environ,
+                   NNSTPU_COMPILE_CACHE_DIR=cache,
+                   NNSTPU_COMPILE_WARMUP="1")
+        print(f"{'run':>6} {'ttff s':>8} {'miss':>6} {'persist_hit':>12}")
+        rows = {}
+        for label in ("cold", "warm"):
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), "--ttff-child"],
+                env=env, capture_output=True, text=True, timeout=600)
+            if proc.returncode != 0:
+                print(f"{label}: FAILED\n{proc.stderr[-400:]}")
+                return
+            child = json.loads(proc.stdout.strip().splitlines()[-1])
+            rows[label] = child
+            c = child["compiles"]
+            print(f"{label:>6} {child['ttff_s']:>8.3f} "
+                  f"{c.get('miss', 0):>6} {c.get('persist_hit', 0):>12}")
+        misses = rows["warm"]["compiles"].get("miss", 0)
+        speedup = rows["cold"]["ttff_s"] / max(rows["warm"]["ttff_s"], 1e-9)
+        verdict = ("zero cold-start OK" if misses == 0
+                   else "COLD COMPILES ON THE REQUEST PATH")
+        print(f"warm misses = {misses} ({verdict}); "
+              f"ttff speedup = {speedup:.2f}x")
+    finally:
+        shutil.rmtree(cache, ignore_errors=True)
+
+
 def main():
+    if TTFF_CHILD:
+        ttff_child()
+        return
+    if TTFF:
+        ttff_sweep()
+        return
     ncpu = os.cpu_count()
     print(f"mux overhead sweep: total={TOTAL} frames, host cpus={ncpu}, "
           f"threads-per-config = streams sources + 1/elt + sinks")

@@ -766,6 +766,100 @@ finally:
             pass
 PY
 
+run_step "Cold-start smoke (warm a pipeline, restart the process, zero compile misses)" \
+  python - <<'PY'
+# Compile-ahead acceptance gate: a warmed-then-restarted pipeline must
+# serve its first frame with nnstpu_compile_total{result="miss"} == 0 —
+# every executable reconstructed from the persistent cache (result in
+# {hit, persist_hit} only) — and warmup-phase compile spans must land on
+# the "warmup" Perfetto track, never inside the first frame's trace.
+import json
+import shutil
+import subprocess
+import sys
+import tempfile
+
+DRIVER = r'''
+import json, os, time
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+from nnstreamer_tpu import Pipeline
+from nnstreamer_tpu.backends.jax_backend import JaxModel
+from nnstreamer_tpu.elements.dynbatch import DynBatch, DynUnbatch
+from nnstreamer_tpu.elements.filter import TensorFilter
+from nnstreamer_tpu.elements.sink import TensorSink
+from nnstreamer_tpu.elements.testsrc import DataSrc
+from nnstreamer_tpu.obs import spans
+from nnstreamer_tpu.obs.metrics import REGISTRY
+from nnstreamer_tpu.spec import TensorSpec, TensorsSpec
+
+D = 64
+W = np.random.default_rng(0).standard_normal((D, D)).astype(np.float32)
+model = JaxModel(apply=lambda p, x: jax.numpy.tanh(x @ W),
+                 input_spec=TensorsSpec.of(
+                     TensorSpec(dtype=np.float32, shape=(None, D))))
+state = {"first": None}
+
+def cb(frame):
+    if state["first"] is None:
+        np.asarray(frame.tensors[0])
+        state["first"] = time.perf_counter()
+
+p = Pipeline(name="ci_coldstart")
+src = p.add(DataSrc(data=[np.ones(D, np.float32) for _ in range(4)]))
+p.link_chain(src, p.add(DynBatch(max_batch=4)),
+             p.add(TensorFilter(framework="jax", model=model)),
+             p.add(DynUnbatch()), p.add(TensorSink(callback=cb)))
+p.run(timeout=120)
+assert state["first"] is not None, "no frame served"
+
+c = REGISTRY.get("nnstpu_compile_total")
+compiles = {k[0]: int(v.value) for k, v in dict(c.children()).items()}
+
+# span attribution: every compile span sits on the "warmup" track
+doc = spans.chrome_trace(spans.snapshot(), process_name="ci_coldstart")
+comp = [e for e in doc["traceEvents"]
+        if e.get("ph") == "X" and e["name"] == "compile"]
+rows = {e["tid"]: e["args"]["name"] for e in doc["traceEvents"]
+        if e.get("ph") == "M" and e["name"] == "thread_name"}
+warm_rows = [tid for tid, name in rows.items() if name == "warmup"]
+bad = [e for e in comp if e["tid"] not in warm_rows]
+warmed = [e for e in doc["traceEvents"]
+          if e.get("ph") == "X" and str(e["name"]).startswith("warm")]
+print(json.dumps({"compiles": compiles, "compile_spans": len(comp),
+                  "off_track": len(bad), "warmup_spans": len(warmed)}))
+'''
+
+cache = tempfile.mkdtemp(prefix="ci_coldstart_")
+try:
+    env = {"NNSTPU_COMPILE_CACHE_DIR": cache, "NNSTPU_COMPILE_WARMUP": "1",
+           "NNSTPU_TRACERS": "spans", "JAX_PLATFORMS": "cpu",
+           "PATH": "/usr/bin:/bin:/usr/local/bin"}
+    import os
+
+    env = dict(os.environ, **env)
+    runs = {}
+    for label in ("cold", "warm"):
+        proc = subprocess.run([sys.executable, "-c", DRIVER], env=env,
+                              capture_output=True, text=True, timeout=300)
+        assert proc.returncode == 0, (label, proc.stderr[-800:])
+        runs[label] = json.loads(proc.stdout.strip().splitlines()[-1])
+    cold, warm = runs["cold"], runs["warm"]
+    assert cold["compiles"].get("miss", 0) > 0, cold  # cold run really compiled
+    assert warm["compiles"].get("miss", 0) == 0, \
+        f"warmed restart still compiling: {warm['compiles']}"
+    assert warm["compiles"].get("persist_hit", 0) > 0, warm
+    for label, run in runs.items():
+        assert run["compile_spans"] > 0 and run["off_track"] == 0, (label, run)
+        assert run["warmup_spans"] > 0, (label, run)
+    print(f"cold-start smoke OK: cold={cold['compiles']} -> "
+          f"warm={warm['compiles']} (zero misses after restart); "
+          f"all {warm['compile_spans']} compile spans on the warmup track")
+finally:
+    shutil.rmtree(cache, ignore_errors=True)
+PY
+
 run_step "Bench smoke (final JSON line parses, rc=0)" \
   bash -c '
     env BENCH_FRAMES=10 BENCH_QUANT_FRAMES=4 BENCH_BASELINE_FRAMES=3 \
