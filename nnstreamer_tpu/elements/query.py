@@ -23,11 +23,16 @@ protocol version (still 1), the high byte carries header flag bits.
 block** between the fixed header and the tensor list — how a span trace
 (``NNSTPU_TRACERS=spans``, :mod:`nnstreamer_tpu.obs.spans`) follows a
 frame across the wire so server-side spans attach to the client's
-trace.  Version gating keeps old peers working: senders emit the flag
-only after the peer proved it speaks it (the server echoes the flag on
-flagged requests; the client's flagged negotiation probe falls back to
-a plain probe when a strict-v1 server drops the connection), so a
-pre-trace peer only ever sees plain version-1 bytes.
+trace.  ``FLAG_TENANT`` (0x0200) marks an optional **tenant block**
+(u16 length + utf-8, ≤ 64 bytes) after the trace block: the client's
+declared tenant identity, which the scheduler's admission quotas and
+the ``tenant``-labeled SLO metrics key on (without it every client
+behind one NAT/router collapses into its peer IP).  Version gating
+keeps old peers working: senders emit the flags only after the peer
+proved it speaks them (the server echoes the trace flag on flagged
+requests; the client's flagged negotiation probe falls back to a plain
+probe when a strict-v1 server drops the connection), so a pre-trace
+peer only ever sees plain version-1 bytes.
 
 Raw C-order bytes, no pickle — safe against untrusted peers and portable
 across hosts (same discipline as ``utils/checkpoint.py``).
@@ -66,7 +71,9 @@ MAGIC = b"NNSQ"
 VERSION = 1
 VER_MASK = 0x00FF   # low byte: protocol version
 FLAG_TRACE = 0x0100  # high-byte flag: trace-context block follows the header
+FLAG_TENANT = 0x0200  # high-byte flag: tenant-identity block follows trace
 _TRACE_BLOCK = struct.Struct("<QQI")  # trace_id, span_id, reserved
+MAX_TENANT = 64  # tenant-identity byte cap (one label value, not a payload)
 
 
 def _mesh_ndev() -> int:
@@ -166,16 +173,24 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
 
 def send_tensors(sock: socket.socket, tensors, pts: int,
                  trace: Optional[Tuple[int, int]] = None,
-                 fault_key: str = "nnsq") -> None:
+                 fault_key: str = "nnsq",
+                 tenant: Optional[str] = None) -> None:
     """``trace=(trace_id, span_id)`` sets :data:`FLAG_TRACE` and prepends
-    the trace-context block.  Only send it to a peer that proved trace
-    support (see the module docstring) — a strict version-1 peer rejects
-    the flagged header.  ``fault_key`` names this send site to the chaos
-    engine (``socket_drop``/``truncate``/``corrupt`` act here)."""
-    ver = VERSION | (FLAG_TRACE if trace is not None else 0)
+    the trace-context block; ``tenant="team-a"`` sets :data:`FLAG_TENANT`
+    and appends the tenant block (truncated to :data:`MAX_TENANT` bytes).
+    Only send either to a peer that proved flag support (see the module
+    docstring) — a strict version-1 peer rejects any flagged header.
+    ``fault_key`` names this send site to the chaos engine
+    (``socket_drop``/``truncate``/``corrupt`` act here)."""
+    ver = (VERSION | (FLAG_TRACE if trace is not None else 0)
+           | (FLAG_TENANT if tenant else 0))
     parts = [MAGIC, struct.pack("<HHq", ver, len(tensors), pts)]
     if trace is not None:
         parts.append(_TRACE_BLOCK.pack(trace[0], trace[1], 0))
+    if tenant:
+        t = tenant.encode()[:MAX_TENANT]
+        parts.append(struct.pack("<H", len(t)))
+        parts.append(t)
     for t in tensors:
         # np.asarray (not ascontiguousarray: it promotes 0-d to 1-d);
         # tobytes() below emits C-order regardless of memory layout
@@ -213,31 +228,39 @@ MAX_ERRMSG = 4096  # mirrors the cap send_error applies
 
 
 def recv_tensors(sock: socket.socket) -> Tuple[Tuple[np.ndarray, ...], int]:
-    """Receive one frame, discarding any trace context (the pre-trace
-    call shape — every legacy call site keeps its 2-tuple)."""
-    tensors, pts, _ = recv_tensors_ex(sock)
+    """Receive one frame, discarding any trace/tenant context (the
+    pre-trace call shape — every legacy call site keeps its 2-tuple)."""
+    tensors, pts, _, _ = recv_tensors_ex(sock)
     return tensors, pts
 
 
 def recv_tensors_ex(
     sock: socket.socket,
-) -> Tuple[Tuple[np.ndarray, ...], int, Optional[Tuple[int, int]]]:
-    """Receive one frame plus its optional trace context: returns
-    ``(tensors, pts, (trace_id, span_id) | None)``.  Tolerates (and
-    consumes) the :data:`FLAG_TRACE` header bit; any other flag or
-    version still rejects."""
+) -> Tuple[Tuple[np.ndarray, ...], int, Optional[Tuple[int, int]],
+           Optional[str]]:
+    """Receive one frame plus its optional wire metadata: returns
+    ``(tensors, pts, (trace_id, span_id) | None, tenant | None)``.
+    Tolerates (and consumes) the :data:`FLAG_TRACE` and
+    :data:`FLAG_TENANT` header bits; any other flag or version still
+    rejects."""
     head = _recv_exact(sock, 4 + 12)
     if head[:4] != MAGIC:
         raise ConnectionError(f"bad magic {head[:4]!r}")
     ver, n, pts = struct.unpack("<HHq", head[4:])
     flags = ver & ~VER_MASK
-    if (ver & VER_MASK) != VERSION or (flags & ~FLAG_TRACE):
+    if (ver & VER_MASK) != VERSION or (flags & ~(FLAG_TRACE | FLAG_TENANT)):
         raise ConnectionError(f"protocol version {ver} != {VERSION}")
     trace = None
     if flags & FLAG_TRACE:
         t_id, s_id, _reserved = _TRACE_BLOCK.unpack(
             _recv_exact(sock, _TRACE_BLOCK.size))
         trace = (t_id, s_id)
+    tenant = None
+    if flags & FLAG_TENANT:
+        (tlen,) = struct.unpack("<H", _recv_exact(sock, 2))
+        if tlen > MAX_TENANT:
+            raise ConnectionError(f"tenant block {tlen} bytes > {MAX_TENANT}")
+        tenant = _recv_exact(sock, tlen).decode("utf-8", "replace")
     if n == ERR_SENTINEL:
         (mlen,) = struct.unpack("<I", _recv_exact(sock, 4))
         if mlen > MAX_ERRMSG:
@@ -270,7 +293,7 @@ def recv_tensors_ex(
             )
         a = np.frombuffer(_recv_exact(sock, nbytes), dtype=dtype)
         out.append(a.reshape(shape))
-    return tuple(out), pts, trace
+    return tuple(out), pts, trace, tenant
 
 
 class QueryServer:
@@ -442,13 +465,17 @@ class QueryServer:
             with self._conns_lock:
                 self._conns.pop(conn, None)
 
-    def _serve_loop(self, conn, state, client, tenant,
+    def _serve_loop(self, conn, state, client, peer_tenant,
                     OverloadError, BreakerOpenError) -> None:
         while self._running:
             try:
-                tensors, pts, wire_trace = recv_tensors_ex(conn)
+                tensors, pts, wire_trace, wire_tenant = recv_tensors_ex(conn)
             except (ConnectionError, OSError):
                 return
+            # declared tenant identity wins over the peer-IP fallback:
+            # distinct tenants behind one host (or one router) stay
+            # distinct to admission quotas and the tenant-labeled metrics
+            tenant = wire_tenant or peer_tenant
             with state.lock:
                 if self._draining:
                     # a request racing the drain: typed goodbye, not a
@@ -484,7 +511,7 @@ class QueryServer:
                             trace=((wire_trace[0], tok[0])
                                    if tok is not None else None))
                     else:
-                        outs = self._invoke_direct(tensors)
+                        outs = self._invoke_direct(tensors, tenant=tenant)
                     reply_trace = wire_trace
                     if tok is not None:
                         reply_trace = (wire_trace[0], tok[0])
@@ -521,7 +548,7 @@ class QueryServer:
                         pass
                 return
 
-    def _invoke_direct(self, tensors):
+    def _invoke_direct(self, tensors, tenant: str = ""):
         """Unbatched invoke (breaker-gated when a scheduler is attached)."""
 
         def run():
@@ -543,7 +570,7 @@ class QueryServer:
             return outs
 
         if self.scheduler is not None:
-            return self.scheduler.invoke(run)
+            return self.scheduler.invoke(run, tenant=tenant)
         return run()
 
     # -- cross-client batching ---------------------------------------------
@@ -754,17 +781,30 @@ class QueryServer:
                         spec = TensorsSpec.from_arrays(chunk)
                         outs_ = self._backend_for(spec).invoke(chunk)
                     if t0:
-                        # device leg on the dispatcher thread: ride the
-                        # first member's wire trace (the group coalesced
-                        # many client traces into one invoke)
-                        _spans.record_span(
-                            "device_invoke", t0, _spans.now_ns() - t0,
-                            cat="device", trace=group[0].trace,
-                            args={"framework": self._framework,
-                                  "rows": int(chunk[0].shape[0])})
+                        # device leg on the dispatcher thread: the group
+                        # coalesced many client traces into one invoke, so
+                        # the shared span is recorded on EVERY member's
+                        # wire trace — each request really did spend this
+                        # device time, and per-trace latency attribution
+                        # (the loadgen report) needs the leg on all of them
+                        dur = _spans.now_ns() - t0
+                        traced = [g.trace for g in group
+                                  if g.trace is not None] or [None]
+                        for i_t, tr in enumerate(traced):
+                            _spans.record_span(
+                                "device_invoke", t0, dur,
+                                cat="device", trace=tr,
+                                args={"framework": self._framework,
+                                      "rows": int(chunk[0].shape[0]),
+                                      "coalesced": len(traced),
+                                      "shared": i_t > 0})
                     return outs_
 
-                outs = sch.invoke(run) if sch is not None else run()
+                g_tenant = next((g.item.tenant for g in group
+                                 if g.item is not None
+                                 and g.item.tenant), "") or ""
+                outs = (sch.invoke(run, tenant=g_tenant)
+                        if sch is not None else run())
                 self.batched_invokes += 1
                 if out_parts is None:
                     out_parts = [[] for _ in outs]
@@ -956,6 +996,7 @@ class TensorQueryClient(Node):
         retry_backoff_cap_ms: float = 2000.0,
         retry_jitter: float = 0.25,
         stateful: bool = False,
+        tenant: str = "",
     ):
         """``request_timeout`` bounds EVERY blocking read after connect
         (the old behavior — block forever on a hung server — needs an
@@ -974,7 +1015,14 @@ class TensorQueryClient(Node):
         (:class:`nnstreamer_tpu.serving.DecodeServer`): a mid-stream
         connection failure then raises :class:`QuerySessionBrokenError`
         immediately, never retrying — the server's session state may
-        already have advanced, and a silent replay would corrupt it."""
+        already have advanced, and a silent replay would corrupt it.
+
+        ``tenant="team-a"`` declares this link's tenant identity on the
+        wire (:data:`FLAG_TENANT`): server-side admission quotas and the
+        ``tenant``-labeled scheduler metrics key on it instead of the
+        peer IP.  Sent only after the negotiation probe proved the peer
+        speaks header flags (the same capability gate as the trace
+        block), so old servers never see the bit."""
         super().__init__(name)
         self.add_sink_pad("sink")
         self.add_src_pad("src")
@@ -988,6 +1036,7 @@ class TensorQueryClient(Node):
         self.retry_backoff_cap_ms = float(retry_backoff_cap_ms)
         self.retry_jitter = float(retry_jitter)
         self.stateful = bool(stateful)
+        self.tenant = str(tenant)
         self.retries_total = 0    # observability: re-sent requests
         self.reconnects = 0       # sockets dropped and re-dialed
         # deterministic per-element jitter stream (crc32: str hash() is
@@ -1036,11 +1085,14 @@ class TensorQueryClient(Node):
         zeros = tuple(np.zeros(t.shape, t.dtype) for t in spec.tensors)
         outs = None
         first_exc: Optional[BaseException] = None
+        # a declared tenant also needs the capability probe: the tenant
+        # block rides the same header-flag machinery as the trace block
+        want_ext = _spans.enabled or bool(self.tenant)
         try:
-            outs = self._probe(zeros, want_trace=_spans.enabled)
+            outs = self._probe(zeros, want_trace=want_ext)
         except (OSError, RuntimeError) as exc:
             first_exc = exc
-            if _spans.enabled:
+            if want_ext:
                 self._reset_socket()
                 try:
                     outs = self._probe(zeros, want_trace=False)
@@ -1056,8 +1108,9 @@ class TensorQueryClient(Node):
     def _probe(self, zeros, want_trace: bool):
         sock = self._connect()
         trace = (_spans.new_trace_id(), 0) if want_trace else None
-        send_tensors(sock, zeros, PROBE_PTS, trace=trace)
-        outs, _, reply_trace = recv_tensors_ex(sock)
+        send_tensors(sock, zeros, PROBE_PTS, trace=trace,
+                     tenant=self.tenant if want_trace else None)
+        outs, _, reply_trace, _ = recv_tensors_ex(sock)
         self._trace_wire = reply_trace is not None
         return outs
 
@@ -1104,11 +1157,12 @@ class TensorQueryClient(Node):
     def _roundtrip(self, frame: Frame) -> Frame:
         """One send/recv attempt on the current (or a fresh) socket."""
         sock = self._connect()
+        tenant = self.tenant if (self.tenant and self._trace_wire) else None
         ctx = (frame.meta.get(_spans.META_KEY)
                if self._trace_wire and _spans.enabled else None)
         if ctx is None:
             send_tensors(sock, frame.tensors, frame.pts,
-                         fault_key="nnsq.client")
+                         fault_key="nnsq.client", tenant=tenant)
             outs, pts = recv_tensors(sock)
             return frame.with_tensors(outs, pts=pts)
         # traced round trip: the rtt span rides the frame's trace, its id
@@ -1118,8 +1172,9 @@ class TensorQueryClient(Node):
         args = {"server": f"{self.host}:{self.port}"}
         try:
             send_tensors(sock, frame.tensors, frame.pts,
-                         trace=(ctx[0], tok[0]), fault_key="nnsq.client")
-            outs, pts, reply_trace = recv_tensors_ex(sock)
+                         trace=(ctx[0], tok[0]), fault_key="nnsq.client",
+                         tenant=tenant)
+            outs, pts, reply_trace, _ = recv_tensors_ex(sock)
             if reply_trace is not None:
                 args["server_span"] = f"{reply_trace[1]:x}"
         finally:

@@ -66,9 +66,21 @@ def _serve_until_signal(drain, stop) -> int:
     return rc["code"]
 
 
+def _enable_spans(name: str) -> None:
+    """Span recording + process naming for subprocess roles: the worker/
+    router records ``nnsq_serve``/``nnsq_route``/``device_*`` spans that
+    the cluster trace collector federates from ``/trace.json``."""
+    from ..obs import collector, spans
+
+    spans.enable(spans.configured_flight_records())
+    collector.set_process_name(name)
+
+
 def _cmd_worker(args) -> int:
     from .worker import FleetWorker
 
+    if args.spans:
+        _enable_spans(args.name)
     engine = None
     if args.decode:
         engine = _parse_kv_ints(args.decode)
@@ -106,6 +118,8 @@ def _cmd_router(args) -> int:
     from .membership import Membership
     from .router import Router
 
+    if args.spans:
+        _enable_spans(args.name)
     membership = Membership()
     for spec in args.workers.split(","):
         spec = spec.strip()
@@ -190,6 +204,11 @@ def main(argv=None) -> int:
         sp.add_argument("--platform", default=None, metavar="NAME",
                         help="pin the jax platform (e.g. cpu) before any "
                              "backend initializes")
+        sp.add_argument("--spans", action="store_true",
+                        help="record flight-recorder spans and serve them "
+                             "at /trace.json for the cluster trace "
+                             "collector (names this process in the merged "
+                             "Perfetto timeline)")
 
     args = ap.parse_args(argv)
     if args.platform:

@@ -363,6 +363,14 @@ class Membership:
         w.state = DOWN
         self._g_state.set(STATE_CODES[DOWN], worker=w.id)
 
+    def trace_sources(self) -> Dict[str, str]:
+        """``{worker_id: "host:port"}`` of every member with a metrics
+        endpoint — the roster the cluster trace collector
+        (:meth:`nnstreamer_tpu.obs.collector.TraceCollector.add_fleet`)
+        federates ``/trace.json`` and ``/metrics`` from."""
+        return {w.id: w.health_addr for w in self.workers()
+                if w.health_addr}
+
     def stats(self) -> dict:
         return {
             "workers": {w.id: w.snapshot() for w in self.workers()},

@@ -183,11 +183,14 @@ class Router:
         self._sessions_lock = threading.Lock()
         # deterministic jitter stream (chaos replays want stable backoff)
         self._rng = random.Random(zlib.crc32(self.name.encode()))
-        # the recovery ledger: offered == delivered + sum(shed.values())
+        # the recovery ledger: offered == delivered + sum(shed.values()),
+        # with a per-tenant split so SLO reports (tools/loadgen.py) can
+        # check goodput-under-overload tenant by tenant without scraping
         self._ledger_lock = threading.Lock()
         self.offered = 0
         self.delivered = 0
         self.shed: Dict[str, int] = {}
+        self.tenants: Dict[str, Dict[str, int]] = {}
         self.rerouted = 0          # transport-failure re-dispatches
         self.sessions_opened = 0
         self.sessions_broken = 0
@@ -265,22 +268,36 @@ class Router:
             else:
                 self._serve_stateless(conn, client, tenant)
 
-    def _count_shed(self, reason: str) -> None:
+    def _count_shed(self, reason: str, tenant: str = "") -> None:
         with self._ledger_lock:
             self.shed[reason] = self.shed.get(reason, 0) + 1
+            if tenant:
+                self._tenant_entry(tenant)["shed"] += 1
 
-    def _serve_stateless(self, conn, client: str, tenant: str) -> None:
+    def _tenant_entry(self, tenant: str) -> Dict[str, int]:
+        """Caller holds the ledger lock."""
+        entry = self.tenants.get(tenant)
+        if entry is None:
+            entry = self.tenants[tenant] = {
+                "offered": 0, "delivered": 0, "shed": 0}
+        return entry
+
+    def _serve_stateless(self, conn, client: str, peer_tenant: str) -> None:
         from ..sched import BreakerOpenError, OverloadError
 
         import numpy as np
 
         while self._running:
             try:
-                tensors, pts, wtrace = recv_tensors_ex(conn)
+                tensors, pts, wtrace, wtenant = recv_tensors_ex(conn)
             except (ConnectionError, OSError):
                 return
+            # declared wire tenant wins over the peer IP: N tenants
+            # behind one loadgen host (or one NAT) meter independently
+            tenant = wtenant or peer_tenant
             with self._ledger_lock:
                 self.offered += 1
+                self._tenant_entry(tenant)["offered"] += 1
             # route span: child of the client's rtt span when the wire
             # carried a trace; otherwise a fresh trace (the hop is still
             # recorded).  The reply echoes the flag ONLY when the
@@ -305,7 +322,8 @@ class Router:
                         # intake is metered here, per tenant
                         item = self.scheduler.admit(
                             client, tenant=tenant, cost=max(1, cost))
-                    outs, opts, w = self._forward(tensors, pts, fwd_trace)
+                    outs, opts, w = self._forward(tensors, pts, fwd_trace,
+                                                  tenant=wtenant)
                     worker_id = w.id
                     reply_trace = ((wtrace[0], tok[0])
                                    if tok is not None and wtrace is not None
@@ -314,6 +332,7 @@ class Router:
                                  fault_key="nnsq.router")
                     with self._ledger_lock:
                         self.delivered += 1
+                        self._tenant_entry(tenant)["delivered"] += 1
                 finally:
                     if item is not None:
                         self.scheduler.release(item)
@@ -322,7 +341,7 @@ class Router:
                             tok, "nnsq_route", "fleet",
                             args={"client": client, "worker": worker_id})
             except (OverloadError, BreakerOpenError) as exc:
-                self._count_shed(getattr(exc, "reason", "admission"))
+                self._count_shed(getattr(exc, "reason", "admission"), tenant)
                 try:
                     send_error(conn, str(exc), code=exc.code)
                 except OSError:
@@ -330,13 +349,13 @@ class Router:
             except QueryError as exc:
                 # typed fleet verdict (worker rejection after exhausting
                 # alternatives, or no worker at all)
-                self._count_shed(exc.code.lower() or "error")
+                self._count_shed(exc.code.lower() or "error", tenant)
                 try:
                     send_error(conn, str(exc), code=exc.code)
                 except OSError:
                     return
             except Exception as exc:  # noqa: BLE001 — report, keep serving
-                self._count_shed("error")
+                self._count_shed("error", tenant)
                 try:
                     send_error(conn, repr(exc))
                 except OSError:
@@ -355,14 +374,17 @@ class Router:
             return link
 
     def _forward(self, tensors, pts,
-                 trace: Optional[Tuple[int, int]]
+                 trace: Optional[Tuple[int, int]],
+                 tenant: Optional[str] = None
                  ) -> Tuple[tuple, int, WorkerInfo]:
         """One stateless request against the fleet: pick, forward, and on
         transport failure re-route to the next eligible worker (bounded,
         with capped backoff).  Typed worker rejections try the next
         worker too (the fleet absorbs one worker's shedding) and only
         surface when every candidate refused; ``[EXPIRED]`` surfaces
-        immediately.  Returns ``(outs, pts, worker)``."""
+        immediately.  ``tenant`` (the client's declared wire identity)
+        is forwarded so worker-side schedulers label the same tenant the
+        front door admitted.  Returns ``(outs, pts, worker)``."""
         tried: Set[str] = set()
         last_typed: Optional[QueryError] = None
         delay_s = self.retry_backoff_ms / 1e3
@@ -386,8 +408,8 @@ class Router:
                 continue
             try:
                 send_tensors(sock, tensors, pts, trace=trace,
-                             fault_key="nnsq.router")
-                outs, opts, _rtrace = recv_tensors_ex(sock)
+                             fault_key="nnsq.router", tenant=tenant)
+                outs, opts, _rtrace, _ = recv_tensors_ex(sock)
             except (QueryTimeoutError, ConnectionError, OSError):
                 # transport failure: the worker is gone or unreachable —
                 # drop the socket (stream position unknowable), mark the
@@ -455,7 +477,7 @@ class Router:
         try:
             while self._running:
                 try:
-                    tensors, pts, wtrace = recv_tensors_ex(conn)
+                    tensors, pts, wtrace, wtenant = recv_tensors_ex(conn)
                 except (ConnectionError, OSError):
                     return
                 tok = None
@@ -475,7 +497,7 @@ class Router:
                             # DecodeServer contract: never pin, freely
                             # re-routed
                             outs, opts, w = self._forward(
-                                tensors, pts, fwd_trace)
+                                tensors, pts, fwd_trace, tenant=wtenant)
                             worker_id = w.id
                             send_tensors(conn, outs, opts,
                                          trace=reply_trace,
@@ -485,7 +507,7 @@ class Router:
                             sess = self._open_session(conn, client)
                             worker_id = sess.worker.id
                         self._session_step(sess, tensors, pts, fwd_trace,
-                                           reply_trace)
+                                           reply_trace, tenant=wtenant)
                     finally:
                         if tok is not None:
                             _spans.span_end(
@@ -545,15 +567,15 @@ class Router:
         return sess
 
     def _session_step(self, sess: _Session, tensors, pts, fwd_trace,
-                      reply_trace) -> None:
+                      reply_trace, tenant: Optional[str] = None) -> None:
         """Forward one frame on the pinned connection.  NO replay on
         failure — the worker's session state already advanced an unknown
         number of steps; the client gets the typed ``[SESSION]`` code
         and rebuilds."""
         try:
             send_tensors(sess.sock, tensors, pts, trace=fwd_trace,
-                         fault_key="nnsq.router")
-            outs, opts, _rt = recv_tensors_ex(sess.sock)
+                         fault_key="nnsq.router", tenant=tenant)
+            outs, opts, _rt = recv_tensors_ex(sess.sock)[:3]
         except (QueryTimeoutError, ConnectionError, OSError) as exc:
             self.membership.report_failure(sess.worker)
             with self._ledger_lock:
@@ -643,6 +665,7 @@ class Router:
                 "rerouted": self.rerouted,
                 "sessions_opened": self.sessions_opened,
                 "sessions_broken": self.sessions_broken,
+                "tenants": {t: dict(e) for t, e in self.tenants.items()},
             }
         out["sessions_active"] = self.session_count()
         with self._sessions_lock:

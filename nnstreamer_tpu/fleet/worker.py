@@ -183,6 +183,16 @@ class FleetWorker:
     def health_port(self) -> Optional[int]:
         return self._health_port if self.metrics_server is not None else None
 
+    @property
+    def trace_addr(self) -> Optional[str]:
+        """``host:port`` serving this worker's ``/trace.json`` +
+        ``/metrics`` (the collector's federation address); None without
+        a metrics server (in-process fleets share one recorder and use a
+        single local collector source instead)."""
+        if self.metrics_server is None:
+            return None
+        return f"{self.metrics_server.host}:{self.metrics_server.port}"
+
     # -- membership probe (in-process fleets) --------------------------------
 
     def probe(self, _info=None) -> str:

@@ -20,7 +20,12 @@ both NNStreamer papers use to find on-device bottlenecks):
   stalled sources, wedged queues, overdue device dispatches →
   ``/healthz`` + ``nnstpu_health`` + automatic stall flight dumps;
 - :mod:`.export` — Prometheus text exposition + stdlib scrape endpoint
-  (plus ``/healthz`` and the merged ``/stats.json``).
+  (plus ``/healthz``, the merged ``/stats.json``, and the
+  ``/trace.json`` flight snapshot);
+- :mod:`.collector` — cluster-wide collection: federates worker
+  ``/metrics`` into one exposition with a ``worker`` label and merges
+  per-process flight snapshots into a single clock-aligned Perfetto
+  trace (the layer ``tools/loadgen.py`` builds its SLO reports on).
 
 Activation is conf-driven like the other ``NNSTPU_COMMON_*`` knobs —
 ``NNSTPU_TRACERS=latency;stats`` and ``NNSTPU_METRICS_PORT=9464`` (the
@@ -72,6 +77,14 @@ from .spans import SpanTracer, chrome_trace, waterfall  # noqa: F401
 # importing .device / .watchdog registers the "device" / "watchdog" tracers
 from . import device  # noqa: E402,F401
 from . import watchdog  # noqa: E402,F401
+from . import collector  # noqa: E402,F401
+from .collector import (  # noqa: F401
+    TraceCollector,
+    attribute_trace,
+    federate_metrics,
+    set_process_name,
+    trace_document,
+)
 from .device import (  # noqa: F401
     DeviceTracer,
     device_memory_snapshot,

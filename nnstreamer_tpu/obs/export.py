@@ -20,7 +20,11 @@ reports unhealthy; fleet membership parses this body) and
 ``/stats.json`` — every registered stats provider (pipelines via
 ``Pipeline.start``, schedulers via
 :class:`nnstreamer_tpu.sched.Scheduler`) merged into one JSON document,
-the structured twin of the Prometheus exposition.
+the structured twin of the Prometheus exposition — and ``/trace.json``,
+the process's flight-recorder snapshot plus a clock stamp
+(:func:`nnstreamer_tpu.obs.collector.trace_document`): what the cluster
+trace collector federates into one cross-process Perfetto timeline
+(``?clock=1`` serves just the stamp, the cheap clock-offset probe).
 """
 
 from __future__ import annotations
@@ -301,6 +305,18 @@ class MetricsServer:
                     doc["health"] = health_document()
                     body = json.dumps(doc, default=str,
                                       sort_keys=True).encode("utf-8")
+                    self._reply(body, "application/json; charset=utf-8")
+                elif path == "/trace.json":
+                    # flight-recorder snapshot + clock stamp: the feed
+                    # the cluster trace collector merges and aligns;
+                    # ?clock=1 answers only the stamp (offset probes
+                    # must not pay for a snapshot copy)
+                    from .collector import trace_document
+
+                    clock_only = "clock=1" in (
+                        self.path.partition("?")[2] or "")
+                    body = json.dumps(trace_document(clock_only),
+                                      default=str).encode("utf-8")
                     self._reply(body, "application/json; charset=utf-8")
                 else:
                     self.send_error(404)

@@ -110,18 +110,22 @@ class Scheduler:
 
             registry = REGISTRY
         self._registry = registry
+        # queue-wait and shed/expired carry a ``tenant`` label so SLO
+        # reports (tools/loadgen.py, dashboards) split per tenant straight
+        # from the exposition instead of scraping stats JSON; tenant ""
+        # is the unattributed bucket (no scheduler item in scope)
         self._m_wait = registry.histogram(
             "nnstpu_sched_queue_wait_ms",
             "admit-to-dispatch wait per scheduled request",
-            labelnames=("server",), buckets=QUEUE_WAIT_BUCKETS_MS)
+            labelnames=("server", "tenant"), buckets=QUEUE_WAIT_BUCKETS_MS)
         self._m_shed = registry.counter(
             "nnstpu_sched_shed_total",
             "requests shed by admission/deadline/breaker, by reason",
-            labelnames=("server", "reason"))
+            labelnames=("server", "reason", "tenant"))
         self._m_expired = registry.counter(
             "nnstpu_sched_expired_total",
             "requests dropped because their deadline passed while queued",
-            labelnames=("server",))
+            labelnames=("server", "tenant"))
         self._m_trips = registry.counter(
             "nnstpu_sched_breaker_trips_total",
             "circuit breaker closed/half-open -> open transitions",
@@ -152,7 +156,8 @@ class Scheduler:
             try:
                 deadline = self.admission.try_admit(tenant, cost)
             except OverloadError as exc:
-                self._m_shed.inc(server=self.name, reason=exc.reason)
+                self._m_shed.inc(server=self.name, reason=exc.reason,
+                                 tenant=tenant)
                 raise
         return SchedItem(client, cost=cost, tenant=tenant,
                          priority=self.priority_for(client),
@@ -185,7 +190,8 @@ class Scheduler:
                      trace: Optional[Tuple[int, int]] = None) -> None:
         now = now if now is not None else self._clock()
         waited_s = max(0.0, now - item.enqueue_t)
-        self._m_wait.observe(waited_s * 1e3, server=self.name)
+        self._m_wait.observe(waited_s * 1e3, server=self.name,
+                             tenant=str(item.tenant or ""))
         if _spans.enabled:
             # the queue-wait interval as a span on the request's trace
             # (``trace`` from the caller, else the thread's current serve
@@ -199,8 +205,9 @@ class Scheduler:
     def expired_error(self, item: SchedItem) -> OverloadError:
         """Count one deadline-expired drop and build its typed error."""
         self.expired += 1
-        self._m_expired.inc(server=self.name)
-        self._m_shed.inc(server=self.name, reason="expired")
+        tenant = str(item.tenant or "")
+        self._m_expired.inc(server=self.name, tenant=tenant)
+        self._m_shed.inc(server=self.name, reason="expired", tenant=tenant)
         waited_ms = (self._clock() - item.enqueue_t) * 1e3
         return OverloadError(
             "expired",
@@ -210,10 +217,11 @@ class Scheduler:
 
     # -- breaker ------------------------------------------------------------
 
-    def invoke(self, fn: Callable[[], object]):
+    def invoke(self, fn: Callable[[], object], tenant: str = ""):
         """Run a backend invoke under the circuit breaker (if any); with
         span tracing on, the invoke (or the breaker rejection) is recorded
-        on the calling thread's current trace."""
+        on the calling thread's current trace.  ``tenant`` attributes a
+        breaker-shed to the tenant whose request hit the open breaker."""
         t0 = _spans.now_ns() if _spans.enabled else 0
         try:
             if self.breaker is None:
@@ -221,7 +229,8 @@ class Scheduler:
             else:
                 out = self.breaker.call(fn)
         except BreakerOpenError:
-            self._m_shed.inc(server=self.name, reason="breaker")
+            self._m_shed.inc(server=self.name, reason="breaker",
+                             tenant=str(tenant or ""))
             if t0:
                 _spans.record_span(
                     "breaker_open", t0, _spans.now_ns() - t0, cat="sched",
@@ -251,13 +260,19 @@ class Scheduler:
         return int(self.priorities.get(host, 0))
 
     def acquire_slot(self, client: str, try_grant: Callable[[], object],
-                     timeout: Optional[float] = None):
-        """Priority-ordered, bounded wait for a contended slot."""
+                     timeout: Optional[float] = None,
+                     tenant: Optional[str] = None):
+        """Priority-ordered, bounded wait for a contended slot.  The
+        tenant defaults to the client's host part (the same fallback the
+        servers apply when no wire identity was declared)."""
+        if tenant is None:
+            tenant = client.rsplit(":", 1)[0]
         try:
             return self.gate.acquire(self.priority_for(client), try_grant,
                                      timeout=timeout)
         except OverloadError as exc:
-            self._m_shed.inc(server=self.name, reason=exc.reason)
+            self._m_shed.inc(server=self.name, reason=exc.reason,
+                             tenant=str(tenant or ""))
             raise
 
     # -- observability ------------------------------------------------------

@@ -91,6 +91,17 @@ class AdmissionController:
         self.admitted = 0
         self.shed_queue_full = 0
         self.shed_rate = 0
+        # per-tenant ledger: SLO reports split admitted/shed by tenant
+        # without reconstructing it from the metric exposition
+        self._by_tenant: Dict[str, Dict[str, int]] = {}
+
+    def _tenant_count(self, tenant: str, key: str) -> None:
+        """Caller holds the lock."""
+        entry = self._by_tenant.get(tenant)
+        if entry is None:
+            entry = self._by_tenant[tenant] = {
+                "admitted": 0, "shed_queue_full": 0, "shed_rate": 0}
+        entry[key] += 1
 
     def try_admit(self, tenant: str, cost: float = 1.0) -> Optional[float]:
         """Admit one request for ``tenant``; returns the absolute deadline
@@ -100,6 +111,7 @@ class AdmissionController:
             n = self._inflight.get(tenant, 0)
             if n >= self.max_queue:
                 self.shed_queue_full += 1
+                self._tenant_count(tenant, "shed_queue_full")
                 raise OverloadError(
                     "queue_full",
                     f"client {tenant} has {n} requests queued "
@@ -111,12 +123,14 @@ class AdmissionController:
                         self.rate, self.burst, now)
                 if not bucket.try_take(now, max(1.0, cost)):
                     self.shed_rate += 1
+                    self._tenant_count(tenant, "shed_rate")
                     raise OverloadError(
                         "rate",
                         f"client {tenant} exceeds {self.rate}/s "
                         f"(burst {bucket.burst:g}); shedding")
             self._inflight[tenant] = n + 1
             self.admitted += 1
+            self._tenant_count(tenant, "admitted")
         if self.deadline_ms > 0:
             return now + self.deadline_ms / 1e3
         return None
@@ -144,6 +158,8 @@ class AdmissionController:
                 "admitted": self.admitted,
                 "shed_queue_full": self.shed_queue_full,
                 "shed_rate": self.shed_rate,
+                "tenants": {t: dict(e)
+                            for t, e in self._by_tenant.items()},
             }
 
 

@@ -765,7 +765,8 @@ class DecodeServer:
             out["sched"] = self.scheduler.stats()
         return out
 
-    def _admit_session(self, client: str) -> DecodeSession:
+    def _admit_session(self, client: str,
+                       tenant: Optional[str] = None) -> DecodeSession:
         """Priority-aware slot assignment: non-blocking grant attempts in
         the gate's (priority, FIFO) order until a slot frees or the
         session timeout / waiting-room bound sheds the join.  With span
@@ -781,7 +782,7 @@ class DecodeServer:
 
         t0 = _spans.now_ns() if _spans.enabled else 0
         sess = self.scheduler.acquire_slot(
-            client, try_grant, timeout=self.session_timeout)
+            client, try_grant, timeout=self.session_timeout, tenant=tenant)
         if t0:
             _spans.record_span(
                 "slot_wait", t0, _spans.now_ns() - t0, cat="sched",
@@ -823,15 +824,19 @@ class DecodeServer:
         with self._conns_lock:
             state = self._conns.get(conn) or self._ConnState()
         sess: Optional[DecodeSession] = None
+        tenant = client.rsplit(":", 1)[0]
         try:
             while self._running:
                 try:
                     # trace context is consumed and echoed (a traced
                     # client keeps its flag; a plain-v1 client never
-                    # sees the bit)
-                    tensors, pts, wtrace = recv_tensors_ex(conn)
+                    # sees the bit); a declared wire tenant wins over
+                    # the peer-IP fallback for shed accounting
+                    tensors, pts, wtrace, wtenant = recv_tensors_ex(conn)
                 except (ConnectionError, OSError):
                     return  # client left: free the slot in finally
+                if wtenant:
+                    tenant = wtenant
                 try:
                     if len(tensors) != 1:
                         raise ValueError(
@@ -869,23 +874,43 @@ class DecodeServer:
                         # lazy join: a probe-only connection never holds a
                         # capacity slot
                         if self.scheduler is not None:
-                            sess = self._admit_session(client)
+                            sess = self._admit_session(client, tenant)
                         else:
                             sess = self.engine.open_session(
                                 timeout=self.session_timeout)
                         with state.lock:
                             state.sess = True
-                    if tensors[0].ndim == 2:
-                        # rank-2 frame = a whole prompt: ONE compiled
-                        # prefill pass builds the slot's KV state (an
-                        # over-length prompt gets prefill's specific
-                        # t_max error, not a generic shape complaint)
-                        sess.prefill(tensors[0])
-                    else:
-                        sess.feed(tensors[0])
-                    y = sess.get(timeout=self.session_timeout)
+                    # a traced step gets a serve span on the client's
+                    # wire trace (the decode analog of nnsq_serve — the
+                    # loadgen report joins it by trace id)
+                    from .obs import spans as _spans
+
+                    tok = (_spans.span_begin(wtrace[0], wtrace[1])
+                           if wtrace is not None and _spans.enabled
+                           else None)
+                    try:
+                        if tensors[0].ndim == 2:
+                            # rank-2 frame = a whole prompt: ONE compiled
+                            # prefill pass builds the slot's KV state (an
+                            # over-length prompt gets prefill's specific
+                            # t_max error, not a generic shape complaint)
+                            sess.prefill(tensors[0])
+                        else:
+                            sess.feed(tensors[0])
+                        y = sess.get(timeout=self.session_timeout)
+                    finally:
+                        if tok is not None:
+                            _spans.span_end(
+                                tok, "nnsq_serve", "decode",
+                                args={"client": client,
+                                      "op": ("prefill"
+                                             if tensors[0].ndim == 2
+                                             else "step")})
+                    reply_trace = wtrace
+                    if tok is not None:
+                        reply_trace = (wtrace[0], tok[0])
                     with state.lock:
-                        send_tensors(conn, (y,), pts, trace=wtrace)
+                        send_tensors(conn, (y,), pts, trace=reply_trace)
                 except OverloadError as exc:
                     # shed join: typed wire rejection, never a parked
                     # connection (the client raises QueryOverloadError)

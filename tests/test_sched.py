@@ -423,8 +423,10 @@ class TestQueryServerSched:
             assert not served  # dropped before the backend
             assert srv.stats()["sched"]["expired"] == 1
         text = render_text(reg)
-        assert 'nnstpu_sched_expired_total{server="q"} 1' in text
-        assert 'nnstpu_sched_shed_total{server="q",reason="expired"} 1' in text
+        assert ('nnstpu_sched_expired_total'
+                '{server="q",tenant="127.0.0.1"} 1') in text
+        assert ('nnstpu_sched_shed_total'
+                '{server="q",reason="expired",tenant="127.0.0.1"} 1') in text
         sch.close()
 
     def test_breaker_degrades_then_recovers(self):

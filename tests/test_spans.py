@@ -314,7 +314,7 @@ class TestNnsqTracePropagation:
             try:
                 send_tensors(s, (np.ones((2, 4), np.float32),), 7,
                              trace=(0xABCD, 0x11))
-                outs, pts, reply = recv_tensors_ex(s)
+                outs, pts, reply, _ = recv_tensors_ex(s)
             finally:
                 s.close()
         np.testing.assert_allclose(outs[0], 2.0)
@@ -419,7 +419,7 @@ class TestNnsqTracePropagation:
             try:
                 send_tensors(s, (np.zeros((4,), np.float32),), PROBE_PTS,
                              trace=(1, 0))
-                outs, pts, reply = recv_tensors_ex(s)
+                outs, pts, reply, _ = recv_tensors_ex(s)
                 assert pts == PROBE_PTS and reply is not None
             finally:
                 s.close()

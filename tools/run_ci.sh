@@ -412,7 +412,8 @@ with QueryServer(framework="custom", model=model, batch=8,
         body = resp.read().decode("utf-8")
     assert "nnstpu_sched_queue_wait_ms_bucket" in body, body[:400]
     assert 'nnstpu_sched_dispatched_total{server="ci"}' in body
-    assert 'nnstpu_sched_shed_total{server="ci_tight",reason="queue_full"} 2' \
+    assert ('nnstpu_sched_shed_total{server="ci_tight",reason="queue_full"'
+            ',tenant="127.0.0.1"} 2') \
         in body, [l for l in body.splitlines() if "shed" in l]
 st = srv.stats()["sched"]
 sch.close()
@@ -858,6 +859,44 @@ try:
           f"all {warm['compile_spans']} compile spans on the warmup track")
 finally:
     shutil.rmtree(cache, ignore_errors=True)
+PY
+
+run_step "SLO gate (loadgen ci-slo: flooding tenant shed typed, well-behaved p99 held, ledger exact)" \
+  python - <<'PY'
+# The production-load SLO gate (ISSUE 10): a fixed seeded scenario — an
+# in-process 2-worker fleet behind a DRR + per-tenant-rate router, one
+# flooding tenant vs three well-behaved tenants on mixed workloads
+# (vision / LSTM window / SSD cascade).  The gate asserts the polite
+# tenants' p99 and goodput hold while the flood is typed-shed, that
+# ZERO requests go lost or unaccounted (client round trips reconcile
+# exactly with the router's offered == delivered + shed ledger), and
+# that per-trace attribution joined client records with server spans.
+import json
+import subprocess
+import sys
+
+proc = subprocess.run(
+    [sys.executable, "tools/loadgen.py", "--scenario", "ci-slo",
+     "--seed", "7", "--assert-slo", "--out", "/tmp/ci_slo_report.json"],
+    capture_output=True, text=True, timeout=300)
+sys.stdout.write(proc.stdout)
+sys.stderr.write(proc.stderr)
+assert proc.returncode == 0, f"SLO gate failed (rc={proc.returncode})"
+report = json.load(open("/tmp/ci_slo_report.json"))
+assert report["slo"]["pass"], report["slo"]["checks"]
+assert report["ledger"]["exact"], report["ledger"]
+assert report["attribution"]["joined"] > 0, report["attribution"]
+flood = report["tenants"]["flood"]
+wb = {n: t for n, t in report["tenants"].items() if t["well_behaved"]}
+assert flood["typed_total"] > 0 and len(wb) == 3
+legs = report["attribution"]["legs_ms"]
+for leg in ("queue", "device", "serve", "route", "rtt"):
+    assert leg in legs, (leg, sorted(legs))
+print(f"SLO gate OK: flood shed {flood['typed_total']} typed of "
+      f"{flood['offered']}; well-behaved p99s "
+      f"{[round(t['latency_ms']['p99_ms'], 1) for t in wb.values()]} ms; "
+      f"ledger exact; {report['attribution']['joined']} traces attributed "
+      f"(queue/device/serve/route/wire)")
 PY
 
 run_step "Bench smoke (final JSON line parses, rc=0)" \
