@@ -169,6 +169,11 @@ def save_tpu_cache(out: dict) -> None:
     try:
         prior = load_tpu_cache()
         prior_result = (prior or {}).get("result") or {}
+        if prior and prior.get("mfu_ladder"):
+            # the MFU-ladder evidence bank rides the same file but is
+            # merged per-cell (merge_ladder_bank), never best-of-run:
+            # a new headline run must not clobber banked ladder cells
+            payload["mfu_ladder"] = prior["mfu_ladder"]
         if prior and not better_run(out, prior_result):
             log(f"# tpu-cache kept: cached run scores {run_score(prior_result)}"
                 f" >= this run {run_score(out)} (archived to BENCH_RUNS only)")
@@ -210,6 +215,61 @@ def load_tpu_cache():
             return json.load(f)
     except Exception:
         return None
+
+
+# ------------------------------------------------- MFU-ladder evidence bank
+#
+# The on-chip campaign as code (ROADMAP item 1): every healthy-chip ladder
+# cell is banked under "mfu_ladder" in BENCH_TPU_CACHE.json, keyed by
+# (config, batch, dtype, mesh, wire_regime), best-of per key — a single
+# good tunnel window banks its cells incrementally across runs, and a
+# later sick-wire run can only ADD evidence, never clobber it.
+
+LADDER_CONFIG = "mobilenet_v2_224"
+LADDER_BATCHES = (8, 32, 128)
+LADDER_DTYPES = ("fp32", "int8")
+LADDER_MESHES = (1, 8)
+# BENCH_NOTES targets on a healthy v5e chip (batch -> minimum MFU);
+# ~15-20% is the realistic depthwise-bound asymptote for this model
+LADDER_TARGETS = {8: 0.01, 32: 0.03, 128: 0.10}
+
+
+def ladder_cell_key(batch, dtype, ndev, regime, config=LADDER_CONFIG) -> str:
+    return f"{config}|batch{batch}|{dtype}|mesh{ndev}|{regime}"
+
+
+def load_ladder_bank() -> dict:
+    """The banked ladder cells ({cell key: cell dict}), possibly {}."""
+    return (load_tpu_cache() or {}).get("mfu_ladder") or {}
+
+
+def merge_ladder_bank(cells: dict) -> dict:
+    """Best-of merge ``cells`` into the evidence bank; returns the merged
+    bank.  Idempotent: merging the same cells twice is a no-op (per-key
+    best-of by mfu, ties keep the incoming measurement's stamp only when
+    it is strictly better).  Never raises — banking evidence must not
+    cost the leg that produced it."""
+    try:
+        cache = load_tpu_cache() or {}
+        bank = cache.get("mfu_ladder") or {}
+        changed = False
+        for key, cell in cells.items():
+            old = bank.get(key)
+            if old is not None and (old.get("mfu") or -1.0) >= (
+                    cell.get("mfu") or -1.0):
+                continue
+            bank[key] = dict(cell)
+            changed = True
+        if changed:
+            cache["mfu_ladder"] = bank
+            tmp = TPU_CACHE_PATH + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(cache, f)
+            os.replace(tmp, TPU_CACHE_PATH)
+        return bank
+    except Exception as exc:
+        log(f"# ladder-bank merge failed: {exc!r}")
+        return dict(cells)
 
 
 class _Skipped(RuntimeError):
@@ -869,6 +929,175 @@ def measure_mfu(batches=None, image_size=224, model_name="mobilenet_v2"):
     return out
 
 
+def ladder_point(batch, dtype, ndev, image_size=224):
+    """One MFU-ladder cell: MobileNet-v2 at ``batch`` in ``dtype``
+    (fp32, or the static-scale full-int8 path) across ``ndev`` chips
+    (batch-axis NamedSharding).  Returns the measured row; MFU is
+    PER-CHIP (whole-program flops / ndev / chip peak) so every cell
+    reads against the same BENCH_NOTES per-chip targets.  The int8 peak
+    is 2× the configured bf16/fp peak (v5e spec)."""
+    import jax
+    import jax.numpy as jnp
+
+    from nnstreamer_tpu.models import mobilenet_v2
+    from nnstreamer_tpu.obs import util as obs_util
+    from nnstreamer_tpu.obs.device import cost_info
+
+    if dtype == "int8":
+        model = mobilenet_v2.build_quantized(
+            num_classes=1001, image_size=image_size, batch=batch,
+            int8_convs=True, static_scales=True)
+    else:
+        model = mobilenet_v2.build(
+            num_classes=1001, image_size=image_size, batch=batch,
+            dtype=jnp.float32)
+
+    def fwd(x):
+        return model.apply(model.params,
+                           (x.astype(jnp.float32) - 127.5) / 127.5)
+
+    kwargs = {}
+    sharding = None
+    if ndev > 1:
+        from nnstreamer_tpu.parallel.mesh import batch_sharding, make_mesh
+
+        mesh = make_mesh((ndev,), ("dp",), devices=jax.devices()[:ndev])
+        sharding = batch_sharding(mesh, 4)
+        kwargs["in_shardings"] = (sharding,)
+    jitted = jax.jit(fwd, **kwargs)
+    rng = np.random.default_rng(0)
+    x_host = rng.integers(
+        0, 256, (batch, image_size, image_size, 3)).astype(np.uint8)
+    compiled = jitted.lower(x_host).compile()
+    info = cost_info(compiled)
+    x = jax.device_put(x_host, sharding) if sharding is not None \
+        else jax.device_put(x_host)
+    jax.block_until_ready(x)
+    t0 = time.perf_counter()
+    jax.block_until_ready(jitted(x))  # warm + step estimate
+    est = time.perf_counter() - t0
+    n = max(2, min(20, int(1.5 / max(est, 1e-4))))
+    t0 = time.perf_counter()
+    for _ in range(n):
+        out = jitted(x)
+    jax.block_until_ready(out)
+    step = (time.perf_counter() - t0) / n
+    peak = obs_util.peak_tflops() * (2.0 if dtype == "int8" else 1.0)
+    # both peaks scale by ndev: MFU normalizes per chip and the ridge
+    # point stays the single-chip ratio
+    rl = obs_util.roofline(info.get("flops"), info.get("bytes"), step,
+                           peak_tf=peak * ndev,
+                           peak_gb=obs_util.peak_gbs() * ndev)
+    row = {
+        "step_ms": round(step * 1e3, 3),
+        "fps": round(batch / step, 1),
+        "per_chip_fps": round(batch / step / ndev, 1),
+        "reps": n,
+        "assumed_peak_tflops_per_chip": peak,
+        "mfu": round(rl["mfu"], 5) if rl["mfu"] is not None else None,
+        "roofline": rl["bound"],
+    }
+    if rl["achieved_tflops"] is not None:
+        row["achieved_tflops"] = round(rl["achieved_tflops"], 3)
+    if rl["achieved_gbs"] is not None:
+        row["achieved_gbs"] = round(rl["achieved_gbs"], 2)
+    if rl["intensity"] is not None:
+        row["intensity"] = round(rl["intensity"], 2)
+    return row
+
+
+def measure_mfu_ladder(wire_gate, on_accel, rep=None):
+    """The on-chip ladder campaign as code: batch {8,32,128} × {fp32,
+    int8} × {1,8 chips} against the BENCH_NOTES per-chip MFU targets.
+
+    Every cell is individually wire-gated: a sick-wire cell records as
+    ``skipped: {reason: "wire"}`` (not a failure) so the matrix stays
+    complete and honest; off-accelerator hosts skip every cell with
+    ``reason: "no_accel"`` (the plumbing — matrix, gating, banking —
+    still runs; ``BENCH_MFU_LADDER_ON_CPU=1`` forces measurement for
+    harness tests).  Healthy cells are banked best-of into
+    BENCH_TPU_CACHE.json (``merge_ladder_bank``) keyed by (config,
+    batch, dtype, mesh, wire_regime), so one good tunnel window banks
+    evidence incrementally across runs."""
+    from nnstreamer_tpu.obs import util as obs_util
+
+    out = {
+        "config": LADDER_CONFIG,
+        "targets": {str(b): t for b, t in LADDER_TARGETS.items()},
+        "cells": {},
+    }
+    force_cpu = os.environ.get("BENCH_MFU_LADDER_ON_CPU") == "1"
+    try:
+        import jax
+
+        ndev_avail = len(jax.devices())
+    except Exception:  # noqa: BLE001 — no backend: every cell will skip
+        ndev_avail = 0
+    fresh = {}
+    for ndev in LADDER_MESHES:
+        for dtype in LADDER_DTYPES:
+            for batch in LADDER_BATCHES:
+                label = f"b{batch}/{dtype}/x{ndev}"
+                cell = {"batch": batch, "dtype": dtype, "mesh": ndev,
+                        "target_mfu": LADDER_TARGETS[batch]}
+                out["cells"][label] = cell
+                if rep is not None and rep.remaining() < 0:
+                    cell["skipped"] = {"reason": "budget"}
+                    continue
+                if not (on_accel or force_cpu):
+                    cell["skipped"] = {"reason": "no_accel"}
+                    continue
+                if ndev > max(1, ndev_avail):
+                    cell["skipped"] = {"reason": "no_mesh",
+                                       "devices_available": ndev_avail}
+                    continue
+                h = wire_gate(f"mfu.ladder {label}")
+                regime = obs_util.wire_regime(
+                    (h or {}).get("put_150k_ms")) if h is not None \
+                    else "local"
+                if regime == "slow":
+                    # the gate already waited for the fast regime and
+                    # did not get it: record the cell as wire-skipped,
+                    # NOT failed — a later healthy window re-measures it
+                    cell["skipped"] = {"reason": "wire", "wire": h}
+                    continue
+                try:
+                    cell.update(ladder_point(batch, dtype, ndev))
+                    cell["wire_regime"] = regime
+                    if h is not None:
+                        cell["wire"] = h
+                    if cell.get("mfu") is not None:
+                        cell["meets_target"] = (
+                            cell["mfu"] >= LADDER_TARGETS[batch])
+                    cell["measured_at"] = time.strftime("%Y-%m-%d %H:%M:%S")
+                    fresh[ladder_cell_key(batch, dtype, ndev, regime)] = \
+                        dict(cell)
+                    log(f"# mfu.ladder {label}: {cell}")
+                except Exception as exc:
+                    cell["error"] = repr(exc)[:200]
+                    log(f"# mfu.ladder {label} failed: {exc!r}")
+                if rep is not None:
+                    rep.snapshot()  # each measured cell is evidence
+    if fresh:
+        bank = merge_ladder_bank(fresh)
+        out["fresh_cells"] = len(fresh)
+    else:
+        bank = load_ladder_bank()
+    # the bank rides the results so a sick-wire (or CPU) run still
+    # SHOWS the best healthy-chip evidence on file, clearly labeled
+    out["banked_cells"] = len(bank)
+    if bank:
+        out["bank"] = bank
+    best = max((c for c in bank.values() if c.get("mfu") is not None),
+               key=lambda c: c["mfu"], default=None)
+    if best is not None:
+        out["best_banked_mfu"] = best["mfu"]
+        out["best_banked_cell"] = ladder_cell_key(
+            best["batch"], best["dtype"], best["mesh"],
+            best.get("wire_regime", "fast"))
+    return out
+
+
 def run_baseline_leg(which: str, timeout: float = 1800.0, drop_env=()):
     """One CPU baseline config in an isolated subprocess (tools/
     bench_baselines.py): the TPU runtime's helper threads never contend
@@ -1001,21 +1230,21 @@ def measure_wire_health(n=20):
     the same put, minutes apart — see the verify skill's notes); recording
     the wire state alongside every bench run separates 'the code got
     slower' from 'the tunnel was sick'.  Called twice (start + end of the
-    run) so drift across the run is visible too."""
-    import jax
+    run) so drift across the run is visible too.
 
-    rng = np.random.default_rng(1)
-    arrs = [rng.integers(0, 256, 150_528).astype(np.uint8) for _ in range(n)]
-    t0 = time.perf_counter()
-    ds = [jax.device_put(a) for a in arrs]
-    jax.block_until_ready(ds)
-    put_ms = (time.perf_counter() - t0) / n * 1e3
-    t0 = time.perf_counter()
-    for d in ds:
-        out = d + 1
-    out.block_until_ready()
-    disp_ms = (time.perf_counter() - t0) / n * 1e3
-    return {"put_150k_ms": round(put_ms, 3), "dispatch_ms": round(disp_ms, 3)}
+    The probe itself lives in ``nnstreamer_tpu.obs.util`` (the watchdog
+    shares it for serving-time checks); every bench probe is also
+    PUBLISHED as the live ``nnstpu_wire_*`` gauges / ``wire_health``
+    stats provider, so a scrape during a bench run sees the same regime
+    the legs were stamped with."""
+    from nnstreamer_tpu.obs import util as obs_util
+
+    h = obs_util.probe_wire_health(n=n)
+    try:
+        obs_util.publish_wire_health(h)
+    except Exception as exc:  # publishing must never cost the probe
+        log(f"# wire-health publish failed: {exc!r}")
+    return h
 
 
 def make_wire_gate(results, on_accel, budget_left=None):
@@ -2367,6 +2596,20 @@ def main(standalone=False):
         results["mfu_vit"] = measure_mfu(model_name="vit_b16")
         log(f"# mfu_vit: {results['mfu_vit']}")
 
+    def leg_mfu_ladder():
+        # the campaign-as-code leg: runs its plumbing (matrix, per-cell
+        # wire gating, evidence-bank merge) on EVERY host — off-accel
+        # cells type themselves skipped{reason=no_accel}, sick-wire
+        # cells skipped{reason=wire}, healthy cells bank incrementally
+        results["mfu_ladder"] = measure_mfu_ladder(wire_gate, on_accel,
+                                                   rep=rep)
+        cells = results["mfu_ladder"]["cells"]
+        measured = sum(1 for c in cells.values() if "mfu" in c)
+        skipped = sum(1 for c in cells.values() if "skipped" in c)
+        log(f"# mfu.ladder: {measured} measured / {skipped} skipped of "
+            f"{len(cells)} cells; "
+            f"{results['mfu_ladder'].get('banked_cells', 0)} banked")
+
     def leg_pallas():
         if not on_accel:
             # CPU-interpreter Pallas numbers are noise (r3: 22x "slowdown",
@@ -2490,6 +2733,9 @@ def main(standalone=False):
         ("breakdown", leg_breakdown, 15.0),
         ("mfu", leg_mfu, 30.0),
         ("mfu_vit", leg_mfu_vit, 30.0),
+        # min_s 5: off-accel the ladder is pure plumbing (every cell
+        # types itself skipped) and must still emit its matrix + bank
+        ("mfu ladder", leg_mfu_ladder, 5.0),
         ("pallas", leg_pallas, 15.0),
         ("cold start ttff", leg_cold_start, 20.0),
         ("wire health end", leg_wire_end, 0.0),

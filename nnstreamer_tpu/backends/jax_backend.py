@@ -267,6 +267,11 @@ class JaxBackend(FilterBackend):
         self._mesh_axis = "dp"
         self._in_shardings = None
         self._wire_in_shardings = None
+        # utilization lane (obs/util.py): the ACTIVE compiled entry's cost
+        # fingerprint — registered per compile with its cost_analysis()
+        # flops/bytes, stamped into device_exec spans by the DeviceTracer
+        # so the reaper can compute per-dispatch MFU/roofline attribution
+        self._cost_key: Optional[str] = None
 
     # -- open/close ---------------------------------------------------------
 
@@ -551,7 +556,7 @@ class JaxBackend(FilterBackend):
             self._cache.move_to_end(key)
             (self._compiled, self._flat_compiled, self._wire_shapes,
              self._out_spec, self._single_output, self._in_shardings,
-             self._wire_in_shardings) = hit
+             self._wire_in_shardings, self._cost_key) = hit
             record_compile(self, key, "hit")
             return self._out_spec
         t0 = time.perf_counter_ns()
@@ -589,17 +594,47 @@ class JaxBackend(FilterBackend):
         self._single_output = not isinstance(outs, (tuple, list))
         out_spec = _spec_from_outputs(outs if not self._single_output else (outs,))
         self._out_spec = out_spec
+        info = cost_info(aot) if aot is not None else {}
+        self._cost_key = self._register_cost(key, in_spec, info)
         self._cache[key] = (
             jitted, self._flat_compiled, self._wire_shapes, out_spec,
             self._single_output, self._in_shardings,
-            self._wire_in_shardings,
+            self._wire_in_shardings, self._cost_key,
         )
         while len(self._cache) > self._cache_size:
             evicted_key, _ = self._cache.popitem(last=False)  # evict LRU
             record_compile(self, evicted_key, "evict")
-        record_compile(self, key, result, time.perf_counter_ns() - t0,
-                       cost_info(aot) if aot is not None else {})
+        record_compile(self, key, result, time.perf_counter_ns() - t0, info)
         return out_spec
+
+    def _register_cost(self, key, in_spec: TensorsSpec, info: dict) -> str:
+        """Register this entry's cost_analysis() profile with the
+        utilization lane (obs/util.py), keyed by a per-process executable
+        fingerprint, and return the key.  Cost-less entries (CPU hosts
+        where cost_analysis() is flaky) register too — their dispatches
+        must show up as ``mfu=None``, not vanish.  Never raises."""
+        try:
+            from ..obs import util as _obs_util
+
+            bucket = 0
+            if in_spec.tensors and in_spec.tensors[0].shape:
+                bucket = int(in_spec.tensors[0].shape[0] or 0)
+            name = getattr(self.model, "name", "") or self.name
+            fp = f"{name}:{hash(key) & 0xffffffffffff:012x}"
+            return _obs_util.register_cost(
+                fp, flops=info.get("flops"), bytes=info.get("bytes"),
+                bucket=bucket, model=name,
+                devices=int(self._mesh.devices.size)
+                if self._mesh is not None else 1)
+        except Exception:  # noqa: BLE001 — attribution must not cost a compile
+            return ""
+
+    def cost_key(self) -> Optional[str]:
+        """The active compiled entry's cost fingerprint (the
+        ``DeviceTracer`` reads this at dispatch time — same thread as
+        ``invoke`` — to stamp MFU/roofline attribution on the matching
+        ``device_exec`` span)."""
+        return self._cost_key
 
     def _aot_compile(self, jitted, structs, lru_key, entry: str):
         """AOT-lower + compile one executable entry, consulting/feeding

@@ -61,6 +61,14 @@ DEFAULTS: Dict[str, Dict[str, str]] = {
         # Device lane (obs/device.py): completion-probe queue bound for the
         # DeviceTracer reaper thread (overflow drops probes, counted).
         "device_probe_queue": "1024",
+        # Utilization lane (obs/util.py): MFU/roofline peaks (empty = the
+        # per-platform default, e.g. v5e bf16 197 TFLOP/s / 819 GB/s), the
+        # sliding window behind nnstpu_device_busy_fraction, and the
+        # minimum device idle gap that becomes a device_idle flight span.
+        "peak_tflops": "",
+        "peak_gbs": "",
+        "busy_window_s": "10",
+        "device_idle_gap_ms": "5",
         # Pipeline health watchdog (obs/watchdog.py, tracer "watchdog").
         "watchdog_interval": "1.0",         # monitor tick, seconds
         "watchdog_stall_s": "5.0",          # source/queue stall window
@@ -68,6 +76,10 @@ DEFAULTS: Dict[str, Dict[str, str]] = {
         "watchdog_device_deadline_s": "30", # device completion deadline
         "watchdog_recover": "false",        # escalate detection to recovery
         "watchdog_recover_budget": "3",     # max recovery attempts per target
+        # >0: the watchdog spot-checks the host->device wire every this
+        # many seconds and publishes nnstpu_wire_* gauges (obs/util.py) —
+        # sick tunnel regimes visible on /metrics during serving
+        "watchdog_wire_probe_s": "0",
     },
     # Host staging-buffer pool (nnstreamer_tpu/pool): the zero-copy batch
     # assembly + wire staging path.  NNSTPU_POOL_* env vars map here.

@@ -16,6 +16,11 @@ both NNStreamer papers use to find on-device bottlenecks):
 - :mod:`.device` — the device lane (``NNSTPU_TRACERS=device``): true
   device timing via completion probes, compile/executable-cache
   accounting, per-device memory gauges;
+- :mod:`.util` — the device *utilization* lane: per-executable
+  ``cost_analysis()`` registry, roofline/MFU math behind
+  ``nnstpu_mfu{device,node,bucket}``, busy/idle interval accounting
+  behind ``nnstpu_device_busy_fraction``, and the shared wire-health
+  probe published as ``nnstpu_wire_*`` gauges;
 - :mod:`.watchdog` — pipeline health watchdog (``watchdog`` tracer):
   stalled sources, wedged queues, overdue device dispatches →
   ``/healthz`` + ``nnstpu_health`` + automatic stall flight dumps;
@@ -76,7 +81,23 @@ from .spans import SpanTracer, chrome_trace, waterfall  # noqa: F401
 
 # importing .device / .watchdog registers the "device" / "watchdog" tracers
 from . import device  # noqa: E402,F401
+from . import util  # noqa: E402,F401
 from . import watchdog  # noqa: E402,F401
+from .util import (  # noqa: F401
+    DeviceUsage,
+    busy_fraction,
+    cost_of,
+    idle_gaps,
+    last_wire_health,
+    merge_intervals,
+    peak_gbs,
+    peak_tflops,
+    probe_wire_health,
+    publish_wire_health,
+    register_cost,
+    roofline,
+    wire_regime,
+)
 from . import collector  # noqa: E402,F401
 from .collector import (  # noqa: F401
     TraceCollector,

@@ -284,6 +284,9 @@ SPAN_LEGS = {
     "slot_wait": "queue",
     "device_invoke": "device",
     "device_exec": "device",
+    # dead-time spans from the device utilization lane (obs/device.py):
+    # how long the chip sat starved before this trace's dispatch ran
+    "device_idle": "device_idle",
 }
 
 
@@ -291,14 +294,18 @@ def attribute_trace(records: List[tuple]) -> Dict[str, float]:
     """Decompose one trace's spans into latency legs (nanoseconds).
 
     Returns cumulative span durations per leg (``rtt``, ``route``,
-    ``serve``, ``queue``, ``device``) plus the derived components used
-    by SLO reports:
+    ``serve``, ``queue``, ``device``, ``device_idle``) plus the derived
+    components used by SLO reports:
 
     - ``wire``: rtt − route (client↔router transport + stacks), falling
       back to rtt − serve when no router was in the path;
     - ``route_overhead``: route − serve (router forwarding cost);
     - ``dispatch``: serve − queue − device (worker-side serve time that
-      is neither queue wait nor device execution).
+      is neither queue wait nor device execution);
+    - ``device_idle``: device starvation observed before this trace's
+      dispatch executed (``device_idle`` flight spans — the reason arg
+      on the span says whether host dispatch, queue wait, or the wire
+      starved the chip).
 
     Derived values clamp at 0 (ring overflow can drop inner spans).
     """

@@ -31,6 +31,19 @@ module is the device lane of the obs subsystem:
   ``nnstpu_device_memory_bytes`` gauges at scrape time and as a dict for
   error flight dumps.  Host platforms without allocator stats simply
   contribute nothing.
+- the **utilization lane** (:mod:`.util`): every reaped dispatch is
+  joined with its executable's registered ``cost_analysis()`` profile
+  (the backend stamps a cost fingerprint per compiled entry) to compute
+  per-dispatch achieved-TFLOPs / achieved-GB/s / MFU
+  (``nnstpu_mfu{device,node,bucket}``) and a roofline classification
+  (``compute_bound``/``bandwidth_bound`` on the span args and
+  ``nnstpu_roofline_dispatches_total``); ``device_exec`` span coverage
+  feeds the windowed ``nnstpu_device_busy_fraction{device}`` gauge, and
+  idle gaps ≥ ``[obs] device_idle_gap_ms`` become ``device_idle``
+  flight spans on the device track (reason: ``wire`` under a sick
+  probe regime, ``host_dispatch`` when nothing was enqueued,
+  ``queue_wait`` otherwise) — see ``docs/observability.md``
+  "Utilization lane".
 
 The watchdog (:mod:`.watchdog`) reads :func:`oldest_inflight` to flag
 dispatches whose device completion exceeds its deadline.
@@ -45,6 +58,7 @@ from typing import Dict, List, Optional, Tuple
 
 from . import hooks as _hooks
 from . import spans
+from . import util as _util
 from .metrics import REGISTRY, MetricsRegistry
 from .tracers import Tracer
 
@@ -327,7 +341,14 @@ class DeviceTracer(Tracer):
         self._running = False
         self._lock = threading.Lock()
         self._by_element: Dict[str, List[int]] = {}  # name -> [count, ns]
-        self._by_device: Dict[str, List[int]] = {}  # label -> [count, ns]
+        # label -> [count, ns, flops_sum, cost_missing_count]: the
+        # utilization view keeps EVERY dispatch (cost-less ones count in
+        # the missing column and read mfu=None — never silently omitted)
+        self._by_device: Dict[str, List] = {}
+        # label -> (last completion ts_ns, probe queue empty then): the
+        # dead-time tracker behind device_idle gap spans
+        self._last_end: Dict[str, tuple] = {}
+        self._usage = _util.DeviceUsage()
         self._sent = 0
         self._completed = 0
         self._dropped = 0
@@ -362,6 +383,32 @@ class DeviceTracer(Tracer):
             "Completion probes dropped on reaper-queue overflow",
             labelnames=("pipeline",),
         )
+        # utilization lane: per-dispatch MFU (cost_analysis flops over
+        # measured enqueue->done time vs the configured peak), roofline
+        # classification counts, and the windowed busy fraction
+        self._mfu_gauge = self._registry.gauge(
+            "nnstpu_mfu",
+            "Model FLOPs utilization of the last observed dispatch "
+            "(cost_analysis flops / device time / peak; see [obs] "
+            "peak_tflops / NNSTPU_PEAK_TFLOPS)",
+            labelnames=("device", "node", "bucket"),
+        )
+        self._bound_counter = self._registry.counter(
+            "nnstpu_roofline_dispatches_total",
+            "Observed dispatches by roofline classification (arithmetic "
+            "intensity vs the peak_tflops/peak_gbs ridge point)",
+            labelnames=("pipeline", "device", "bound"),
+        )
+        self._busy_gauge = self._registry.gauge(
+            "nnstpu_device_busy_fraction",
+            "Fraction of the trailing [obs] busy_window_s each device "
+            "spent executing observed dispatches (device_exec coverage)",
+            labelnames=("device",),
+        )
+        self._peak_tf = _util.peak_tflops()
+        self._peak_gb = _util.peak_gbs()
+        self._idle_gap_ns = int(_util.configured_idle_gap_ms() * 1e6)
+        self._busy_handle = self._registry.add_collector(self._collect_busy)
         self._mem_handle = register_memory_gauges(self._registry)
         self._running = True
         try:
@@ -392,6 +439,9 @@ class DeviceTracer(Tracer):
         if self._mem_handle is not None:
             self._registry.remove_collector(self._mem_handle)
             self._mem_handle = None
+        if getattr(self, "_busy_handle", None) is not None:
+            self._registry.remove_collector(self._busy_handle)
+            self._busy_handle = None
         spans._deactivate()
 
     # -- hook callbacks ------------------------------------------------------
@@ -402,6 +452,17 @@ class DeviceTracer(Tracer):
         ctx = spans.context_of(frame)
         trace_id, parent = (ctx[0], ctx[1]) if ctx is not None else (0, 0)
         head = outs[0] if isinstance(outs, (tuple, list)) and outs else outs
+        # the executable's cost fingerprint, read on the dispatching
+        # thread so it matches the geometry just invoked (a renegotiation
+        # between enqueue and reap must not mislabel this dispatch)
+        cost_key = None
+        backend = getattr(node, "backend", None)
+        ck_fn = getattr(backend, "cost_key", None)
+        if ck_fn is not None:
+            try:
+                cost_key = ck_fn()
+            except Exception:  # noqa: BLE001 — attribution is best-effort
+                cost_key = None
         pid = next(spans._ids)
         fid = next(spans._flow_ids)
         # flow START on the dispatching (host) thread, inside the host
@@ -419,7 +480,7 @@ class DeviceTracer(Tracer):
             with _inflight_lock:
                 _inflight[pid] = (t0_ns, node.name)
             self._q.append((pid, node.name, head, t0_ns, trace_id, parent,
-                            fid))
+                            fid, cost_key))
             self._cv.notify()
 
     def _on_compile(self, backend, key, result, dur_ns, info) -> None:
@@ -439,13 +500,14 @@ class DeviceTracer(Tracer):
                     self._cv.wait(0.5)
                 if not self._running and not self._q:
                     return
-                pid, name, head, t0, trace_id, parent, fid = self._q.popleft()
+                (pid, name, head, t0, trace_id, parent, fid,
+                 cost_key) = self._q.popleft()
             try:
                 shards = _mesh_shards(head)
                 if shards is not None:
                     dur = self._reap_sharded(
                         shards, name, t0, trace_id, parent, fid,
-                        pipeline_name)
+                        pipeline_name, cost_key)
                 else:
                     try:
                         import jax
@@ -458,23 +520,21 @@ class DeviceTracer(Tracer):
                     t_done = now_ns()
                     dur = max(0, t_done - t0)
                     label = _head_device_label(head)
+                    track = threading.current_thread().name
                     sid = next(spans._ids)
+                    args = {"element": name, "device": label}
+                    args.update(self._utilization(
+                        label, track, name, t0, dur, trace_id, parent,
+                        cost_key, pipeline_name))
                     # both records land on THIS thread: the device track
                     spans._recorder.append((
-                        spans.PH_FLOW_END, t0, 0,
-                        threading.current_thread().name, "device", "device",
+                        spans.PH_FLOW_END, t0, 0, track, "device", "device",
                         trace_id, fid, 0, None))
                     spans._recorder.append((
-                        spans.PH_COMPLETE, t0, dur,
-                        threading.current_thread().name, "device_exec",
-                        "device", trace_id, sid, parent,
-                        {"element": name, "device": label}))
+                        spans.PH_COMPLETE, t0, dur, track, "device_exec",
+                        "device", trace_id, sid, parent, args))
                     self._hist.observe(dur / 1e9, pipeline=pipeline_name,
                                        element=name, device=label)
-                    with self._lock:
-                        d = self._by_device.setdefault(label, [0, 0])
-                        d[0] += 1
-                        d[1] += dur
                 self._dispatches.inc(1, pipeline=pipeline_name, element=name)
                 with self._lock:
                     self._completed += 1
@@ -491,17 +551,20 @@ class DeviceTracer(Tracer):
                     _inflight.pop(pid, None)
 
     def _reap_sharded(self, shards, name, t0, trace_id, parent, fid,
-                      pipeline_name) -> int:
+                      pipeline_name, cost_key=None) -> int:
         """Per-mesh-device completion for a sharded dispatch: each shard's
         readiness is observed individually and recorded on its OWN
         ``device:<platform>:<ordinal>`` Perfetto track (the recorder keys
         rows by the tid string, not the OS thread, so one reaper thread
         fans out to ndev rows) with a per-device
         ``nnstpu_device_exec_seconds{device=...}`` observation — shard
-        skew shows up as differing span lengths side by side.  Returns the
+        skew shows up as differing span lengths side by side.  The
+        executable's cost_analysis() covers the WHOLE mesh program, so
+        each shard is attributed flops/ndev for its MFU.  Returns the
         whole-dispatch duration (= the slowest shard observed)."""
         flow_done = False
         dur = 0
+        nshards = max(1, len(shards))
         for label, _ordinal, data in shards:
             wait = getattr(data, "block_until_ready", None)
             if wait is not None:
@@ -518,26 +581,140 @@ class DeviceTracer(Tracer):
                     trace_id, fid, 0, None))
                 flow_done = True
             sid = next(spans._ids)
+            args = {"element": name, "device": label}
+            args.update(self._utilization(
+                label, track, name, t0, shard_dur, trace_id, parent,
+                cost_key, pipeline_name, nshards=nshards))
             spans._recorder.append((
                 spans.PH_COMPLETE, t0, shard_dur, track, "device_exec",
-                "device", trace_id, sid, parent,
-                {"element": name, "device": label}))
+                "device", trace_id, sid, parent, args))
             self._hist.observe(shard_dur / 1e9, pipeline=pipeline_name,
                                element=name, device=label)
-            with self._lock:
-                d = self._by_device.setdefault(label, [0, 0])
-                d[0] += 1
-                d[1] += shard_dur
         return dur
+
+    # -- utilization attribution ---------------------------------------------
+
+    def _utilization(self, label, track, name, t0, dur, trace_id, parent,
+                     cost_key, pipeline_name, nshards: int = 1) -> dict:
+        """Per-dispatch efficiency attribution for one device: roofline
+        args for the ``device_exec`` span, the ``nnstpu_mfu`` gauge and
+        roofline counter, the busy-interval feed, the ``device_idle``
+        gap span when the device sat starved since its last observed
+        completion, and the by-device aggregates.  Cost-less dispatches
+        (no registered flops) still count everywhere, with ``mfu: None``
+        — throughput accounting stays exact.  Never raises."""
+        extra: dict = {}
+        try:
+            t_done = t0 + dur
+            info = _util.cost_of(cost_key)
+            flops = bytes_ = None
+            bucket = 0
+            if info is not None:
+                bucket = int(info.get("bucket") or 0)
+                flops = info.get("flops")
+                bytes_ = info.get("bytes")
+                if flops:
+                    flops = flops / nshards
+                if bytes_:
+                    bytes_ = bytes_ / nshards
+                extra["cost_key"] = cost_key
+                if flops:
+                    extra["flops"] = flops
+                if bytes_:
+                    extra["bytes"] = bytes_
+            rl = _util.roofline(flops, bytes_, dur / 1e9,
+                                self._peak_tf, self._peak_gb)
+            sig = lambda v: float(f"{v:.4g}")  # noqa: E731 — 4 significant
+            extra["mfu"] = sig(rl["mfu"]) if rl["mfu"] is not None else None
+            extra["roofline"] = rl["bound"]
+            if rl["achieved_tflops"] is not None:
+                extra["achieved_tflops"] = sig(rl["achieved_tflops"])
+            if rl["achieved_gbs"] is not None:
+                extra["achieved_gbs"] = sig(rl["achieved_gbs"])
+            if rl["intensity"] is not None:
+                extra["intensity"] = sig(rl["intensity"])
+            if rl["mfu"] is not None:
+                self._mfu_gauge.set(rl["mfu"], device=label, node=name,
+                                    bucket=str(bucket))
+            self._bound_counter.inc(1, pipeline=pipeline_name, device=label,
+                                    bound=rl["bound"])
+            # dead-time accounting: a gap since this device's last
+            # observed completion >= [obs] device_idle_gap_ms becomes a
+            # device_idle span on its track, attributed to the waiting
+            # dispatch's trace so Perfetto shows WHY the chip starved
+            prev = self._last_end.get(label)
+            if prev is not None and t0 - prev[0] >= self._idle_gap_ns:
+                gap = t0 - prev[0]
+                wire = _util.last_wire_health()
+                if wire is not None and wire.get("regime") == "slow":
+                    reason = "wire"
+                elif prev[1]:
+                    # nothing was enqueued when the device went idle: the
+                    # host (dispatch path / upstream queue) starved it
+                    reason = "host_dispatch"
+                else:
+                    reason = "queue_wait"
+                spans._recorder.append((
+                    spans.PH_COMPLETE, prev[0], gap, track, "device_idle",
+                    "device", trace_id, next(spans._ids), parent,
+                    {"device": label, "gap_ms": round(gap / 1e6, 3),
+                     "reason": reason}))
+            with self._cv:
+                q_empty = not self._q
+            self._last_end[label] = (t_done, q_empty)
+            self._usage.add(label, t0, t_done)
+            # set the busy gauge here too (windowed up to this
+            # completion): the scrape-time collector keeps it fresh while
+            # the tracer is live, this keeps the series present after
+            # stop() removed the collector (CI scrapes after the run)
+            frac = self._usage.busy_fractions(now_ns=t_done).get(label)
+            if frac is not None:
+                self._busy_gauge.set(round(frac, 6), device=label)
+            with self._lock:
+                d = self._by_device.setdefault(label, [0, 0, 0.0, 0])
+                d[0] += 1
+                d[1] += dur
+                if flops:
+                    d[2] += flops
+                else:
+                    d[3] += 1
+        except Exception:  # noqa: BLE001 — attribution must never kill a probe
+            import logging
+
+            logging.getLogger("nnstreamer_tpu.obs").exception(
+                "utilization attribution failed for %s", name)
+        return extra
+
+    def _collect_busy(self) -> None:
+        """Scrape-time collector: windowed busy fraction per device from
+        observed device_exec coverage ([obs] busy_window_s)."""
+        for device, frac in self._usage.busy_fractions().items():
+            self._busy_gauge.set(round(frac, 6), device=device)
 
     def summary(self) -> dict:
         with self._cv:
             inflight = len(self._q)
+        busy = self._usage.busy_fractions()
+        peak_tf = getattr(self, "_peak_tf", None) or _util.peak_tflops()
         with self._lock:
             per = {name: {"count": c[0], "device_ns": c[1]}
                    for name, c in self._by_element.items()}
-            per_dev = {label: {"count": c[0], "device_ns": c[1]}
-                       for label, c in self._by_device.items()}
+            per_dev = {}
+            for label, c in self._by_device.items():
+                count, ns, flops_sum, missing = c[0], c[1], c[2], c[3]
+                # aggregate MFU over the device's observed busy time;
+                # None (not omission) when no dispatch carried cost info —
+                # count/device_ns stay exact either way
+                mfu = None
+                if flops_sum and ns > 0:
+                    mfu = float(
+                        f"{flops_sum / (ns / 1e9) / (peak_tf * 1e12):.4g}")
+                entry = {"count": count, "device_ns": ns, "mfu": mfu,
+                         "cost_missing": missing}
+                frac = busy.get(label)
+                if frac is not None:
+                    entry["busy_fraction"] = round(frac, 4)
+                per_dev[label] = entry
             total_ns = sum(c[1] for c in self._by_element.values())
             out = {
                 "dispatches": self._sent,

@@ -1,0 +1,441 @@
+"""Device *utilization* lane: live MFU / roofline attribution.
+
+The device lane (:mod:`.device`) answers "how long did each dispatch
+execute"; this module turns those durations into *efficiency*: was the
+chip busy, idle, compute-bound or wire-starved — the instrument panel
+the on-chip performance campaign (ROADMAP item 1, TVM's measure→search→
+cache→serve discipline) steers by.
+
+- **Per-executable cost registry** — ``backends/jax_backend.py`` calls
+  :func:`register_cost` once per compiled entry with the executable's
+  ``cost_analysis()`` flops/bytes (keyed by a per-process executable
+  fingerprint); the :class:`~.device.DeviceTracer` reaper looks the key
+  back up per dispatch and computes achieved-TFLOPs / achieved-GB/s /
+  MFU for the ``nnstpu_mfu{device,node,bucket}`` gauge and the
+  ``device_exec`` span args.
+- **Roofline math** — :func:`roofline` classifies an executable by
+  arithmetic intensity against the configured peaks' ridge point
+  (``compute_bound`` / ``bandwidth_bound``); peaks come from
+  ``NNSTPU_PEAK_TFLOPS`` / ini ``[obs] peak_tflops`` (and the ``_gbs``
+  twins) over per-platform defaults.  Synthetic/partial payloads (zero
+  or missing flops, bytes-only entries, CPU hosts where
+  ``cost_analysis()`` is flaky) degrade to ``mfu=None`` +
+  ``bound="unknown"`` — never an exception, never a silent drop.
+- **Dead-time accounting** — :func:`merge_intervals` /
+  :func:`busy_fraction` / :func:`idle_gaps` compute windowed busy/idle
+  coverage from ``device_exec`` span intervals (overlapping multi-device
+  spans merge per device); :class:`DeviceUsage` is the bounded
+  per-device interval store behind
+  ``nnstpu_device_busy_fraction{device}``.
+- **Wire health as live metrics** — :func:`probe_wire_health` is the
+  single implementation of the 150 KB host→device put spot-check
+  (``bench.py`` delegates here); :func:`publish_wire_health` republishes
+  any probe as ``nnstpu_wire_put_ms`` / ``nnstpu_wire_dispatch_ms`` /
+  ``nnstpu_wire_regime`` gauges plus a ``wire_health`` stats provider,
+  so sick-wire regimes are visible on ``/metrics`` during serving, not
+  only inside bench runs (the watchdog can probe on an interval —
+  ``[obs] watchdog_wire_probe_s``).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict, deque
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from .metrics import REGISTRY, MetricsRegistry
+
+# -- peak configuration -------------------------------------------------------
+
+# Peak compute (TFLOP/s) and memory bandwidth (GB/s) per platform, the
+# denominators of MFU and the ridge point.  The TPU row is the v5e bf16
+# spec (197 TFLOP/s, 819 GB/s HBM — BENCH_NOTES targets assume it); the
+# CPU row is a deliberately round laptop-class envelope so CPU-host runs
+# produce plausible, clearly-not-chip numbers instead of dividing by a
+# TPU peak.
+PEAK_TFLOPS_DEFAULTS: Dict[str, float] = {
+    "tpu": 197.0,
+    "gpu": 60.0,
+    "cpu": 0.5,
+}
+PEAK_GBS_DEFAULTS: Dict[str, float] = {
+    "tpu": 819.0,
+    "gpu": 900.0,
+    "cpu": 40.0,
+}
+
+WIRE_SICK_PUT_MS = 5.0  # >5 ms per 150 KB put = the slow tunnel regime
+
+
+def _default_platform() -> str:
+    try:
+        import jax
+
+        return jax.default_backend()
+    except Exception:  # noqa: BLE001 — no backend at all
+        return "cpu"
+
+
+def _peak_from(env_key: str, conf_key: str, defaults: Dict[str, float],
+               platform: Optional[str]) -> float:
+    import os
+
+    val = os.environ.get(env_key)
+    if val in (None, ""):
+        from ..conf import conf
+
+        val = conf.get("obs", conf_key, "")
+    if val not in (None, ""):
+        try:
+            peak = float(val)
+            if peak > 0:
+                return peak
+        except ValueError:
+            pass  # malformed override falls through to the platform default
+    plat = platform or _default_platform()
+    return defaults.get(plat, defaults["cpu"])
+
+
+def peak_tflops(platform: Optional[str] = None) -> float:
+    """Peak compute in TFLOP/s: ``NNSTPU_PEAK_TFLOPS`` over ini ``[obs]
+    peak_tflops`` over the per-platform default."""
+    return _peak_from("NNSTPU_PEAK_TFLOPS", "peak_tflops",
+                      PEAK_TFLOPS_DEFAULTS, platform)
+
+
+def peak_gbs(platform: Optional[str] = None) -> float:
+    """Peak memory bandwidth in GB/s: ``NNSTPU_PEAK_GBS`` over ini
+    ``[obs] peak_gbs`` over the per-platform default."""
+    return _peak_from("NNSTPU_PEAK_GBS", "peak_gbs",
+                      PEAK_GBS_DEFAULTS, platform)
+
+
+# -- per-executable cost registry ---------------------------------------------
+
+_COST_CAP = 256  # executables are LRU-bounded per backend; this bounds all
+
+_cost_lock = threading.Lock()
+_costs: "OrderedDict[str, dict]" = OrderedDict()
+
+
+def register_cost(key: str, flops: Optional[float] = None,
+                  bytes: Optional[float] = None, **meta) -> str:
+    """Record one compiled executable's cost profile under ``key`` (the
+    backend's executable fingerprint).  ``flops``/``bytes`` may be None
+    or 0 — CPU hosts and fused wrappers sometimes expose neither; the
+    entry still registers so every dispatch resolves to *something* and
+    cost-less executables show up as ``mfu=None`` instead of vanishing
+    from the efficiency view.  Returns ``key``."""
+    entry = dict(meta)
+    entry["flops"] = float(flops) if flops else None
+    entry["bytes"] = float(bytes) if bytes else None
+    with _cost_lock:
+        _costs[key] = entry
+        _costs.move_to_end(key)
+        while len(_costs) > _COST_CAP:
+            _costs.popitem(last=False)
+    return key
+
+
+def cost_of(key: Optional[str]) -> Optional[dict]:
+    """The registered cost profile for ``key``, or None."""
+    if not key:
+        return None
+    with _cost_lock:
+        entry = _costs.get(key)
+        return dict(entry) if entry is not None else None
+
+
+def clear_costs() -> None:
+    """Drop every registered cost profile (test isolation)."""
+    with _cost_lock:
+        _costs.clear()
+
+
+# -- roofline math ------------------------------------------------------------
+
+def roofline(flops: Optional[float], bytes_: Optional[float], dur_s: float,
+             peak_tf: Optional[float] = None,
+             peak_gb: Optional[float] = None) -> dict:
+    """One dispatch on the roofline.
+
+    Returns ``{achieved_tflops, achieved_gbs, mfu, intensity, ridge,
+    bound}`` where ``bound`` is ``"compute_bound"`` / ``"bandwidth_bound"``
+    / ``"unknown"``.  Degenerate inputs (no duration, zero/missing flops,
+    bytes-only entries) fill None + ``"unknown"`` instead of raising —
+    the reaper calls this per dispatch and must never die on a flaky
+    ``cost_analysis()``.  A bytes-only entry (flops absent, bytes known)
+    is pure data movement and classifies ``bandwidth_bound``."""
+    peak_tf = peak_tf if peak_tf is not None else peak_tflops()
+    peak_gb = peak_gb if peak_gb is not None else peak_gbs()
+    out: dict = {
+        "achieved_tflops": None,
+        "achieved_gbs": None,
+        "mfu": None,
+        "intensity": None,
+        "ridge": round(peak_tf * 1e12 / (peak_gb * 1e9), 3)
+        if peak_gb > 0 else None,
+        "bound": "unknown",
+    }
+    try:
+        dur_s = float(dur_s)
+        flops = float(flops) if flops else None
+        bytes_ = float(bytes_) if bytes_ else None
+    except (TypeError, ValueError):
+        return out
+    if dur_s <= 0.0:
+        return out
+    if flops:
+        out["achieved_tflops"] = flops / dur_s / 1e12
+        if peak_tf > 0:
+            out["mfu"] = flops / dur_s / (peak_tf * 1e12)
+    if bytes_:
+        out["achieved_gbs"] = bytes_ / dur_s / 1e9
+    if flops and bytes_:
+        out["intensity"] = flops / bytes_
+        if out["ridge"] is not None:
+            out["bound"] = ("compute_bound"
+                            if out["intensity"] >= out["ridge"]
+                            else "bandwidth_bound")
+    elif bytes_ and not flops:
+        out["bound"] = "bandwidth_bound"
+    return out
+
+
+# -- busy/idle interval accounting --------------------------------------------
+
+def merge_intervals(intervals: Iterable[Tuple[int, int]]
+                    ) -> List[Tuple[int, int]]:
+    """Union of ``(start, end)`` intervals, sorted and coalesced —
+    overlapping spans (a mesh dispatch observed per shard, concurrent
+    streams on one device) count their covered time once."""
+    ivs = sorted((int(s), int(e)) for s, e in intervals if e > s)
+    out: List[Tuple[int, int]] = []
+    for s, e in ivs:
+        if out and s <= out[-1][1]:
+            if e > out[-1][1]:
+                out[-1] = (out[-1][0], e)
+        else:
+            out.append((s, e))
+    return out
+
+
+def busy_fraction(intervals: Iterable[Tuple[int, int]], t0: int,
+                  t1: int) -> Optional[float]:
+    """Fraction of the window ``[t0, t1)`` covered by the (possibly
+    overlapping) intervals; None for an empty window."""
+    if t1 <= t0:
+        return None
+    covered = 0
+    for s, e in merge_intervals(intervals):
+        s, e = max(s, t0), min(e, t1)
+        if e > s:
+            covered += e - s
+    return covered / (t1 - t0)
+
+
+def idle_gaps(intervals: Iterable[Tuple[int, int]], min_gap: int,
+              t0: Optional[int] = None, t1: Optional[int] = None
+              ) -> List[Tuple[int, int]]:
+    """``(start, duration)`` of every idle gap ≥ ``min_gap`` between the
+    merged busy intervals (window edges included when ``t0``/``t1`` are
+    given)."""
+    merged = merge_intervals(intervals)
+    gaps: List[Tuple[int, int]] = []
+    if not merged:
+        if t0 is not None and t1 is not None and t1 - t0 >= min_gap:
+            gaps.append((t0, t1 - t0))
+        return gaps
+    if t0 is not None and merged[0][0] - t0 >= min_gap:
+        gaps.append((t0, merged[0][0] - t0))
+    for (_, e0), (s1, _) in zip(merged, merged[1:]):
+        if s1 - e0 >= min_gap:
+            gaps.append((e0, s1 - e0))
+    if t1 is not None and t1 - merged[-1][1] >= min_gap:
+        gaps.append((merged[-1][1], t1 - merged[-1][1]))
+    return gaps
+
+
+DEFAULT_BUSY_WINDOW_S = 10.0
+DEFAULT_IDLE_GAP_MS = 5.0
+DEFAULT_USAGE_CAP = 512
+
+
+def configured_busy_window_s() -> float:
+    """Sliding window for the busy-fraction gauge: ini ``[obs]
+    busy_window_s`` (env ``NNSTPU_OBS_BUSY_WINDOW_S``)."""
+    from ..conf import conf
+
+    try:
+        w = conf.get_float("obs", "busy_window_s", DEFAULT_BUSY_WINDOW_S)
+    except ValueError:
+        return DEFAULT_BUSY_WINDOW_S
+    return w if w > 0 else DEFAULT_BUSY_WINDOW_S
+
+
+def configured_idle_gap_ms() -> float:
+    """Minimum device idle gap that becomes a ``device_idle`` flight
+    span: ini ``[obs] device_idle_gap_ms``."""
+    from ..conf import conf
+
+    try:
+        g = conf.get_float("obs", "device_idle_gap_ms", DEFAULT_IDLE_GAP_MS)
+    except ValueError:
+        return DEFAULT_IDLE_GAP_MS
+    return g if g >= 0 else DEFAULT_IDLE_GAP_MS
+
+
+class DeviceUsage:
+    """Bounded per-device store of observed busy intervals.
+
+    The :class:`~.device.DeviceTracer` reaper feeds one ``(enqueue,
+    done)`` interval per observed dispatch (per shard under mesh
+    dispatch); :meth:`busy_fractions` computes the sliding-window busy
+    fraction per device at scrape time.  Intervals are host perf-counter
+    nanoseconds — the same clock as every span.
+    """
+
+    def __init__(self, cap: int = DEFAULT_USAGE_CAP):
+        self._cap = max(8, int(cap))
+        self._lock = threading.Lock()
+        self._by_device: Dict[str, deque] = {}
+
+    def add(self, device: str, start_ns: int, end_ns: int) -> None:
+        if end_ns <= start_ns:
+            end_ns = start_ns + 1  # instantaneous completions still count
+        with self._lock:
+            dq = self._by_device.get(device)
+            if dq is None:
+                dq = self._by_device[device] = deque(maxlen=self._cap)
+            dq.append((int(start_ns), int(end_ns)))
+
+    def devices(self) -> List[str]:
+        with self._lock:
+            return sorted(self._by_device)
+
+    def intervals(self, device: str) -> List[Tuple[int, int]]:
+        with self._lock:
+            return list(self._by_device.get(device, ()))
+
+    def busy_fractions(self, window_ns: Optional[int] = None,
+                       now_ns: Optional[int] = None) -> Dict[str, float]:
+        """{device: busy fraction over the trailing window}.  The window
+        is clipped to start no earlier than the oldest retained interval
+        so a bounded ring never reads as idle time it simply forgot."""
+        if window_ns is None:
+            window_ns = int(configured_busy_window_s() * 1e9)
+        now = now_ns if now_ns is not None else time.perf_counter_ns()
+        out: Dict[str, float] = {}
+        with self._lock:
+            snap = {d: list(dq) for d, dq in self._by_device.items()}
+        for device, ivs in snap.items():
+            if not ivs:
+                continue
+            t0 = max(now - window_ns, min(s for s, _ in ivs))
+            frac = busy_fraction(ivs, t0, now)
+            if frac is not None:
+                out[device] = frac
+        return out
+
+    def clear(self) -> None:
+        with self._lock:
+            self._by_device.clear()
+
+
+# -- wire health: one probe, published live -----------------------------------
+
+_wire_lock = threading.Lock()
+_wire_last: Optional[dict] = None
+_wire_registered = False
+
+
+def wire_regime(put_ms: Optional[float]) -> str:
+    """``"fast"`` / ``"slow"`` classification of a 150 KB put time (the
+    oscillating-tunnel brackets bench has always recorded)."""
+    if put_ms is None:
+        return "unknown"
+    return "slow" if put_ms > WIRE_SICK_PUT_MS else "fast"
+
+
+def probe_wire_health(n: int = 20, nbytes: int = 150_528) -> dict:
+    """Spot-check the host→device wire (150 KB flat put + dispatch
+    rate) — the single implementation behind ``bench.measure_wire_health``
+    and the watchdog's optional serving-time probe.  The tunneled chip's
+    transfer path oscillates >100× (0.3 ms ↔ 30 ms for the same put),
+    so the regime must be measured next to whatever cites it."""
+    import numpy as np
+
+    import jax
+
+    rng = np.random.default_rng(1)
+    arrs = [rng.integers(0, 256, nbytes).astype(np.uint8) for _ in range(n)]
+    t0 = time.perf_counter()
+    ds = [jax.device_put(a) for a in arrs]
+    jax.block_until_ready(ds)
+    put_ms = (time.perf_counter() - t0) / n * 1e3
+    t0 = time.perf_counter()
+    for d in ds:
+        out = d + 1
+    out.block_until_ready()
+    disp_ms = (time.perf_counter() - t0) / n * 1e3
+    return {"put_150k_ms": round(put_ms, 3), "dispatch_ms": round(disp_ms, 3)}
+
+
+def last_wire_health() -> Optional[dict]:
+    """The most recently published wire-health probe (with its regime
+    and timestamp), or None if nothing probed yet this process."""
+    with _wire_lock:
+        return dict(_wire_last) if _wire_last is not None else None
+
+
+def publish_wire_health(health: dict,
+                        registry: Optional[MetricsRegistry] = None) -> dict:
+    """Republish one wire-health probe as live gauges + stats provider.
+
+    Sets ``nnstpu_wire_put_ms`` / ``nnstpu_wire_dispatch_ms`` /
+    ``nnstpu_wire_regime`` (0 fast, 1 slow) and registers a
+    ``wire_health`` provider in ``/stats.json`` on first publish — the
+    shared surface bench legs and the serving watchdog both feed, so a
+    sick tunnel is visible on any scrape.  Returns the stamped record."""
+    global _wire_last, _wire_registered
+    registry = registry if registry is not None else REGISTRY
+    put_ms = health.get("put_150k_ms")
+    regime = wire_regime(put_ms)
+    record = dict(health)
+    record["regime"] = regime
+    record["probed_at"] = time.time()
+    with _wire_lock:
+        _wire_last = record
+        first = not _wire_registered
+        _wire_registered = True
+    if put_ms is not None:
+        registry.gauge(
+            "nnstpu_wire_put_ms",
+            "Host-to-device wire spot-check: ms per 150 KB flat put",
+        ).set(float(put_ms))
+    if health.get("dispatch_ms") is not None:
+        registry.gauge(
+            "nnstpu_wire_dispatch_ms",
+            "Host-to-device wire spot-check: ms per trivial dispatch",
+        ).set(float(health["dispatch_ms"]))
+    registry.gauge(
+        "nnstpu_wire_regime",
+        "Wire regime from the last spot-check (0 fast, 1 slow/sick)",
+    ).set(1.0 if regime == "slow" else 0.0)
+    if first:
+        from .export import register_stats
+
+        register_stats("wire_health", lambda: last_wire_health() or {})
+    return dict(record)
+
+
+def reset_wire_health() -> None:
+    """Forget the last probe + provider registration (test isolation)."""
+    global _wire_last, _wire_registered
+    from .export import unregister_stats
+
+    with _wire_lock:
+        _wire_last = None
+        _wire_registered = False
+    unregister_stats("wire_health")

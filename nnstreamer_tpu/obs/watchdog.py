@@ -136,6 +136,12 @@ class PipelineWatchdog(Tracer):
                     "obs", "watchdog_recover_budget", 3)
             except ValueError:
                 self._recover_budget = 3
+        # >0: spot-check the host->device wire every this many seconds
+        # and publish it live (obs/util.py nnstpu_wire_* gauges + the
+        # wire_health stats provider — the same probe bench.py uses), so
+        # a sick tunnel regime is visible on /metrics DURING serving
+        self._wire_probe_s = self._conf_float("watchdog_wire_probe_s", 0.0)
+        self._last_wire_probe = 0.0
         self._gauge = self._registry.gauge(
             "nnstpu_health",
             "Pipeline health as judged by the watchdog (1 healthy, "
@@ -249,6 +255,17 @@ class PipelineWatchdog(Tracer):
                 continue
             with self._lock:
                 self._checks += 1
+            if (self._wire_probe_s > 0
+                    and time.monotonic() - self._last_wire_probe
+                    >= self._wire_probe_s):
+                self._last_wire_probe = time.monotonic()
+                try:
+                    from . import util as _util
+
+                    _util.publish_wire_health(
+                        _util.probe_wire_health(n=4), self._registry)
+                except Exception:  # noqa: BLE001 — a failed probe must
+                    pass           # never flag health or kill the monitor
             try:
                 reasons = self._evaluate()
             except Exception:  # noqa: BLE001 — the monitor must survive
@@ -372,6 +389,13 @@ class PipelineWatchdog(Tracer):
         degraded = degraded_snapshot()
         if degraded:
             out["degraded"] = degraded
+        # last published wire-health probe (ours or bench's): the sick-
+        # tunnel regime next to the health verdict it often explains
+        from .util import last_wire_health
+
+        wire = last_wire_health()
+        if wire is not None:
+            out["wire"] = wire
         return out
 
 

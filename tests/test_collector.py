@@ -6,6 +6,7 @@ on one timeline through a live 2-worker fleet)."""
 
 import json
 import socket
+import time
 import urllib.request
 
 import numpy as np
@@ -321,12 +322,21 @@ class TestFleetNesting:
 
             collector = TraceCollector()
             collector.add_local("inproc")
-            index = collector.spans_by_trace()
-            by_name = {}
-            for r in index.get(tid, ()):
-                by_name.setdefault(r[4], r)
             chain = ["nnsq_rtt", "nnsq_route", "nnsq_serve",
                      "device_invoke"]
+            # bounded poll: the worker records nnsq_serve AFTER sending
+            # the reply, so on a loaded 1-core host its thread can be
+            # descheduled past the client's recv (the test_spans race)
+            deadline = time.monotonic() + 10.0
+            by_name = {}
+            while time.monotonic() < deadline:
+                index = collector.spans_by_trace()
+                by_name = {}
+                for r in index.get(tid, ()):
+                    by_name.setdefault(r[4], r)
+                if set(chain) <= set(by_name):
+                    break
+                time.sleep(0.02)
             assert set(chain) <= set(by_name), sorted(by_name)
             for outer, inner in zip(chain, chain[1:]):
                 o, i = by_name[outer], by_name[inner]

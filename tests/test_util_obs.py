@@ -1,0 +1,452 @@
+"""The device utilization lane (obs/util.py + device-lane wiring):
+roofline math over synthetic cost payloads, busy-fraction windowing over
+overlapping multi-device spans, per-dispatch MFU attribution on a CPU
+host (where ``cost_analysis()`` may be flaky), ``device_idle`` dead-time
+spans, live wire-health gauges, and the bench MFU-ladder evidence bank.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu import Pipeline
+from nnstreamer_tpu.backends.jax_backend import JaxBackend, JaxModel
+from nnstreamer_tpu.buffer import Frame
+from nnstreamer_tpu.elements.filter import TensorFilter
+from nnstreamer_tpu.elements.sink import TensorSink
+from nnstreamer_tpu.elements.testsrc import DataSrc
+from nnstreamer_tpu.graph.node import Node
+from nnstreamer_tpu.obs import hooks, spans
+from nnstreamer_tpu.obs import util as obs_util
+from nnstreamer_tpu.obs.collector import attribute_trace
+from nnstreamer_tpu.obs.device import DeviceTracer, cost_info
+from nnstreamer_tpu.obs.export import render_text, unregister_stats
+from nnstreamer_tpu.obs.metrics import MetricsRegistry
+from nnstreamer_tpu.spec import TensorSpec, TensorsSpec
+
+
+def _wait_for(cond, timeout=10.0, step=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(step)
+    return cond()
+
+
+@pytest.fixture(autouse=True)
+def _reset_util_state():
+    yield
+    obs_util.clear_costs()
+    obs_util.reset_wire_health()
+    unregister_stats("wire_health")
+
+
+# -- roofline math over synthetic cost_analysis payloads ----------------------
+
+class TestRoofline:
+    def test_compute_vs_bandwidth_bound(self):
+        # peak 100 TFLOP/s over 100 GB/s -> ridge = 1000 flops/byte
+        rl = obs_util.roofline(2e12, 1e9, 1.0, peak_tf=100.0, peak_gb=100.0)
+        assert rl["intensity"] == 2000.0
+        assert rl["bound"] == "compute_bound"
+        assert rl["mfu"] == pytest.approx(0.02)
+        assert rl["achieved_tflops"] == pytest.approx(2.0)
+        assert rl["achieved_gbs"] == pytest.approx(1.0)
+        low = obs_util.roofline(1e9, 1e9, 1.0, peak_tf=100.0, peak_gb=100.0)
+        assert low["bound"] == "bandwidth_bound"
+        assert low["intensity"] == 1.0
+
+    def test_zero_and_missing_flops(self):
+        """Zero/missing flops (flaky CPU cost_analysis) degrade to
+        mfu=None + unknown — never an exception."""
+        for flops in (None, 0, 0.0):
+            rl = obs_util.roofline(flops, None, 0.5)
+            assert rl["mfu"] is None
+            assert rl["achieved_tflops"] is None
+            assert rl["bound"] == "unknown"
+
+    def test_bytes_only_entry_is_bandwidth_bound(self):
+        rl = obs_util.roofline(None, 4e9, 1.0, peak_tf=100.0, peak_gb=100.0)
+        assert rl["mfu"] is None
+        assert rl["achieved_gbs"] == pytest.approx(4.0)
+        assert rl["bound"] == "bandwidth_bound"
+
+    def test_degenerate_duration_and_garbage(self):
+        assert obs_util.roofline(1e9, 1e6, 0.0)["mfu"] is None
+        assert obs_util.roofline(1e9, 1e6, -1.0)["bound"] == "unknown"
+        assert obs_util.roofline("x", "y", "z")["mfu"] is None
+
+    def test_cost_info_payload_shapes(self):
+        """cost_analysis() shapes across jax versions / fused wrappers:
+        a dict, a per-program list, missing keys, a raising backend."""
+
+        class ListCA:
+            def cost_analysis(self):
+                return [{"flops": 10.0, "bytes accessed": 20.0}]
+
+        class DictCA:
+            def cost_analysis(self):
+                return {"flops": 0.0, "bytes_accessed": 7.0}
+
+        class NoneCA:
+            def cost_analysis(self):
+                return None
+
+        class Raises:
+            def cost_analysis(self):
+                raise RuntimeError("unimplemented")
+
+        assert cost_info(ListCA()) == {"flops": 10.0, "bytes": 20.0}
+        # zero flops drops out; the alternate bytes spelling resolves
+        assert cost_info(DictCA()) == {"bytes": 7.0}
+        assert cost_info(NoneCA()) == {}
+        assert cost_info(Raises()) == {}
+
+
+class TestCostRegistry:
+    def test_register_and_lookup(self):
+        key = obs_util.register_cost("m:abc", flops=5.0, bytes=10.0,
+                                     bucket=8, model="m")
+        info = obs_util.cost_of(key)
+        assert info["flops"] == 5.0 and info["bytes"] == 10.0
+        assert info["bucket"] == 8
+        assert obs_util.cost_of("missing") is None
+        assert obs_util.cost_of(None) is None
+
+    def test_costless_entry_registers_as_none(self):
+        """A fused wrapper / CPU entry with no usable cost still
+        registers — its dispatches must resolve to mfu=None, not
+        vanish."""
+        obs_util.register_cost("m:empty", flops=0, bytes=None)
+        info = obs_util.cost_of("m:empty")
+        assert info is not None
+        assert info["flops"] is None and info["bytes"] is None
+
+    def test_registry_bounded(self):
+        for i in range(obs_util._COST_CAP + 10):
+            obs_util.register_cost(f"k{i}", flops=1.0)
+        assert obs_util.cost_of("k0") is None  # oldest evicted
+        assert obs_util.cost_of(f"k{obs_util._COST_CAP + 9}") is not None
+
+
+# -- busy/idle interval accounting --------------------------------------------
+
+class TestIntervals:
+    def test_merge_overlapping_multi_device_spans(self):
+        merged = obs_util.merge_intervals(
+            [(0, 10), (5, 15), (20, 30), (30, 40), (50, 50)])
+        assert merged == [(0, 15), (20, 40)]
+
+    def test_busy_fraction_windowing(self):
+        ivs = [(0, 10), (5, 15), (20, 30)]
+        # full window 0..40: covered 15 + 10 = 25
+        assert obs_util.busy_fraction(ivs, 0, 40) == pytest.approx(25 / 40)
+        # window clipped into an interval
+        assert obs_util.busy_fraction(ivs, 25, 35) == pytest.approx(0.5)
+        # window past every interval
+        assert obs_util.busy_fraction(ivs, 100, 200) == 0.0
+        # empty/inverted window
+        assert obs_util.busy_fraction(ivs, 10, 10) is None
+
+    def test_idle_gaps(self):
+        ivs = [(10, 20), (21, 30), (50, 60)]
+        assert obs_util.idle_gaps(ivs, min_gap=5) == [(30, 20)]
+        assert obs_util.idle_gaps(ivs, min_gap=1) == [(20, 1), (30, 20)]
+        # window edges count when given
+        assert obs_util.idle_gaps(ivs, min_gap=5, t0=0, t1=80) == [
+            (0, 10), (30, 20), (60, 20)]
+        assert obs_util.idle_gaps([], min_gap=5, t0=0, t1=10) == [(0, 10)]
+
+    def test_device_usage_windowed_fractions(self):
+        usage = obs_util.DeviceUsage(cap=16)
+        usage.add("cpu:0", 1_000, 2_000)
+        usage.add("cpu:0", 1_500, 3_000)  # overlap coalesces
+        usage.add("cpu:1", 2_000, 2_500)
+        fr = usage.busy_fractions(window_ns=10_000, now_ns=3_000)
+        # cpu:0 window clips to its oldest interval start (1000):
+        # covered 2000 of [1000, 3000)
+        assert fr["cpu:0"] == pytest.approx(1.0)
+        assert fr["cpu:1"] == pytest.approx(0.5)
+        # a wider real window dilutes
+        fr = usage.busy_fractions(window_ns=2_000, now_ns=4_000)
+        assert fr["cpu:0"] == pytest.approx(0.5)  # [2000,4000): 1000 busy
+
+
+# -- live wire-health metrics -------------------------------------------------
+
+class TestWireHealth:
+    def test_publish_sets_gauges_and_stats_provider(self):
+        reg = MetricsRegistry()
+        rec = obs_util.publish_wire_health(
+            {"put_150k_ms": 0.4, "dispatch_ms": 0.1}, reg)
+        assert rec["regime"] == "fast"
+        text = render_text(reg)
+        assert "nnstpu_wire_put_ms 0.4" in text
+        assert "nnstpu_wire_regime 0" in text
+        from nnstreamer_tpu.obs.export import stats_snapshot
+
+        snap = stats_snapshot()
+        assert snap["wire_health"]["regime"] == "fast"
+        # a sick probe flips the regime gauge
+        obs_util.publish_wire_health({"put_150k_ms": 22.0}, reg)
+        assert "nnstpu_wire_regime 1" in render_text(reg)
+        assert obs_util.last_wire_health()["regime"] == "slow"
+
+    def test_regime_classification(self):
+        assert obs_util.wire_regime(0.3) == "fast"
+        assert obs_util.wire_regime(5.1) == "slow"
+        assert obs_util.wire_regime(None) == "unknown"
+
+    def test_probe_runs_on_cpu_host(self):
+        h = obs_util.probe_wire_health(n=2, nbytes=1024)
+        assert h["put_150k_ms"] >= 0 and h["dispatch_ms"] >= 0
+
+
+# -- the wired-up device lane on a CPU host -----------------------------------
+
+def _matmul_model(dim=64):
+    import jax.numpy as jnp
+
+    w = np.random.default_rng(0).standard_normal((dim, dim)).astype(
+        np.float32)
+    return JaxModel(
+        apply=lambda p, x: jnp.tanh(x @ w),
+        input_spec=TensorsSpec.of(
+            TensorSpec(dtype=np.float32, shape=(dim,))),
+    )
+
+
+class TestUtilizationLane:
+    def test_mfu_series_and_span_args_on_cpu(self):
+        """The acceptance pipeline: a jax filter + DeviceTracer on a CPU
+        host yields nnstpu_mfu / nnstpu_device_busy_fraction series,
+        roofline-classified device_exec span args, and a by_device
+        summary carrying busy fraction + aggregate MFU."""
+        reg = MetricsRegistry()
+        p = Pipeline(name="util_lane")
+        src = p.add(DataSrc(
+            data=[np.ones(64, np.float32) for _ in range(6)], name="s"))
+        filt = p.add(TensorFilter(framework="jax", model=_matmul_model(),
+                                  name="f"))
+        p.link_chain(src, filt, p.add(TensorSink(name="o")))
+        tracer = p.attach_tracer(DeviceTracer(registry=reg))
+        p.run(timeout=60)
+        assert _wait_for(lambda: tracer.summary()["completed"] == 6)
+        summ = tracer.summary()
+        (label, dev), = summ["by_device"].items()
+        assert dev["count"] == 6
+        assert dev["mfu"] is not None and dev["mfu"] > 0
+        assert 0.0 <= dev["busy_fraction"] <= 1.0
+        assert dev["cost_missing"] == 0
+
+        execs = [r for r in spans.snapshot()
+                 if r[0] == spans.PH_COMPLETE and r[4] == "device_exec"]
+        assert len(execs) == 6
+        args = execs[-1][9]
+        assert args["flops"] > 0 and args["bytes"] > 0
+        assert args["mfu"] is not None
+        assert args["roofline"] in ("compute_bound", "bandwidth_bound")
+        assert args["cost_key"]
+
+        text = render_text(reg)
+        assert 'nnstpu_mfu{device="%s",node="f",bucket="64"}' % label in text
+        assert 'nnstpu_device_busy_fraction{device="%s"}' % label in text
+        assert "nnstpu_roofline_dispatches_total" in text
+
+    def test_costless_dispatch_included_with_mfu_none(self):
+        """A dispatch whose executable lacks cost info (no backend, or a
+        backend without cost_analysis) still lands in by_device — with
+        mfu=None and a cost_missing count, never silently omitted."""
+        reg = MetricsRegistry()
+        p = Pipeline(name="util_nocost")
+        node = p.add(Node(name="f"))  # no .backend: no cost key
+        tracer = DeviceTracer(registry=reg, capacity=8)
+        p._tracers.append(tracer)
+        tracer.start(p)
+        try:
+            hooks.emit("device_dispatch", node,
+                       Frame.of(np.zeros(4, np.float32)),
+                       (np.zeros(4, np.float32),), time.perf_counter_ns())
+            assert _wait_for(lambda: tracer.summary()["completed"] == 1)
+            summ = tracer.summary()
+            dev = summ["by_device"]["host"]
+            assert dev["count"] == 1
+            assert dev["mfu"] is None
+            assert dev["cost_missing"] == 1
+            execs = [r for r in spans.snapshot()
+                     if r[0] == spans.PH_COMPLETE and r[4] == "device_exec"]
+            assert execs[-1][9]["mfu"] is None
+            assert execs[-1][9]["roofline"] == "unknown"
+        finally:
+            tracer.stop()
+
+    def test_device_idle_gap_spans_and_attribution_leg(self, monkeypatch):
+        """A gap >= [obs] device_idle_gap_ms between completions becomes
+        a device_idle span on the device track, attributed to the
+        waiting dispatch's trace — and attribute_trace reports it as the
+        device_idle leg."""
+        monkeypatch.setenv("NNSTPU_OBS_DEVICE_IDLE_GAP_MS", "10")
+        reg = MetricsRegistry()
+        p = Pipeline(name="util_idle")
+        node = p.add(Node(name="f"))
+        tracer = DeviceTracer(registry=reg, capacity=8)
+        p._tracers.append(tracer)
+        tracer.start(p)
+        trace_id = spans.new_trace_id()
+        frame = Frame.of(np.zeros(4, np.float32))
+        frame.meta[spans.META_KEY] = [trace_id, 7, 0, None]
+        try:
+            hooks.emit("device_dispatch", node, frame,
+                       (np.zeros(4, np.float32),), time.perf_counter_ns())
+            assert _wait_for(lambda: tracer.summary()["completed"] == 1)
+            time.sleep(0.05)  # 50 ms idle >> the 10 ms threshold
+            hooks.emit("device_dispatch", node, frame,
+                       (np.zeros(4, np.float32),), time.perf_counter_ns())
+            assert _wait_for(lambda: tracer.summary()["completed"] == 2)
+            idles = [r for r in spans.snapshot()
+                     if r[0] == spans.PH_COMPLETE and r[4] == "device_idle"]
+            assert len(idles) == 1
+            args = idles[0][9]
+            assert args["gap_ms"] >= 10
+            assert args["reason"] in ("host_dispatch", "queue_wait", "wire")
+            assert idles[0][6] == trace_id
+            # the collector decomposition grows a device_idle leg
+            recs = [r for r in spans.snapshot()
+                    if r[0] == spans.PH_COMPLETE and r[6] == trace_id]
+            legs = attribute_trace(recs)
+            assert legs["device_idle"] > 0
+            assert legs["device"] > 0
+        finally:
+            tracer.stop()
+
+    def test_overlapping_multi_device_busy_windowing(self):
+        """Mesh-style shards: overlapping spans on distinct devices keep
+        distinct busy fractions; overlaps within one device coalesce."""
+        usage = obs_util.DeviceUsage()
+        t0 = 1_000_000
+        for dev in ("tpu:0", "tpu:1"):
+            usage.add(dev, t0, t0 + 1_000_000)
+        usage.add("tpu:0", t0 + 500_000, t0 + 1_500_000)  # overlap
+        fr = usage.busy_fractions(window_ns=2_000_000, now_ns=t0 + 2_000_000)
+        assert fr["tpu:0"] == pytest.approx(0.75)
+        assert fr["tpu:1"] == pytest.approx(0.5)
+
+
+class TestBackendCostRegistration:
+    def test_compile_registers_cost_and_hit_restores_key(self):
+        be = JaxBackend()
+        poly = JaxModel(
+            apply=lambda p, x: x * 2,
+            input_spec=TensorsSpec.of(
+                TensorSpec(dtype=np.float32, shape=(None,))),
+        )
+        be.open(poly, custom="compile_cache=4")
+        spec = TensorsSpec.of(TensorSpec(dtype=np.float32, shape=(64,)))
+        be.reconfigure(spec)
+        key1 = be.cost_key()
+        assert key1
+        info = obs_util.cost_of(key1)
+        assert info is not None and info["bucket"] == 64
+        # a second geometry gets its own key; re-selecting the first via
+        # the LRU restores the first key
+        spec2 = TensorsSpec.of(TensorSpec(dtype=np.float32, shape=(32,)))
+        be.reconfigure(spec2)
+        key2 = be.cost_key()
+        assert key2 and key2 != key1
+        be.reconfigure(spec)
+        assert be.cost_key() == key1
+
+
+# -- the bench MFU-ladder campaign -------------------------------------------
+
+class TestMfuLadder:
+    @pytest.fixture
+    def bench_mod(self, tmp_path, monkeypatch):
+        import bench
+
+        cache = str(tmp_path / "cache.json")
+        monkeypatch.setattr(bench, "TPU_CACHE_PATH", cache)
+        # save_tpu_cache archives next to a REDIRECTED cache only when
+        # the env var is set — keep the append-only run archive out of
+        # the repo's BENCH_RUNS/
+        monkeypatch.setenv("BENCH_TPU_CACHE_PATH", cache)
+        return bench
+
+    def test_plumbing_matrix_off_accel(self, bench_mod):
+        """On a host with no accelerator every cell types itself
+        skipped{reason=no_accel}; the 12-cell matrix is complete."""
+        gates = []
+        res = bench_mod.measure_mfu_ladder(
+            lambda label: gates.append(label), on_accel=False)
+        assert len(res["cells"]) == 12
+        assert all(c["skipped"]["reason"] == "no_accel"
+                   for c in res["cells"].values())
+        assert gates == []  # no wire probes burned on skipped cells
+        assert res["banked_cells"] == 0
+
+    def test_sick_wire_cell_is_typed_skip(self, bench_mod, monkeypatch):
+        monkeypatch.setattr(bench_mod, "LADDER_BATCHES", (8,))
+        monkeypatch.setattr(bench_mod, "LADDER_DTYPES", ("fp32",))
+        monkeypatch.setattr(bench_mod, "LADDER_MESHES", (1,))
+        res = bench_mod.measure_mfu_ladder(
+            lambda label: {"put_150k_ms": 30.0, "dispatch_ms": 1.0},
+            on_accel=True)
+        (cell,) = res["cells"].values()
+        assert cell["skipped"]["reason"] == "wire"
+        assert cell["skipped"]["wire"]["put_150k_ms"] == 30.0
+
+    def test_bank_merge_idempotent_and_best_of(self, bench_mod):
+        key = bench_mod.ladder_cell_key(8, "fp32", 1, "fast")
+        cell = {"batch": 8, "dtype": "fp32", "mesh": 1, "mfu": 0.012,
+                "wire_regime": "fast", "measured_at": "t"}
+        b1 = bench_mod.merge_ladder_bank({key: cell})
+        b2 = bench_mod.merge_ladder_bank({key: cell})
+        assert b1 == b2 == bench_mod.load_ladder_bank()
+        # a worse later measurement never clobbers the banked evidence
+        bench_mod.merge_ladder_bank({key: dict(cell, mfu=0.001)})
+        assert bench_mod.load_ladder_bank()[key]["mfu"] == 0.012
+        # a better one replaces it
+        bench_mod.merge_ladder_bank({key: dict(cell, mfu=0.05)})
+        assert bench_mod.load_ladder_bank()[key]["mfu"] == 0.05
+
+    def test_save_tpu_cache_preserves_bank(self, bench_mod):
+        key = bench_mod.ladder_cell_key(32, "int8", 8, "fast")
+        bench_mod.merge_ladder_bank(
+            {key: {"batch": 32, "dtype": "int8", "mesh": 8, "mfu": 0.2}})
+        bench_mod.save_tpu_cache(
+            {"value": 1.0, "vs_baseline": None, "extra": {}})
+        assert bench_mod.load_ladder_bank()[key]["mfu"] == 0.2
+
+    def test_forced_cpu_cell_measures_and_banks(self, bench_mod,
+                                                monkeypatch):
+        """BENCH_MFU_LADDER_ON_CPU=1 exercises the real measurement +
+        banking path on the host backend (slow model shrunk to one tiny
+        cell via the grid monkeypatch)."""
+        monkeypatch.setenv("BENCH_MFU_LADDER_ON_CPU", "1")
+        monkeypatch.setattr(bench_mod, "LADDER_BATCHES", (8,))
+        monkeypatch.setattr(bench_mod, "LADDER_DTYPES", ("fp32",))
+        monkeypatch.setattr(bench_mod, "LADDER_MESHES", (1,))
+        monkeypatch.setattr(bench_mod, "LADDER_TARGETS", {8: 0.01})
+
+        orig_point = bench_mod.ladder_point
+
+        def tiny_point(batch, dtype, ndev, image_size=224):
+            return orig_point(batch, dtype, ndev, image_size=32)
+
+        monkeypatch.setattr(bench_mod, "ladder_point", tiny_point)
+        res = bench_mod.measure_mfu_ladder(lambda label: None,
+                                           on_accel=False)
+        (cell,) = res["cells"].values()
+        assert "skipped" not in cell, cell
+        assert cell["step_ms"] > 0 and cell["wire_regime"] == "local"
+        assert cell["roofline"] in ("compute_bound", "bandwidth_bound",
+                                    "unknown")
+        bank = bench_mod.load_ladder_bank()
+        assert len(bank) == 1
+        # second run re-reads the bank (idempotent across invocations)
+        res2 = bench_mod.measure_mfu_ladder(lambda label: None,
+                                            on_accel=False)
+        assert res2["banked_cells"] == 1

@@ -25,7 +25,12 @@ This tool isolates where the per-stream cost lands:
   probe per dispatch yields a ``dev us/fr`` column — on an async
   backend the ``dispatch_exit`` attribution only times the enqueue, so
   without this column device compute hides inside whichever element
-  blocks first.
+  blocks first;
+- shows UTILIZATION, not just latency (the obs/util.py lane): ``mfu``
+  (cost_analysis flops over measured device time vs the configured
+  peak) and ``busy`` (windowed device_exec coverage per device)
+  columns ride the same sweep, so "8 streams decline" separates into
+  "chip idle" vs "chip busy on machinery".
 
 Usage: ``python tools/profile_mux_overhead.py [--mesh[=SPEC]] [--ttff]
 [TOTAL_FRAMES] [SWEEP...]`` e.g. ``python tools/profile_mux_overhead.py
@@ -215,6 +220,16 @@ def run_mux(streams, frames_per_stream, attribute=False):
     dsum = dev.summary()
     copies.dev_us_per_frame = dsum["device_ns"] / 1e3 / max(1, total_in)
     copies.dev_dispatches = dsum["completed"]
+    # utilization columns (obs/util.py lane): aggregate MFU and mean
+    # busy fraction across the devices this config touched — so the
+    # 1→8 stream sweep shows whether added streams buy chip utilization
+    # or only host machinery (mfu None = no cost_analysis on this host)
+    devs = list(dsum["by_device"].values())
+    mfus = [d["mfu"] for d in devs if d.get("mfu") is not None]
+    copies.mfu = sum(mfus) / len(mfus) if mfus else None
+    busys = [d["busy_fraction"] for d in devs
+             if d.get("busy_fraction") is not None]
+    copies.busy = sum(busys) / len(busys) if busys else None
     # mesh columns: chips the LAST compiled executable actually spanned
     # (an indivisible leading dim falls back to 1) and the per-shard rows
     mesh = getattr(filt.backend, "_mesh", None)
@@ -289,15 +304,23 @@ def main():
     if MESH is not None:
         print(f"mesh-sharded dispatch: NNSTPU_MESH={MESH!r} over "
               f"{len(jax.devices())} host devices")
+    def fmt_mfu(v):
+        return f"{v * 100:>8.3f}%" if v is not None else f"{'-':>9}"
+
+    def fmt_busy(v):
+        return f"{v * 100:>6.1f}%" if v is not None else f"{'-':>7}"
+
     run_mux(1, 50)
     base_fps, _, _, base_cp = run_mux(1, TOTAL)
     print(f"\n{'streams':>7} {'agg fps':>10} {'us/frame':>10} "
           f"{'vs 1-stream':>11} {'copy KB/fr':>11} {'allocs/fr':>10} "
-          f"{'dev us/fr':>10} {'chips':>6} {'b/shard':>8}")
+          f"{'dev us/fr':>10} {'mfu':>9} {'busy':>7} {'chips':>6} "
+          f"{'b/shard':>8}")
     print(f"{1:>7} {base_fps:>10.0f} {1e6 / base_fps:>10.1f} {'1.00x':>11} "
           f"{base_cp.per_frame / 1024:>11.1f} "
           f"{base_cp.allocs_per_frame:>10.3f} "
           f"{base_cp.dev_us_per_frame:>10.1f} "
+          f"{fmt_mfu(base_cp.mfu)} {fmt_busy(base_cp.busy)} "
           f"{base_cp.chips:>6} {base_cp.per_shard:>8.2f}")
     results = {1: base_fps}
     for s in [s for s in SWEEP if s != 1]:
@@ -307,6 +330,7 @@ def main():
         print(f"{s:>7} {fps:>10.0f} {1e6 / fps:>10.1f} "
               f"{fps / base_fps:>10.2f}x {cp.per_frame / 1024:>11.1f} "
               f"{cp.allocs_per_frame:>10.3f} {cp.dev_us_per_frame:>10.1f} "
+              f"{fmt_mfu(cp.mfu)} {fmt_busy(cp.busy)} "
               f"{cp.chips:>6} {cp.per_shard:>8.2f}")
 
     # attribution pass at the widest sweep point
@@ -332,6 +356,12 @@ def main():
           f"{cp.dev_us_per_frame:.1f} us/frame over {cp.dev_dispatches} "
           f"probed dispatches (device lane; host attribution above times "
           f"the enqueue only)")
+    mfu_s = f"{cp.mfu * 100:.3f}%" if cp.mfu is not None \
+        else "n/a (no cost_analysis)"
+    busy_s = f"{cp.busy * 100:.1f}%" if cp.busy is not None else "n/a"
+    print(f"  utilization at {widest} streams: mfu {mfu_s}, device busy "
+          f"fraction {busy_s} (the rest of the device window is idle — "
+          f"host dispatch, queue wait, or wire; see device_idle spans)")
 
 
 if __name__ == "__main__":

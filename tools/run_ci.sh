@@ -586,6 +586,138 @@ print(f"mesh smoke OK: sharded backend matched single-device to 1e-5, "
       f"compile misses={summ['compiles']['miss']} (no per-frame churn)")
 PY
 
+run_step "Utilization smoke (nnstpu_mfu + busy-fraction series, device_idle spans, mfu.ladder plumbing + bank idempotence)" \
+  env NNSTPU_MESH=dp:8 XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+      JAX_PLATFORMS=cpu NNSTPU_OBS_DEVICE_IDLE_GAP_MS=10 \
+  python - <<'PY'
+# The device utilization observatory (ISSUE 11): on a CPU-mesh host a
+# dynbatch pipeline must expose per-device nnstpu_mfu and
+# nnstpu_device_busy_fraction series with roofline-classified
+# device_exec span args; device starvation must render as device_idle
+# spans in the Perfetto export; and the bench mfu.ladder leg must run
+# its full 12-cell plumbing off-accel (every cell a typed skip) with an
+# idempotent evidence-bank merge.
+import os
+import tempfile
+import time
+
+import jax
+jax.config.update('jax_platforms', 'cpu')
+import numpy as np
+
+from nnstreamer_tpu import Pipeline
+from nnstreamer_tpu.backends.jax_backend import JaxModel
+from nnstreamer_tpu.buffer import Frame
+from nnstreamer_tpu.elements.dynbatch import DynBatch, DynUnbatch
+from nnstreamer_tpu.elements.filter import TensorFilter
+from nnstreamer_tpu.elements.sink import TensorSink
+from nnstreamer_tpu.elements.testsrc import DataSrc
+from nnstreamer_tpu.graph.node import Node
+from nnstreamer_tpu.obs import hooks, spans
+from nnstreamer_tpu.obs import util as obs_util
+from nnstreamer_tpu.obs.collector import attribute_trace
+from nnstreamer_tpu.obs.device import DeviceTracer
+from nnstreamer_tpu.obs.export import render_text
+from nnstreamer_tpu.obs.metrics import MetricsRegistry
+
+assert len(jax.devices()) == 8
+
+# -- mesh dynbatch pipeline: per-device MFU + busy series ---------------
+import jax.numpy as jnp
+W = np.random.default_rng(0).standard_normal((64, 64)).astype(np.float32)
+mdl = JaxModel(apply=lambda p, x: jnp.tanh(x @ W), input_spec=None)
+reg = MetricsRegistry()
+p = Pipeline(name="ci_util")
+src = p.add(DataSrc(data=[np.ones(64, np.float32) for _ in range(24)],
+                    name="s"))
+p.link_chain(src, p.add(DynBatch(max_batch=8, name="db")),
+             p.add(TensorFilter(framework="jax", model=mdl, name="f")),
+             p.add(DynUnbatch(name="un")),
+             p.add(TensorSink(name="out")))
+dev = p.attach_tracer(DeviceTracer(registry=reg))
+p.run(timeout=120)
+deadline = time.time() + 30
+while time.time() < deadline:
+    s = dev.summary()
+    if s["dispatches"] and s["completed"] == s["dispatches"]:
+        break
+    time.sleep(0.05)
+summ = dev.summary()
+assert len(summ["by_device"]) == 8, summ["by_device"]
+for label, d in summ["by_device"].items():
+    assert d["mfu"] is not None and d["mfu"] > 0, (label, d)
+    assert 0.0 <= d["busy_fraction"] <= 1.0, (label, d)
+text = render_text(reg)
+mfu_series = [l for l in text.splitlines() if l.startswith("nnstpu_mfu{")]
+busy_series = [l for l in text.splitlines()
+               if l.startswith("nnstpu_device_busy_fraction{")]
+assert len(mfu_series) >= 8, mfu_series
+assert len(busy_series) == 8, busy_series
+execs = [r for r in spans.snapshot()
+         if r[0] == spans.PH_COMPLETE and r[4] == "device_exec"]
+assert execs and all(
+    r[9].get("flops") and r[9].get("roofline") in
+    ("compute_bound", "bandwidth_bound") for r in execs), execs[-1][9]
+
+# -- device_idle dead-time spans + attribution leg ----------------------
+reg2 = MetricsRegistry()
+p2 = Pipeline(name="ci_idle")
+node = p2.add(Node(name="f"))
+tr = DeviceTracer(registry=reg2, capacity=8)
+p2._tracers.append(tr)
+tr.start(p2)
+trace_id = spans.new_trace_id()
+frame = Frame.of(np.zeros(4, np.float32))
+frame.meta[spans.META_KEY] = [trace_id, 1, 0, None]
+for pause in (0.0, 0.05):  # 50 ms gap >> the 10 ms threshold
+    time.sleep(pause)
+    hooks.emit("device_dispatch", node, frame,
+               (np.zeros(4, np.float32),), time.perf_counter_ns())
+    deadline = time.time() + 10
+    while tr.summary()["completed"] < 1 and time.time() < deadline:
+        time.sleep(0.01)
+deadline = time.time() + 10
+while tr.summary()["completed"] < 2 and time.time() < deadline:
+    time.sleep(0.01)
+tr.stop()
+doc = spans.chrome_trace(spans.snapshot())
+idle_events = [e for e in doc["traceEvents"]
+               if e.get("ph") == "X" and e["name"] == "device_idle"]
+assert idle_events, "no device_idle span in the Perfetto export"
+assert idle_events[0]["args"]["reason"] in (
+    "host_dispatch", "queue_wait", "wire")
+legs = attribute_trace(
+    [r for r in spans.snapshot()
+     if r[0] == spans.PH_COMPLETE and r[6] == trace_id])
+assert legs.get("device_idle", 0) > 0, legs
+
+# -- mfu.ladder plumbing + evidence-bank idempotence --------------------
+import bench
+with tempfile.TemporaryDirectory() as tmp:
+    bench.TPU_CACHE_PATH = os.path.join(tmp, "cache.json")
+    res = bench.measure_mfu_ladder(lambda label: None, on_accel=False)
+    cells = res["cells"]
+    assert len(cells) == 12, sorted(cells)
+    assert all(c["skipped"]["reason"] in ("wire", "no_accel")
+               for c in cells.values()), cells
+    key = bench.ladder_cell_key(32, "int8", 8, "fast")
+    cell = {"batch": 32, "dtype": "int8", "mesh": 8, "mfu": 0.12,
+            "wire_regime": "fast", "measured_at": "ci"}
+    b1 = bench.merge_ladder_bank({key: cell})
+    b2 = bench.merge_ladder_bank({key: cell})
+    assert b1 == b2 == bench.load_ladder_bank(), (b1, b2)
+    res2 = bench.measure_mfu_ladder(lambda label: None, on_accel=False)
+    assert res2["banked_cells"] == 1 and res2["bank"][key]["mfu"] == 0.12
+
+print(f"utilization smoke OK: {len(mfu_series)} nnstpu_mfu series + "
+      f"{len(busy_series)} busy-fraction series over 8 devices, "
+      f"{len(execs)} roofline-classified device_exec spans, "
+      f"{len(idle_events)} device_idle span(s) "
+      f"(reason={idle_events[0]['args']['reason']}, device_idle leg "
+      f"attributed), mfu.ladder 12/12 cells typed-skipped off-accel, "
+      f"evidence bank idempotent")
+PY
+
 run_step "Fleet smoke (router + 3 workers: kill -9, SIGTERM drain, /healthz convergence)" \
   python - <<'PY'
 import jax
