@@ -153,6 +153,13 @@ DEFAULTS: Dict[str, Dict[str, str]] = {
         "drain_deadline_s": "10",   # session-drain wait before force-break
         "repo_addr": "",            # host:port of a TensorRepoServer; ""
                                     # keeps tensor_repo process-local
+        "migrate": "1",             # live-migrate decode sessions on a
+                                    # planned drain (needs repo_addr);
+                                    # 0 = legacy force-break [SESSION]
+        "migrate_timeout_s": "10",  # per-handoff deadline (quiesce +
+                                    # snapshot + restore + re-pin)
+        "migrate_check_s": "0.25",  # stateful router's monitor period
+                                    # for self-draining workers
     },
     # Self-healing (graph/pipeline.py restart policies + backend
     # degradation).  NNSTPU_RECOVERY_* env vars map here.

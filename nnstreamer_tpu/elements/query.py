@@ -133,6 +133,21 @@ class QuerySessionBrokenError(QueryError):
     code = "SESSION"
 
 
+class QueryMigratingError(QueryError):
+    """The typed ``[MIGRATING]`` wire code: a live-migration operation on
+    a decode session could not be honored (snapshot refused, restore
+    refused, session already moved away) — crucially WITHOUT the session
+    state having advanced.  This is the one stateful error whose frame
+    is safe to re-send exactly once (to the session's NEW home), which
+    is how the fleet router closes the handoff race without ever
+    duplicating a decode step.  Peers that pre-date migration never emit
+    the code, and a migration-capable router degrades any unexpected
+    occurrence to the session-fatal ``[SESSION]`` verdict — old clients
+    on the far side only ever see the fallback they already understand."""
+
+    code = "MIGRATING"
+
+
 # wire code -> client-side exception; unknown/absent codes stay the
 # legacy RuntimeError so old servers interoperate with new clients
 ERROR_TYPES = {
@@ -140,12 +155,47 @@ ERROR_TYPES = {
     "EXPIRED": QueryExpiredError,
     "UNAVAILABLE": QueryUnavailableError,
     "SESSION": QuerySessionBrokenError,
+    "MIGRATING": QueryMigratingError,
 }
 # pts of the client's negotiation probe frame.  DISTINCT from NONE_TS (-1):
 # unstamped stream frames are legitimate, and a stateful server (the
 # serving.DecodeServer) must answer a probe without advancing its session —
 # it can only do that if probes are unambiguous on the wire.
 PROBE_PTS = -2
+# live-migration control sentinels on a decode connection (the version
+# gate is the sentinel itself: a pre-migration DecodeServer sees the
+# control frame as a malformed decode step and answers a plain error,
+# which the router treats as "this peer cannot migrate" and degrades to
+# the typed [SESSION] drain path — old peers never need new code):
+# MIGRATE_PTS asks the serving end to quiesce + snapshot THIS
+# connection's session into a tensor_repo slot and release it;
+# RESUME_PTS asks a fresh connection to restore a session from one.
+MIGRATE_PTS = -3
+RESUME_PTS = -4
+
+
+def pack_session_control(repo_addr: str, key: int,
+                         deadline_ms: int = 10000) -> tuple:
+    """The payload of a ``MIGRATE_PTS``/``RESUME_PTS`` control frame:
+    which :class:`~nnstreamer_tpu.fleet.repo.TensorRepoServer` slot the
+    snapshot crosses through, and how long the op may take."""
+    return (np.array([int(key), int(deadline_ms)], np.int64),
+            np.frombuffer(repo_addr.encode("utf-8"), np.uint8))
+
+
+def parse_session_control(tensors) -> Tuple[str, int, int]:
+    """Inverse of :func:`pack_session_control` ->
+    ``(repo_addr, key, deadline_ms)``; malformed frames raise."""
+    if len(tensors) != 2:
+        raise ValueError(
+            f"session control frame takes 2 tensors, got {len(tensors)}")
+    head = np.asarray(tensors[0])
+    addr_b = np.asarray(tensors[1])
+    if head.dtype != np.int64 or head.shape != (2,) or \
+            addr_b.dtype != np.uint8 or addr_b.ndim != 1 or \
+            addr_b.size > 256:
+        raise ValueError("malformed session control frame")
+    return (addr_b.tobytes().decode("utf-8"), int(head[0]), int(head[1]))
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:

@@ -41,6 +41,12 @@ Call sites (the injection points):
                    ``partition`` (health + data paths unreachable for
                    ``ms``); the router/membership tier is the intended
                    survivor (``nnstreamer_tpu/fleet``)
+``migrate``        the fleet router's per-handoff-phase consultation
+                   (:func:`maybe_migrate`, site name
+                   ``<router>:<phase>:<worker>``) — ``migrate_abort``
+                   raises mid-handoff; the router must degrade to the
+                   typed ``[SESSION]`` fallback with the source slot
+                   freed, never hang or duplicate a step
 =================  =====================================================
 """
 
@@ -160,6 +166,19 @@ def maybe_fleet(name: str):
     if eng is None:
         return None
     return eng.decide("fleet", name)
+
+
+def maybe_migrate(name: str) -> None:
+    """``migrate`` point: one opportunity per handoff phase
+    (``<router>:<phase>:<worker>``); a firing ``migrate_abort`` raises
+    :class:`InjectedFault` — the router's abort path (typed ``[SESSION]``
+    degradation, source slot freed) is the intended survivor."""
+    eng = _engine
+    if eng is None:
+        return
+    rule = eng.decide("migrate", name)
+    if rule is not None:
+        raise InjectedFault(rule.kind, name, rule.opportunities)
 
 
 def maybe_queue_wedge(name: str) -> None:

@@ -68,6 +68,12 @@ POINT_OF = {
     "worker_kill": "fleet",
     "worker_hang": "fleet",
     "partition": "fleet",
+    # live decode-session migration (fleet/router.py): consulted at each
+    # handoff phase ("<router>:<phase>:<worker>", phase in quiesce/
+    # snapshot/restore) — a firing rule raises, aborting the handoff,
+    # and the router must degrade to the typed [SESSION] path with the
+    # source slot freed (never a hang, never a duplicate step)
+    "migrate_abort": "migrate",
 }
 
 KINDS = frozenset(POINT_OF)

@@ -35,6 +35,7 @@ the stateless/stateful failover matrix.
 from .membership import (  # noqa: F401
     DEGRADED,
     DOWN,
+    DRAINING,
     SUSPECT,
     UNHEALTHY,
     UP,

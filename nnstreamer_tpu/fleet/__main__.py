@@ -10,7 +10,13 @@ n_heads=2,n_layers=1 --decode-port 0]
 Router (the front door)::
 
     python -m nnstreamer_tpu.fleet router --port 0 \\
-        --workers 127.0.0.1:7001/9001,127.0.0.1:7002/9002 [--stateful]
+        --workers 127.0.0.1:7001/9001,127.0.0.1:7002/9002 [--stateful] \\
+        [--repo 127.0.0.1:9500]
+
+Repo (a shared TensorRepoServer — cross-process recurrence slots AND
+the channel live session migration snapshots cross)::
+
+    python -m nnstreamer_tpu.fleet repo --port 0
 
 Each process prints ONE JSON line describing its bound ports (a
 supervisor parses it), then serves until signalled:
@@ -132,7 +138,8 @@ def _cmd_router(args) -> int:
                        if health else None)
     membership.start()
     router = Router(membership, host=args.host, port=args.port,
-                    stateful=args.stateful, name=args.name).start()
+                    stateful=args.stateful, name=args.name,
+                    repo_addr=args.repo or None).start()
     health_port = None
     metrics = None
     if args.health_port is not None:
@@ -154,6 +161,17 @@ def _cmd_router(args) -> int:
             metrics.stop()
 
     return _serve_until_signal(stop, stop)
+
+
+def _cmd_repo(args) -> int:
+    from .repo import TensorRepoServer
+
+    srv = TensorRepoServer(host=args.host, port=args.port).start()
+    print(json.dumps({
+        "role": "repo", "name": args.name, "pid": os.getpid(),
+        "port": srv.port,
+    }), flush=True)
+    return _serve_until_signal(srv.stop, srv.stop)
 
 
 def main(argv=None) -> int:
@@ -198,9 +216,19 @@ def main(argv=None) -> int:
                    help="host:query_port[/health_port],...")
     r.add_argument("--stateful", action="store_true",
                    help="front a DecodeServer fleet (sticky sessions)")
+    r.add_argument("--repo", default="",
+                   help="host:port of a TensorRepoServer — enables live "
+                        "decode-session migration on planned drains "
+                        "(zero-downtime, token-identical)")
     r.set_defaults(fn=_cmd_router)
 
-    for sp in (w, r):
+    p = sub.add_parser("repo", help="a shared TensorRepoServer process")
+    p.add_argument("--name", default="repo")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=0)
+    p.set_defaults(fn=_cmd_repo)
+
+    for sp in (w, r, p):
         sp.add_argument("--platform", default=None, metavar="NAME",
                         help="pin the jax platform (e.g. cpu) before any "
                              "backend initializes")

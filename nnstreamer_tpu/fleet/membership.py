@@ -49,10 +49,16 @@ DOWN = "down"
 # the worker is alive and converging; routing to it would serve requests
 # into cold executables (exactly what warmup exists to prevent)
 WARMING = "warming"
+# the worker itself reported a graceful drain in progress (SIGTERM):
+# no new dispatch or sessions, existing sessions still flow — and the
+# stateful router's migration monitor treats this as the signal to move
+# the worker's live decode sessions elsewhere before the drain deadline
+# force-breaks them
+DRAINING = "draining"
 
 # numeric encoding for the state gauge (Prometheus can't label strings)
 STATE_CODES = {UP: 0, DEGRADED: 1, UNHEALTHY: 2, SUSPECT: 3, DOWN: 4,
-               WARMING: 5}
+               WARMING: 5, DRAINING: 6}
 
 
 class NoWorkerAvailable(RuntimeError):
@@ -81,6 +87,10 @@ class WorkerInfo:
         self.last_seen = time.monotonic()
         self.block_health = False       # chaos: heartbeat channel cut
         self.block_data = False         # chaos: data path cut
+        # sessions currently mid-handoff OFF this worker (router-owned):
+        # drain accounting counts them as migrating, not live — an
+        # operator watching a drain sees progress, not a stuck count
+        self.sessions_migrating = 0
         self._breaker_cfg = (int(breaker_failures), float(breaker_reset_s))
         self.breaker = CircuitBreaker(
             failure_threshold=self._breaker_cfg[0],
@@ -113,6 +123,7 @@ class WorkerInfo:
             "routed": self.routed,
             "failures": self.failures,
             "revivals": self.revivals,
+            "sessions_migrating": self.sessions_migrating,
         }
 
 
@@ -127,6 +138,16 @@ def _http_probe(worker: WorkerInfo, timeout_s: float) -> str:
             body = resp.read()
     except urllib.error.HTTPError as exc:
         if exc.code == 503:
+            # a SIGTERM-draining worker answers 503 with its reason in
+            # the JSON body: surface DRAINING (the migration monitor's
+            # signal) instead of a bare UNHEALTHY
+            try:
+                doc = json.loads(exc.read().decode("utf-8"))
+                fails = doc.get("failures") or {}
+                if any("draining" in str(v) for v in fails.values()):
+                    return DRAINING
+            except (ValueError, AttributeError, OSError):
+                pass
             return UNHEALTHY
         raise
     try:
@@ -300,6 +321,12 @@ class Membership:
             # worker unhealthy — it reports ready when warmup completes
             w.state = WARMING
             w.degraded_reason = status.partition(":")[2]
+        elif status.startswith(DRAINING):
+            # the worker announced its own graceful drain: out of NEW
+            # selection (pick() only serves the UP/DEGRADED tiers) but
+            # not unhealthy — its live sessions still flow, and the
+            # stateful router migrates them off before the deadline
+            w.state = DRAINING
         elif status in ("unhealthy", UNHEALTHY):
             w.state = UNHEALTHY
         else:

@@ -34,6 +34,7 @@ from __future__ import annotations
 
 import socket
 import threading
+import time
 from typing import Optional, Tuple
 
 import numpy as np
@@ -51,8 +52,35 @@ OP_PREPARE = 5
 OP_REOPEN = 6
 OP_TAKE_RESTORED = 7
 
+# ops safe to blindly re-send after a transport failure: applying them
+# twice is indistinguishable from applying them once.  SET is NOT (a
+# lost reply may mean the frame WAS published — re-sending double-
+# publishes), GET is NOT (the reply may have carried the one frame),
+# TAKE_RESTORED is NOT (it consumes a one-shot flag).
+_IDEMPOTENT_OPS = frozenset({OP_EOS, OP_CLEAR, OP_PREPARE, OP_REOPEN})
+_OP_NAMES = {OP_SET: "SET", OP_GET: "GET", OP_EOS: "EOS",
+             OP_CLEAR: "CLEAR", OP_PREPARE: "PREPARE",
+             OP_REOPEN: "REOPEN", OP_TAKE_RESTORED: "TAKE_RESTORED"}
+
 _PTS_EMPTY = -1   # GET poll timeout: nothing published yet
 _PTS_EOS = -2     # GET: the slot is at EOS
+
+
+class RemoteRepoError(ConnectionError):
+    """Typed failure of a remote ``tensor_repo`` op: the transport died
+    and the op either could not be retried (non-idempotent — the
+    server-side effect is unknowable) or kept failing through the retry
+    budget.  A ``ConnectionError`` subclass so every existing caller's
+    transport handling still applies; the typed class is what the
+    migration/recovery paths branch on."""
+
+    def __init__(self, op: int, slot: int, cause: BaseException):
+        super().__init__(
+            f"remote repo {_OP_NAMES.get(op, op)} on slot {slot} failed: "
+            f"{cause}")
+        self.op = op
+        self.slot = slot
+        self.cause = cause
 
 
 class TensorRepoServer:
@@ -171,12 +199,17 @@ class RemoteTensorRepo:
       and intersects against its caps (geometry mismatches still fail).
     """
 
-    def __init__(self, host: str, port: int, connect_timeout: float = 10.0):
+    def __init__(self, host: str, port: int, connect_timeout: float = 10.0,
+                 op_retries: int = 2, retry_backoff_s: float = 0.05):
         self.host, self.port = str(host), int(port)
         self.connect_timeout = float(connect_timeout)
+        self.op_retries = int(op_retries)       # idempotent ops only
+        self.retry_backoff_s = float(retry_backoff_s)
+        self.retries_total = 0  # observability: re-sent idempotent ops
         self._tls = threading.local()
         self._lock = threading.Lock()
-        self._socks = []  # every dialed socket, for close()
+        self._socks = []  # every LIVE dialed socket, for close()
+        self._closed = False
 
     @classmethod
     def from_addr(cls, addr: str) -> "RemoteTensorRepo":
@@ -184,6 +217,9 @@ class RemoteTensorRepo:
         return cls(host or "127.0.0.1", int(port))
 
     def _sock(self) -> socket.socket:
+        if self._closed:
+            raise RemoteRepoError(
+                0, -1, RuntimeError("repo client closed"))
         sock = getattr(self._tls, "sock", None)
         if sock is None:
             sock = socket.create_connection(
@@ -193,6 +229,15 @@ class RemoteTensorRepo:
             sock.settimeout(600.0)
             self._tls.sock = sock
             with self._lock:
+                if self._closed:
+                    # lost the race with close(): never leak the fd
+                    try:
+                        sock.close()
+                    except OSError:
+                        pass
+                    self._tls.sock = None
+                    raise RemoteRepoError(
+                        0, -1, RuntimeError("repo client closed"))
                 self._socks.append(sock)
         return sock
 
@@ -200,13 +245,26 @@ class RemoteTensorRepo:
         sock = getattr(self._tls, "sock", None)
         self._tls.sock = None
         if sock is not None:
+            with self._lock:
+                # a dead socket leaves the tracked set immediately — the
+                # live-socket list stays bounded across a churn soak
+                # instead of accumulating every connection ever dialed
+                try:
+                    self._socks.remove(sock)
+                except ValueError:
+                    pass
             try:
                 sock.close()
             except OSError:
                 pass
 
     def close(self) -> None:
+        """Close every cached per-thread connection (idempotent).  The
+        client is unusable afterwards — threads whose cached socket was
+        just closed get a typed :class:`RemoteRepoError` instead of
+        silently re-dialing (which would leak fds past the close)."""
         with self._lock:
+            self._closed = True
             socks, self._socks = self._socks, []
         for sock in socks:
             try:
@@ -216,16 +274,31 @@ class RemoteTensorRepo:
 
     def _op(self, op: int, slot: int, arg: int = 0,
             payload: tuple = (), pts: int = 0) -> Tuple[tuple, int]:
-        sock = self._sock()
-        try:
-            send_tensors(
-                sock,
-                (np.array([op, slot, arg], np.int64),) + tuple(payload),
-                pts, fault_key="nnsq.repo")
-            return recv_tensors(sock)
-        except (ConnectionError, OSError):
-            self._reset()
-            raise
+        """One request/reply round trip.  Idempotent ops retry with a
+        fresh connection (bounded, backed off) — a fault-injected drop
+        or truncation on the wire heals transparently; non-idempotent
+        ops (``SET``/``GET``/``TAKE_RESTORED``) fail typed immediately,
+        because the server-side effect of the lost exchange is
+        unknowable and a blind re-send could double-publish or eat a
+        frame."""
+        attempts = 1 + (self.op_retries if op in _IDEMPOTENT_OPS else 0)
+        for attempt in range(attempts):
+            try:
+                sock = self._sock()
+                send_tensors(
+                    sock,
+                    (np.array([op, slot, arg], np.int64),) + tuple(payload),
+                    pts, fault_key="nnsq.repo")
+                return recv_tensors(sock)
+            except RemoteRepoError:
+                raise
+            except (ConnectionError, OSError) as exc:
+                self._reset()
+                if attempt + 1 < attempts:
+                    self.retries_total += 1
+                    time.sleep(self.retry_backoff_s * (attempt + 1))
+                    continue
+                raise RemoteRepoError(op, slot, exc) from exc
 
     # -- the TensorRepo surface ---------------------------------------------
 

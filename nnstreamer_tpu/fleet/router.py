@@ -34,8 +34,16 @@ door — the ``sched/`` tenancy model extended across workers, where it
 actually bounds aggregate load instead of per-process slices.
 
 **Rebalance** (:meth:`Router.drain_worker`): stop new work via
-membership draining, wait for the worker's live sessions to finish (up
-to the deadline), force-break stragglers with ``[SESSION]``, eject.
+membership draining, then **live-migrate** every pinned decode session
+to another worker (quiesce at a tick boundary → snapshot the engine
+slot through the ``[fleet] repo_addr`` TensorRepo → restore on the
+target → re-pin the client's sticky backend socket; the client keeps
+streaming, token-identical).  Only what cannot migrate (old workers on
+the version-gated wire path, no repo, no spare capacity, an injected
+``migrate_abort``) degrades to the legacy path: wait to the deadline,
+force-break with ``[SESSION]``, eject.  A migration monitor applies the
+same handoff to workers that announce their OWN drain (SIGTERM →
+``draining`` probe verdict) — true rolling restarts.
 
 With span tracing active the router records an ``nnsq_route`` span on
 the client's wire trace and forwards its span id as the worker-side
@@ -55,18 +63,22 @@ from typing import Dict, List, Optional, Set, Tuple
 
 from .. import faults as _faults
 from ..elements.query import (
+    MIGRATE_PTS,
     PROBE_PTS,
+    RESUME_PTS,
     QueryError,
     QueryExpiredError,
+    QueryMigratingError,
     QueryOverloadError,
     QueryTimeoutError,
     QueryUnavailableError,
+    pack_session_control,
     recv_tensors_ex,
     send_error,
     send_tensors,
 )
 from ..obs import spans as _spans
-from .membership import Membership, NoWorkerAvailable, WorkerInfo
+from .membership import DRAINING, Membership, NoWorkerAvailable, WorkerInfo
 
 
 class _WorkerLink:
@@ -126,7 +138,8 @@ class _WorkerLink:
 class _Session:
     """One pinned stateful session: client conn + dedicated worker sock."""
 
-    __slots__ = ("worker", "sock", "client", "lock", "broken", "steps")
+    __slots__ = ("worker", "sock", "client", "lock", "broken", "steps",
+                 "mig_lock", "migrating")
 
     def __init__(self, worker: WorkerInfo, sock: socket.socket, client):
         self.worker = worker
@@ -135,6 +148,12 @@ class _Session:
         self.lock = threading.Lock()
         self.broken = False
         self.steps = 0
+        # handoff gate: a forward holds it for the whole backend round
+        # trip, a live migration holds it for the whole handoff — so a
+        # client frame arriving mid-handoff simply waits, then rides the
+        # NEW pinned socket (zero downtime, never a lost or torn step)
+        self.mig_lock = threading.Lock()
+        self.migrating = False
 
 
 class Router:
@@ -148,7 +167,19 @@ class Router:
                  connect_timeout: Optional[float] = None,
                  request_timeout: Optional[float] = None,
                  drain_deadline_s: Optional[float] = None,
-                 name: str = "router"):
+                 name: str = "router",
+                 repo_addr: Optional[str] = None,
+                 migrate: Optional[bool] = None,
+                 migrate_timeout_s: Optional[float] = None,
+                 migrate_check_s: Optional[float] = None):
+        """``repo_addr`` (``host:port`` of a
+        :class:`~nnstreamer_tpu.fleet.repo.TensorRepoServer`, default
+        ``[fleet] repo_addr``) enables **live session migration** on a
+        stateful router: a planned drain quiesces each pinned session,
+        snapshots its engine state through the repo, restores it on
+        another worker, and re-pins the client's backend socket — the
+        client keeps streaming, token-identical.  ``migrate=False``
+        (``[fleet] migrate``) keeps the legacy force-break drain."""
         from ..conf import conf
 
         def _f(key, arg, default):
@@ -194,7 +225,31 @@ class Router:
         self.rerouted = 0          # transport-failure re-dispatches
         self.sessions_opened = 0
         self.sessions_broken = 0
+        self.sessions_closed = 0   # every session ends here exactly once
+        self.sessions_migrated = 0
+        self.migration_aborts: Dict[str, int] = {}  # phase -> count
         self._stats_key: Optional[str] = None
+        # -- live migration (stateful routers) --------------------------------
+        self.repo_addr = (str(repo_addr) if repo_addr is not None
+                          else conf.get("fleet", "repo_addr", "") or "")
+        self.migrate_enabled = (bool(migrate) if migrate is not None
+                                else conf.get_bool("fleet", "migrate", True))
+        self.migrate_timeout_s = _f("migrate_timeout_s", migrate_timeout_s,
+                                    10.0)
+        self.migrate_check_s = _f("migrate_check_s", migrate_check_s, 0.25)
+        self._mig_seq = 0  # repo-slot key sequence (per-router namespace)
+        self._mig_thread: Optional[threading.Thread] = None
+        self._mig_stop = threading.Event()
+        from ..obs.metrics import REGISTRY
+
+        self._c_migrations = REGISTRY.counter(
+            "nnstpu_session_migrations_total",
+            "live decode-session migrations by result "
+            "(ok / abort / fallback)", labelnames=("result",))
+        self._h_migration = REGISTRY.histogram(
+            "nnstpu_session_migration_seconds",
+            "handoff duration of one live session migration "
+            "(quiesce + snapshot + restore + re-pin)")
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -207,6 +262,16 @@ class Router:
             target=self._accept_loop, daemon=True,
             name=f"fleet-router:{self.name}")
         self._accept_thread.start()
+        if self.stateful and self.migrate_enabled and self.repo_addr:
+            # migration monitor: a worker that announces its OWN drain
+            # (SIGTERM → probe verdict DRAINING) gets its live sessions
+            # moved off before the worker-side deadline breaks them —
+            # router-initiated drains (drain_worker) migrate inline
+            self._mig_stop.clear()
+            self._mig_thread = threading.Thread(
+                target=self._migrate_monitor, daemon=True,
+                name=f"fleet-migrate:{self.name}")
+            self._mig_thread.start()
         from ..obs.export import register_stats
 
         self._stats_key = f"fleet:{self.name}"
@@ -215,6 +280,10 @@ class Router:
 
     def stop(self) -> None:
         self._running = False
+        self._mig_stop.set()
+        if self._mig_thread is not None:
+            self._mig_thread.join(timeout=5)
+            self._mig_thread = None
         if self._srv is not None:
             self._srv.close()
         with self._links_lock:
@@ -465,12 +534,26 @@ class Router:
             group = self._sessions.get(sess.worker.id)
             if group is not None:
                 group.discard(sess)
+        with self._ledger_lock:
+            # the session ledger: opened == active + closed, always
+            self.sessions_closed += 1
 
-    def session_count(self, worker_id: Optional[str] = None) -> int:
+    def session_count(self, worker_id: Optional[str] = None,
+                      live_only: bool = False) -> int:
+        """Pinned sessions (optionally for one worker).  ``live_only``
+        excludes sessions mid-handoff (drain accounting counts those as
+        migrating, not live, so a drain never waits on its own
+        migrations) and already-broken ones (typed-terminated; nothing
+        left to wait for)."""
         with self._sessions_lock:
             if worker_id is not None:
-                return len(self._sessions.get(worker_id, ()))
-            return sum(len(g) for g in self._sessions.values())
+                group = self._sessions.get(worker_id, ())
+            else:
+                group = [s for g in self._sessions.values() for s in g]
+            if live_only:
+                return sum(1 for s in group
+                           if not s.migrating and not s.broken)
+            return len(group)
 
     def _serve_stateful(self, conn, client: str) -> None:
         sess: Optional[_Session] = None
@@ -571,28 +654,37 @@ class Router:
         """Forward one frame on the pinned connection.  NO replay on
         failure — the worker's session state already advanced an unknown
         number of steps; the client gets the typed ``[SESSION]`` code
-        and rebuilds."""
-        try:
-            send_tensors(sess.sock, tensors, pts, trace=fwd_trace,
-                         fault_key="nnsq.router", tenant=tenant)
-            outs, opts, _rt = recv_tensors_ex(sess.sock)[:3]
-        except (QueryTimeoutError, ConnectionError, OSError) as exc:
-            self.membership.report_failure(sess.worker)
-            with self._ledger_lock:
-                self.sessions_broken += 1
-            with sess.lock:
-                if not sess.broken:
-                    sess.broken = True
-                    try:
-                        send_error(
-                            sess.client,
-                            f"decode session on worker {sess.worker.id} "
-                            f"broken mid-stream ({exc}); stateful requests "
-                            "are never replayed — reconnect and re-prefill",
-                            code="SESSION")
-                    except OSError:
-                        pass
-            raise _SessionOver() from exc
+        and rebuilds.  The one exception is the typed ``[MIGRATING]``
+        verdict, which guarantees the frame was NOT applied: the frame
+        re-sends exactly once on the (by then re-pinned) backend socket.
+        Each forward holds the session's migration gate, so a frame
+        arriving mid-handoff waits and then rides the new worker."""
+        for attempt in (0, 1):
+            try:
+                with sess.mig_lock:
+                    send_tensors(sess.sock, tensors, pts, trace=fwd_trace,
+                                 fault_key="nnsq.router", tenant=tenant)
+                    outs, opts, _rt = recv_tensors_ex(sess.sock)[:3]
+            except QueryMigratingError as exc:
+                # the worker says the session moved and this frame did
+                # not touch state: safe to re-send ONCE after the
+                # handoff re-pins the socket.  Persisting = the handoff
+                # failed → session-fatal, the fallback old clients know.
+                if attempt == 0:
+                    continue
+                self._break_session(
+                    sess, f"decode session migration on worker "
+                    f"{sess.worker.id} did not converge ({exc}); "
+                    "reconnect and re-prefill")
+                raise _SessionOver() from exc
+            except (QueryTimeoutError, ConnectionError, OSError) as exc:
+                self.membership.report_failure(sess.worker)
+                self._break_session(
+                    sess, f"decode session on worker {sess.worker.id} "
+                    f"broken mid-stream ({exc}); stateful requests "
+                    "are never replayed — reconnect and re-prefill")
+                raise _SessionOver() from exc
+            break
         with sess.lock:
             if sess.broken:
                 raise _SessionOver()
@@ -600,6 +692,239 @@ class Router:
                          fault_key="nnsq.router")
         sess.steps += 1
         self.membership.report_success(sess.worker)
+
+    def _break_session(self, sess: _Session, msg: str) -> None:
+        """Terminate one pinned session with the typed ``[SESSION]``
+        verdict (idempotent; never a torn client socket).  The ledger
+        counts BEFORE the frame goes out: a client reacting to the
+        typed error must already see the break in stats()."""
+        with sess.lock:
+            if sess.broken:
+                return
+            sess.broken = True
+            with self._ledger_lock:
+                self.sessions_broken += 1
+            try:
+                send_error(sess.client, msg, code="SESSION")
+            except OSError:
+                pass
+
+    # -- live migration ------------------------------------------------------
+
+    def _next_migration_key(self) -> int:
+        """A repo-slot key unique across routers sharing one repo server
+        (router-name namespace | per-router sequence)."""
+        with self._ledger_lock:
+            self._mig_seq += 1
+            seq = self._mig_seq
+        return ((zlib.crc32(self.name.encode()) & 0x7FF) << 20) | \
+            (seq & 0xFFFFF)
+
+    def _count_migration(self, result: str, phase: str = "",
+                         t0: Optional[float] = None) -> None:
+        if result == "noop":
+            return  # nothing was attempted (session already gone)
+        self._c_migrations.inc(1, result=result)
+        if result == "ok" and t0 is not None:
+            self._h_migration.observe(time.monotonic() - t0)
+        if result != "ok" and phase:
+            with self._ledger_lock:
+                self.migration_aborts[phase] = \
+                    self.migration_aborts.get(phase, 0) + 1
+
+    def _migrate_session(self, sess: _Session) -> bool:
+        """Hand one pinned session off to another worker with zero
+        client-visible downtime: quiesce (grab the session's migration
+        gate — in-flight forward completes, new frames wait) → snapshot
+        (``MIGRATE_PTS`` on the source socket publishes the engine state
+        into the repo and frees the source slot) → restore
+        (``RESUME_PTS`` on a fresh socket to the target rebuilds it) →
+        re-pin (swap the backend socket under the gate).
+
+        Returns True when the session was RESOLVED — migrated, or (after
+        the source slot was irrevocably released) broken typed — and
+        False when it was left untouched, in which case the caller falls
+        back to the legacy wait-then-force-break drain path."""
+        if not (self.migrate_enabled and self.repo_addr):
+            return False
+        t0 = time.monotonic()
+        # the session_migrate parent span opens before the quiesce so
+        # every phase (quiesce/snapshot/restore/resume, plus the worker-
+        # side spans via the forwarded trace) nests under it in the
+        # merged Perfetto timeline
+        tok = (_spans.span_begin(_spans.new_trace_id(), 0)
+               if _spans.enabled else None)
+        ts = _spans.now_ns() if _spans.enabled else 0
+        if not sess.mig_lock.acquire(timeout=self.migrate_timeout_s):
+            # quiesce failed: a forward is wedged on the old worker
+            self._count_migration("abort", "quiesce")
+            if tok is not None:
+                _spans.span_end(tok, "session_migrate", "migrate",
+                                args={"src": sess.worker.id,
+                                      "result": "abort",
+                                      "phase": "quiesce"})
+            return False
+        if ts:
+            _spans.record_span("migrate_quiesce", ts,
+                               _spans.now_ns() - ts, cat="migrate",
+                               args={"worker": sess.worker.id})
+        phase = "quiesce"
+        snapshot_done = False
+        src = sess.worker
+        key = self._next_migration_key()
+        wire_trace = (tok[2], tok[0]) if tok is not None else None
+        result = "noop"
+        target = None
+        nsock = None
+        try:
+            with sess.lock:
+                if sess.broken:
+                    return True  # nothing left to move
+            sess.migrating = True
+            src.sessions_migrating += 1
+            phase = "target"
+            try:
+                target = self.membership.pick(exclude={src.id})
+            except NoWorkerAvailable:
+                result = "fallback"
+                return False
+            ctl = pack_session_control(
+                self.repo_addr, key, int(self.migrate_timeout_s * 1e3))
+            phase = "snapshot"
+            if _faults.enabled:
+                _faults.maybe_migrate(f"{self.name}:snapshot:{src.id}")
+            ts = _spans.now_ns() if _spans.enabled else 0
+            # quiesce + snapshot happen server-side at a tick boundary;
+            # an old worker answers the control frame with a plain error
+            # (version gate) and we fall back without touching state
+            send_tensors(sess.sock, ctl, MIGRATE_PTS,
+                         fault_key="nnsq.router", trace=wire_trace)
+            recv_tensors_ex(sess.sock)
+            snapshot_done = True  # source slot is freed; no way back
+            if ts:
+                _spans.record_span("migrate_snapshot", ts,
+                                   _spans.now_ns() - ts, cat="migrate",
+                                   args={"worker": src.id})
+            phase = "restore"
+            if _faults.enabled:
+                _faults.maybe_migrate(f"{self.name}:restore:{target.id}")
+            ts = _spans.now_ns() if _spans.enabled else 0
+            nsock = socket.create_connection(
+                target.addr, timeout=self.connect_timeout)
+            nsock.settimeout(self.request_timeout)
+            send_tensors(nsock, ctl, RESUME_PTS, fault_key="nnsq.router",
+                         trace=wire_trace)
+            recv_tensors_ex(nsock)
+            if ts:
+                _spans.record_span("migrate_restore", ts,
+                                   _spans.now_ns() - ts, cat="migrate",
+                                   args={"worker": target.id})
+            phase = "resume"
+            ts = _spans.now_ns() if _spans.enabled else 0
+            old_sock = sess.sock
+            with self._sessions_lock:
+                group = self._sessions.get(src.id)
+                if group is not None:
+                    group.discard(sess)
+                self._sessions.setdefault(target.id, set()).add(sess)
+            sess.worker = target
+            sess.sock = nsock
+            nsock = None  # now owned by the session
+            try:
+                old_sock.close()
+            except OSError:
+                pass
+            if ts:
+                _spans.record_span("migrate_resume", ts,
+                                   _spans.now_ns() - ts, cat="migrate",
+                                   args={"worker": target.id})
+            with self._ledger_lock:
+                self.sessions_migrated += 1
+            self.membership.report_success(target)
+            result = "ok"
+            return True
+        except Exception as exc:  # noqa: BLE001 — degrade, never hang
+            result = "fallback" if not snapshot_done else "abort"
+            if nsock is not None:
+                try:
+                    nsock.close()
+                except OSError:
+                    pass
+            if not snapshot_done:
+                # source untouched: the caller's legacy drain path
+                # (wait, then force-break typed) still owns the session
+                return False
+            # point of no return crossed: the source slot is freed and
+            # the state sits in the repo — the session cannot continue
+            # anywhere, so it degrades to today's typed [SESSION] path
+            self._break_session(
+                sess, f"decode session handoff {src.id} -> "
+                f"{target.id if target else '?'} aborted at {phase} "
+                f"({exc}); reconnect and re-prefill")
+            try:
+                sess.sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            self._repo_clear(key)
+            return True
+        finally:
+            self._count_migration(result, phase, t0)
+            if sess.migrating:
+                sess.migrating = False
+                src.sessions_migrating = max(0, src.sessions_migrating - 1)
+            sess.mig_lock.release()
+            if tok is not None:
+                _spans.span_end(
+                    tok, "session_migrate", "migrate",
+                    args={"src": src.id,
+                          "dst": target.id if target else "",
+                          "result": result, "phase": phase,
+                          "key": key})
+
+    def _repo_clear(self, key: int) -> None:
+        """Best-effort cleanup of an orphaned snapshot slot."""
+        from .repo import RemoteTensorRepo
+
+        try:
+            repo = RemoteTensorRepo.from_addr(self.repo_addr)
+            try:
+                repo.clear(key)
+            finally:
+                repo.close()
+        except Exception:  # noqa: BLE001 — cleanup must not mask the abort
+            pass
+
+    def migrate_worker_sessions(self, worker_id: str) -> int:
+        """Move every live session off ``worker_id``; returns how many
+        were resolved (migrated or, past the point of no return, broken
+        typed).  Sessions it could not touch stay for the caller's
+        legacy drain path."""
+        with self._sessions_lock:
+            sessions = list(self._sessions.get(worker_id, ()))
+        n = 0
+        for sess in sessions:
+            if sess.broken or sess.migrating:
+                continue
+            if self._migrate_session(sess):
+                n += 1
+        return n
+
+    def _migrate_monitor(self) -> None:
+        """Watch membership for workers announcing their own drain
+        (SIGTERM → probe verdict DRAINING) and migrate their sessions
+        before the worker-side deadline force-breaks them — the rolling-
+        restart path where nobody calls :meth:`drain_worker`."""
+        while not self._mig_stop.wait(self.migrate_check_s):
+            try:
+                for w in self.membership.workers():
+                    if (w.draining or w.state == DRAINING) and \
+                            self.session_count(w.id):
+                        self.migrate_worker_sessions(w.id)
+            except Exception:  # noqa: BLE001 — the monitor must survive
+                import logging
+
+                logging.getLogger("nnstreamer_tpu.fleet").exception(
+                    "%s: migration monitor pass failed", self.name)
 
     # -- rebalance -----------------------------------------------------------
 
@@ -630,16 +955,26 @@ class Router:
         return n
 
     def drain_worker(self, worker_id: str,
-                     deadline_s: Optional[float] = None) -> int:
-        """Planned removal: stop new work (membership drain), wait for
-        the worker's live sessions to finish up to ``deadline_s``, break
-        stragglers with the typed ``[SESSION]`` code, then eject.
-        Returns the number of force-broken sessions (0 = clean drain)."""
+                     deadline_s: Optional[float] = None,
+                     migrate: Optional[bool] = None) -> int:
+        """Planned removal, migrate-first: stop new work (membership
+        drain), live-migrate every pinned session to another worker
+        (zero client-visible downtime, token-identical continuation),
+        wait out anything unmigratable up to ``deadline_s``, force-break
+        stragglers with the typed ``[SESSION]`` code (the fallback path
+        — old workers, no repo, no capacity), then eject.  Returns the
+        number of force-broken sessions (0 = clean drain)."""
         deadline_s = (self.drain_deadline_s if deadline_s is None
                       else float(deadline_s))
         self.membership.drain(worker_id)
+        if migrate is None:
+            migrate = self.stateful and self.migrate_enabled \
+                and bool(self.repo_addr)
+        if migrate:
+            self.migrate_worker_sessions(worker_id)
         deadline = time.monotonic() + deadline_s
-        while time.monotonic() < deadline and self.session_count(worker_id):
+        while time.monotonic() < deadline and \
+                self.session_count(worker_id, live_only=True):
             time.sleep(0.02)
         broken = 0
         if self.session_count(worker_id):
@@ -665,9 +1000,24 @@ class Router:
                 "rerouted": self.rerouted,
                 "sessions_opened": self.sessions_opened,
                 "sessions_broken": self.sessions_broken,
+                "sessions_closed": self.sessions_closed,
+                "sessions_migrated": self.sessions_migrated,
+                "migration_aborts": dict(self.migration_aborts),
                 "tenants": {t: dict(e) for t, e in self.tenants.items()},
             }
+        out["migration"] = {
+            "enabled": bool(self.migrate_enabled and self.repo_addr),
+            "repo_addr": self.repo_addr,
+        }
         out["sessions_active"] = self.session_count()
+        out["sessions_migrating"] = (
+            out["sessions_active"] - self.session_count(live_only=True))
+        # the session ledger: every opened session is either still
+        # active or ended exactly once — operators judging a stuck
+        # drain read active/migrating per worker below
+        out["session_ledger_exact"] = (
+            out["sessions_opened"]
+            == out["sessions_active"] + out["sessions_closed"])
         with self._sessions_lock:
             out["sessions_by_worker"] = {
                 wid: len(group) for wid, group in self._sessions.items()

@@ -201,7 +201,11 @@ class FleetWorker:
         if self._killed:
             raise ConnectionError(f"{self.name}: killed")
         if self._draining:
-            return "unhealthy"
+            # distinct from unhealthy: membership maps this to DRAINING,
+            # which is the stateful router's cue to migrate this
+            # worker's live decode sessions off before the drain
+            # deadline force-breaks them
+            return "draining"
         if self._warming:
             return "warming:compile-ahead warmup"
         if self.degraded_reason:

@@ -68,6 +68,13 @@ class DecodeSession:
         self._q_out: "queue.Queue[np.ndarray]" = queue.Queue()
         self.closed = False
         self.steps = 0
+        # host-side mirror of the slot's cache position (prefill sets it
+        # to the prompt length, each gated step advances it) — cheap
+        # occupancy/pos observability without a device pull per stats()
+        self.pos = 0
+        # migration gate: a gated session is invisible to _gather (its
+        # queued inputs stay queued) while its slot state is snapshotted
+        self._gated = False
 
     def feed(self, x) -> None:
         """Queue one step's features ((d_in,) float32); returns immediately.
@@ -176,6 +183,15 @@ class DecodeSession:
                     + (f" (engine failure: {err!r})" if err else "")
                 )
             return out
+
+    def snapshot(self) -> dict:
+        """Checkpoint this session's complete decode state (KV cache
+        slice, position, pending queue items) quiesced at a tick
+        boundary — see :meth:`ContinuousBatcher.snapshot_session`.  The
+        session stays gated (no further ticks touch its slot) until it
+        is closed or :meth:`ContinuousBatcher.abort_snapshot` re-arms
+        it."""
+        return self._engine.snapshot_session(self)
 
     def close(self) -> None:
         """Release the slot (reusable by the next :meth:`ContinuousBatcher.
@@ -319,11 +335,21 @@ class ContinuousBatcher:
         self._active: Dict[int, DecodeSession] = {}
         self._free = list(range(self.capacity - 1, -1, -1))  # pop() -> slot 0 first
         self._resets: list = []
+        # pending checkpoint restores: (slot, cache np, pos) applied by
+        # _gather AFTER resets (a restore overrides the join-time zero)
+        self._restores: list = []
+        # True while the engine thread is between _gather and the tick's
+        # closing critical section — the window in which the device state
+        # (possibly donated) must not be read.  snapshot_session waits
+        # for False under _cv: that IS the tick boundary.
+        self._ticking = False
         self._running = True
         self._error: Optional[BaseException] = None
         self.ticks = 0          # compiled steps dispatched
         self.steps_total = 0    # per-stream steps served
         self.prefill_tokens = 0  # prompt tokens absorbed via prefill
+        self.sessions_migrated_out = 0  # snapshots taken for migration
+        self.sessions_migrated_in = 0   # sessions restored from snapshots
         self._thread = threading.Thread(
             target=self._loop, daemon=True, name="continuous-batcher")
         self._thread.start()
@@ -360,9 +386,19 @@ class ContinuousBatcher:
 
     def stats(self) -> dict:
         """Engine observability snapshot (the ``tensor_debug`` discipline:
-        thread-safe, no device pulls): occupancy, served counters, and the
-        tick-coalescing ratio."""
+        thread-safe, no device pulls): occupancy, served counters, the
+        tick-coalescing ratio, and per-slot occupancy + position (the
+        state an operator needs to judge a stuck drain)."""
         with self._cv:
+            slots = {}
+            for slot in range(self.capacity):
+                sess = self._active.get(slot)
+                slots[slot] = {
+                    "occupied": sess is not None,
+                    "pos": sess.pos if sess is not None else 0,
+                    "steps": sess.steps if sess is not None else 0,
+                    "gated": bool(sess is not None and sess._gated),
+                }
             return {
                 "capacity": self.capacity,
                 "active_sessions": len(self._active),
@@ -373,6 +409,9 @@ class ContinuousBatcher:
                 "coalescing": round(self.steps_total / self.ticks, 3)
                 if self.ticks else None,
                 "running": self._running,
+                "sessions_migrated_out": self.sessions_migrated_out,
+                "sessions_migrated_in": self.sessions_migrated_in,
+                "slots": slots,
             }
 
     def stop(self) -> None:
@@ -420,8 +459,130 @@ class ContinuousBatcher:
         with self._cv:
             if self._active.get(sess.slot) is sess:
                 del self._active[sess.slot]
+                # a queued-but-unapplied restore for this slot must not
+                # leak into the NEXT stream that reserves it (resets
+                # apply before restores in _gather)
+                self._restores = [r for r in self._restores
+                                  if r[0] != sess.slot]
                 self._free.append(sess.slot)
                 self._cv.notify_all()
+
+    # -- live migration: checkpoint / restore --------------------------------
+
+    def snapshot_session(self, sess: DecodeSession,
+                         timeout: float = 10.0) -> dict:
+        """Checkpoint one session, quiesced at a tick boundary: gate the
+        slot off (``_gather`` skips it), wait for any in-flight tick to
+        complete AND deliver its outputs, then capture the slot's KV
+        cache slice, position, and both pending queues.  The session
+        stays gated afterwards — the caller either closes it (migration
+        committed) or re-arms it via :meth:`abort_snapshot`.
+
+        The returned dict round-trips through
+        :func:`pack_session_snapshot` / :func:`unpack_session_snapshot`
+        (flat numpy tensors, the ``tensor_repo`` frame shape) and feeds
+        :meth:`restore_session` on any engine with matching geometry —
+        including one with a different mesh width (the slot state is
+        re-placed under the target's sharding)."""
+        with self._cv:
+            if self._active.get(sess.slot) is not sess:
+                raise RuntimeError(
+                    "session is not active on this engine (closed, or a "
+                    "foreign engine's session)")
+            sess._gated = True
+            try:
+                if not self._cv.wait_for(
+                    lambda: not self._ticking or not self._running,
+                    timeout=timeout,
+                ):
+                    raise TimeoutError(
+                        f"engine did not reach a tick boundary within "
+                        f"{timeout}s")
+                self._check_alive()
+                # safe under _cv: the engine thread needs the lock to
+                # start the next tick, and the last one fully closed
+                cache = np.asarray(jax.device_get(
+                    self._caches[sess.slot].astype(jnp.float32)))
+                pos = int(np.asarray(
+                    jax.device_get(self._poss[sess.slot])).reshape(-1)[0])
+                pending_in = []
+                while True:
+                    try:
+                        pending_in.append(sess._q_in.get_nowait())
+                    except queue.Empty:
+                        break
+                pending_out = []
+                while True:
+                    try:
+                        item = sess._q_out.get_nowait()
+                    except queue.Empty:
+                        break
+                    if item is not _STOPPED:
+                        pending_out.append(np.asarray(item, np.float32))
+                self.sessions_migrated_out += 1
+            except BaseException:
+                sess._gated = False
+                self._cv.notify_all()
+                raise
+        return {
+            "version": 1,
+            "d_in": self.d_in,
+            "n_out": self.n_out,
+            "t_max": self.t_max,
+            "window": bool(self.window),
+            "cache": cache,
+            "pos": pos,
+            "steps": int(sess.steps),
+            "pending_in": pending_in,
+            "pending_out": pending_out,
+        }
+
+    def abort_snapshot(self, sess: DecodeSession, snapshot: dict) -> None:
+        """Undo a snapshot whose handoff failed BEFORE the source slot
+        was released: re-queue the drained pending items (cache/pos were
+        never touched — the slot was gated) and re-arm the session, so
+        it keeps serving exactly where it was."""
+        with self._cv:
+            for item in snapshot.get("pending_in", ()):
+                sess._q_in.put(item)
+            for item in snapshot.get("pending_out", ()):
+                sess._q_out.put(item)
+            sess._gated = False
+            self._cv.notify_all()
+
+    def restore_session(self, snapshot: dict,
+                        timeout: Optional[float] = None) -> DecodeSession:
+        """Open a session whose slot continues from ``snapshot`` (a
+        :meth:`snapshot_session` dict): the KV cache slice and position
+        are re-placed into this engine's batch (under its own sharding)
+        before the session's first tick, pending inputs re-queue in
+        order, and already-computed outputs re-deliver first — so the
+        stream's token sequence is identical to an unmigrated run.
+        Raises ValueError on geometry mismatch (wrong state is never
+        silently served)."""
+        cache = np.asarray(snapshot["cache"], np.float32)
+        want = tuple(self._caches.shape[1:])
+        mine = dict(d_in=self.d_in, n_out=self.n_out, t_max=self.t_max,
+                    window=bool(self.window))
+        theirs = {k: snapshot.get(k) for k in mine}
+        theirs["window"] = bool(theirs["window"])
+        if theirs != mine or tuple(cache.shape) != want:
+            raise ValueError(
+                f"snapshot geometry mismatch: snapshot has {theirs} with "
+                f"cache {tuple(cache.shape)}, this engine expects {mine} "
+                f"with cache {want} — refusing to restore wrong state")
+        sess = self.open_session(timeout=timeout)
+        with self._cv:
+            sess.steps = int(snapshot.get("steps", 0))
+            sess.pos = int(snapshot["pos"])
+            self._restores.append((sess.slot, cache, sess.pos))
+            for item in snapshot.get("pending_out", ()):
+                sess._q_out.put(np.asarray(item, np.float32))
+            for item in snapshot.get("pending_in", ()):
+                sess._q_in.put(item)
+            self.sessions_migrated_in += 1
+            self._cv.notify_all()
+        return sess
 
     def warmup_prefill(self, max_len: Optional[int] = None) -> dict:
         """Compile-ahead for the prefill path: AOT-compile every prompt
@@ -467,19 +628,38 @@ class ContinuousBatcher:
         return fn
 
     def _gather(self):
-        """Under the lock: apply pending slot resets, collect at most one
-        queued item per active session (a decode step or a prefill
-        marker).  Returns (xs, gates, fed, prefills) or None when idle."""
+        """Under the lock: apply pending slot resets and checkpoint
+        restores, collect at most one queued item per active session (a
+        decode step or a prefill marker).  Returns (xs, gates, fed,
+        prefills) or None when idle."""
         for slot in self._resets:
             # join-time state reset, serialized with stepping (no cross-
             # thread mutation of the device arrays)
             self._caches = self._caches.at[slot].set(0)
             self._poss = self._poss.at[slot].set(0)
         self._resets.clear()
+        for slot, cache, pos in self._restores:
+            # checkpoint restore overrides the join-time zero: the slot
+            # continues exactly where the snapshot left it (position T)
+            cache = jnp.asarray(cache, self._caches.dtype)
+            pos_a = jnp.asarray(pos, jnp.int32)
+            if self.mesh is not None:
+                # same re-placement the prefill path needs: a host value
+                # must compose with the sharded state (slot axis may be
+                # sharded over a DIFFERENT mesh width than the source's)
+                from .parallel.mesh import replicated
+
+                cache = jax.device_put(cache, replicated(self.mesh))
+                pos_a = jax.device_put(pos_a, replicated(self.mesh))
+            self._caches = self._caches.at[slot].set(cache)
+            self._poss = self._poss.at[slot].set(pos_a)
+        self._restores.clear()
         xs = gates = None
         fed = {}
         prefills = []
         for slot, sess in self._active.items():
+            if sess._gated:
+                continue  # mid-snapshot: its queued inputs stay queued
             try:
                 item = sess._q_in.get_nowait()
             except queue.Empty:
@@ -510,6 +690,10 @@ class ContinuousBatcher:
                     if batch is None and not self._running:
                         return
                     xs, gates, fed, prefills = batch
+                    # tick in flight: the device state (donated through
+                    # the step on accelerators) is unreadable until the
+                    # closing critical section flips this back
+                    self._ticking = True
                 # Dispatches (and any first-bucket prefill COMPILE) run
                 # OUTSIDE the lock: the device state is engine-thread-
                 # exclusive, and holding _cv through a multi-second XLA
@@ -554,18 +738,133 @@ class ContinuousBatcher:
                         self.ticks += 1
                         self.steps_total += 1
                         sess.steps += 1
+                        sess.pos = n
                     if ys_np is not None:
                         self.ticks += 1
                         self.steps_total += len(fed)
                         for sess in fed.values():
                             sess.steps += 1
-                for sess, y_last, n in pre_out:
-                    sess._q_out.put(np.asarray(y_last).copy())
-                if ys_np is not None:
-                    for slot, sess in fed.items():
-                        sess._q_out.put(ys_np[slot].copy())
+                            sess.pos += 1
+                    # outputs are delivered INSIDE the same critical
+                    # section that ends the tick: when _ticking flips
+                    # back, every result of this tick is already in its
+                    # session's queue — the tick-boundary contract
+                    # snapshot_session relies on (nothing of a migrated
+                    # slot can be in flight once the boundary is seen)
+                    for sess, y_last, n in pre_out:
+                        sess._q_out.put(np.asarray(y_last).copy())
+                    if ys_np is not None:
+                        for slot, sess in fed.items():
+                            sess._q_out.put(ys_np[slot].copy())
+                    self._ticking = False
+                    self._cv.notify_all()
         except BaseException as exc:  # noqa: BLE001 — wake the waiters
             self._fail(exc)
+
+
+# -- session snapshot wire format --------------------------------------------
+#
+# A snapshot travels as ONE flat tuple of numpy tensors (the tensor_repo
+# frame shape — raw endian-explicit bytes over the NNSQ framing, no
+# pickle, the untrusted-peer discipline of the whole wire layer):
+#
+#   t[0]  int64 header: [version, d_in, n_out, t_max, window, pos, steps,
+#                        n_pending_in, n_pending_out, *pending_in_meta]
+#         where pending_in_meta[i] is -1 for a queued step and the
+#         UNPADDED prompt length for a queued prefill;
+#   t[1]  float32 cache slice (L, 2, T_max, d_model);
+#   t[2]  float32 (n_pending_out, n_out) already-computed outputs;
+#   t[3:] the pending input items, in queue order (steps rank-1,
+#         prefill prompts rank-2 at their padded bucket length).
+
+SNAPSHOT_VERSION = 1
+# the NNSQ frame carries at most 16 tensors; 3 are fixed, so a session
+# with more queued inputs than this cannot migrate (it falls back to the
+# typed [SESSION] drain path — in the synchronous DecodeServer flow the
+# queue is empty at snapshot time, so this is a pathological bound)
+MAX_SNAPSHOT_PENDING = 12
+
+
+def pack_session_snapshot(snap: dict) -> tuple:
+    """A :meth:`ContinuousBatcher.snapshot_session` dict -> flat numpy
+    tensors for one repo/NNSQ frame."""
+    pending_in = list(snap.get("pending_in", ()))
+    if len(pending_in) > MAX_SNAPSHOT_PENDING:
+        raise RuntimeError(
+            f"session has {len(pending_in)} pending inputs; at most "
+            f"{MAX_SNAPSHOT_PENDING} fit a snapshot frame")
+    meta, items = [], []
+    for item in pending_in:
+        if isinstance(item, tuple) and item[0] == "prefill":
+            meta.append(int(item[2]))
+            items.append(np.asarray(item[1], np.float32))
+        else:
+            meta.append(-1)
+            items.append(np.asarray(item, np.float32))
+    pending_out = [np.asarray(o, np.float32)
+                   for o in snap.get("pending_out", ())]
+    # the wire/spec layer requires every dim >= 1: an empty pending-out
+    # stack ships one zero row, declared empty by n_pending_out == 0
+    outs = (np.stack(pending_out) if pending_out
+            else np.zeros((1, int(snap["n_out"])), np.float32))
+    header = np.array(
+        [SNAPSHOT_VERSION, snap["d_in"], snap["n_out"], snap["t_max"],
+         int(bool(snap["window"])), snap["pos"], snap.get("steps", 0),
+         len(items), len(pending_out)] + meta, np.int64)
+    return (header, np.asarray(snap["cache"], np.float32), outs,
+            *items)
+
+
+def unpack_session_snapshot(tensors) -> dict:
+    """Inverse of :func:`pack_session_snapshot`; validates the framing
+    (a corrupt/foreign frame raises ValueError, never restores junk)."""
+    if len(tensors) < 3:
+        raise ValueError(
+            f"session snapshot needs >= 3 tensors, got {len(tensors)}")
+    header = np.asarray(tensors[0])
+    if header.dtype != np.int64 or header.ndim != 1 or header.size < 9:
+        raise ValueError(f"bad snapshot header {header.dtype}/{header.shape}")
+    ver = int(header[0])
+    if ver != SNAPSHOT_VERSION:
+        raise ValueError(f"snapshot version {ver} != {SNAPSHOT_VERSION}")
+    d_in, n_out, t_max, window, pos, steps, n_in, n_pout = (
+        int(v) for v in header[1:9])
+    if header.size != 9 + n_in or len(tensors) != 3 + n_in:
+        raise ValueError(
+            f"snapshot declares {n_in} pending inputs but carries "
+            f"{len(tensors) - 3} (header size {header.size})")
+    outs = np.asarray(tensors[2], np.float32)
+    if outs.ndim != 2 or outs.shape != (max(1, n_pout), n_out):
+        raise ValueError(
+            f"snapshot pending outputs {outs.shape} != ({n_pout}, {n_out})")
+    pending_in = []
+    for i in range(n_in):
+        arr = np.asarray(tensors[3 + i], np.float32)
+        n = int(header[9 + i])
+        if n < 0:
+            if arr.shape != (d_in,):
+                raise ValueError(
+                    f"pending step {i} has shape {arr.shape} != ({d_in},)")
+            pending_in.append(arr)
+        else:
+            if arr.ndim != 2 or arr.shape[1] != d_in or not \
+                    1 <= n <= arr.shape[0]:
+                raise ValueError(
+                    f"pending prefill {i} has shape {arr.shape} with "
+                    f"length {n}")
+            pending_in.append(("prefill", arr, n))
+    return {
+        "version": ver,
+        "d_in": d_in,
+        "n_out": n_out,
+        "t_max": t_max,
+        "window": bool(window),
+        "cache": np.asarray(tensors[1], np.float32),
+        "pos": pos,
+        "steps": steps,
+        "pending_in": pending_in,
+        "pending_out": [outs[i] for i in range(n_pout)],
+    }
 
 
 class DecodeServer:
@@ -596,7 +895,7 @@ class DecodeServer:
 
     def __init__(self, engine: ContinuousBatcher, host: str = "127.0.0.1",
                  port: int = 0, session_timeout: float = 30.0,
-                 scheduler=None):
+                 scheduler=None, migration: bool = True):
         """``scheduler`` (:class:`nnstreamer_tpu.sched.Scheduler`) makes
         session admission priority-aware when capacity slots are
         contended: joiners wait in (priority, FIFO) order behind a
@@ -604,10 +903,18 @@ class DecodeServer:
         ``NNSQ`` error frame instead of parking the connection for the
         whole ``session_timeout``.  ``scheduler=None`` consults conf
         (``NNSTPU_SCHED_POLICY``); unset keeps the legacy first-come
-        ``open_session`` path."""
+        ``open_session`` path.
+
+        ``migration=False`` disables the live-migration control ops
+        (``MIGRATE_PTS``/``RESUME_PTS`` fall through to the decode-step
+        validation, exactly what a pre-migration server answers) — the
+        knob the version-gate tests and a paranoid operator use."""
         self.engine = engine
         self.host, self.port = host, int(port)
         self.session_timeout = float(session_timeout)
+        self.migration = bool(migration)
+        self.sessions_migrated = 0   # snapshots shipped off this server
+        self.sessions_restored = 0   # sessions restored onto this server
         self._srv: Optional[socket.socket] = None
         self._accept: Optional[threading.Thread] = None
         self._running = False
@@ -629,11 +936,12 @@ class DecodeServer:
         self._conns_lock = threading.Lock()
 
     class _ConnState:
-        __slots__ = ("lock", "sess")
+        __slots__ = ("lock", "sess", "migrated")
 
         def __init__(self):
             self.lock = threading.Lock()
             self.sess = False  # this connection holds a decode session
+            self.migrated = False  # its session was migrated away
 
     def start(self) -> "DecodeServer":
         from . import faults as _faults
@@ -760,7 +1068,10 @@ class DecodeServer:
 
     def stats(self) -> dict:
         """Server snapshot (engine state lives in ``engine.stats()``)."""
-        out = {"running": self._running, "connections": self.connections}
+        out = {"running": self._running, "connections": self.connections,
+               "migration": self.migration,
+               "sessions_migrated": self.sessions_migrated,
+               "sessions_restored": self.sessions_restored}
         if self.scheduler is not None:
             out["sched"] = self.scheduler.stats()
         return out
@@ -807,9 +1118,105 @@ class DecodeServer:
             threading.Thread(target=self._serve, args=(conn,),
                              daemon=True).start()
 
+    def _handle_migration(self, conn, state, sess, tensors, pts, wtrace,
+                          client) -> Optional[DecodeSession]:
+        """One live-migration control op on this connection.  Returns the
+        connection's (possibly new) session.  Every failure answers the
+        typed ``[MIGRATING]`` code — and, for a snapshot that had not yet
+        crossed the point of no return, re-arms the session in place, so
+        a failed handoff never advances or loses state."""
+        from .buffer import Frame
+        from .elements.query import (
+            MIGRATE_PTS,
+            QueryMigratingError,
+            parse_session_control,
+            send_error,
+            send_tensors,
+        )
+        from .fleet.repo import RemoteTensorRepo
+        from .obs import spans as _spans
+
+        op = "snapshot" if pts == MIGRATE_PTS else "restore"
+        tok = (_spans.span_begin(wtrace[0], wtrace[1])
+               if wtrace is not None and _spans.enabled else None)
+        try:
+            addr, key, deadline_ms = parse_session_control(tensors)
+            deadline_s = max(0.1, deadline_ms / 1e3)
+            if pts == MIGRATE_PTS:
+                if sess is None:
+                    raise QueryMigratingError(
+                        "no live session on this connection to migrate")
+                snap = self.engine.snapshot_session(sess,
+                                                    timeout=deadline_s)
+                try:
+                    packed = pack_session_snapshot(snap)
+                    repo = RemoteTensorRepo.from_addr(addr)
+                    try:
+                        if not repo.set_buffer(
+                                key, Frame(tensors=packed, pts=0)):
+                            raise RuntimeError(
+                                f"repo slot {key} refused the snapshot "
+                                "(EOS)")
+                    finally:
+                        repo.close()
+                except BaseException:
+                    # the slot was only gated: re-queue the drained
+                    # items and keep serving exactly where it was
+                    self.engine.abort_snapshot(sess, snap)
+                    raise
+                sess.close()
+                with state.lock:
+                    state.sess = False
+                    state.migrated = True
+                    self.sessions_migrated += 1
+                    send_tensors(conn, (np.array([1], np.int64),), pts,
+                                 trace=wtrace)
+                return None
+            # RESUME_PTS: restore a snapshot onto a fresh connection
+            if sess is not None:
+                raise QueryMigratingError(
+                    "restore needs a fresh connection (this one already "
+                    "holds a session)")
+            if self._draining:
+                raise QueryMigratingError(
+                    "decode server draining: restore refused")
+            repo = RemoteTensorRepo.from_addr(addr)
+            try:
+                frame, _spec, eos = repo.get_buffer(key, timeout=deadline_s)
+            finally:
+                repo.close()
+            if frame is None or eos:
+                raise QueryMigratingError(
+                    f"no snapshot in repo slot {key} within {deadline_s}s")
+            snap = unpack_session_snapshot(frame.tensors)
+            # ValueError here = geometry mismatch: typed-refused below,
+            # wrong state is never restored
+            new_sess = self.engine.restore_session(
+                snap, timeout=min(deadline_s, self.session_timeout))
+            with state.lock:
+                state.sess = True
+                self.sessions_restored += 1
+                send_tensors(conn, (np.array([1], np.int64),), pts,
+                             trace=wtrace)
+            return new_sess
+        except Exception as exc:  # noqa: BLE001 — typed refusal, keep serving
+            try:
+                with state.lock:
+                    send_error(conn, f"decode server {op} failed: {exc}",
+                               code="MIGRATING")
+            except OSError:
+                pass
+            return sess
+        finally:
+            if tok is not None:
+                _spans.span_end(tok, f"migrate_{op}", "migrate",
+                                args={"client": client})
+
     def _serve(self, conn: socket.socket) -> None:
         from .elements.query import (
+            MIGRATE_PTS,
             PROBE_PTS,
+            RESUME_PTS,
             recv_tensors_ex,
             send_error,
             send_tensors,
@@ -837,6 +1244,29 @@ class DecodeServer:
                     return  # client left: free the slot in finally
                 if wtenant:
                     tenant = wtenant
+                if state.migrated:
+                    # the session moved away mid-handoff race: typed
+                    # verdict that explicitly did NOT apply the frame,
+                    # so a migration-aware peer may re-send it to the
+                    # session's new home (never a duplicate step)
+                    try:
+                        with state.lock:
+                            send_error(
+                                conn, "session migrated away; the frame "
+                                "was not applied — resume on the new "
+                                "worker", code="MIGRATING")
+                    except OSError:
+                        pass
+                    return
+                if pts in (MIGRATE_PTS, RESUME_PTS) and self.migration:
+                    # version-gated wire path: with migration disabled
+                    # (or on a pre-migration server) these sentinels fall
+                    # through to the decode-step validation below and
+                    # answer a plain error — the router reads that as
+                    # "cannot migrate" and degrades to [SESSION]
+                    sess = self._handle_migration(
+                        conn, state, sess, tensors, pts, wtrace, client)
+                    continue
                 try:
                     if len(tensors) != 1:
                         raise ValueError(

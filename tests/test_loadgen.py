@@ -182,3 +182,31 @@ class TestDecodeScenario:
         attr = report["attribution"]
         assert attr["joined"] > 0
         assert "serve" in attr["legs_ms"]
+        # stateful-session accounting: every session accounted for,
+        # migrated-vs-broken distinguished (none of either in a calm run)
+        ds = report["decode_sessions"]
+        assert ds["total"] == ds["completed"] + ds["broken"] + ds["shed"]
+        assert ds["completed"] == ds["total"] > 0
+        assert ds["broken"] == 0 and ds["migrated"] == 0
+
+    def test_stateful_goodput_slo_checks(self):
+        """The drain gate's SLO keys: 100% stateful goodput passes on a
+        clean run; a synthetic broken session fails it."""
+        report = {
+            "tenants": {}, "ledger": {"exact": True, "client":
+                                      {"transport": 0}},
+            "decode_sessions": {"total": 4, "completed": 4, "broken": 0,
+                                "shed": 0, "migrated": 2},
+        }
+        ok, checks = loadgen.check_slo(
+            report, {"stateful_goodput_min": 1.0,
+                     "max_broken_sessions": 0})
+        assert ok, checks
+        report["decode_sessions"] = {"total": 4, "completed": 3,
+                                     "broken": 1, "shed": 0,
+                                     "migrated": 1}
+        ok, checks = loadgen.check_slo(
+            report, {"stateful_goodput_min": 1.0,
+                     "max_broken_sessions": 0})
+        assert not ok
+        assert sum(1 for c in checks if not c["ok"]) == 2

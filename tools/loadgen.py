@@ -380,8 +380,12 @@ class LoadGen:
                     seq: int, d_in: int) -> None:
         """One decode session: prefill prompt, a burst of back-to-back
         steps, then paced steps.  Every frame is its own record (own
-        trace id) so the report sees per-step tails, not session means."""
+        trace id) so the report sees per-step tails, not session means.
+        Every record carries the session id (``sid``), so the report can
+        tell a session that completed every step — including one that
+        was live-migrated under a drain — from one that broke."""
         name = tenant["name"]
+        sid = f"{name}/{seq}"
         sock = None
         try:
             sock = socket.create_connection(
@@ -406,7 +410,7 @@ class LoadGen:
                 except (ConnectionError, OSError) as exc:
                     status, code = "transport", type(exc).__name__
                 self._record(tenant=name, workload=wl.name, op=op,
-                             trace_ids=[tid] if tid else [],
+                             sid=sid, trace_ids=[tid] if tid else [],
                              t_sched_ns=t_s, t_start_ns=t_s,
                              t_done_ns=_spans.now_ns(),
                              status=status, code=code)
@@ -414,7 +418,7 @@ class LoadGen:
                     return
         except (ConnectionError, OSError) as exc:
             self._record(tenant=name, workload=wl.name, op="session",
-                         trace_ids=[], t_sched_ns=t_sched_ns,
+                         sid=sid, trace_ids=[], t_sched_ns=t_sched_ns,
                          t_start_ns=t_sched_ns, t_done_ns=_spans.now_ns(),
                          status="transport", code=type(exc).__name__)
         finally:
@@ -423,7 +427,6 @@ class LoadGen:
                     sock.close()
                 except OSError:
                     pass
-        del seq
 
     def run(self, replay: Optional[List[dict]] = None,
             d_in: int = 8) -> List[dict]:
@@ -701,6 +704,39 @@ def build_report(records: List[dict], duration_s: float, t0_ns: int,
     else:
         ledger["exact"] = ledger["client_exact"]
 
+    # stateful-session accounting: a decode session either COMPLETED
+    # every step (possibly live-migrated mid-stream — invisible to the
+    # client, counted from the router's handoff ledger), was SHED typed
+    # at the join, or BROKE mid-stream ([SESSION]/transport) — the
+    # distinction the drain SLO gate needs to require 100% stateful
+    # goodput through a planned drain
+    sessions: Dict[str, str] = {}
+    for r in records:
+        sid = r.get("sid")
+        if not sid:
+            continue
+        verdict = sessions.get(sid, "completed")
+        if verdict == "completed" and r["status"] != "ok":
+            if r["status"] == "transport" or r.get("code") in (
+                    "SESSION", "MIGRATING", "TIMEOUT"):
+                verdict = "broken"
+            else:
+                verdict = "shed"  # typed join rejection (overload etc.)
+        sessions[sid] = verdict
+    decode_sessions: dict = {}
+    if sessions:
+        decode_sessions = {
+            "total": len(sessions),
+            "completed": sum(1 for v in sessions.values()
+                             if v == "completed"),
+            "broken": sum(1 for v in sessions.values() if v == "broken"),
+            "shed": sum(1 for v in sessions.values() if v == "shed"),
+        }
+        drt = (server_stats or {}).get("decode_router", {})
+        decode_sessions["migrated"] = drt.get("sessions_migrated", 0)
+        decode_sessions["migration_aborts"] = drt.get(
+            "migration_aborts", {})
+
     # per-trace attribution: join client records with collected server
     # spans by NNSQ trace id
     attribution: dict = {"joined": 0, "client_only": 0, "server_only": 0}
@@ -758,6 +794,7 @@ def build_report(records: List[dict], duration_s: float, t0_ns: int,
         "tenants": tenants,
         "curves": curves,
         "ledger": ledger,
+        "decode_sessions": decode_sessions,
         "attribution": attribution,
         "server": server_stats or {},
     }
@@ -774,7 +811,11 @@ def check_slo(report: dict, slo: dict) -> Tuple[bool, List[dict]]:
     - ``flood_shed_min``: the flooding tenant really was shed (the
       overload scenario must actually overload);
     - ``ledger_exact``: zero lost/unaccounted requests on both sides;
-    - ``max_transport_errors``: transport failures ≤ bound.
+    - ``max_transport_errors``: transport failures ≤ bound;
+    - ``stateful_goodput_min``: completed/total decode sessions ≥ bound
+      (migrated sessions count as completed — the drain gate sets 1.0);
+    - ``max_broken_sessions``: sessions broken ``[SESSION]``/torn ≤
+      bound.
     """
     checks: List[dict] = []
 
@@ -807,6 +848,18 @@ def check_slo(report: dict, slo: dict) -> Tuple[bool, List[dict]]:
         bound = int(slo["max_transport_errors"])
         n = report["ledger"]["client"]["transport"]
         add(f"transport_errors <= {bound}", n <= bound, n, bound)
+    ds = report.get("decode_sessions") or {}
+    if "stateful_goodput_min" in slo:
+        # 100% here through a drain is the live-migration promise: every
+        # session completes, none break [SESSION]
+        bound = float(slo["stateful_goodput_min"])
+        ratio = (ds.get("completed", 0) / ds["total"]) if ds.get("total") \
+            else 0.0
+        add(f"stateful_goodput >= {bound}", ratio >= bound, ratio, bound)
+    if "max_broken_sessions" in slo:
+        bound = int(slo["max_broken_sessions"])
+        n = ds.get("broken", 0)
+        add(f"broken_sessions <= {bound}", n <= bound, n, bound)
     ok = all(c["ok"] for c in checks)
     return ok, checks
 

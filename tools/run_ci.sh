@@ -899,6 +899,184 @@ finally:
             pass
 PY
 
+run_step "Migration smoke (SIGTERM-drain a session-hosting worker: zero [SESSION], token-identical)" \
+  python - <<'PY'
+# ISSUE 12 acceptance, subprocess edition: a stateful fleet (2 decode
+# workers + repo + migrating router), a live decode session mid-
+# generation, SIGTERM the session-hosting worker — the router's
+# migration monitor moves the session to the survivor, the client sees
+# ZERO errors, the transcript is token-identical to an unmigrated
+# control run, the session ledger stays exact, and
+# nnstpu_session_migrations_total{result="ok"} >= 1 on the router's
+# /metrics.  Stateless traffic rides its own router through the same
+# churn with an exact offered == delivered + shed ledger.
+import jax
+jax.config.update('jax_platforms', 'cpu')
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+
+import numpy as np
+
+from nnstreamer_tpu.elements.query import recv_tensors, send_tensors
+from nnstreamer_tpu.serving import ContinuousBatcher
+
+DECODE = "capacity=2,t_max=8,d_in=4,n_out=4,d_model=16,n_heads=2,n_layers=1"
+ENGINE = dict(capacity=2, t_max=8, d_in=4, n_out=4, d_model=16, n_heads=2,
+              n_layers=1)
+
+
+def spawn(args):
+    p = subprocess.Popen(
+        [sys.executable, "-m", "nnstreamer_tpu.fleet"] + args
+        + ["--platform", "cpu"],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True)
+    line = p.stdout.readline()
+    return p, json.loads(line)
+
+
+procs = []
+try:
+    repo_p, repo = spawn(["repo", "--port", "0"])
+    procs.append(repo_p)
+    workers = []
+    for i in range(2):
+        p, info = spawn(["worker", "--name", f"mw{i}", "--port", "0",
+                         "--health-port", "0", "--model", "x2",
+                         "--decode", DECODE, "--decode-port", "0",
+                         "--drain-timeout", "8"])
+        procs.append(p)
+        workers.append(info)
+    qspec = ",".join(f"127.0.0.1:{w['port']}/{w['health_port']}"
+                     for w in workers)
+    dspec = ",".join(f"127.0.0.1:{w['decode_port']}/{w['health_port']}"
+                     for w in workers)
+    qr_p, qr = spawn(["router", "--name", "mig-q", "--port", "0",
+                      "--health-port", "0", "--workers", qspec])
+    procs.append(qr_p)
+    dr_p, dr = spawn(["router", "--name", "mig-d", "--port", "0",
+                      "--health-port", "0", "--stateful",
+                      "--repo", f"127.0.0.1:{repo['port']}",
+                      "--workers", dspec])
+    procs.append(dr_p)
+
+    prompt = np.random.RandomState(0).rand(3, 4).astype(np.float32)
+    steps = [np.random.RandomState(i + 10).rand(4).astype(np.float32)
+             for i in range(24)]
+
+    # control transcript: one unmigrated in-process engine, same params
+    with ContinuousBatcher(**ENGINE) as ctl_eng:
+        cs = ctl_eng.open_session()
+        cs.prefill(prompt)
+        control = [cs.get(timeout=15)]
+        for s in steps:
+            cs.feed(s)
+            control.append(cs.get(timeout=15))
+        cs.close()
+
+    # stateless traffic through the same churn window (exact ledger)
+    stateless = {"n": 0, "errors": []}
+    stop = threading.Event()
+
+    def q_client():
+        i = 0
+        while not stop.is_set():
+            i += 1
+            try:
+                s = socket.create_connection(("127.0.0.1", qr["port"]),
+                                             timeout=20)
+                s.settimeout(20)
+                send_tensors(s, (np.full(4, float(i), np.float32),), 0)
+                outs, _ = recv_tensors(s)
+                assert float(np.asarray(outs[0])[0]) == 2.0 * i
+                stateless["n"] += 1
+                s.close()
+            except Exception as exc:  # noqa: BLE001
+                stateless["errors"].append(repr(exc))
+            time.sleep(0.01)
+
+    qt = threading.Thread(target=q_client)
+    qt.start()
+
+    # the migrating session: prefill + paced steps spanning the drain
+    sock = socket.create_connection(("127.0.0.1", dr["port"]), timeout=20)
+    sock.settimeout(20)
+
+    def rt(arr):
+        send_tensors(sock, (arr,), 0)
+        outs, _ = recv_tensors(sock)
+        return np.asarray(outs[0])
+
+    out = [rt(prompt)]
+    for s in steps[:6]:
+        out.append(rt(s))
+
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{dr['health_port']}/stats.json",
+            timeout=10) as r:
+        by_worker = json.load(r)["fleet:mig-d"]["sessions_by_worker"]
+    victim_addr = next(iter(by_worker))
+    vi = next(i for i, w in enumerate(workers)
+              if victim_addr.endswith(f":{w['decode_port']}"))
+    os.kill(workers[vi]["pid"], signal.SIGTERM)  # drain mid-generation
+    for s in steps[6:]:                          # stream THROUGH the drain
+        out.append(rt(s))
+        time.sleep(0.05)
+    stop.set()
+    qt.join(timeout=30)
+    sock.close()
+
+    assert len(out) == len(control)
+    for i, (x, y) in enumerate(zip(control, out)):
+        np.testing.assert_array_equal(x, y, err_msg=f"token {i}")
+    assert stateless["errors"] == [], stateless["errors"][:3]
+    assert stateless["n"] >= 20, stateless
+
+    # the drained worker exits 0 (its decode drain completed clean —
+    # the session was migrated off, not force-broken)
+    assert procs[1 + vi].wait(timeout=30) == 0
+
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{dr['health_port']}/stats.json",
+            timeout=10) as r:
+        st = json.load(r)["fleet:mig-d"]
+    assert st["sessions_migrated"] >= 1, st
+    assert st["sessions_broken"] == 0, st
+    assert st["session_ledger_exact"], st
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{qr['health_port']}/stats.json",
+            timeout=10) as r:
+        qst = json.load(r)["fleet:mig-q"]
+    assert qst["offered"] == qst["delivered"] + qst["shed_total"], qst
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{dr['health_port']}/metrics",
+            timeout=10) as r:
+        metrics = r.read().decode()
+    ok_line = next(
+        (ln for ln in metrics.splitlines()
+         if ln.startswith("nnstpu_session_migrations_total")
+         and 'result="ok"' in ln), "")
+    assert ok_line and float(ok_line.rsplit(" ", 1)[1]) >= 1, ok_line
+    print(f"migration smoke OK: SIGTERM drain mid-generation migrated "
+          f"the session ({ok_line.rsplit(' ', 1)[1]} ok handoffs), "
+          f"{len(out)} outputs token-identical to the unmigrated "
+          f"control, zero [SESSION] errors, session ledger exact, "
+          f"{stateless['n']} stateless requests zero-error with "
+          f"{qst['offered']}=={qst['delivered']}+{qst['shed_total']}")
+finally:
+    for p in procs:
+        try:
+            p.kill()
+        except OSError:
+            pass
+PY
+
 run_step "Cold-start smoke (warm a pipeline, restart the process, zero compile misses)" \
   python - <<'PY'
 # Compile-ahead acceptance gate: a warmed-then-restarted pipeline must
