@@ -35,3 +35,11 @@ from .spec import (  # noqa: F401
 )
 
 __version__ = "0.1.0"
+
+# Opt-in lock-order verification (NNSTPU_LOCKDEP=1 / ini [analysis]
+# lockdep): installed at import so locks created by module-level and
+# constructor code are tracked from birth.  A cheap env/conf check when
+# disabled.  See docs/static-analysis.md.
+from .analysis.lockdep import maybe_install as _lockdep_maybe_install  # noqa: E402
+
+_lockdep_maybe_install()

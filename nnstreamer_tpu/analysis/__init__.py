@@ -1,0 +1,29 @@
+"""Static + dynamic analysis instruments for the runtime.
+
+Two instruments, one discipline — every invariant the fleet-scale runtime
+leans on gets *checked by the framework*, not by whichever developer last
+touched it (the NNStreamer thesis, applied to our own code):
+
+- :mod:`.lockdep` — a runtime lock-order verifier (the Linux-kernel
+  lockdep idea in CPython terms): opt-in via ``NNSTPU_LOCKDEP=1`` or ini
+  ``[analysis] lockdep``, it wraps ``threading.Lock``/``RLock``/
+  ``Condition`` construction, keys every lock by allocation site, and
+  accumulates the cross-thread acquisition-order graph.  Cycles in that
+  graph are potential ABBA deadlocks; it also flags blocking acquires
+  while holding other locks and blocking calls (socket recv, untimed
+  ``queue.get``, ``subprocess`` waits) made under a lock.  Running the
+  test suite under lockdep turns the whole corpus into a deadlock
+  detector for the pipeline/reaper/watchdog/router/membership/migration
+  lock hierarchy.
+
+- :mod:`.lint` — AST-based contract lint (CLI: ``tools/nnslint.py``)
+  cross-verifying the hand-maintained registries against their use
+  sites: hook points (``obs/hooks.py``), ``nnstpu_*`` metric names vs
+  ``docs/observability.md``, conf ``DEFAULTS`` knobs vs reads and docs,
+  NNSQ ``ERROR_TYPES`` wire codes vs typed exceptions, thread
+  daemon/join hygiene, and bare ``except:`` handlers.
+
+See ``docs/static-analysis.md`` for how to run, read, and extend both.
+"""
+
+from __future__ import annotations

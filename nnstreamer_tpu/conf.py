@@ -45,6 +45,7 @@ DEFAULTS: Dict[str, Dict[str, str]] = {
         "dump_dot_dir": "",         # write <pipeline>.PLAYING.dot here
         "tracers": "",              # GST_TRACERS analog: "latency;stats;drops"
         "metrics_port": "",         # Prometheus scrape port ("" = disabled)
+        "xplane_trace_dir": "",     # jax.profiler xplane trace of PLAYING
     },
     "filter": {
         "jax_dtype": "bfloat16",    # compute dtype for the jax backend
@@ -161,6 +162,16 @@ DEFAULTS: Dict[str, Dict[str, str]] = {
         "migrate_check_s": "0.25",  # stateful router's monitor period
                                     # for self-draining workers
     },
+    # Analysis instruments (nnstreamer_tpu/analysis): runtime lockdep.
+    # The short env spelling NNSTPU_LOCKDEP takes precedence over the
+    # NNSTPU_ANALYSIS_LOCKDEP form mapped here.
+    "analysis": {
+        "lockdep": "false",         # wrap threading.Lock/RLock/Condition
+                                    # with the lock-order verifier
+        "lockdep_block_ms": "200",  # blocked-while-holding report threshold
+        "lockdep_allow": "",        # comma-separated site substrings whose
+                                    # findings are accepted (annotated)
+    },
     # Self-healing (graph/pipeline.py restart policies + backend
     # degradation).  NNSTPU_RECOVERY_* env vars map here.
     "recovery": {
@@ -173,6 +184,28 @@ DEFAULTS: Dict[str, Dict[str, str]] = {
         "backoff_cap_ms": "2000",   # backoff ceiling
         "cpu_fallback": "true",     # degrade jax compile failures to CPU
     },
+}
+
+
+# Short env spellings: convenience env vars that do NOT follow the
+# NNSTPU_<SECTION>_<KEY> derivation but alias a DEFAULTS knob (value =
+# (section, key)) or are meta-configuration with no knob (value = None,
+# e.g. the ini-file locator).  This is a machine-checked contract:
+# ``analysis/lint.py`` verifies every literal NNSTPU_* env read in the
+# tree resolves through DEFAULTS or this table — a new short spelling
+# must be declared here or the lint gate fails.
+SHORT_ENV: Dict[str, Optional[tuple]] = {
+    "NNSTPU_CONF": None,                # ini file path (the locator itself)
+    "NNSTPU_PLUGIN_PATH": ("common", "plugin_path"),
+    "NNSTPU_TRACERS": ("common", "tracers"),
+    "NNSTPU_METRICS_PORT": ("common", "metrics_port"),
+    "NNSTPU_METRICS_BUCKETS": ("obs", "buckets"),
+    "NNSTPU_FLIGHT_RECORDS": ("obs", "flight_records"),
+    "NNSTPU_PEAK_TFLOPS": ("obs", "peak_tflops"),
+    "NNSTPU_PEAK_GBS": ("obs", "peak_gbs"),
+    "NNSTPU_MESH": ("mesh", "spec"),
+    "NNSTPU_FAULTS": ("faults", "spec"),
+    "NNSTPU_LOCKDEP": ("analysis", "lockdep"),
 }
 
 

@@ -154,6 +154,12 @@ ERROR_TYPES = {
     "OVERLOAD": QueryOverloadError,
     "EXPIRED": QueryExpiredError,
     "UNAVAILABLE": QueryUnavailableError,
+    # TIMEOUT is mostly raised client-side, but server-side dispatch
+    # timeouts relay it via ``send_error(..., code=exc.code)`` — without
+    # this entry a relayed [TIMEOUT] degraded to a bare RuntimeError and
+    # the client retry path couldn't classify it (found by nnslint's
+    # wire-codes check: every class-level ``code`` must be registered)
+    "TIMEOUT": QueryTimeoutError,
     "SESSION": QuerySessionBrokenError,
     "MIGRATING": QueryMigratingError,
 }

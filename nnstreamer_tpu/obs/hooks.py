@@ -82,28 +82,35 @@ from typing import Callable, Dict, Tuple
 
 _LOG = logging.getLogger("nnstreamer_tpu.obs")
 
-HOOKS = (
-    "pad_push",
-    "dispatch_enter",
-    "dispatch_exit",
-    "queue_push",
-    "queue_pop",
-    "queue_drop",
-    "source_push",
-    "source_spawn",
-    "state_change",
-    "error",
-    "rate_drop",
-    "rate_dup",
-    "dynbatch_flush",
-    "copy",
-    "device_dispatch",
-    "compile",
-    "health",
-    "fault",
-    "recovery",
-    "warmup",
-)
+# The machine-readable registry behind the docstring table above: hook
+# point -> positional emit signature.  ``analysis/lint.py`` cross-checks
+# every ``hooks.emit(name, ...)`` site against this dict (name known,
+# arity matching), so extending it here is the ONE place a new hook
+# point gets declared.
+HOOK_SIGNATURES: Dict[str, Tuple[str, ...]] = {
+    "pad_push": ("pad", "item"),
+    "dispatch_enter": ("node", "pad", "item", "t0_ns"),
+    "dispatch_exit": ("node", "pad", "item", "dur_ns"),
+    "queue_push": ("node", "depth"),
+    "queue_pop": ("node", "depth"),
+    "queue_drop": ("node", "reason"),
+    "source_push": ("pipeline", "node", "frame"),
+    "source_spawn": ("pipeline", "node"),
+    "state_change": ("pipeline", "old", "new"),
+    "error": ("pipeline", "node", "exc"),
+    "rate_drop": ("node",),
+    "rate_dup": ("node",),
+    "dynbatch_flush": ("node", "n", "bucket"),
+    "copy": ("node", "nbytes", "allocs"),
+    "device_dispatch": ("node", "frame", "outs", "t0_ns"),
+    "compile": ("backend", "key", "result", "dur_ns", "info"),
+    "health": ("pipeline", "healthy", "reason"),
+    "fault": ("point", "kind", "target"),
+    "recovery": ("pipeline_name", "action", "target", "result"),
+    "warmup": ("pipeline", "node_name", "label", "done", "total", "dur_ns"),
+}
+
+HOOKS = tuple(HOOK_SIGNATURES)
 
 # The fast-path gate: True iff at least one callback is connected anywhere.
 # Hot sites read this module attribute directly; everything past the gate

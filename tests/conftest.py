@@ -71,3 +71,39 @@ def _reset_global_state():
     from nnstreamer_tpu import pool as _pool
 
     _pool.reset_default_pool()  # conf-driven singleton: re-read per test
+
+
+# -- lockdep: NNSTPU_LOCKDEP=1 turns the whole suite into a deadlock
+# detector (docs/static-analysis.md).  Installation happens at
+# nnstreamer_tpu import (maybe_install); here we only surface the
+# accumulated report once the run ends.
+
+def pytest_terminal_summary(terminalreporter):
+    from nnstreamer_tpu.analysis import lockdep
+
+    if not lockdep.installed():
+        return
+    rep = lockdep.report()
+    terminalreporter.section("lockdep")
+    terminalreporter.write_line(lockdep.format_report())
+    if rep["cycles"]:
+        terminalreporter.write_line(
+            "lockdep: POTENTIAL ABBA DEADLOCK(S) — see cycles above",
+            red=True)
+
+
+@pytest.fixture
+def lockdep_session():
+    """Install lockdep for one test with a clean slate, uninstall after
+    (no-op teardown if the whole run is already under lockdep)."""
+    from nnstreamer_tpu.analysis import lockdep
+
+    fresh = lockdep.install()
+    saved_allow = list(lockdep._allow_patterns)
+    lockdep.reset()
+    yield lockdep
+    if fresh:
+        lockdep.uninstall()
+    else:
+        lockdep._allow_patterns[:] = saved_allow
+        lockdep.reset()

@@ -1,0 +1,475 @@
+"""The analysis instruments: runtime lockdep + contract lint.
+
+Lockdep tests use the ``lockdep_session`` fixture (conftest): installed
+fresh per test, state reset, uninstalled after — and they allocate their
+locks from THIS file, which is in-scope for the site filter (not stdlib,
+not site-packages).
+
+Lint tests build throwaway fixture trees (``_write_tree``) carrying
+their own mini registries, proving the linter re-derives contracts from
+the target tree rather than the live process.
+"""
+
+import json
+import os
+import queue
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from nnstreamer_tpu.analysis import lint, lockdep
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# lockdep
+
+
+class TestLockdep:
+    def test_seeded_abba_cycle_detected(self, lockdep_session):
+        a = threading.Lock()
+        b = threading.Lock()
+
+        def ab():
+            with a:
+                with b:
+                    time.sleep(0.001)
+
+        def ba():
+            with b:
+                with a:
+                    time.sleep(0.001)
+
+        for target in (ab, ba):
+            t = threading.Thread(target=target)
+            t.start()
+            t.join(timeout=30)
+        cycles = lockdep.report()["cycles"]
+        assert len(cycles) == 1, lockdep.format_report()
+        sites = cycles[0]["sites"]
+        assert any("test_analysis.py" in s for s in sites)
+        # both directed witnesses are present
+        assert len(cycles[0]["witnesses"]) == 2
+        # the report is deduped: re-running the pattern adds nothing
+        t = threading.Thread(target=ba)
+        t.start()
+        t.join(timeout=30)
+        assert len(lockdep.report()["cycles"]) == 1
+
+    def test_clean_hierarchy_reports_nothing(self, lockdep_session):
+        a = threading.Lock()
+        b = threading.Lock()
+
+        def ordered():
+            for _ in range(3):
+                with a:
+                    with b:
+                        pass
+
+        threads = [threading.Thread(target=ordered) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        rep = lockdep.report()
+        assert rep["cycles"] == []
+        assert rep["blocking_calls"] == []
+        assert rep["edges"] >= 1  # the a->b ordering was observed
+
+    def test_blocking_queue_get_under_lock(self, lockdep_session):
+        lock = threading.Lock()
+        q = queue.Queue()
+        q.put("ready")
+        with lock:
+            q.get()  # no timeout, lock held: the finding
+        found = lockdep.findings("blocking_call_under_lock")
+        assert any(f["call"] == "queue.get" for f in found), found
+        # with a timeout it is not a finding
+        lockdep.reset()
+        q.put("again")
+        with lock:
+            q.get(timeout=5)
+        assert lockdep.findings("blocking_call_under_lock") == []
+
+    def test_blocking_socket_recv_under_lock(self, lockdep_session):
+        lock = threading.Lock()
+        s1, s2 = socket.socketpair()
+        try:
+            s1.sendall(b"x")
+            with lock:
+                s2.recv(1)
+            found = lockdep.findings("blocking_call_under_lock")
+            assert any(f["call"] == "socket.recv" for f in found), found
+            # a socket with a timeout is exempt
+            lockdep.reset()
+            s1.sendall(b"y")
+            s2.settimeout(5)
+            with lock:
+                s2.recv(1)
+            assert lockdep.findings("blocking_call_under_lock") == []
+        finally:
+            s1.close()
+            s2.close()
+
+    def test_subprocess_wait_under_lock(self, lockdep_session):
+        lock = threading.Lock()
+        with lock:
+            subprocess.run([sys.executable, "-c", "pass"], check=True)
+        found = lockdep.findings("blocking_call_under_lock")
+        assert any(f["call"] == "subprocess.wait" for f in found), found
+
+    def test_blocked_while_holding(self, lockdep_session):
+        lockdep._block_ms = 20  # shrink the outlier threshold for the test
+        outer = threading.Lock()
+        inner = threading.Lock()
+        started = threading.Event()
+
+        def holder():
+            with inner:
+                started.set()
+                time.sleep(0.15)
+
+        t = threading.Thread(target=holder)
+        t.start()
+        assert started.wait(timeout=30)
+        with outer:
+            with inner:  # blocks ~150 ms while holding `outer`
+                pass
+        t.join(timeout=30)
+        found = lockdep.findings("blocked_while_holding")
+        assert found and found[0]["waited_ms"] >= 20, found
+
+    def test_allow_suppresses_and_counts(self, lockdep_session):
+        lockdep.allow("test_analysis.py")
+        a = threading.Lock()
+        b = threading.Lock()
+
+        def ab():
+            with a:
+                with b:
+                    pass
+
+        def ba():
+            with b:
+                with a:
+                    pass
+
+        for target in (ab, ba):
+            t = threading.Thread(target=target)
+            t.start()
+            t.join(timeout=30)
+        rep = lockdep.report()
+        assert rep["cycles"] == []
+        assert rep["suppressed"] == 1
+
+    def test_condition_event_rlock_still_work(self, lockdep_session):
+        # the proxies must be drop-in: Condition wait/notify, Event,
+        # RLock reentrancy, and with-statement semantics
+        done = threading.Event()
+        cv = threading.Condition()
+        rl = threading.RLock()
+        with rl:
+            with rl:  # reentrant
+                pass
+
+        def waker():
+            with cv:
+                cv.notify_all()
+            done.set()
+
+        t = threading.Thread(target=waker)
+        with cv:
+            t.start()
+            cv.wait(timeout=5)
+        assert done.wait(timeout=5)
+        t.join(timeout=30)
+        assert lockdep.report()["cycles"] == []
+
+    def test_env_activation_and_uninstall(self, monkeypatch):
+        if lockdep.installed():
+            pytest.skip("whole run is under NNSTPU_LOCKDEP; cannot "
+                        "exercise install/uninstall transitions")
+        assert not lockdep.installed()
+        monkeypatch.setenv("NNSTPU_LOCKDEP", "0")
+        assert lockdep.maybe_install() is False
+        monkeypatch.setenv("NNSTPU_LOCKDEP", "1")
+        assert lockdep.maybe_install() is True
+        try:
+            assert lockdep.installed()
+            assert lockdep.maybe_install() is False  # idempotent
+        finally:
+            lockdep.uninstall()
+        assert not lockdep.installed()
+        assert threading.Lock is not lockdep._make_lock
+
+    def test_conf_activation(self, monkeypatch):
+        from nnstreamer_tpu.conf import Conf
+
+        if lockdep.installed():
+            pytest.skip("whole run is under NNSTPU_LOCKDEP; cannot "
+                        "exercise install/uninstall transitions")
+        monkeypatch.delenv("NNSTPU_LOCKDEP", raising=False)
+        monkeypatch.setenv("NNSTPU_ANALYSIS_LOCKDEP", "true")
+        # maybe_install consults the module-global conf (env > ini >
+        # defaults); the env var above feeds [analysis] lockdep
+        assert Conf().get_bool("analysis", "lockdep") is True
+        assert lockdep.maybe_install() is True
+        try:
+            assert lockdep.installed()
+        finally:
+            lockdep.uninstall()
+
+    def test_format_report_mentions_everything(self, lockdep_session):
+        lock = threading.Lock()
+        q = queue.Queue()
+        q.put(1)
+        with lock:
+            q.get()
+        text = lockdep.format_report()
+        assert "BLOCKING-CALL" in text and "queue.get" in text
+
+
+# ---------------------------------------------------------------------------
+# lint fixtures
+
+
+def _write_tree(root, files):
+    for rel, content in files.items():
+        path = os.path.join(root, rel)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "w", encoding="utf-8") as fh:
+            fh.write(content)
+    return str(root)
+
+
+_REGISTRIES = {
+    "pkg/hooks.py": (
+        'HOOK_SIGNATURES = {\n'
+        '    "pad_push": ("pad", "item"),\n'
+        '    "error": ("pipeline", "node", "exc"),\n'
+        '}\n'
+    ),
+    "pkg/conf.py": (
+        'DEFAULTS = {\n'
+        '    "common": {"tracers": "", "metrics_port": ""},\n'
+        '    "obs": {"buckets": ""},\n'
+        '}\n'
+        'SHORT_ENV = {\n'
+        '    "NNSTPU_CONF": None,\n'
+        '    "NNSTPU_TRACERS": ("common", "tracers"),\n'
+        '}\n'
+    ),
+    "pkg/query.py": (
+        'class QueryError(RuntimeError):\n'
+        '    code = ""\n'
+        'class OverloadError(QueryError):\n'
+        '    code = "OVERLOAD"\n'
+        'ERROR_TYPES = {"OVERLOAD": OverloadError}\n'
+        'def send_error(sock, msg, code=""):\n'
+        '    pass\n'
+    ),
+    "docs/observability.md": (
+        "metrics: `nnstpu_good_total`, the `nnstpu_fam_*` family.\n"
+        "knobs: `tracers`, `metrics_port`, `buckets`; env `NNSTPU_CONF`.\n"
+    ),
+}
+
+
+def _clean_code():
+    return {
+        "pkg/app.py": (
+            "import threading\n"
+            "from . import hooks\n"
+            "from .conf import conf\n"
+            "def go(reg, sock):\n"
+            '    hooks.emit("pad_push", sock, 1)\n'
+            '    reg.counter("nnstpu_good_total", "h")\n'
+            '    reg.gauge("nnstpu_fam_depth", "h")\n'
+            '    conf.get("common", "tracers")\n'
+            '    t = threading.Thread(target=go, daemon=True)\n'
+            "    t.start()\n"
+        ),
+    }
+
+
+class TestLintFixtures:
+    def test_clean_tree_has_no_findings(self, tmp_path):
+        root = _write_tree(tmp_path, {**_REGISTRIES, **_clean_code()})
+        assert lint.run_checks(root) == []
+
+    @pytest.mark.parametrize("code,check,fragment", [
+        ('hooks.emit("ghost", 1)\n', "hooks", "unregistered hook"),
+        ('hooks.emit("pad_push", 1)\n', "hooks", "1 args"),
+        ('hooks.emit("error", 1, 2, 3, 4)\n', "hooks", "3"),
+        ('reg.counter("nnstpu_ghost_total", "h")\n', "metrics",
+         "not documented"),
+        ('conf.get("ghost_sec", "x")\n', "conf", "unknown section"),
+        ('conf.get_int("obs", "ghost_key", 1)\n', "conf", "no DEFAULTS"),
+        ('import os\nos.environ.get("NNSTPU_GHOST_THING")\n', "conf",
+         "no DEFAULTS knob"),
+        ('send_error(None, "x", code="GHOST")\n', "wire-codes",
+         "not registered"),
+        ('import threading\nthreading.Thread(target=print).start()\n',
+         "threads", "fire-and-forget"),
+        ('try:\n    pass\nexcept:\n    pass\n', "bare-except",
+         "bare 'except:'"),
+    ])
+    def test_seeded_violation_fires(self, tmp_path, code, check, fragment):
+        files = {**_REGISTRIES, **_clean_code()}
+        files["pkg/bad.py"] = "from . import hooks\nfrom .conf import conf\n" \
+                              "from .query import send_error\n" + code
+        root = _write_tree(tmp_path, files)
+        found = [f for f in lint.run_checks(root) if f.check == check]
+        assert found and any(fragment in f.message for f in found), \
+            lint.run_checks(root)
+
+    def test_stale_doc_metric_and_uncarried_wire_code(self, tmp_path):
+        files = {**_REGISTRIES, **_clean_code()}
+        files["docs/observability.md"] += "gone: `nnstpu_stale_total`.\n"
+        files["pkg/query.py"] = (
+            'class QueryError(RuntimeError):\n'
+            '    code = ""\n'
+            'class OverloadError(QueryError):\n'
+            '    code = "OVERLOAD"\n'
+            'ERROR_TYPES = {"OVERLOAD": OverloadError,\n'
+            '               "PHANTOM": OverloadError}\n'
+            'def send_error(sock, msg, code=""):\n'
+            '    pass\n'
+        )
+        root = _write_tree(tmp_path, files)
+        msgs = [f.message for f in lint.run_checks(root)]
+        assert any("nnstpu_stale_total" in m and "does not exist" in m
+                   for m in msgs), msgs
+        assert any("PHANTOM" in m and "no exception class" in m
+                   for m in msgs), msgs
+
+    def test_arity_splat_and_wildcards_do_not_fire(self, tmp_path):
+        files = {**_REGISTRIES, **_clean_code()}
+        files["pkg/ok.py"] = (
+            "from . import hooks\n"
+            "def go(args, reg):\n"
+            '    hooks.emit("error", *args)\n'           # splat: no arity
+            '    reg.counter("nnstpu_fam_hits_total", "h")\n'  # wildcard doc
+        )
+        root = _write_tree(tmp_path, files)
+        assert lint.run_checks(root) == []
+
+    def test_threads_joined_via_loop_and_return(self, tmp_path):
+        files = {**_REGISTRIES, **_clean_code()}
+        files["pkg/ok.py"] = (
+            "import threading\n"
+            "def spawn_threads():\n"
+            "    return [threading.Thread(target=print)]\n"
+            "def fleet():\n"
+            "    ts = [threading.Thread(target=print) for _ in range(3)]\n"
+            "    for t in ts:\n"
+            "        t.start()\n"
+            "    for t in ts:\n"
+            "        t.join()\n"
+            "def owned(self):\n"
+            "    self._t = threading.Thread(target=print)\n"
+            "    self._t.start()\n"
+            "    self._t.join()\n"
+        )
+        root = _write_tree(tmp_path, files)
+        assert [f for f in lint.run_checks(root)
+                if f.check == "threads"] == []
+
+    def test_suppressions_same_line_and_next_line(self, tmp_path):
+        files = {**_REGISTRIES, **_clean_code()}
+        files["pkg/sup.py"] = (
+            "from . import hooks\n"
+            'hooks.emit("ghost", 1)  # nnslint: disable=hooks\n'
+            "# nnslint: disable-next-line=bare-except\n"
+            "try:\n"
+            "    pass\n"
+            "except:\n"
+            "    pass\n"
+        )
+        # the bare-except suppression must sit on the handler line
+        root = _write_tree(tmp_path, files)
+        found = lint.run_checks(root)
+        assert all(f.check != "hooks" for f in found), found
+        # disable-next-line targeted line 4 (`try:`), the finding is on
+        # line 6 — still fires, proving suppression is line-accurate
+        assert any(f.check == "bare-except" for f in found)
+        files["pkg/sup.py"] = (
+            "from . import hooks\n"
+            'hooks.emit("ghost", 1)  # nnslint: disable=all\n'
+            "try:\n"
+            "    pass\n"
+            "except:  # nnslint: disable=bare-except\n"
+            "    pass\n"
+        )
+        root = _write_tree(tmp_path, files)
+        assert lint.run_checks(root) == []
+
+    def test_baseline_round_trip(self, tmp_path):
+        files = {**_REGISTRIES, **_clean_code()}
+        files["pkg/bad.py"] = 'from . import hooks\nhooks.emit("ghost", 1)\n'
+        root = _write_tree(tmp_path, files)
+        findings = lint.run_checks(root)
+        assert len(findings) == 1
+        bl_path = os.path.join(root, ".nnslint-baseline.json")
+        lint.write_baseline(bl_path, findings)
+        baseline = lint.load_baseline(bl_path)
+        new, resolved = lint.partition(lint.run_checks(root), baseline)
+        assert new == [] and resolved == set()
+        # a NEW violation is not masked by the baseline
+        files["pkg/bad.py"] += 'hooks.emit("ghost2", 1)\n'
+        _write_tree(tmp_path, files)
+        new, _ = lint.partition(lint.run_checks(root), baseline)
+        assert len(new) == 1 and "ghost2" in new[0].message
+        # fixing the old one reports it as resolved
+        files["pkg/bad.py"] = 'from . import hooks\nhooks.emit("ghost2", 1)\n'
+        _write_tree(tmp_path, files)
+        new, resolved = lint.partition(lint.run_checks(root), baseline)
+        assert len(new) == 1 and len(resolved) == 1
+        # fingerprints survive line movement (line-number-free)
+        files["pkg/bad.py"] = ('from . import hooks\n# pad\n# pad\n'
+                               'hooks.emit("ghost", 1)\n')
+        _write_tree(tmp_path, files)
+        new, _ = lint.partition(lint.run_checks(root), baseline)
+        assert new == []
+
+    def test_unknown_check_rejected(self, tmp_path):
+        root = _write_tree(tmp_path, _REGISTRIES)
+        with pytest.raises(ValueError, match="unknown checks"):
+            lint.run_checks(root, ["ghost-check"])
+
+
+class TestNnslintCLI:
+    def _run(self, *args):
+        return subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "nnslint.py"),
+             *args],
+            capture_output=True, text=True, timeout=120)
+
+    def test_shipped_tree_is_clean(self):
+        res = self._run()
+        assert res.returncode == 0, res.stdout + res.stderr
+
+    def test_seeded_tree_fails_and_baseline_gates(self, tmp_path):
+        root = _write_tree(tmp_path, {
+            **_REGISTRIES, **_clean_code(),
+            "pkg/bad.py": 'from . import hooks\nhooks.emit("ghost", 1)\n',
+        })
+        res = self._run("--root", root, "--no-baseline")
+        assert res.returncode == 1 and "ghost" in res.stdout
+        res = self._run("--root", root, "--write-baseline")
+        assert res.returncode == 0
+        res = self._run("--root", root)
+        assert res.returncode == 0, res.stdout
+        res = self._run("--root", root, "--format", "json")
+        doc = json.loads(res.stdout)
+        assert doc["findings"][0]["new"] is False
+
+    def test_list_checks(self):
+        res = self._run("--list-checks")
+        assert res.returncode == 0
+        assert set(res.stdout.split()) == set(lint.ALL_CHECKS)

@@ -136,7 +136,9 @@ class TestHookBus:
         seen = []
         hooks.connect("pad_push", seen.append)
         assert hooks.enabled is True
-        hooks.emit("pad_push", "x")
+        # a dummy 1-arg emit (real signature: (pad, item)) — fine for a
+        # bus unit test, not for real sites
+        hooks.emit("pad_push", "x")  # nnslint: disable=hooks
         assert seen == ["x"]
         hooks.disconnect("pad_push", seen.append)
         assert hooks.enabled is False

@@ -1,0 +1,104 @@
+#!/usr/bin/env python
+"""nnslint: the contract-lint CLI (see nnstreamer_tpu/analysis/lint.py).
+
+Cross-verifies the hand-maintained registries (hook points, nnstpu_*
+metric names, conf DEFAULTS knobs, NNSQ ERROR_TYPES wire codes, thread
+hygiene, bare excepts) against their use sites, whole-repo, AST-only —
+no imports of the linted tree, so it works on fixture trees and broken
+checkouts.
+
+Usage:
+    python tools/nnslint.py                    # lint the repo, gate on
+                                               # NEW findings vs baseline
+    python tools/nnslint.py --root DIR         # lint another tree
+    python tools/nnslint.py --checks hooks,conf
+    python tools/nnslint.py --no-baseline      # gate on ALL findings
+    python tools/nnslint.py --write-baseline   # accept current findings
+    python tools/nnslint.py --format json
+
+Exit codes: 0 clean (no new findings), 1 new findings, 2 usage error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+from nnstreamer_tpu.analysis import lint  # noqa: E402
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="nnslint", description=__doc__)
+    ap.add_argument("--root", default=_REPO,
+                    help="tree to lint (default: this repo)")
+    ap.add_argument("--checks", default="",
+                    help=f"comma-separated subset of: "
+                         f"{', '.join(lint.ALL_CHECKS)}")
+    ap.add_argument("--baseline", default=None,
+                    help="baseline file (default: <root>/.nnslint-baseline"
+                         ".json when present)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore any baseline: every finding fails the run")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="accept the current findings into the baseline")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--list-checks", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.list_checks:
+        for c in lint.ALL_CHECKS:
+            print(c)
+        return 0
+
+    root = os.path.abspath(args.root)
+    if not os.path.isdir(root):
+        print(f"nnslint: no such tree: {root}", file=sys.stderr)
+        return 2
+    checks = [c.strip() for c in args.checks.split(",") if c.strip()] or None
+    try:
+        findings = lint.run_checks(root, checks)
+    except ValueError as exc:
+        print(f"nnslint: {exc}", file=sys.stderr)
+        return 2
+
+    baseline_path = args.baseline or os.path.join(root,
+                                                  ".nnslint-baseline.json")
+    if args.write_baseline:
+        lint.write_baseline(baseline_path, findings)
+        print(f"nnslint: wrote {len(findings)} accepted finding(s) to "
+              f"{baseline_path}")
+        return 0
+
+    baseline = set() if args.no_baseline else lint.load_baseline(
+        baseline_path)
+    new, resolved = lint.partition(findings, baseline)
+
+    if args.format == "json":
+        print(json.dumps({
+            "findings": [vars(f) | {"fingerprint": f.fingerprint,
+                                    "new": f.fingerprint not in baseline}
+                         for f in findings],
+            "resolved_baseline": sorted(resolved),
+        }, indent=2))
+    else:
+        for f in findings:
+            tag = "" if f.fingerprint not in baseline else " (baseline)"
+            print(f"{f}{tag}")
+        if resolved:
+            print(f"nnslint: {len(resolved)} baseline finding(s) no longer "
+                  f"occur — regenerate with --write-baseline:")
+            for fp in sorted(resolved):
+                print(f"  resolved: {fp}")
+        print(f"nnslint: {len(findings)} finding(s), {len(new)} new, "
+              f"{len(baseline & {f.fingerprint for f in findings})} "
+              f"baselined, {len(resolved)} resolved")
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
