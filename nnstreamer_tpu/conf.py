@@ -114,6 +114,18 @@ DEFAULTS: Dict[str, Dict[str, str]] = {
         "spec": "",                 # "" = off; "auto" | "dp:8" | "8" — see
                                     # parallel.mesh.parse_mesh_spec
     },
+    # Dispatcher lanes (graph/lanes.py): run-to-completion event-loop
+    # runtime replacing thread-per-element.  NNSTPU_DISPATCH_* env vars
+    # map here (NNSTPU_DISPATCH_LANES is the documented spelling).
+    "dispatch": {
+        "lanes": "0",               # 0 = thread-per-element (legacy);
+                                    # "auto" = min(4, cpus); N pins it
+        "helpers": "16",            # bounded blocking-task helper pool
+        "block_ms": "20",           # source pull over this => blocking,
+                                    # shunted to the helper pool
+        "quantum": "8",             # frames/items per task slice before
+                                    # the lane is yielded
+    },
     # Serving QoS (nnstreamer_tpu/sched): NNSTPU_SCHED_* env vars map here.
     # An empty policy disables scheduling entirely (legacy FIFO dispatch).
     "sched": {

@@ -26,6 +26,8 @@ class AppSrc(SourceNode):
     :class:`TensorsSpec`) or from :meth:`set_spec` before start.
     """
 
+    LANE_BLOCKING = True  # frames() blocks on the application's push queue
+
     def __init__(
         self,
         name: Optional[str] = None,

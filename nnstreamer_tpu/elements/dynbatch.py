@@ -89,6 +89,9 @@ class DynBatch(Node):
             )
         self.max_size = int(max_size_buffers)
         self._q = None
+        # dispatcher-lane mode (graph/lanes.py)
+        self._lane_rt = None
+        self._lane_task = None
         self.batches_emitted = 0  # observability: how often we coalesced
         self.frames_in = 0
         self._pool = None  # shared staging pool, resolved lazily
@@ -173,11 +176,67 @@ class DynBatch(Node):
     def _dispatch(self, pad: Pad, item) -> None:
         del pad
         self._ensure_queue()
+        rt, task = self._lane_rt, self._lane_task
+        if rt is not None and task is not None and not task.promoted:
+            rt.backpressure_push(self._q, item, "no", task)
+            rt.arm(task)
+            return
         self._q.push(item, leaky="no")
 
     def spawn_threads(self) -> List[threading.Thread]:
         self._ensure_queue()
         return [threading.Thread(target=self._worker, name=f"dynbatch:{self.name}")]
+
+    def lane_task(self, rt):
+        """Dispatcher-lane registration (``graph/lanes.py``): the
+        coalescing drain task that replaces the worker thread."""
+        from ..graph.lanes import DrainTask
+
+        self._ensure_queue()
+        self._lane_rt = rt
+        self._lane_task = DrainTask(f"dynbatch:{self.name}", self,
+                                    rt._assign_lane())
+        return self._lane_task
+
+    def _lane_step(self, rt) -> Optional[str]:
+        """One lane slice: the cooperative twin of :meth:`_worker` — pop
+        one frame, greedily coalesce whatever else is already queued
+        (never blocking), emit the batch."""
+        q = self._q
+        if q is None:
+            return "done"
+        max_pending = self.max_batch * max(1, self._mesh_dev)
+        for _ in range(rt.quantum):
+            status, item = q.pop(0)
+            if status == SHUTDOWN:
+                return "done"
+            if status != OK:
+                return None  # drained; re-armed by the next push
+            pending: List[Frame] = []
+            try:
+                if isinstance(item, Event):
+                    if self._event(item):
+                        return "done"
+                    continue
+                pending.append(item)
+                while len(pending) < max_pending:
+                    status, nxt = q.pop(0)
+                    if status != OK:
+                        break
+                    if isinstance(nxt, Event):
+                        self._emit_batch(pending)
+                        pending = []
+                        if self._event(nxt):
+                            return "done"
+                        break
+                    pending.append(nxt)
+                if pending:
+                    self._emit_batch(pending)
+            except BaseException as exc:  # noqa: BLE001
+                if self.pipeline is not None:
+                    self.pipeline.post_error(self, exc)
+                return "done"
+        return None
 
     def _pool_or_default(self):
         if self._pool is None:
@@ -324,6 +383,8 @@ class DynBatch(Node):
         if self._q is not None:
             self._q.shutdown()
             self._q = None
+        self._lane_rt = None
+        self._lane_task = None
         super().stop()
 
 

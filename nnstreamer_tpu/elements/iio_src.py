@@ -159,6 +159,7 @@ class _PollChannel:
 
 @register_element("tensor_src_iio")
 class TensorSrcIIO(SourceNode):
+    LANE_BLOCKING = True  # select()/timed reads against sysfs trigger files
     def __init__(
         self,
         name: Optional[str] = None,

@@ -571,13 +571,20 @@ class QueryServer:
                     reply_trace = wire_trace
                     if tok is not None:
                         reply_trace = (wire_trace[0], tok[0])
+                        # record the serve span BEFORE the reply bytes go
+                        # out: a client that snapshots our flight recorder
+                        # the instant its recv returns must already see it
+                        # (the reply carries tok's span id either way)
+                        _spans.span_end(tok, "nnsq_serve", "query",
+                                        args={"client": client})
+                        tok = None
                     with state.lock:
                         send_tensors(conn, outs, pts, trace=reply_trace,
                                      fault_key="nnsq.server")
                 finally:
                     if item is not None:
                         self.scheduler.release(item)
-                    if tok is not None:
+                    if tok is not None:  # error path: close the span typed
                         _spans.span_end(tok, "nnsq_serve", "query",
                                         args={"client": client})
             except (OverloadError, BreakerOpenError) as exc:
@@ -1038,6 +1045,11 @@ class TensorQueryClient(Node):
     frame's tensors go to the server, the reply frame flows downstream
     (pts preserved; per-frame round trip — put a ``queue`` upstream to
     pipeline the wire like any other blocking hop)."""
+
+    # every process() is a blocking NNSQ round trip: under dispatcher
+    # lanes the fused segment containing this node runs on the helper
+    # pool (graph/lanes.py blocking-boundary rule)
+    LANE_BLOCKING = True
 
     def __init__(
         self,

@@ -48,6 +48,10 @@ class Queue(Node):
         self.leaky = str(leaky)
         self._q = None
         self._worker_thread: Optional[threading.Thread] = None
+        # dispatcher-lane mode (graph/lanes.py): the drain task replacing
+        # the worker thread, and the runtime scheduling it
+        self._lane_rt = None
+        self._lane_task = None
         # cumulative leaky-mode drops; element-level (survives stop(),
         # unlike the backend queue's own counter) — feeds the drops tracer
         self.dropped = 0
@@ -68,7 +72,13 @@ class Queue(Node):
     def _dispatch(self, pad: Pad, item) -> None:
         del pad
         self._ensure_queue()
-        status = self._q.push(item, leaky=self.leaky)
+        rt, task = self._lane_rt, self._lane_task
+        if rt is not None and task is not None and not task.promoted:
+            # lane mode: a full queue is backpressure, never a parked
+            # lane — on push timeout the producer helps drain inline
+            status = rt.backpressure_push(self._q, item, self.leaky, task)
+        else:
+            status = self._q.push(item, leaky=self.leaky)
         if status in (OK_DROPPED_OLDEST, DROPPED_INCOMING):
             self.dropped += 1
             if _hooks.enabled:
@@ -79,12 +89,63 @@ class Queue(Node):
                 )
         if _hooks.enabled:
             _hooks.emit("queue_push", self, len(self._q))
+        if rt is not None and task is not None:
+            rt.arm(task)  # lane-to-lane handoff through the ready-ring
 
     def spawn_threads(self) -> List[threading.Thread]:
         self._ensure_queue()
         self._worker_thread = threading.Thread(
             target=self._worker, name=f"queue:{self.name}")
         return [self._worker_thread]
+
+    def lane_task(self, rt):
+        """Dispatcher-lane registration (``graph/lanes.py``): the drain
+        task that replaces the worker thread."""
+        from ..graph.lanes import DrainTask
+
+        self._ensure_queue()
+        self._lane_rt = rt
+        self._lane_task = DrainTask(f"queue:{self.name}", self,
+                                    rt._assign_lane())
+        return self._lane_task
+
+    def _lane_step(self, rt) -> Optional[str]:
+        """One lane slice: drain up to ``rt.quantum`` items without
+        blocking — the cooperative twin of :meth:`_worker`, same event,
+        fault, and error semantics."""
+        q = self._q
+        if q is None:
+            return "done"
+        for _ in range(rt.quantum):
+            if _faults.enabled:
+                # chaos: queue_wedge sleeps HERE (the lane analog of the
+                # worker-loop wedge) — pops stop while pushes pile up
+                _faults.maybe_queue_wedge(self.name)
+            status, item = q.pop(0)
+            if status == SHUTDOWN:
+                return "done"
+            if status != OK:
+                return None  # drained; re-armed by the next push
+            if _hooks.enabled:
+                _hooks.emit("queue_pop", self, len(q))
+            try:
+                if isinstance(item, Event):
+                    if item.kind == "eos":
+                        self.sink_pads["sink"].eos = True
+                        self._on_eos()
+                        return "done"
+                    if item.kind == "caps":
+                        self._handle_caps(self.sink_pads["sink"],
+                                          item.payload)
+                    else:
+                        self.on_event(self.sink_pads["sink"], item)
+                else:
+                    self.push(item)
+            except BaseException as exc:  # noqa: BLE001
+                if self.pipeline is not None:
+                    self.pipeline.post_error(self, exc)
+                return "done"
+        return None
 
     def _worker(self) -> None:
         q = self._q  # stop() may null the attribute while we drain
@@ -156,6 +217,14 @@ class Queue(Node):
             for ev in events:
                 q.push(ev, leaky="no")
         threads: List[threading.Thread] = []
+        rt, task = self._lane_rt, self._lane_task
+        if rt is not None and task is not None and not task.promoted:
+            # lane mode: no worker thread to respawn — re-create a dead
+            # drain task (a faulted consumer) and re-arm it
+            rt.ensure_armed(self)
+            self._lane_task = rt._tasks.get(f"queue:{self.name}",
+                                            self._lane_task)
+            return drained, threads
         t = self._worker_thread
         if q is not None and (t is None or not t.is_alive()):
             self._worker_thread = threading.Thread(
@@ -171,4 +240,6 @@ class Queue(Node):
         if self._q is not None:
             self._q.shutdown()
             self._q = None
+        self._lane_rt = None
+        self._lane_task = None
         super().stop()

@@ -189,6 +189,7 @@ def configured_repo():
 
 @register_element("tensor_reposink")
 class TensorRepoSink(SinkTerminal):
+    LANE_BLOCKING = True  # a full slot blocks until the consumer takes it
     def __init__(
         self,
         name: Optional[str] = None,
@@ -248,6 +249,8 @@ class TensorRepoSink(SinkTerminal):
 
 @register_element("tensor_reposrc")
 class TensorRepoSrc(SourceNode):
+    LANE_BLOCKING = True  # blocks on the repo slot condition variable
+
     def __init__(
         self,
         name: Optional[str] = None,

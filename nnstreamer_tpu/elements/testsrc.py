@@ -49,6 +49,9 @@ class VideoTestSrc(SourceNode):
             rate=Fraction(framerate),
         )
         self.is_live = is_live in (True, "true", "1")
+        # a live source sleeps to honor the framerate: a blocking
+        # boundary for the dispatcher-lane runtime (graph/lanes.py)
+        self.LANE_BLOCKING = self.is_live
         self.seed = int(seed)
 
     def output_spec(self) -> TensorsSpec:

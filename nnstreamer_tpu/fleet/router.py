@@ -397,6 +397,15 @@ class Router:
                     reply_trace = ((wtrace[0], tok[0])
                                    if tok is not None and wtrace is not None
                                    else None)
+                    if tok is not None:
+                        # record the route span BEFORE the reply bytes go
+                        # out (same root-cause fix as the worker's
+                        # nnsq_serve): a collector snapshotting on reply
+                        # arrival must already see the whole chain
+                        _spans.span_end(
+                            tok, "nnsq_route", "fleet",
+                            args={"client": client, "worker": worker_id})
+                        tok = None
                     send_tensors(conn, outs, opts, trace=reply_trace,
                                  fault_key="nnsq.router")
                     with self._ledger_lock:
@@ -405,7 +414,7 @@ class Router:
                 finally:
                     if item is not None:
                         self.scheduler.release(item)
-                    if tok is not None:
+                    if tok is not None:  # error path: close the span typed
                         _spans.span_end(
                             tok, "nnsq_route", "fleet",
                             args={"client": client, "worker": worker_id})

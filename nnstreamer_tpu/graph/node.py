@@ -16,6 +16,11 @@ Threading model (mirrors the reference's, ``README.md:41-44``):
 - :class:`~nnstreamer_tpu.elements.queue.Queue` nodes introduce thread
   boundaries with bounded buffering (the ``queue`` element analog);
 - nodes with multiple sink pads serialize internally (CollectPads analog).
+
+With ``[dispatch] lanes`` > 0 the same fused chains run as cooperative
+tasks on a small pool of event-loop lanes instead of dedicated threads
+(:mod:`nnstreamer_tpu.graph.lanes`); the Pad/Node API and all hook/span
+semantics are unchanged — only the execution substrate differs.
 """
 
 from __future__ import annotations
@@ -149,6 +154,11 @@ class Node:
     REQUEST_SINK_PADS = False
     # Set by subclasses that create src pads on demand (demux/split/tee).
     REQUEST_SRC_PADS = False
+    # Set by elements that block on the outside world (NNSQ sockets,
+    # repo slots, timed sleeps): under the dispatcher-lane runtime
+    # (graph/lanes.py) the fused segment containing such a node is
+    # shunted to the bounded helper pool so a lane never stalls.
+    LANE_BLOCKING = False
 
     # Monotonic auto-name ids (gst's elementN numbering): a process-global
     # counter — id(self) was used before, but CPython reuses addresses, so

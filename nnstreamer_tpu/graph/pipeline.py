@@ -109,6 +109,9 @@ class Pipeline:
         self._shed_frames: Dict[str, int] = {}           # node -> frames shed
         # compile-ahead warmup (graph/warmup.py): report of the last run
         self.warmup_report: Optional[dict] = None
+        # dispatcher-lane runtime (graph/lanes.py); None = the legacy
+        # thread-per-element scheduler ([dispatch] lanes = 0)
+        self._lanes = None
 
     # -- graph construction -------------------------------------------------
 
@@ -297,6 +300,10 @@ class Pipeline:
         for t in [t for t in self.threads if t.name == f"src:{name}"]:
             t.join(timeout=2.0)
             self.threads.remove(t)
+        if self._lanes is not None:
+            # lane analog of the join above: wait out the stale task's
+            # executor before re-arming the stop event below
+            self._lanes.retire_source(name)
         node._stop_evt.clear()
         try:
             node.stop()
@@ -308,14 +315,28 @@ class Pipeline:
         self._bump("restart_source")
         if _hooks.enabled:
             _hooks.emit("source_spawn", self, node)
-        t = threading.Thread(
-            target=self._source_loop, args=(node,), name=f"src:{name}",
-            daemon=True,
-        )
-        self.threads.append(t)
-        t.start()
+        if self._lanes is not None:
+            # lane mode: the stale task exits on the bumped epoch; a
+            # fresh pull task takes over (graph/lanes.py)
+            self._lanes.respawn_source(node)
+        else:
+            t = threading.Thread(
+                target=self._source_loop, args=(node,), name=f"src:{name}",
+                daemon=True,
+            )
+            self.threads.append(t)
+            t.start()
         _recovery.record(self.name, "restart_source", "ok", name)
         return True
+
+    def source_alive(self, name: str) -> bool:
+        """Is the source's execution vehicle still live — its streaming
+        thread (thread mode) or its lane task / promoted helper (lane
+        mode)?  The watchdog keys stalled-source detection on this."""
+        if self._lanes is not None:
+            return self._lanes.source_alive(name)
+        return any(t.name == f"src:{name}" and t.is_alive()
+                   for t in self.threads)
 
     def recover_queue(self, name: str) -> int:
         """Watchdog escalation: drain a wedged queue (shed its backlog
@@ -502,8 +523,21 @@ class Pipeline:
         self._post_negotiate_hooks()
         if _hooks.enabled:
             _hooks.emit("state_change", self, "NULL", "PLAYING")
+        # Scheduling substrate: with [dispatch] lanes > 0, queue drains
+        # and source pulls become lane tasks (graph/lanes.py); lanes=0
+        # keeps the legacy thread-per-element spawn below byte-for-byte.
+        from . import lanes as _lanes
+
+        nlanes = _lanes.configured_lanes()
+        if nlanes > 0:
+            self._lanes = _lanes.LaneRuntime(self, nlanes)
+            self._lanes.start()
         # Spawn worker threads requested by nodes (queues), then sources.
         for node in self.nodes.values():
+            if self._lanes is not None \
+                    and getattr(node, "lane_task", None) is not None:
+                self._lanes.add_element(node)
+                continue
             spawn = getattr(node, "spawn_threads", None)
             if spawn is not None:
                 for t in spawn():
@@ -514,6 +548,9 @@ class Pipeline:
             if isinstance(node, SourceNode):
                 if _hooks.enabled:
                     _hooks.emit("source_spawn", self, node)
+                if self._lanes is not None:
+                    self._lanes.add_source(node)
+                    continue
                 t = threading.Thread(
                     target=self._source_loop, args=(node,), name=f"src:{node.name}",
                     daemon=True,
@@ -619,6 +656,9 @@ class Pipeline:
             t.join(timeout=5.0)
             if t.is_alive():
                 leaked.append(t.name)
+        if self._lanes is not None:
+            leaked.extend(self._lanes.stop(timeout=5.0))
+            self._lanes = None
         if leaked:
             import warnings
 
@@ -765,6 +805,8 @@ class Pipeline:
         rec = self.recovery_stats()
         if rec:
             out["recovery"] = rec
+        if self._lanes is not None:
+            out["lanes"] = self._lanes.stats()
         return out
 
     def flight_snapshot(self) -> list:

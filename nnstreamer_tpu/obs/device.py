@@ -549,6 +549,15 @@ class DeviceTracer(Tracer):
             finally:
                 with _inflight_lock:
                     _inflight.pop(pid, None)
+                # dispatcher lanes: a device completion is a lane wakeup
+                # (idle lanes and backpressured producers re-poll now,
+                # not on the next timeout tick) — never a blocked thread
+                try:
+                    from ..graph import lanes as _lanes
+
+                    _lanes.device_wakeup()
+                except Exception:  # noqa: BLE001 — observability only
+                    pass
 
     def _reap_sharded(self, shards, name, t0, trace_id, parent, fid,
                       pipeline_name, cost_key=None) -> int:

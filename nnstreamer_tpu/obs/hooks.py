@@ -60,6 +60,12 @@ must stay allocation-light):
                    ``escalate``.  The first argument is the pipeline
                    NAME (string, may be empty for backend-level
                    actions), not the object.
+``lane_promote``   ``(pipeline, task, reason)`` — the dispatcher-lane
+                   runtime (:mod:`nnstreamer_tpu.graph.lanes`) shunted
+                   a blocking task to its helper pool; ``task`` is the
+                   logical task name (``src:<n>``/``queue:<n>``),
+                   ``reason`` is ``hint:ok``/``measured:ok``/
+                   ``…:denied`` (helper pool exhausted).
 ``warmup``         ``(pipeline, node_name, label, done, total,
                    dur_ns)`` — compile-ahead warmup progress
                    (:mod:`nnstreamer_tpu.graph.warmup`): one emission
@@ -108,6 +114,7 @@ HOOK_SIGNATURES: Dict[str, Tuple[str, ...]] = {
     "fault": ("point", "kind", "target"),
     "recovery": ("pipeline_name", "action", "target", "result"),
     "warmup": ("pipeline", "node_name", "label", "done", "total", "dur_ns"),
+    "lane_promote": ("pipeline", "task", "reason"),
 }
 
 HOOKS = tuple(HOOK_SIGNATURES)

@@ -85,7 +85,22 @@ now_ns = time.perf_counter_ns  # the one clock (see obs/hooks.py)
 
 
 def _tid() -> str:
-    return threading.current_thread().name
+    override = getattr(_tls, "tid_override", None)
+    return override if override is not None \
+        else threading.current_thread().name
+
+
+def set_tid(name: Optional[str]) -> Optional[str]:
+    """Override the calling thread's *logical* identity for span records
+    (``None`` restores the OS thread name); returns the previous
+    override so callers can nest.  The dispatcher lanes runtime
+    (:mod:`nnstreamer_tpu.graph.lanes`) sets the executing task's name
+    (``src:<name>``, ``queue:<name>``) around each slice, so records,
+    flow pairing, and Perfetto rows from a lane run are byte-identical
+    to the thread-per-element mode they replaced."""
+    prev = getattr(_tls, "tid_override", None)
+    _tls.tid_override = name
+    return prev
 
 
 def _rec(ph, ts, dur, name, cat, trace_id, span_id, parent_id, args) -> None:
@@ -320,9 +335,16 @@ class SpanTracer(Tracer):
     # -- hook callbacks ------------------------------------------------------
 
     def _stack(self) -> list:
-        stack = getattr(self._stacks, "stack", None)
+        # keyed by the *logical* tid, not the OS thread: a lane running
+        # a helped drain slice inside a producer's chain must not nest
+        # the drained dispatches under the producer's spans (each task
+        # keeps the stack its dedicated thread would have had)
+        stacks = getattr(self._stacks, "by_tid", None)
+        if stacks is None:
+            stacks = self._stacks.by_tid = {}
+        stack = stacks.get(_tid())
         if stack is None:
-            stack = self._stacks.stack = []
+            stack = stacks[_tid()] = []
         return stack
 
     def _on_source_push(self, pipeline, node, frame) -> None:

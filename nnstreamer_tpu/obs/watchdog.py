@@ -217,6 +217,12 @@ class PipelineWatchdog(Tracer):
     # -- the monitor ---------------------------------------------------------
 
     def _source_thread_alive(self, name: str) -> bool:
+        # the pipeline knows the execution substrate (streaming thread
+        # vs dispatcher-lane task); older pipeline objects without the
+        # helper fall back to the thread-name check
+        alive = getattr(self._pipeline, "source_alive", None)
+        if alive is not None:
+            return alive(name)
         return any(t.name == f"src:{name}" and t.is_alive()
                    for t in self._pipeline.threads)
 

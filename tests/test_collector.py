@@ -324,19 +324,13 @@ class TestFleetNesting:
             collector.add_local("inproc")
             chain = ["nnsq_rtt", "nnsq_route", "nnsq_serve",
                      "device_invoke"]
-            # bounded poll: the worker records nnsq_serve AFTER sending
-            # the reply, so on a loaded 1-core host its thread can be
-            # descheduled past the client's recv (the test_spans race)
-            deadline = time.monotonic() + 10.0
+            # worker and router record their spans BEFORE sending each
+            # reply, so once the client's recv returned the whole chain
+            # is already in the flight recorders — no poll
+            index = collector.spans_by_trace()
             by_name = {}
-            while time.monotonic() < deadline:
-                index = collector.spans_by_trace()
-                by_name = {}
-                for r in index.get(tid, ()):
-                    by_name.setdefault(r[4], r)
-                if set(chain) <= set(by_name):
-                    break
-                time.sleep(0.02)
+            for r in index.get(tid, ()):
+                by_name.setdefault(r[4], r)
             assert set(chain) <= set(by_name), sorted(by_name)
             for outer, inner in zip(chain, chain[1:]):
                 o, i = by_name[outer], by_name[inner]

@@ -1,0 +1,407 @@
+"""Dispatcher lanes (graph/lanes.py): the run-to-completion runtime.
+
+The contract under test: with ``[dispatch] lanes`` > 0 the pipeline
+behaves byte-for-byte like thread-per-element mode — same delivery,
+ordering, span semantics (logical rows, flow arrows, dispatch nesting),
+recovery ledger, and watchdog detection — while running on a small lane
+pool; ``lanes=0`` keeps the legacy substrate untouched.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu import Frame, Pipeline, faults
+from nnstreamer_tpu.elements.dynbatch import DynBatch, DynUnbatch
+from nnstreamer_tpu.elements.filter import TensorFilter
+from nnstreamer_tpu.elements.queue import Queue
+from nnstreamer_tpu.elements.sink import TensorSink
+from nnstreamer_tpu.elements.testsrc import DataSrc
+from nnstreamer_tpu.graph import lanes
+from nnstreamer_tpu.graph.node import SourceNode
+from nnstreamer_tpu.obs import hooks, spans
+from nnstreamer_tpu.obs.metrics import REGISTRY
+from nnstreamer_tpu.obs.spans import SpanTracer
+from nnstreamer_tpu.obs.watchdog import PipelineWatchdog
+from nnstreamer_tpu.spec import TensorSpec, TensorsSpec
+
+F32 = np.float32
+VEC4 = TensorsSpec.of(TensorSpec(dtype=F32, shape=(4,)))
+
+
+def _chain_pipeline(n=32, name="lp", queue_size=16):
+    got = []
+    p = Pipeline(name=name)
+    src = p.add(DataSrc(data=[np.full(4, float(i), F32) for i in range(n)],
+                        name="s"))
+    q = p.add(Queue(max_size_buffers=queue_size, name="q"))
+    f = p.add(TensorFilter(framework="custom", model=lambda x: x * 2.0,
+                           name="f"))
+    sink = p.add(TensorSink(callback=got.append, name="out"))
+    p.link_chain(src, q, f, sink)
+    return p, got
+
+
+class TestConfiguration:
+    def test_configured_lanes_parsing(self, monkeypatch):
+        import os
+
+        monkeypatch.setenv("NNSTPU_DISPATCH_LANES", "0")
+        assert lanes.configured_lanes() == 0
+        monkeypatch.setenv("NNSTPU_DISPATCH_LANES", "3")
+        assert lanes.configured_lanes() == 3
+        monkeypatch.setenv("NNSTPU_DISPATCH_LANES", "auto")
+        assert lanes.configured_lanes() == max(
+            1, min(4, os.cpu_count() or 1))
+        monkeypatch.delenv("NNSTPU_DISPATCH_LANES")
+        assert lanes.configured_lanes() == 0  # conf default: legacy mode
+
+    def test_lanes_zero_keeps_thread_mode(self, monkeypatch):
+        monkeypatch.setenv("NNSTPU_DISPATCH_LANES", "0")
+        p, got = _chain_pipeline(name="lz")
+        p.start()
+        try:
+            assert p._lanes is None
+            assert any(t.name == "src:s" for t in p.threads)
+            assert any(t.name == "queue:q" for t in p.threads)
+            assert p.wait(60)
+        finally:
+            p.stop()
+        assert len(got) == 32
+
+    def test_lane_mode_runs_on_lane_pool(self, monkeypatch):
+        monkeypatch.setenv("NNSTPU_DISPATCH_LANES", "2")
+        p, got = _chain_pipeline(name="lm")
+        p.start()
+        try:
+            assert p._lanes is not None and p._lanes.nlanes == 2
+            assert not any(t.name.startswith(("src:", "queue:"))
+                           for t in p.threads)
+            st = p.stats()["lanes"]
+            assert st["lanes"] == 2 and st["tasks"] == 2
+            assert p.wait(60)
+        finally:
+            p.stop()
+        assert p._lanes is None  # released at stop
+        assert [float(np.asarray(fr.tensor(0))[0]) for fr in got] == \
+            [2.0 * i for i in range(32)]
+
+
+class TestEquivalence:
+    @pytest.mark.parametrize("nlanes", ["1", "3"])
+    def test_order_and_values_with_dynbatch(self, nlanes, monkeypatch):
+        monkeypatch.setenv("NNSTPU_DISPATCH_LANES", nlanes)
+        got = []
+        p = Pipeline(name=f"ldb{nlanes}")
+        src = p.add(DataSrc(data=[np.full(4, float(i), F32)
+                                  for i in range(40)], name="s"))
+        db = p.add(DynBatch(max_batch=4, name="db"))
+        f = p.add(TensorFilter(framework="custom", model=lambda x: x + 1.0,
+                               name="f"))
+        un = p.add(DynUnbatch(name="un"))
+        p.link_chain(src, db, f, un,
+                     p.add(TensorSink(callback=got.append, name="out")))
+        p.run(timeout=120)
+        vals = [float(np.asarray(fr.tensor(0))[0]) for fr in got]
+        assert vals == [i + 1.0 for i in range(40)]
+        assert p["db"].batches_emitted >= 1
+
+    def test_single_lane_backpressure_no_deadlock(self, monkeypatch):
+        """A full bounded queue on a ONE-lane runtime must behave as
+        backpressure (the producer helps drain inline), never as a
+        deadlock — the sharpest difference from naive event loops."""
+        monkeypatch.setenv("NNSTPU_DISPATCH_LANES", "1")
+        p, got = _chain_pipeline(n=64, name="lbp", queue_size=2)
+        p.run(timeout=120)
+        assert [fr.pts for fr in got] == sorted(fr.pts for fr in got)
+        assert len(got) == 64
+
+    def test_leaky_queue_drops_still_counted(self, monkeypatch):
+        monkeypatch.setenv("NNSTPU_DISPATCH_LANES", "1")
+        drops = []
+        hooks.connect("queue_drop", lambda node, reason:
+                      drops.append((node.name, reason)))
+        try:
+            got = []
+            p = Pipeline(name="lleak")
+            src = p.add(DataSrc(data=[np.full(4, float(i), F32)
+                                      for i in range(50)], name="s"))
+            q = p.add(Queue(max_size_buffers=2, leaky="downstream",
+                            name="ql"))
+            slow = p.add(TensorFilter(
+                framework="custom",
+                model=lambda x: (time.sleep(0.002), x)[1], name="f"))
+            p.link_chain(src, q, slow,
+                         p.add(TensorSink(callback=got.append, name="out")))
+            p.run(timeout=120)
+            assert q.dropped > 0
+            assert q.dropped == len([d for d in drops if d[0] == "ql"])
+            assert len(got) + q.dropped == 50
+        finally:
+            hooks.clear()
+
+
+class TestSpanParity:
+    def test_logical_rows_flows_and_lane_track(self, monkeypatch):
+        """Lane-mode flight snapshots must render the SAME logical rows
+        as thread mode (src:<n>, queue:<n>), with flow arrows across the
+        queue hop and nested dispatch spans — plus a lane:<n> track of
+        task slices."""
+        monkeypatch.setenv("NNSTPU_DISPATCH_LANES", "2")
+        p, got = _chain_pipeline(n=8, name="lsp")
+        p.attach_tracer(SpanTracer())
+        p.run(timeout=60)
+        assert len(got) == 8
+        doc = spans.chrome_trace(p.flight_snapshot())
+        rows = {e["tid"]: e["args"]["name"] for e in doc["traceEvents"]
+                if e.get("ph") == "M" and e["name"] == "thread_name"}
+        names = set(rows.values())
+        assert "src:s" in names and "queue:q" in names, names
+        assert any(n.startswith("lane:") for n in names), names
+        xs = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+        # dispatch spans land on the queue's LOGICAL row, as in thread mode
+        qrow = [e for e in xs if rows[e["tid"]] == "queue:q"]
+        assert {e["name"] for e in qrow} >= {"f", "out"}
+        # lane track carries task slices
+        lrow = [e for e in xs if rows[e["tid"]].startswith("lane:")]
+        assert {e["name"] for e in lrow} & {"src:s", "queue:q"}
+        assert all(e["cat"] == "lane" for e in lrow)
+        # flow arrows across the queue hop (logical-tid crossing)
+        starts = {e["id"]: e for e in doc["traceEvents"]
+                  if e.get("ph") == "s"}
+        cross = [e for e in doc["traceEvents"] if e.get("ph") == "f"
+                 and e["id"] in starts
+                 and starts[e["id"]]["tid"] != e["tid"]]
+        assert cross, "no flow arrow across the lane handoff"
+        # nesting: the filter slice contains the sink's on the same row
+        nested = any(
+            a["tid"] == b["tid"] and a["name"] == "f" and b["name"] == "out"
+            and a["ts"] <= b["ts"]
+            and b["ts"] + b["dur"] <= a["ts"] + a["dur"] + 1e-6
+            for a in xs for b in xs)
+        assert nested, "dispatch spans are not nested"
+
+
+class _BlockingSrc(SourceNode):
+    LANE_BLOCKING = True
+
+    def __init__(self, n=6, **kw):
+        super().__init__(**kw)
+        self.n = n
+
+    def output_spec(self):
+        return VEC4
+
+    def frames(self):
+        for i in range(self.n):
+            if self.stopped:
+                return
+            yield Frame.of(np.full(4, float(i), F32), pts=i)
+
+
+class _SleepySrc(SourceNode):
+    def __init__(self, n=8, sleep_s=0.01, **kw):
+        super().__init__(**kw)
+        self.n = n
+        self.sleep_s = sleep_s
+
+    def output_spec(self):
+        return VEC4
+
+    def frames(self):
+        for i in range(self.n):
+            if self.stopped:
+                return
+            time.sleep(self.sleep_s)
+            yield Frame.of(np.full(4, float(i), F32), pts=i)
+
+
+class TestBlockingBoundaries:
+    def test_hinted_source_promotes_to_helper(self, monkeypatch):
+        monkeypatch.setenv("NNSTPU_DISPATCH_LANES", "2")
+        promotions = []
+        hooks.connect("lane_promote", lambda pl, task, reason:
+                      promotions.append((task, reason)))
+        try:
+            got = []
+            p = Pipeline(name="lhint")
+            src = p.add(_BlockingSrc(name="bsrc"))
+            p.link(src, p.add(TensorSink(callback=got.append, name="out")))
+            p.start()
+            try:
+                st = p._lanes.stats()
+                assert "src:bsrc" in st["promoted"], st
+                assert p.wait(60)
+            finally:
+                p.stop()
+            assert len(got) == 6
+            assert ("src:bsrc", "hint:ok") in promotions
+        finally:
+            hooks.clear()
+
+    def test_measured_blocking_source_promotes(self, monkeypatch):
+        monkeypatch.setenv("NNSTPU_DISPATCH_LANES", "2")
+        monkeypatch.setenv("NNSTPU_DISPATCH_BLOCK_MS", "2")
+        promotions = []
+        hooks.connect("lane_promote", lambda pl, task, reason:
+                      promotions.append((task, reason)))
+        try:
+            got = []
+            p = Pipeline(name="lmeas")
+            src = p.add(_SleepySrc(n=24, sleep_s=0.005, name="ssrc"))
+            p.link(src, p.add(TensorSink(callback=got.append, name="out")))
+            p.run(timeout=120)
+            assert len(got) == 24
+            assert ("src:ssrc", "measured:ok") in promotions, promotions
+        finally:
+            hooks.clear()
+
+    def test_promotion_metric_counts(self, monkeypatch):
+        monkeypatch.setenv("NNSTPU_DISPATCH_LANES", "2")
+        c = REGISTRY.get("nnstpu_lane_promotions_total")
+        before = (sum(v.value for _, v in c.children()) if c else 0)
+        p = Pipeline(name="lpm")
+        p.link(p.add(_BlockingSrc(name="b2")),
+               p.add(TensorSink(name="out")))
+        p.run(timeout=60)
+        c = REGISTRY.get("nnstpu_lane_promotions_total")
+        assert c is not None
+        assert sum(v.value for _, v in c.children()) > before
+
+
+class TestMetrics:
+    def test_lane_series_populate(self, monkeypatch):
+        monkeypatch.setenv("NNSTPU_DISPATCH_LANES", "2")
+        p, got = _chain_pipeline(n=16, name="lmx")
+        p.run(timeout=60)
+        assert len(got) == 16
+        tasks = REGISTRY.get("nnstpu_lane_tasks_total")
+        assert tasks is not None
+        mine = [(k, v) for k, v in tasks.children() if k[0] == "lmx"]
+        assert mine and sum(v.value for _, v in mine) > 0
+        depth = REGISTRY.get("nnstpu_lane_ready_depth")
+        assert depth is not None
+        assert any(k[0] == "lmx" for k, _ in depth.children())
+
+
+class _StallOnceSrc(SourceNode):
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        self.runs = 0
+
+    def output_spec(self):
+        return VEC4
+
+    def frames(self):
+        self.runs += 1
+        yield Frame.of(np.zeros(4, F32), pts=0)
+        if self.runs == 1:
+            self._stop_evt.wait()  # stall until restarted
+            return
+        for i in range(1, 5):
+            yield Frame.of(np.full(4, float(i), F32), pts=i)
+
+
+class TestRecoveryUnderLanes:
+    def test_watchdog_restarts_stalled_source(self, monkeypatch):
+        """A source blocked inside frames() holds its lane; the watchdog
+        must still see the stall (task executing, no source_push) and
+        restart_source must retire the stale task and respawn a fresh
+        one — the thread-mode contract, on lanes."""
+        monkeypatch.setenv("NNSTPU_DISPATCH_LANES", "2")
+        got = []
+        p = Pipeline(name="lwd")
+        src = p.add(_StallOnceSrc(name="cam"))
+        sink = p.add(TensorSink(name="out"))
+        sink.connect("new-data", lambda fr: got.append(fr.pts))
+        p.link(src, sink)
+        p.attach_tracer(PipelineWatchdog(interval_s=0.05, stall_s=0.2,
+                                         recover=True))
+        p.start()
+        try:
+            assert p.wait(timeout=60)
+        finally:
+            p.stop()
+        assert src.runs == 2
+        assert 1 in got and 4 in got
+        assert p.recovery_stats()["actions"]["restart_source"] >= 1
+
+    def test_wedged_queue_drained(self, monkeypatch):
+        monkeypatch.setenv("NNSTPU_DISPATCH_LANES", "2")
+        n = 40
+        faults.install("queue_wedge@lq:after=1,ms=1500")
+        try:
+            got = []
+            p = Pipeline(name="lwq")
+            src = p.add(DataSrc(data=[
+                Frame.of(np.full(4, float(i), F32), pts=i)
+                for i in range(n)], name="s"))
+            q = p.add(Queue(max_size_buffers=200, name="lq"))
+            sink = p.add(TensorSink(name="out"))
+            sink.connect("new-data", lambda fr: got.append(fr.pts))
+            p.link_chain(src, q, sink)
+            p.attach_tracer(PipelineWatchdog(interval_s=0.05, stall_s=0.2,
+                                             recover=True))
+            p.run(timeout=120)
+            rec = p.recovery_stats()
+            assert rec["actions"].get("drain_queue", 0) >= 1
+            assert len(got) + rec["shed_total"] == n
+            assert rec["shed_total"] > 0
+        finally:
+            faults.deactivate()
+
+    def test_restart_policy_ledger_balances(self, monkeypatch):
+        monkeypatch.setenv("NNSTPU_DISPATCH_LANES", "2")
+        n = 60
+        faults.install("seed=9;invoke_raise@f:every=10")
+        try:
+            got = []
+            p = Pipeline(name="lrp")
+            src = p.add(DataSrc(data=[
+                Frame.of(np.full(4, float(i), F32), pts=i)
+                for i in range(n)], name="s"))
+            q = p.add(Queue(max_size_buffers=16, name="q"))
+            f = p.add(TensorFilter(framework="custom",
+                                   model=lambda x: x * 2.0, name="f"))
+            sink = p.add(TensorSink(name="out"))
+            sink.connect("new-data", lambda fr: got.append(fr.pts))
+            p.link_chain(src, q, f, sink)
+            p.set_restart_policy("f", mode="restart", backoff_ms=1,
+                                 max_restarts=100, window_s=60.0)
+            p.run(timeout=120)
+            raises = faults.engine().injections.get("invoke_raise", 0)
+            rec = p.recovery_stats()
+            assert raises > 0
+            assert rec["actions"]["restart_node"] == raises
+            assert len(got) + rec["shed_total"] == n
+        finally:
+            faults.deactivate()
+
+
+class TestLifecycle:
+    def test_stop_mid_stream_and_restart(self, monkeypatch):
+        monkeypatch.setenv("NNSTPU_DISPATCH_LANES", "2")
+        got = []
+        p = Pipeline(name="lcyc")
+        src = p.add(DataSrc(
+            data=[np.full(4, float(i), F32) for i in range(2000)],
+            name="s"))
+        q = p.add(Queue(max_size_buffers=8, name="q"))
+        p.link_chain(src, q, p.add(TensorSink(callback=got.append,
+                                              name="out")))
+        p.start()
+        time.sleep(0.05)
+        p.stop()  # mid-stream: lanes + tasks torn down cleanly
+        assert p._lanes is None
+        n1 = len(got)
+        # a fresh start on the same graph builds a fresh runtime
+        src.data = [np.full(4, float(i), F32) for i in range(16)]
+        p.start()
+        try:
+            assert p._lanes is not None
+            assert p.wait(60)
+        finally:
+            p.stop()
+        assert len(got) >= n1

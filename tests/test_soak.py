@@ -12,6 +12,7 @@ import threading
 import time
 
 import numpy as np
+import pytest
 
 from nnstreamer_tpu import Pipeline, faults
 from nnstreamer_tpu.backends.jax_backend import JaxModel
@@ -26,7 +27,9 @@ from nnstreamer_tpu.elements.upload import TensorUpload
 from nnstreamer_tpu.spec import TensorSpec, TensorsSpec
 
 
-def test_soak_mixed_topology_with_renegotiation():
+@pytest.mark.parametrize("lanes", ["0", "2"], ids=["threads", "lanes"])
+def test_soak_mixed_topology_with_renegotiation(lanes, monkeypatch):
+    monkeypatch.setenv("NNSTPU_DISPATCH_LANES", lanes)
     n_phase = 300  # per shape phase; 3 phases
     shapes = [(4,), (2, 3), (4,)]
     frames = []
@@ -81,13 +84,17 @@ def test_soak_mixed_topology_with_renegotiation():
         assert got_b[i] == golden(i), (i, got_b[i], golden(i))
 
 
-def test_chaos_soak_seeded_fault_injection():
+@pytest.mark.parametrize("lanes", ["0", "2"], ids=["threads", "lanes"])
+def test_chaos_soak_seeded_fault_injection(lanes, monkeypatch):
     """Chaos soak: a seeded fault mix (raising + delayed invokes) over N
     frames with a restart policy on the filter.  The pipeline must end
     healthy, the frame ledger must balance exactly (delivered + typed
     sheds == offered, zero silent losses), recovery actions must match
     injected raises one-for-one, and the identical seed must reproduce
-    the identical injection sequence."""
+    the identical injection sequence.  Runs on both scheduling
+    substrates: thread-per-element and dispatcher lanes ([dispatch]
+    lanes) — the ledger and the replay log must be mode-invariant."""
+    monkeypatch.setenv("NNSTPU_DISPATCH_LANES", lanes)
     n = 400
     spec = "seed=1234;invoke_raise@f:rate=0.03;invoke_delay@f:rate=0.02,ms=1"
     eng = faults.install(spec)

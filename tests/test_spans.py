@@ -320,15 +320,10 @@ class TestNnsqTracePropagation:
         np.testing.assert_allclose(outs[0], 2.0)
         assert pts == 7
         assert reply is not None and reply[0] == 0xABCD and reply[1] != 0x11
-        # the serve span closes on the server's connection thread AFTER
-        # the reply bytes go out: poll briefly instead of racing it
-        deadline = time.monotonic() + 5.0
-        serve = []
-        while not serve and time.monotonic() < deadline:
-            serve = [r for r in x_spans(spans.snapshot())
-                     if r[4] == "nnsq_serve"]
-            if not serve:
-                time.sleep(0.01)
+        # the server records nnsq_serve BEFORE sending the reply, so the
+        # span is visible the instant recv_tensors_ex returned — no poll
+        serve = [r for r in x_spans(spans.snapshot())
+                 if r[4] == "nnsq_serve"]
         assert serve, "no server-side span recorded"
         assert serve[-1][6] == 0xABCD  # client's trace id
         assert serve[-1][8] == 0x11    # parent = client's span id
@@ -395,16 +390,10 @@ class TestNnsqTracePropagation:
         assert cli._trace_wire is True
         frame_traces = {f.meta[spans.META_KEY][0] for f in got}
         assert len(frame_traces) == 4
-        # the server records nnsq_serve on its connection thread AFTER
-        # sending the reply, so the final frame's span can land a moment
-        # after the client's sink fired — poll briefly before judging
-        deadline = time.time() + 5
-        while True:
-            snap = spans.snapshot()
-            serve = {r[6] for r in x_spans(snap) if r[4] == "nnsq_serve"}
-            if serve >= frame_traces or time.time() > deadline:
-                break
-            time.sleep(0.01)
+        # the server records nnsq_serve BEFORE sending each reply, so by
+        # the time every sink fired, every serve span is recorded
+        snap = spans.snapshot()
+        serve = {r[6] for r in x_spans(snap) if r[4] == "nnsq_serve"}
         rtt = {r[6] for r in x_spans(snap) if r[4] == "nnsq_rtt"}
         assert rtt == frame_traces
         assert serve >= frame_traces, (

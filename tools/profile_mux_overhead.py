@@ -33,13 +33,20 @@ This tool isolates where the per-stream cost lands:
   "chip idle" vs "chip busy on machinery".
 
 Usage: ``python tools/profile_mux_overhead.py [--mesh[=SPEC]] [--ttff]
-[TOTAL_FRAMES] [SWEEP...]`` e.g. ``python tools/profile_mux_overhead.py
-2000 1 2 4 8``.  ``--mesh`` (default spec ``dp:8``) sweeps the
-mesh-sharded dispatch lane over a forced 8-device host mesh and adds
-chips-used / per-shard-batch columns.  ``--ttff`` prints cold-vs-warm
-time-to-first-frame columns instead of the sweep: two fresh processes
-against one persistent executable cache (``[compile] cache_dir`` +
-warmup), the warm row gated on zero compile misses.
+[--lanes[=N]] [TOTAL_FRAMES] [SWEEP...]`` e.g. ``python
+tools/profile_mux_overhead.py 2000 1 2 4 8 16 32 64``.  ``--mesh``
+(default spec ``dp:8``) sweeps the mesh-sharded dispatch lane over a
+forced 8-device host mesh and adds chips-used / per-shard-batch
+columns.  ``--ttff`` prints cold-vs-warm time-to-first-frame columns
+instead of the sweep: two fresh processes against one persistent
+executable cache (``[compile] cache_dir`` + warmup), the warm row gated
+on zero compile misses.  ``--lanes`` (default ``auto``) runs the sweep
+on the dispatcher-lane runtime (``graph/lanes.py``) instead of
+thread-per-element; either way a ``lanes`` column reports the mode and
+the run ends with a lane-vs-thread A/B at the widest point (the other
+mode re-measured) plus the 8→widest flatness verdict — thread mode
+multiplies host threads per stream and declines, lanes must hold the
+widest point within ~10% of the 8-stream point.
 ``NNSTPU_POOL_ENABLED=false NNSTPU_POOL_CONCAT_THRESHOLD=0`` reproduces
 the pre-pool behavior for an A/B.  Appends nothing; copy the table +
 verdict into BENCH_NOTES.md.
@@ -65,6 +72,14 @@ for _arg in list(sys.argv):
         sys.argv.remove(_arg)
     elif _arg == "--ttff-child":
         TTFF_CHILD = True
+        sys.argv.remove(_arg)
+
+# --lanes[=N|auto]: run the sweep on the dispatcher-lane runtime
+# ([dispatch] lanes); the A/B verdict at the end measures the other mode
+LANES = None
+for _arg in list(sys.argv):
+    if _arg == "--lanes" or _arg.startswith("--lanes="):
+        LANES = _arg.partition("=")[2] or "auto"
         sys.argv.remove(_arg)
 
 # --mesh[=SPEC] (default dp:8): sweep the mesh-sharded dispatch lane —
@@ -93,6 +108,7 @@ from nnstreamer_tpu.elements.batch import TensorBatch, TensorUnbatch
 from nnstreamer_tpu.elements.demux import TensorDemux
 from nnstreamer_tpu.elements.filter import TensorFilter
 from nnstreamer_tpu.elements.mux import TensorMux
+from nnstreamer_tpu.elements.queue import Queue
 from nnstreamer_tpu.elements.sink import TensorSink
 from nnstreamer_tpu.elements.testsrc import DataSrc
 from nnstreamer_tpu.obs import hooks
@@ -101,7 +117,7 @@ from nnstreamer_tpu.obs.metrics import MetricsRegistry
 from nnstreamer_tpu.spec import TensorSpec, TensorsSpec
 
 TOTAL = int(sys.argv[1]) if len(sys.argv) > 1 else 2000
-SWEEP = [int(a) for a in sys.argv[2:]] or [1, 2, 4, 8]
+SWEEP = [int(a) for a in sys.argv[2:]] or [1, 2, 4, 8, 16, 32, 64]
 # identity isolates the collect/batch machinery; matmul emulates the
 # compute-bound config5 regime (is the decline machinery or model?)
 MODEL = os.environ.get("MUX_PROFILE_MODEL", "identity")
@@ -165,22 +181,53 @@ class CopyCount:
             self.allocs += int(allocs)
 
 
-def run_mux(streams, frames_per_stream, attribute=False):
+def run_mux(streams, frames_per_stream, attribute=False, lanes=None,
+            wide=None):
+    """One measured pipeline run.  ``lanes``: None = whatever the
+    environment says, ``0`` = force thread-per-element, ``N``/``auto``
+    = force the dispatcher-lane runtime.  ``wide`` forces the
+    independent-chains topology regardless of stream count (used to
+    anchor the flatness verdict within ONE topology)."""
+    if lanes is not None:
+        os.environ["NNSTPU_DISPATCH_LANES"] = str(lanes)
+    use_wide = (streams > 16) if wide is None else bool(wide)
     state = {"count": 0, "t0": None}
+    _cb_lock = threading.Lock()
 
     def cb(frame):
-        if state["t0"] is None:
-            state["t0"] = time.perf_counter()
-        state["count"] += 1
+        with _cb_lock:
+            if state["t0"] is None:
+                state["t0"] = time.perf_counter()
+            state["count"] += 1
 
     p = Pipeline()
-    if streams == 1:
+    if streams == 1 and not use_wide:
         src = p.add(DataSrc(name="s0", data=[arr.copy() for _ in
                                              range(frames_per_stream)]))
         filt = p.add(TensorFilter(name="f", framework="jax",
                                   model=model_for(1)))
         sink = p.add(TensorSink(name="o0", callback=cb))
         p.link_chain(src, filt, sink)
+    elif use_wide:
+        # TensorMux caps at 16 sink pads, and past 16 streams the
+        # question changes anyway: this is the fleet-worker regime —
+        # N INDEPENDENT chains per host (src → queue → filter → sink),
+        # where thread-per-element pays 2 threads per stream and the
+        # dispatcher lanes pay none.  The filters are host-side
+        # (framework=custom): what this regime measures is pure
+        # scheduling machinery — per-chain jax backends would each
+        # compile inside the measured window and drown it.
+        filt = None
+        for i in range(streams):
+            src = p.add(DataSrc(name=f"s{i}", data=[
+                arr.copy() for _ in range(frames_per_stream)]))
+            qn = p.add(Queue(name=f"q{i}", max_size_buffers=16))
+            fn = p.add(TensorFilter(name=f"f{i}", framework="custom",
+                                    model=lambda x: x * 2.0))
+            p.link_chain(src, qn, fn,
+                         p.add(TensorSink(name=f"o{i}", callback=cb)))
+            if filt is None:
+                filt = fn
     else:
         mux = p.add(TensorMux(sync_mode="nosync"))
         for i in range(streams):
@@ -202,9 +249,22 @@ def run_mux(streams, frames_per_stream, attribute=False):
     hooks.connect("copy", copies)
     if attribute:
         hooks.connect("dispatch_exit", attr)
+    nlanes = 0
+    host_threads = 0
     try:
         t_start = time.perf_counter()
-        p.run(timeout=600)
+        p.start()
+        nlanes = p._lanes.nlanes if p._lanes is not None else 0
+        # threads the graph OWNS (spawned sources/workers, or lanes +
+        # promoted helpers) — active_count() would under-count fast
+        # finite sources that exit before the sweep ends
+        if p._lanes is not None:
+            host_threads = nlanes + len(p._lanes._helpers)
+        else:
+            host_threads = len(p.threads)
+        if not p.wait(600):
+            raise RuntimeError("sweep pipeline did not finish")
+        p.stop()
         wall = time.perf_counter() - t_start
     finally:
         hooks.disconnect("copy", copies)
@@ -235,6 +295,8 @@ def run_mux(streams, frames_per_stream, attribute=False):
     mesh = getattr(filt.backend, "_mesh", None)
     copies.chips = int(mesh.devices.size) if mesh is not None else 1
     copies.per_shard = max(1, streams) / copies.chips
+    copies.lanes = nlanes
+    copies.host_threads = host_threads
     return fps, wall, attr, copies
 
 
@@ -299,8 +361,9 @@ def main():
         ttff_sweep()
         return
     ncpu = os.cpu_count()
+    mode_lanes = LANES if LANES is not None else 0
     print(f"mux overhead sweep: total={TOTAL} frames, host cpus={ncpu}, "
-          f"threads-per-config = streams sources + 1/elt + sinks")
+          f"mode={'lanes=' + str(mode_lanes) if LANES is not None else 'thread-per-element'}")
     if MESH is not None:
         print(f"mesh-sharded dispatch: NNSTPU_MESH={MESH!r} over "
               f"{len(jax.devices())} host devices")
@@ -310,33 +373,74 @@ def main():
     def fmt_busy(v):
         return f"{v * 100:>6.1f}%" if v is not None else f"{'-':>7}"
 
-    run_mux(1, 50)
-    base_fps, _, _, base_cp = run_mux(1, TOTAL)
-    print(f"\n{'streams':>7} {'agg fps':>10} {'us/frame':>10} "
+    run_mux(1, 50, lanes=mode_lanes)
+    base_fps, _, _, base_cp = run_mux(1, TOTAL, lanes=mode_lanes)
+    print(f"\n{'streams':>7} {'lanes':>6} {'agg fps':>10} {'us/frame':>10} "
           f"{'vs 1-stream':>11} {'copy KB/fr':>11} {'allocs/fr':>10} "
           f"{'dev us/fr':>10} {'mfu':>9} {'busy':>7} {'chips':>6} "
           f"{'b/shard':>8}")
-    print(f"{1:>7} {base_fps:>10.0f} {1e6 / base_fps:>10.1f} {'1.00x':>11} "
+    print(f"{1:>7} {base_cp.lanes:>6} {base_fps:>10.0f} "
+          f"{1e6 / base_fps:>10.1f} {'1.00x':>11} "
           f"{base_cp.per_frame / 1024:>11.1f} "
           f"{base_cp.allocs_per_frame:>10.3f} "
           f"{base_cp.dev_us_per_frame:>10.1f} "
           f"{fmt_mfu(base_cp.mfu)} {fmt_busy(base_cp.busy)} "
           f"{base_cp.chips:>6} {base_cp.per_shard:>8.2f}")
     results = {1: base_fps}
+    last_cp = base_cp
     for s in [s for s in SWEEP if s != 1]:
-        run_mux(s, 40)  # warm the s-wide executable
-        fps, _, _, cp = run_mux(s, TOTAL // s)
+        run_mux(s, max(8, 160 // s), lanes=mode_lanes)  # warm the s-wide exe
+        fps, _, _, cp = run_mux(s, TOTAL // s, lanes=mode_lanes)
         results[s] = fps
-        print(f"{s:>7} {fps:>10.0f} {1e6 / fps:>10.1f} "
+        last_cp = cp
+        print(f"{s:>7} {cp.lanes:>6} {fps:>10.0f} {1e6 / fps:>10.1f} "
               f"{fps / base_fps:>10.2f}x {cp.per_frame / 1024:>11.1f} "
               f"{cp.allocs_per_frame:>10.3f} {cp.dev_us_per_frame:>10.1f} "
               f"{fmt_mfu(cp.mfu)} {fmt_busy(cp.busy)} "
               f"{cp.chips:>6} {cp.per_shard:>8.2f}")
 
-    # attribution pass at the widest sweep point
+    # lane-vs-thread A/B at the widest point: re-measure in the OTHER
+    # mode, then judge flatness per mode — widest vs the 8-stream point
+    # measured in the SAME topology (past 16 streams the sweep switches
+    # to independent chains, so the anchor is re-run wide too)
     widest = max(SWEEP)
-    run_mux(widest, 30)
-    fps, wall, attr, cp = run_mux(widest, TOTAL // widest, attribute=True)
+    other = 0 if LANES is not None else "auto"
+    run_mux(widest, max(8, 160 // widest), lanes=other)
+    ab_fps, _, _, ab_cp = run_mux(widest, TOTAL // widest, lanes=other)
+    this_label = f"lanes={mode_lanes}" if LANES is not None else "threads"
+    other_label = "threads" if LANES is not None else f"lanes({ab_cp.lanes})"
+    this_threads = last_cp.host_threads
+    print(f"\nA/B at {widest} streams: {this_label} {results[widest]:.0f} "
+          f"fps on {this_threads} host threads vs {other_label} "
+          f"{ab_fps:.0f} fps on {ab_cp.host_threads} host threads "
+          f"({results[widest] / max(ab_fps, 1e-9):.2f}x fps, "
+          f"{ab_cp.host_threads / max(this_threads, 1)}x the threads)")
+    if widest > 16:
+        wide = widest > 16
+        run_mux(8, 20, lanes=mode_lanes, wide=wide)
+        anchor, _, _, _ = run_mux(8, TOTAL // 8, lanes=mode_lanes,
+                                  wide=wide)
+        run_mux(8, 20, lanes=other, wide=wide)
+        anchor_ab, _, _, _ = run_mux(8, TOTAL // 8, lanes=other, wide=wide)
+    else:
+        anchor = anchor_ab = results.get(8) or results[
+            min(results, key=lambda k: abs(k - 8))]
+    flat = results[widest] / max(anchor, 1e-9)
+    flat_ab = ab_fps / max(anchor_ab, 1e-9)
+    if LANES is not None:
+        verdict = "FLAT (within 10%)" if flat >= 0.90 else "DECLINING"
+        print(f"lane flatness: {widest}-stream agg is {flat:.2f}x the "
+              f"8-stream point (same topology) -> {verdict}; thread mode: "
+              f"{flat_ab:.2f}x its own 8-stream point")
+    else:
+        print(f"thread flatness: {widest}-stream agg is {flat:.2f}x the "
+              f"8-stream point (same topology); lane mode: {flat_ab:.2f}x "
+              f"its own 8-stream point")
+
+    # attribution pass at the widest sweep point (sweep mode)
+    run_mux(widest, 30, lanes=mode_lanes)
+    fps, wall, attr, cp = run_mux(widest, TOTAL // widest, attribute=True,
+                                  lanes=mode_lanes)
     print(f"\nper-element busy time at {widest} streams "
           f"({TOTAL // widest} frames/stream, wall {wall:.2f}s; "
           "dispatch_exit hook, sink-pad wall-ns):")

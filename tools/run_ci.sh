@@ -104,8 +104,32 @@ assert len(got) == 16, got
 rep = lockdep.report()
 assert rep["cycles"] == [], lockdep.format_report()
 assert rep["blocking_calls"] == [], lockdep.format_report()
+
+# 3) the dispatcher-lane runtime is clean too: the same pipeline on
+# event-loop lanes (ready-rings, arm/run locks, helper promotion, the
+# backpressure help path) must add its lock sites without a single new
+# order cycle or blocking call under lock
+import os
+lockdep.reset()
+os.environ["NNSTPU_DISPATCH_LANES"] = "2"
+got2 = []
+p2 = Pipeline(name="ci_lockdep_lanes")
+src2 = p2.add(DataSrc(data=[np.full(4, i, np.float32) for i in range(16)],
+                      name="s"))
+q2 = p2.add(Queue(max_size_buffers=4, name="q"))
+filt2 = p2.add(TensorFilter(framework="custom", model=lambda x: x * 2,
+                            name="f"))
+p2.link_chain(src2, q2, filt2, p2.add(TensorSink(callback=got2.append,
+                                                 name="out")))
+p2.run(timeout=120)
+del os.environ["NNSTPU_DISPATCH_LANES"]
+assert len(got2) == 16, got2
+rep2 = lockdep.report()
+assert rep2["cycles"] == [], lockdep.format_report()
+assert rep2["blocking_calls"] == [], lockdep.format_report()
 print(f"lockdep smoke OK: seeded cycle detected, pipeline clean over "
-      f"{rep['sites']} lock sites / {rep['edges']} order edges")
+      f"{rep['sites']} lock sites / {rep['edges']} order edges; lane "
+      f"runtime clean over {rep2['sites']} sites / {rep2['edges']} edges")
 PY
 
 # NOTE: on this host the axon sitecustomize makes the JAX_PLATFORMS env
@@ -569,6 +593,75 @@ print(f"chaos smoke OK: {drops} injected socket drops all retried to "
       f"success; watchdog drained the wedged queue (shed "
       f"{rec['shed_total']} typed), ledger balances "
       f"{len(got)}+{rec['shed_total']}=={n}, /healthz back to 200")
+PY
+
+run_step "Dispatcher-lane smoke (chaos soak on lanes: healthy end, exact ledger, byte-identical replay)" \
+  env NNSTPU_DISPATCH_LANES=auto \
+  python - <<'PY'
+# The chaos-soak template (tests/test_soak.py) in lane mode: the
+# run-to-completion runtime must ride a seeded raise+delay fault mix to
+# a healthy EOS with the recovery ledger balancing EXACTLY and the
+# fault engine's decision log replaying byte-identical — proof that
+# supervised recovery and deterministic chaos are substrate-invariant.
+import jax
+jax.config.update('jax_platforms', 'cpu')
+import numpy as np
+
+from nnstreamer_tpu import Pipeline, faults
+from nnstreamer_tpu.buffer import Frame
+from nnstreamer_tpu.elements.filter import TensorFilter
+from nnstreamer_tpu.elements.queue import Queue
+from nnstreamer_tpu.elements.sink import TensorSink
+from nnstreamer_tpu.elements.testsrc import DataSrc
+
+n = 400
+spec = "seed=1234;invoke_raise@f:rate=0.03;invoke_delay@f:rate=0.02,ms=1"
+eng = faults.install(spec)
+try:
+    got = []
+    p = Pipeline(name="ci_lane_soak")
+    src = p.add(DataSrc(data=[
+        Frame.of(np.full(4, float(i), np.float32), pts=i)
+        for i in range(n)]))
+    q = p.add(Queue(max_size_buffers=64, name="qsoak"))
+    filt = p.add(TensorFilter(framework="custom",
+                              model=lambda x: x * 2.0, name="f"))
+    sink = p.add(TensorSink(name="out"))
+    sink.connect("new-data",
+                 lambda fr: got.append((fr.pts,
+                                        float(np.asarray(fr.tensor(0))[0]))))
+    p.link_chain(src, q, filt, sink)
+    p.set_restart_policy("f", mode="restart", backoff_ms=1,
+                         backoff_cap_ms=4, max_restarts=1000,
+                         window_s=300.0)
+    p.start()
+    assert p._lanes is not None, "lane runtime did not activate"
+    nlanes = p._lanes.nlanes
+    assert p.wait(timeout=600)
+    p.stop()
+
+    raises = eng.injections.get("invoke_raise", 0)
+    delays = eng.injections.get("invoke_delay", 0)
+    assert raises > 0 and delays > 0, eng.stats()
+    assert p.state == "STOPPED" and p._error is None
+    rec = p.recovery_stats()
+    assert rec["actions"]["restart_node"] == raises, rec
+    assert rec["shed_total"] == raises, rec
+    assert len(got) + rec["shed_total"] == n, (len(got), rec)
+    assert [pts for pts, _ in got] == sorted(pts for pts, _ in got)
+    for pts, val in got:
+        assert val == 2.0 * pts, (pts, val)
+    replay = faults.ChaosEngine(spec)
+    for _ in range(n):
+        replay.decide("backend_invoke", "f")
+    assert replay.log == eng.log, "replay diverged from the live run"
+    assert replay.injections == eng.injections
+    print(f"lane smoke OK: {nlanes} lane(s), {len(got)} delivered + "
+          f"{rec['shed_total']} typed shed == {n} offered, "
+          f"{raises} restarts == {raises} injected raises, replay "
+          f"byte-identical over {len(eng.log)} decisions")
+finally:
+    faults.deactivate()
 PY
 
 run_step "Mesh smoke (8-device host mesh: equivalence + per-chip spans)" \
