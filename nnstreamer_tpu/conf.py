@@ -174,6 +174,41 @@ DEFAULTS: Dict[str, Dict[str, str]] = {
         "migrate_check_s": "0.25",  # stateful router's monitor period
                                     # for self-draining workers
     },
+    # Elastic fleet autoscaling (nnstreamer_tpu/fleet/autoscaler.py +
+    # supervisor.py): the SLO-driven control loop over the fleet's
+    # federated signals.  NNSTPU_AUTOSCALE_* env vars map here.
+    "autoscale": {
+        "min_workers": "1",         # fleet floor (never drained below)
+        "max_workers": "4",         # fleet ceiling (never spawned above)
+        "interval_s": "0.5",        # control-loop tick period
+        "queue_wait_hi_ms": "50",   # queue-wait p99 above this => scale up
+        "queue_wait_lo_ms": "5",    # ...below this (and idle) => scale down
+        "busy_hi": "0.85",          # device_busy_fraction/MFU upper band
+        "busy_lo": "0.20",          # ...lower band (scale-down eligible)
+        "shed_hi": "0.01",          # shed-rate (shed/offered) => scale up
+        "up_cooldown_s": "1",       # min gap between scale-UP actions
+        "down_cooldown_s": "5",     # min gap between scale-DOWN actions
+        "flap_window_s": "30",      # direction reversals counted here...
+        "flap_limit": "3",          # ...beyond this: damped (held steady)
+        "storm_budget": "6",        # max spawns per storm window before
+                                    # the typed degraded /healthz escalation
+        "storm_window_s": "30",     # the spawn-storm budget window
+        "forecast": "true",         # predictive leg over offered-load
+                                    # history (diurnal profiles forecast)
+        "forecast_horizon_s": "5",  # how far ahead the forecast looks
+        "history_window_s": "60",   # offered-load history retained
+        "worker_rps": "0",          # per-worker capacity estimate feeding
+                                    # the forecast (0 = predictive leg off)
+        "crash_limit": "3",         # worker deaths within crash_window_s
+                                    # => crash-loop quarantine
+        "crash_window_s": "30",     # the crash-loop detection window
+        "quarantine_s": "30",       # hold-down before a quarantined
+                                    # worker may respawn
+        "respawn_backoff_ms": "200",   # first respawn backoff (doubles)
+        "respawn_backoff_cap_ms": "5000",  # respawn backoff ceiling
+        "spawn_timeout_s": "30",    # spawn + warmup deadline before the
+                                    # attempt counts as failed
+    },
     # Analysis instruments (nnstreamer_tpu/analysis): runtime lockdep.
     # The short env spelling NNSTPU_LOCKDEP takes precedence over the
     # NNSTPU_ANALYSIS_LOCKDEP form mapped here.

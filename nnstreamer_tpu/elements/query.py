@@ -596,7 +596,16 @@ class QueryServer:
             except Exception as exc:  # noqa: BLE001 — report, keep serving
                 try:
                     with state.lock:
-                        send_error(conn, repr(exc))
+                        if not self._running:
+                            # killed/stopped mid-dispatch: a typed
+                            # goodbye (same contract as drain) so a
+                            # fleet router fails over transparently
+                            # instead of relaying an untyped corpse
+                            # error to its client
+                            send_error(conn, repr(exc),
+                                       code="UNAVAILABLE")
+                        else:
+                            send_error(conn, repr(exc))
                 except OSError:
                     return
             finally:

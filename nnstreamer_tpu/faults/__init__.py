@@ -47,6 +47,16 @@ Call sites (the injection points):
                    raises mid-handoff; the router must degrade to the
                    typed ``[SESSION]`` fallback with the source slot
                    freed, never hang or duplicate a step
+``autoscale``      the elastic-fleet tier (``fleet/supervisor.py`` +
+                   ``fleet/autoscaler.py``) — ``spawn_fail``
+                   (:func:`maybe_spawn_fail`, site
+                   ``<supervisor>:spawn:<worker>``) raises at a spawn
+                   attempt: the supervisor counts the failure, backs
+                   off, and keeps serving from the current fleet;
+                   ``scale_flap`` (:func:`maybe_scale_flap`, site
+                   ``<autoscaler>:plan``) perturbs the controller's raw
+                   desired worker count each tick it fires: hysteresis
+                   + flap damping must hold the fleet steady
 =================  =====================================================
 """
 
@@ -179,6 +189,31 @@ def maybe_migrate(name: str) -> None:
     rule = eng.decide("migrate", name)
     if rule is not None:
         raise InjectedFault(rule.kind, name, rule.opportunities)
+
+
+def maybe_spawn_fail(name: str) -> None:
+    """``autoscale`` point, ``spawn_fail`` kind: one opportunity per
+    worker-spawn attempt (``<supervisor>:spawn:<worker>``); a firing
+    rule raises :class:`InjectedFault` — the supervisor's degrade path
+    (count the failure, back off, keep the current fleet serving) is
+    the intended survivor."""
+    eng = _engine
+    if eng is None:
+        return
+    rule = eng.decide("autoscale", name, kinds=("spawn_fail",))
+    if rule is not None:
+        raise InjectedFault(rule.kind, name, rule.opportunities)
+
+
+def maybe_scale_flap(name: str):
+    """``autoscale`` point, ``scale_flap`` kind: one opportunity per
+    controller tick (``<autoscaler>:plan``); returns the firing
+    :class:`FaultRule` (the controller applies it as a desired-count
+    perturbation its flap damper must absorb) or None."""
+    eng = _engine
+    if eng is None:
+        return None
+    return eng.decide("autoscale", name, kinds=("scale_flap",))
 
 
 def maybe_queue_wedge(name: str) -> None:

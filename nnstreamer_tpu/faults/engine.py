@@ -74,6 +74,16 @@ POINT_OF = {
     # and the router must degrade to the typed [SESSION] path with the
     # source slot freed (never a hang, never a duplicate step)
     "migrate_abort": "migrate",
+    # elastic-fleet autoscaling (fleet/supervisor.py + fleet/
+    # autoscaler.py): `spawn_fail` is consulted per spawn attempt
+    # ("<supervisor>:spawn:<worker>") and raises — the supervisor must
+    # degrade to the current fleet (count the failure, back off) instead
+    # of wedging the control loop; `scale_flap` is consulted per
+    # controller tick ("<autoscaler>:plan") and perturbs the raw desired
+    # worker count — the controller's hysteresis + flap damping are the
+    # intended survivors (the fleet must not oscillate)
+    "spawn_fail": "autoscale",
+    "scale_flap": "autoscale",
 }
 
 KINDS = frozenset(POINT_OF)
@@ -219,15 +229,23 @@ class ChaosEngine:
     def points(self) -> frozenset:
         return frozenset(self._by_point)
 
-    def decide(self, point: str, name: str = "") -> Optional[FaultRule]:
+    def decide(self, point: str, name: str = "",
+               kinds=None) -> Optional[FaultRule]:
         """One opportunity at ``point``; returns the firing rule (first
         match wins) or None.  Fires are logged + counted here so every
-        call site shares one accounting path."""
+        call site shares one accounting path.  ``kinds`` (optional
+        iterable) restricts the consult to rules of those kinds — rules
+        of other kinds at the same point do NOT consume an opportunity,
+        so call sites that only understand one kind (e.g. the autoscale
+        point's ``spawn_fail`` vs ``scale_flap``) keep every rule's
+        random stream — and therefore the replay — well-defined."""
         rules = self._by_point.get(point)
         if not rules:
             return None
         with self._lock:
             for rule in rules:
+                if kinds is not None and rule.kind not in kinds:
+                    continue
                 if not rule.matches(name):
                     continue
                 if rule.decide():

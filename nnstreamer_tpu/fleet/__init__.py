@@ -25,7 +25,12 @@ stack already proved under chaos:
 - :mod:`.repo` — ``tensor_repo`` over the wire, so cross-pipeline
   recurrence survives process boundaries (``[fleet] repo_addr``);
 - :mod:`.chaos` — applies the faults engine's seeded fleet-scope kinds
-  (``worker_kill`` / ``worker_hang`` / ``partition``) to live workers.
+  (``worker_kill`` / ``worker_hang`` / ``partition``) to live workers;
+- :mod:`.supervisor` / :mod:`.autoscaler` — the **self-healing elastic
+  fleet**: supervised spawn/respawn with crash-loop quarantine, and the
+  SLO-driven control loop (hysteresis, per-direction cooldowns, flap
+  damping, a scale-storm budget, a predictive diurnal leg) that grows
+  and shrinks the fleet over the signals it already publishes.
 
 ``python -m nnstreamer_tpu.fleet worker|router`` runs either role as a
 process (see :mod:`.__main__`); ``docs/fleet.md`` has the topology and
@@ -43,5 +48,14 @@ from .membership import (  # noqa: F401
     NoWorkerAvailable,
     WorkerInfo,
 )
+from .autoscaler import Autoscaler, FleetSignals, RouterSignals  # noqa: F401
 from .router import Router  # noqa: F401
+from .supervisor import (  # noqa: F401
+    InProcWorkerFactory,
+    ScaleEventLog,
+    SpawnError,
+    SubprocWorkerFactory,
+    Supervisor,
+    Surface,
+)
 from .worker import BUILTIN_MODELS, FleetWorker  # noqa: F401

@@ -18,6 +18,14 @@ the channel live session migration snapshots cross)::
 
     python -m nnstreamer_tpu.fleet repo --port 0
 
+Autoscale (the self-scaling fleet: router(s) + supervisor + autoscaler
+in one process, worker subprocesses spawned/drained to track the SLO —
+``[autoscale]`` conf knobs / ``NNSTPU_AUTOSCALE_*``)::
+
+    python -m nnstreamer_tpu.fleet autoscale --port 0 --health-port 0 \\
+        --model x2 --min-workers 1 --max-workers 3 --worker-rps 40 \\
+        [--decode capacity=4,... --repo ''(self-hosted)]
+
 Each process prints ONE JSON line describing its bound ports (a
 supervisor parses it), then serves until signalled:
 
@@ -112,10 +120,18 @@ def _cmd_worker(args) -> int:
         drain_timeout_s=args.drain_timeout,
         warmup_spec=warmup_spec,
         warmup_engine=args.warmup_engine).start()
+    # the ports line is the spawn contract: every port may be requested
+    # ephemeral (0) and the CHOSEN ports are reported here — a
+    # supervisor-spawned worker never collides with a draining
+    # predecessor's still-releasing port, because it never asks for a
+    # fixed one.  trace_addr feeds the cluster trace collector; nonce is
+    # the incarnation witness membership keys per-worker state by.
     print(json.dumps({
         "role": "worker", "name": worker.name, "pid": os.getpid(),
         "port": worker.query_port, "decode_port": worker.decode_port,
         "health_port": worker.health_port,
+        "trace_addr": worker.trace_addr,
+        "nonce": worker.incarnation,
     }), flush=True)
     return _serve_until_signal(worker.drain, worker.stop)
 
@@ -161,6 +177,97 @@ def _cmd_router(args) -> int:
             metrics.stop()
 
     return _serve_until_signal(stop, stop)
+
+
+def _cmd_autoscale(args) -> int:
+    """The self-scaling fleet-in-a-box: router(s) + supervisor +
+    autoscaler in THIS process, workers spawned as subprocesses with
+    every port ephemeral.  SIGTERM drains the whole fleet."""
+    from ..obs.export import MetricsServer
+    from .autoscaler import Autoscaler, RouterSignals
+    from .membership import Membership
+    from .router import Router
+    from .supervisor import SubprocWorkerFactory, Supervisor, Surface
+
+    if args.spans:
+        _enable_spans(args.name)
+    worker_args = ["--model", args.model, "--framework", args.framework]
+    if args.custom:
+        worker_args += ["--custom", args.custom]
+    if args.batch:
+        worker_args += ["--batch", str(args.batch)]
+    worker_args += ["--max-batch", str(args.max_batch)]
+    if args.decode:
+        worker_args += ["--decode", args.decode]
+    if args.warmup_spec:
+        worker_args += ["--warmup-spec", args.warmup_spec]
+    if args.warmup_engine:
+        worker_args += ["--warmup-engine"]
+    if args.spans:
+        worker_args += ["--spans"]
+    factory = SubprocWorkerFactory(worker_args, platform=args.platform)
+
+    membership = Membership().start()
+    router = Router(membership, host=args.host, port=args.port,
+                    name=args.name).start()
+    surfaces = [Surface(membership, router, port_key="port", name="query")]
+    repo_srv = None
+    dmembership = drouter = None
+    if args.decode:
+        repo_addr = args.repo
+        if not repo_addr:
+            # self-host the migration snapshot channel so a scale-down
+            # drain can live-migrate sessions without extra processes
+            from .repo import TensorRepoServer
+
+            repo_srv = TensorRepoServer(host=args.host, port=0).start()
+            repo_addr = f"{args.host}:{repo_srv.port}"
+        dmembership = Membership().start()
+        drouter = Router(dmembership, host=args.host,
+                         port=args.decode_router_port, stateful=True,
+                         name=f"{args.name}-decode",
+                         repo_addr=repo_addr).start()
+        surfaces.append(Surface(dmembership, drouter,
+                                port_key="decode_port", name="decode"))
+    supervisor = Supervisor(factory, surfaces, name=args.name)
+    autoscaler = Autoscaler(
+        supervisor, RouterSignals(router, membership), name=args.name,
+        min_workers=args.min_workers, max_workers=args.max_workers,
+        worker_rps=args.worker_rps if args.worker_rps else None)
+    for _ in range(autoscaler.min_workers):
+        supervisor.spawn_worker(detail="initial fleet floor")
+    autoscaler.start()
+    metrics = None
+    health_port = None
+    if args.health_port is not None:
+        metrics = MetricsServer(port=args.health_port).start()
+        health_port = metrics.port
+    print(json.dumps({
+        "role": "autoscale", "name": args.name, "pid": os.getpid(),
+        "port": router.port,
+        "decode_port": drouter.port if drouter is not None else None,
+        "repo_port": repo_srv.port if repo_srv is not None else None,
+        "health_port": health_port,
+        "min_workers": autoscaler.min_workers,
+        "max_workers": autoscaler.max_workers,
+    }), flush=True)
+
+    def teardown(drain):
+        autoscaler.stop()
+        supervisor.stop(drain=drain)
+        for r in (router, drouter):
+            if r is not None:
+                r.stop()
+        for m in (membership, dmembership):
+            if m is not None:
+                m.stop()
+        if repo_srv is not None:
+            repo_srv.stop()
+        if metrics is not None:
+            metrics.stop()
+
+    return _serve_until_signal(lambda: teardown(True),
+                               lambda: teardown(False))
 
 
 def _cmd_repo(args) -> int:
@@ -228,7 +335,40 @@ def main(argv=None) -> int:
     p.add_argument("--port", type=int, default=0)
     p.set_defaults(fn=_cmd_repo)
 
-    for sp in (w, r, p):
+    a = sub.add_parser(
+        "autoscale",
+        help="self-scaling fleet: router(s) + supervisor + autoscaler, "
+             "workers spawned as subprocesses on ephemeral ports")
+    a.add_argument("--name", default="autoscale")
+    a.add_argument("--host", default="127.0.0.1")
+    a.add_argument("--port", type=int, default=0,
+                   help="the stateless (query) router port")
+    a.add_argument("--decode-router-port", type=int, default=0,
+                   help="the stateful decode router port (with --decode)")
+    a.add_argument("--health-port", type=int, default=0)
+    a.add_argument("--min-workers", type=int, default=None,
+                   help="fleet floor (default [autoscale] min_workers)")
+    a.add_argument("--max-workers", type=int, default=None,
+                   help="fleet ceiling (default [autoscale] max_workers)")
+    a.add_argument("--worker-rps", type=float, default=0.0,
+                   help="per-worker capacity estimate feeding the "
+                        "predictive leg (0 = [autoscale] worker_rps)")
+    a.add_argument("--framework", default="custom")
+    a.add_argument("--model", default="x2")
+    a.add_argument("--custom", default="")
+    a.add_argument("--batch", type=int, default=0)
+    a.add_argument("--max-batch", type=int, default=64)
+    a.add_argument("--decode", default="",
+                   help="ContinuousBatcher kwargs for the workers — also "
+                        "starts the stateful decode router surface")
+    a.add_argument("--repo", default="",
+                   help="host:port of a TensorRepoServer for migrate-first "
+                        "drains ('' with --decode = self-host one)")
+    a.add_argument("--warmup-spec", default="", metavar="DTYPE:DIMS")
+    a.add_argument("--warmup-engine", action="store_true")
+    a.set_defaults(fn=_cmd_autoscale)
+
+    for sp in (w, r, p, a):
         sp.add_argument("--platform", default=None, metavar="NAME",
                         help="pin the jax platform (e.g. cpu) before any "
                              "backend initializes")

@@ -84,6 +84,18 @@ class WorkerInfo:
         self.draining = False
         self.misses = 0
         self.degraded_reason = ""
+        # incarnation witness: probes may report a per-process start
+        # nonce (the /healthz "nonce" key, or the second element of a
+        # (status, nonce) probe return).  Per-worker failure state —
+        # breaker, miss streak, draining — is keyed by (address, nonce):
+        # a respawned process must not inherit its dead predecessor's
+        # quarantine, whatever address it came back on.
+        self.incarnation: Optional[str] = None
+        # bumped on every rebind: consumers holding per-worker resources
+        # keyed by this object (the router's connection pools) must
+        # discard them when the generation moves — pooled sockets to the
+        # dead incarnation's address are not connections to this worker
+        self.generation = 0
         self.last_seen = time.monotonic()
         self.block_health = False       # chaos: heartbeat channel cut
         self.block_data = False         # chaos: data path cut
@@ -111,6 +123,28 @@ class WorkerInfo:
             failure_threshold=self._breaker_cfg[0],
             reset_timeout_s=self._breaker_cfg[1])
 
+    def rebind(self, host: str, port: int,
+               health_addr: Optional[str] = None,
+               probe: Optional[Callable[["WorkerInfo"], str]] = None
+               ) -> None:
+        """Move this roster entry to a NEW incarnation's address (a
+        supervisor respawned the worker, possibly on different ports):
+        fresh breaker, cleared miss/suspect/draining state — nothing of
+        the dead incarnation survives but the id and its counters."""
+        self.host, self.port = host, int(port)
+        if health_addr is not None:
+            self.health_addr = health_addr
+        if probe is not None:
+            self.probe = probe
+        self.generation += 1
+        self.reset_breaker()
+        self.misses = 0
+        self.draining = False
+        self.degraded_reason = ""
+        self.incarnation = None  # learned from the next probe
+        self.block_health = False
+        self.block_data = False
+
     def snapshot(self) -> dict:
         return {
             "id": self.id,
@@ -120,6 +154,7 @@ class WorkerInfo:
             "misses": self.misses,
             "degraded_reason": self.degraded_reason,
             "breaker": self.breaker.stats()["state"],
+            "incarnation": self.incarnation,
             "routed": self.routed,
             "failures": self.failures,
             "revivals": self.revivals,
@@ -127,9 +162,10 @@ class WorkerInfo:
         }
 
 
-def _http_probe(worker: WorkerInfo, timeout_s: float) -> str:
+def _http_probe(worker: WorkerInfo, timeout_s: float):
     """Default prober: GET the worker's ``/healthz`` and map the JSON
-    body to a status string; raising = unreachable (a miss)."""
+    body to ``(status string, incarnation nonce or None)``; raising =
+    unreachable (a miss)."""
     if worker.health_addr is None:
         raise ConnectionError(f"{worker.id}: no health address")
     url = f"http://{worker.health_addr}/healthz"
@@ -145,29 +181,30 @@ def _http_probe(worker: WorkerInfo, timeout_s: float) -> str:
                 doc = json.loads(exc.read().decode("utf-8"))
                 fails = doc.get("failures") or {}
                 if any("draining" in str(v) for v in fails.values()):
-                    return DRAINING
+                    return DRAINING, doc.get("nonce")
             except (ValueError, AttributeError, OSError):
                 pass
-            return UNHEALTHY
+            return UNHEALTHY, None
         raise
     try:
         doc = json.loads(body.decode("utf-8"))
         status = str(doc.get("status", "ok"))
+        nonce = doc.get("nonce")
         if status == "degraded":
             # carry WHY (e.g. "jax:f: compile failed ...; cpu fallback")
             # so operators see the deprioritization reason in the roster
             reasons = "; ".join(
                 f"{k}: {v}" for k, v in sorted(
                     (doc.get("degraded") or {}).items()))
-            return f"degraded:{reasons}"
+            return f"degraded:{reasons}", nonce
         if status == "warming":
             reasons = "; ".join(
                 f"{k}: {v}" for k, v in sorted(
                     (doc.get("warming") or {}).items()))
-            return f"warming:{reasons}"
-        return status
+            return f"warming:{reasons}", nonce
+        return status, nonce
     except (ValueError, AttributeError):
-        return "ok"  # pre-JSON peer: 200 means serving
+        return "ok", None  # pre-JSON peer: 200 means serving
 
 
 class Membership:
@@ -236,6 +273,26 @@ class Membership:
         with self._lock:
             self._workers.pop(worker_id, None)
 
+    def rebind(self, worker_id: str, host: str, port: int,
+               health_addr: Optional[str] = None,
+               probe: Optional[Callable[[WorkerInfo], str]] = None
+               ) -> WorkerInfo:
+        """Point an existing roster entry at a respawned incarnation —
+        possibly on a *different* address (ephemeral ports).  The entry
+        keeps its id and traffic counters but none of the dead
+        incarnation's failure state (breaker, misses, draining); the
+        next probe's verdict (with the new nonce) brings it back into
+        rotation.  Unknown ids fall through to :meth:`add` so a
+        supervisor can use one call for both paths."""
+        with self._lock:
+            w = self._workers.get(worker_id)
+        if w is None:
+            return self.add(host, port, health_addr=health_addr,
+                            probe=probe, worker_id=worker_id)
+        w.rebind(host, port, health_addr=health_addr, probe=probe)
+        self._g_state.set(STATE_CODES[w.state], worker=w.id)
+        return w
+
     def get(self, worker_id: str) -> WorkerInfo:
         with self._lock:
             return self._workers[worker_id]
@@ -292,7 +349,12 @@ class Membership:
             except Exception:  # noqa: BLE001 — any probe failure is a miss
                 self._miss(w)
             else:
-                self._verdict(w, status)
+                # probe contract: a status string, or (status, nonce)
+                # where nonce is the worker's incarnation witness
+                nonce = None
+                if isinstance(status, tuple):
+                    status, nonce = status
+                self._verdict(w, status, nonce)
             self._g_state.set(STATE_CODES[w.state], worker=w.id)
 
     def _miss(self, w: WorkerInfo) -> None:
@@ -304,14 +366,28 @@ class Membership:
             # partition ≠ crash: out of rotation, nothing torn down
             w.state = SUSPECT
 
-    def _verdict(self, w: WorkerInfo, status: str) -> None:
+    def _verdict(self, w: WorkerInfo, status: str,
+                 nonce: Optional[str] = None) -> None:
         w.misses = 0
         w.last_seen = time.monotonic()
+        fresh_incarnation = (nonce is not None
+                             and w.incarnation is not None
+                             and nonce != w.incarnation)
         if w.state == DOWN:
             # resurrection (restarted process / healed partition): fresh
             # breaker, no inherited failure streak
             w.reset_breaker()
             w.revivals += 1
+        elif fresh_incarnation:
+            # the process restarted without us ever declaring it DOWN
+            # (fast respawn, or a supervisor rebind raced the probe):
+            # same contract — the dead incarnation's breaker/suspect
+            # state must not survive into the new one
+            w.reset_breaker()
+            w.draining = False
+            w.revivals += 1
+        if nonce is not None:
+            w.incarnation = nonce
         if status.startswith("degraded"):
             w.state = DEGRADED
             w.degraded_reason = status.partition(":")[2]

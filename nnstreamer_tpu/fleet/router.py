@@ -91,6 +91,7 @@ class _WorkerLink:
     def __init__(self, worker: WorkerInfo, connect_timeout: float,
                  request_timeout: float):
         self.worker = worker
+        self.generation = getattr(worker, "generation", 0)
         self.connect_timeout = connect_timeout
         self.request_timeout = request_timeout
         self._idle: List[socket.socket] = []
@@ -444,8 +445,13 @@ class Router:
     def _link(self, w: WorkerInfo) -> _WorkerLink:
         with self._links_lock:
             link = self._links.get(w.id)
-            if link is None or link.worker is not w:
-                # new or revived worker object: fresh pool
+            if link is None or link.worker is not w or \
+                    link.generation != getattr(w, "generation", 0):
+                # new, revived, or REBOUND worker (a supervisor
+                # respawned it, possibly on different ports): fresh pool
+                # — pooled sockets to the dead incarnation are garbage
+                if link is not None:
+                    link.close_all()
                 link = _WorkerLink(w, self.connect_timeout,
                                    self.request_timeout)
                 self._links[w.id] = link

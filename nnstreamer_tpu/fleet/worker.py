@@ -99,12 +99,20 @@ class FleetWorker:
         self._draining = False
         self._lock = threading.Lock()
         self.restarts = 0
+        # incarnation nonce: regenerated per start(), surfaced on
+        # /healthz ("nonce") and the (status, nonce) probe — membership
+        # keys breaker/suspect state by it, so a respawned worker never
+        # inherits its dead predecessor's failure streak
+        self.incarnation = ""
 
     # -- lifecycle -----------------------------------------------------------
 
     def start(self) -> "FleetWorker":
         self._killed = False
         self._draining = False
+        import uuid
+
+        self.incarnation = uuid.uuid4().hex[:12]
         self.query_server = QueryServer(**self._q_kwargs).start()
         self._q_kwargs["port"] = self.query_server.port  # pin for restart
         if self._engine_cfg is not None:
@@ -125,8 +133,12 @@ class FleetWorker:
                 register_health,
                 register_stats,
                 register_warming,
+                set_health_nonce,
             )
 
+            # subprocess mode (one worker per process): stamp this
+            # incarnation into /healthz so membership keys state by it
+            set_health_nonce(self.incarnation)
             self.metrics_server = MetricsServer(
                 port=int(self._health_port)).start()
             self._health_port = self.metrics_server.port
@@ -211,6 +223,13 @@ class FleetWorker:
         if self.degraded_reason:
             return f"degraded:{self.degraded_reason}"
         return "ok"
+
+    def probe_inc(self, _info=None):
+        """The incarnation-aware probe: ``(status, nonce)``.  Supervised
+        fleets register THIS with membership so a respawned worker's
+        fresh nonce resets the dead incarnation's breaker/suspect
+        state."""
+        return self.probe(_info), self.incarnation
 
     # -- shutdown paths ------------------------------------------------------
 

@@ -130,6 +130,27 @@ def warming_snapshot() -> Dict[str, str]:
     return out
 
 
+_nonce_lock = threading.Lock()
+_health_nonce: str = ""
+
+
+def set_health_nonce(value: str) -> None:
+    """Stamp this process's *incarnation nonce* into the ``/healthz``
+    document (``"nonce"`` key).  Fleet membership keys per-worker state
+    (breaker, suspect streak) by (address, nonce): a worker process
+    restarted — possibly at a different address — presents a fresh nonce
+    and must not inherit the dead incarnation's failure state.  One
+    value per process (subprocess fleet workers set it at start)."""
+    global _health_nonce
+    with _nonce_lock:
+        _health_nonce = str(value)
+
+
+def health_nonce() -> str:
+    with _nonce_lock:
+        return _health_nonce
+
+
 _degraded_lock = threading.Lock()
 _degraded_providers: Dict[str, Callable[[], str]] = {}
 
@@ -200,6 +221,12 @@ def health_document() -> dict:
               else "warming" if warming
               else "degraded" if degraded else "ok")
     doc = {"status": status, "failures": failures, "degraded": degraded}
+    nonce = health_nonce()
+    if nonce:
+        # incarnation witness: membership resets per-worker state when
+        # this changes (a restarted process is a NEW worker, whatever
+        # address it came back on)
+        doc["nonce"] = nonce
     if warming:
         # compile-ahead still running: membership suspends NEW dispatch
         # (not an outage — /healthz stays 200)

@@ -60,6 +60,14 @@ must stay allocation-light):
                    ``escalate``.  The first argument is the pipeline
                    NAME (string, may be empty for backend-level
                    actions), not the object.
+``scale_event``    ``(name, action, worker, detail)`` — the fleet
+                   autoscaler (:mod:`nnstreamer_tpu.fleet.autoscaler`)
+                   or its supervisor acted: ``action`` is ``spawn`` /
+                   ``join`` / ``spawn_fail`` / ``drain`` / ``respawn``
+                   / ``quarantine`` / ``release`` / ``flap_damped`` /
+                   ``storm``; ``worker`` names the target (may be empty
+                   for fleet-wide actions) and ``detail`` carries the
+                   WHY (threshold crossed, crash count, budget state).
 ``lane_promote``   ``(pipeline, task, reason)`` — the dispatcher-lane
                    runtime (:mod:`nnstreamer_tpu.graph.lanes`) shunted
                    a blocking task to its helper pool; ``task`` is the
@@ -115,6 +123,7 @@ HOOK_SIGNATURES: Dict[str, Tuple[str, ...]] = {
     "recovery": ("pipeline_name", "action", "target", "result"),
     "warmup": ("pipeline", "node_name", "label", "done", "total", "dur_ns"),
     "lane_promote": ("pipeline", "task", "reason"),
+    "scale_event": ("name", "action", "worker", "detail"),
 }
 
 HOOKS = tuple(HOOK_SIGNATURES)

@@ -1,0 +1,836 @@
+"""Elastic fleet: the SLO-driven autoscaler (ISSUE 15 acceptance).
+
+Controller semantics run against synthetic signal streams with a fake
+clock (hysteresis, cooldowns, storm budget, flap damping, forecast lead
+time); the supervisor's respawn/quarantine mechanics and the seeded
+diurnal e2e run against real in-process workers behind real routers —
+the CI autoscale smoke exercises the same machinery as subprocesses.
+"""
+
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu import faults
+from nnstreamer_tpu.elements.query import (
+    QueryError,
+    recv_tensors,
+    send_tensors,
+)
+from nnstreamer_tpu.fleet import (
+    DOWN,
+    UP,
+    Autoscaler,
+    FleetSignals,
+    InProcWorkerFactory,
+    Membership,
+    Router,
+    RouterSignals,
+    ScaleEventLog,
+    Supervisor,
+    Surface,
+)
+from nnstreamer_tpu.fleet.supervisor import QUARANTINED, READY
+from nnstreamer_tpu.obs.export import health_document
+
+VEC = (4,)
+
+
+def _wait_for(fn, timeout=15.0, interval=0.01):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if fn():
+            return True
+        time.sleep(interval)
+    return fn()
+
+
+class StubSupervisor:
+    """Pure-mechanics stub: counts workers, never touches sockets."""
+
+    def __init__(self, clock, n=1):
+        self.n = n
+        self.seq = 0
+        self.events = ScaleEventLog("stub", clock=clock)
+        self.surfaces = []
+        self.spawn_log = []
+        self.drain_log = []
+
+    def tick(self):
+        pass
+
+    def worker_count(self, include_joining=True):
+        return self.n
+
+    def ready_count(self):
+        return self.n
+
+    def quarantined_count(self):
+        return 0
+
+    def draining_count(self):
+        return 0
+
+    def spawn_worker(self, wid=None, detail=""):
+        self.seq += 1
+        self.n += 1
+        wid = wid or f"stub-w{self.seq}"
+        self.spawn_log.append(wid)
+        self.events.emit("spawn", wid, detail, fleet=self.n)
+        return wid
+
+    def pick_victim(self):
+        return f"stub-w{self.seq}" if self.n else None
+
+    def drain_worker(self, wid, detail="", blocking=False):
+        self.n -= 1
+        self.drain_log.append(wid)
+        self.events.emit("drain", wid, detail, fleet=self.n)
+        return True
+
+    def stats(self):
+        return {"spawns": len(self.spawn_log),
+                "joined": len(self.spawn_log), "failed": 0,
+                "quarantined": 0, "pending": 0, "ledger_exact": True,
+                "workers": {}}
+
+
+@pytest.fixture
+def clocked():
+    """(advance, autoscaler-factory) pair sharing one fake clock."""
+    t = [1000.0]
+
+    def advance(dt):
+        t[0] += dt
+
+    made = []
+
+    def make(sup=None, sig=None, **over):
+        sup = sup if sup is not None else StubSupervisor(lambda: t[0])
+        holder = {"sig": sig or FleetSignals()}
+        kw = dict(name=f"as-{len(made)}-{time.monotonic_ns()}",
+                  clock=lambda: t[0], sweep=False,
+                  min_workers=1, max_workers=4, worker_rps=0.0,
+                  forecast=False, up_cooldown_s=1.0, down_cooldown_s=2.0,
+                  queue_wait_hi_ms=50.0, queue_wait_lo_ms=5.0,
+                  busy_hi=0.85, busy_lo=0.2, shed_hi=0.01,
+                  flap_window_s=30.0, flap_limit=3,
+                  storm_budget=3, storm_window_s=10.0)
+        kw.update(over)
+        a = Autoscaler(sup, lambda: holder["sig"], **kw)
+        a._sig_holder = holder  # tests mutate the stream
+        made.append(a)
+        return a, sup, holder
+
+    yield advance, make
+    for a in made:
+        a.stop()
+    faults.deactivate()
+
+
+# -- controller semantics on synthetic signal streams ------------------------
+
+
+class TestController:
+    def test_hysteresis_dead_band_absorbs_noise(self, clocked):
+        """A queue-wait signal bouncing anywhere inside the (lo, hi)
+        dead band — noisy, but never over a threshold — must produce
+        ZERO scale actions, however long it bounces."""
+        advance, make = clocked
+        a, sup, holder = make()
+        for i in range(40):
+            # bounce across the whole dead band, 6ms..49ms
+            holder["sig"] = FleetSignals(
+                queue_wait_p99_ms=6.0 + (i * 7) % 43, busy=0.5,
+                offered_rps=10.0)
+            advance(0.5)
+            a.tick()
+        assert sup.spawn_log == [] and sup.drain_log == []
+        assert a.events.snapshot() == []
+
+    def test_scale_up_above_band_and_up_cooldown(self, clocked):
+        """A burning signal scales up — but a second action must wait
+        out the per-direction cooldown however loud the signal stays."""
+        advance, make = clocked
+        a, sup, holder = make(up_cooldown_s=5.0, max_workers=5)
+        holder["sig"] = FleetSignals(queue_wait_p99_ms=200.0)
+        advance(0.1)
+        a.tick()
+        assert sup.n == 2           # one step up
+        for _ in range(8):          # 4s of shouting: still cooling down
+            advance(0.5)
+            a.tick()
+        assert sup.n == 2           # the cooldown held every one of them
+        for _ in range(4):          # ...until it expires (once)
+            advance(0.5)
+            a.tick()
+        assert sup.n == 3           # exactly ONE more action in 6s
+
+    def test_scale_down_requires_all_signals_idle_and_cooldown(self, clocked):
+        advance, make = clocked
+        a, sup, holder = make(down_cooldown_s=4.0)
+        sup.n = 3
+        # queue idle but busy still high: NOT a scale-down
+        holder["sig"] = FleetSignals(queue_wait_p99_ms=1.0, busy=0.5)
+        advance(1.0)
+        a.tick()
+        assert sup.n == 3
+        holder["sig"] = FleetSignals(queue_wait_p99_ms=1.0, busy=0.05)
+        advance(1.0)
+        a.tick()
+        assert sup.n == 2
+        advance(1.0)                # cooling
+        a.tick()
+        assert sup.n == 2
+        advance(4.1)
+        a.tick()
+        assert sup.n == 1
+        advance(10.0)               # at min_workers: never below
+        a.tick()
+        assert sup.n == 1
+
+    def test_storm_budget_escalates_typed_degraded(self, clocked):
+        """Past the spawn budget the controller must STOP forking and
+        escalate: a `storm` event plus a typed degraded /healthz reason
+        — and recover once the window frees budget."""
+        advance, make = clocked
+        a, sup, holder = make(up_cooldown_s=0.0, max_workers=10,
+                              storm_budget=3, storm_window_s=10.0)
+        holder["sig"] = FleetSignals(queue_wait_p99_ms=500.0)
+        for _ in range(6):
+            advance(0.2)
+            a.tick()
+        assert len(sup.spawn_log) == 3          # budget-capped
+        assert a.events.count("storm") == 1     # escalated once, typed
+        doc = health_document()
+        assert doc["status"] == "degraded"
+        reason = doc["degraded"][f"autoscale:{a.name}"]
+        assert "scale-storm budget exhausted" in reason
+        assert a.stats()["storm_reason"]
+        # the window drains: budget returns, degradation clears
+        advance(11.0)
+        a.tick()
+        assert len(sup.spawn_log) == 4
+        assert health_document()["status"] == "ok"
+        assert a.stats()["storm_reason"] == ""
+
+    def test_flap_damping_freezes_oscillation(self, clocked):
+        """A signal stream alternating up/down pressure: after
+        flap_limit direction reversals in the window the controller
+        holds the fleet steady (one flap_damped event with the WHY)."""
+        advance, make = clocked
+        a, sup, holder = make(up_cooldown_s=0.0, down_cooldown_s=0.0,
+                              flap_limit=3, flap_window_s=60.0,
+                              storm_budget=50)
+        hot = FleetSignals(queue_wait_p99_ms=500.0)
+        cold = FleetSignals(queue_wait_p99_ms=0.5)
+        sizes = []
+        for i in range(16):
+            holder["sig"] = hot if i % 2 == 0 else cold
+            advance(0.5)
+            a.tick()
+            sizes.append(sup.n)
+        # damping engaged: the tail of the run is FLAT
+        assert a.events.count("flap_damped") >= 1
+        damp = next(e for e in a.events.snapshot()
+                    if e["action"] == "flap_damped")
+        assert "direction reversals" in damp["detail"]
+        assert len(set(sizes[-6:])) == 1, sizes
+        # and the total action count is bounded by the flap limit, not
+        # by the number of oscillating ticks
+        actions = [e for e in a.events.snapshot()
+                   if e["action"] in ("spawn", "drain")]
+        assert len(actions) <= 2 * a.flap_limit + 2
+
+    def test_forecast_spawns_before_the_slo_burns(self, clocked):
+        """The predictive leg: a ramping offered-load history triggers
+        the scale-up while queue-wait is still far below the reactive
+        band — the lead time that keeps a diurnal ramp from ever
+        burning the SLO."""
+        advance, make = clocked
+        a, sup, holder = make(forecast=True, forecast_horizon_s=5.0,
+                              history_window_s=60.0, worker_rps=10.0,
+                              up_cooldown_s=0.0, max_workers=4)
+        # offered ramps 2 -> 20 rps; queue wait never leaves ~0
+        for i in range(10):
+            holder["sig"] = FleetSignals(
+                queue_wait_p99_ms=0.5, offered_rps=2.0 + 2.0 * i)
+            advance(1.0)
+            a.tick()
+        assert sup.n >= 2, a.stats()
+        first = next(e for e in a.events.snapshot()
+                     if e["action"] == "spawn")
+        assert "forecast" in first["detail"]
+        # the reactive band never fired: every tick's queue wait was low
+        assert all("queue_wait" not in e["detail"]
+                   for e in a.events.snapshot())
+        assert a.stats()["forecast_rps"] > 20.0  # ahead of the ramp
+
+    def test_scale_flap_chaos_damped_and_replayable(self, clocked):
+        """The seeded scale_flap kind: injected desired-count bias every
+        tick must be absorbed by the damper (fleet bounded, then flat),
+        and the injection log replays byte-identically."""
+        advance, make = clocked
+        spec = "seed=9;scale_flap@plan:every=2"
+        eng = faults.install(spec)
+        a, sup, holder = make(up_cooldown_s=0.0, down_cooldown_s=0.0,
+                              flap_limit=2, flap_window_s=120.0,
+                              storm_budget=50, min_workers=1, max_workers=4)
+        holder["sig"] = FleetSignals(queue_wait_p99_ms=10.0)  # dead band
+        sizes = []
+        for _ in range(20):
+            advance(0.5)
+            a.tick()
+            sizes.append(sup.n)
+        assert all(1 <= n <= 4 for n in sizes), sizes
+        assert a.events.count("flap_damped") >= 1
+        assert len(set(sizes[-8:])) == 1, sizes  # held steady
+        # byte-identical replay over the same consult order
+        replay = faults.ChaosEngine(spec)
+        for _ in range(a.ticks):
+            replay.decide("autoscale", f"{a.name}:plan",
+                          kinds=("scale_flap",))
+        assert replay.log == eng.log
+        assert replay.injections == eng.injections
+
+
+# -- supervisor mechanics over real in-process workers -----------------------
+
+
+class _LiveFleet:
+    """Real workers behind a real router, supervised + autoscaled."""
+
+    def __init__(self, **asc_over):
+        self.membership = Membership(heartbeat_s=30.0)
+        self.router = Router(self.membership, port=0,
+                             name=f"asl-{time.monotonic_ns()}",
+                             route_retries=4, retry_backoff_ms=1,
+                             retry_backoff_cap_ms=5).start()
+        self.factory = InProcWorkerFactory(model=lambda x: x * 2.0)
+        self.supervisor = Supervisor(
+            self.factory, [Surface(self.membership, self.router)],
+            name=self.router.name, respawn_backoff_ms=1,
+            respawn_backoff_cap_ms=50, crash_limit=3, crash_window_s=10.0,
+            quarantine_s=0.3, spawn_timeout_s=10.0, drain_deadline_s=5.0)
+        kw = dict(name=self.router.name, sweep=True, min_workers=1,
+                  max_workers=3, forecast=False, worker_rps=0.0,
+                  up_cooldown_s=0.0, down_cooldown_s=0.0)
+        kw.update(asc_over)
+        self.autoscaler = Autoscaler(
+            self.supervisor, RouterSignals(self.router, self.membership),
+            **kw)
+
+    def request(self, v):
+        s = socket.create_connection(("127.0.0.1", self.router.port),
+                                     timeout=10)
+        s.settimeout(10)
+        try:
+            send_tensors(s, (np.full(VEC, v, np.float32),), 0)
+            outs, _ = recv_tensors(s)
+            return float(np.asarray(outs[0])[0])
+        finally:
+            s.close()
+
+    def settle(self, ticks=3, sleep=0.01):
+        for _ in range(ticks):
+            self.autoscaler.tick()
+            time.sleep(sleep)
+
+    def close(self):
+        self.autoscaler.stop()
+        self.supervisor.stop()
+        self.router.stop()
+        self.membership.stop()
+
+
+@pytest.fixture
+def live():
+    fleets = []
+
+    def make(**over):
+        f = _LiveFleet(**over)
+        fleets.append(f)
+        return f
+
+    yield make
+    for f in fleets:
+        f.close()
+    faults.deactivate()
+
+
+class TestSupervisor:
+    def test_kill_respawns_same_wid_new_incarnation(self, live):
+        f = make_and_floor(live)
+        wid = f.supervisor.managed()[0].wid
+        old_port = f.membership.get(wid).port
+        old_gen = f.membership.get(wid).generation
+        f.supervisor.get(wid).handle.kill()
+        assert _wait_for(lambda: (f.settle(2) or
+                                  f.supervisor.get(wid).state == READY), 10)
+        m = f.supervisor.get(wid)
+        assert m.restarts == 1
+        assert len(f.supervisor.managed()) == 1  # no duplicate worker
+        info = f.membership.get(wid)
+        # rebind: fresh generation (the router discards pooled sockets
+        # to the dead incarnation), state back in rotation
+        assert info.generation == old_gen + 1
+        assert info.state == UP
+        assert info.port != 0 and isinstance(old_port, int)
+        assert f.request(3.0) == 6.0
+        assert f.supervisor.stats()["ledger_exact"]
+
+    def test_crash_loop_quarantined_with_why_then_released(self, live):
+        f = make_and_floor(live)
+        wid = f.supervisor.managed()[0].wid
+        for _ in range(3):
+            f.supervisor.get(wid).handle.kill()
+            assert _wait_for(
+                lambda: (f.settle(2) or
+                         f.supervisor.get(wid).state in (READY,
+                                                         QUARANTINED)), 10)
+        m = f.supervisor.get(wid)
+        assert m.state == QUARANTINED
+        # the WHY is recorded where operators look
+        snap = f.supervisor.stats()["workers"][wid]
+        assert "crash loop" in snap["quarantine_reason"]
+        assert snap["quarantined_for_s"] > 0
+        assert f.autoscaler.events.count("quarantine") == 1
+        st = f.supervisor.stats()
+        assert st["quarantined"] == 1 and st["ledger_exact"]
+        # membership holds it DOWN while quarantined
+        assert f.membership.get(wid).state == DOWN
+        # release after the hold-down: respawns and serves again
+        time.sleep(0.35)
+        assert _wait_for(lambda: (f.settle(2) or
+                                  f.supervisor.get(wid).state == READY), 10)
+        assert f.autoscaler.events.count("release") == 1
+        assert f.request(4.0) == 8.0
+        assert f.supervisor.stats()["ledger_exact"]
+
+    def test_spawn_fail_injected_degrades_not_wedges(self, live):
+        """A seeded spawn_fail: the attempt resolves `failed`, the
+        control loop keeps ticking, the NEXT attempt succeeds, and the
+        ledger stays exact."""
+        faults.install("seed=3;spawn_fail@spawn:after=1")  # 2nd attempt
+        f = make_and_floor(live)
+        wid2 = f.supervisor.spawn_worker(detail="scale-up")  # attempt #2
+        assert wid2 is None  # injected failure surfaced as a degrade
+        assert f.autoscaler.events.count("spawn_fail") == 1
+        st = f.supervisor.stats()
+        assert st["failed"] == 1 and st["ledger_exact"]
+        # the loop is not wedged: the next attempt joins fine (driven
+        # through the supervisor alone — the controller, left to tick,
+        # would rightly drain the surplus back to min_workers)
+        wid3 = f.supervisor.spawn_worker(detail="retry")
+        assert wid3 is not None
+        for _ in range(2):
+            f.membership.sweep()
+            f.supervisor.tick()
+        assert f.supervisor.get(wid3).state == READY
+        assert f.request(5.0) == 10.0
+        assert f.supervisor.stats()["ledger_exact"]
+
+    def test_join_timeout_resolves_failed(self, live):
+        """A spawn whose probe never turns routable (stuck warming)
+        times out, counts failed, and is torn down — not a zombie."""
+        f = make_and_floor(live)
+        f.supervisor.spawn_timeout_s = 0.1
+
+        class StuckFactory:
+            def spawn(self, wid):
+                w = InProcWorkerFactory(
+                    model=lambda x: x).spawn(wid)
+                w.worker._warming = True  # never reports routable
+                return w
+
+        f.supervisor.factory = StuckFactory()
+        wid = f.supervisor.spawn_worker(detail="doomed")
+        assert wid is not None
+        time.sleep(0.15)
+        f.settle(2)
+        st = f.supervisor.stats()
+        assert st["failed"] == 1 and st["ledger_exact"], st
+        assert any(e["action"] == "spawn_fail"
+                   and "join timeout" in e["detail"]
+                   for e in f.autoscaler.events.snapshot())
+
+    def test_worker_kill_chaos_mid_scale_up_respawned_replayable(self, live):
+        """The seeded fleet-scope worker_kill fired MID-scale-up: the
+        supervisor respawns the corpse, the transition still converges,
+        and the injection schedule replays byte-identically."""
+        from nnstreamer_tpu.fleet.chaos import FleetChaos, InProcHandle
+
+        spec = "seed=7;worker_kill:after=2"  # fires at the 3rd consult
+        eng = faults.install(spec)
+        f = make_and_floor(live)
+        f.supervisor.spawn_worker(detail="scale-up")  # transition open
+        handles = {
+            m.wid: InProcHandle(m.handle.worker,
+                                f.membership.get(m.wid))
+            for m in f.supervisor.managed()}
+        chaos = FleetChaos(handles)
+        for _ in range(2):  # 2 consults per tick: injects on tick 2
+            chaos.tick()
+        killed = [w for w, kind in chaos.applied
+                  if kind == "worker_kill"]
+        assert len(killed) == 1
+        # the supervisor heals the kill (supervisor-only ticks: the
+        # controller would also be entitled to shrink back to min)
+        def healed():
+            f.membership.sweep()
+            f.supervisor.tick()
+            return f.supervisor.ready_count() == 2
+        assert _wait_for(healed, 15)
+        assert f.supervisor.get(killed[0]).restarts == 1
+        assert f.request(3.0) == 6.0
+        assert f.supervisor.stats()["ledger_exact"]
+        # byte-identical replay over the recorded consult order
+        replay = faults.ChaosEngine(spec)
+        for name in chaos.consults:
+            replay.decide("fleet", name)
+        assert replay.log == eng.log
+        assert replay.injections == eng.injections
+
+    def test_scale_down_drains_newest_first(self, live):
+        f = make_and_floor(live)
+        w2 = f.supervisor.spawn_worker()
+        w3 = f.supervisor.spawn_worker()
+        for _ in range(2):  # supervisor-only: hold the fleet at 3
+            f.membership.sweep()
+            f.supervisor.tick()
+        assert f.supervisor.ready_count() == 3
+        assert f.supervisor.pick_victim() == w3
+        assert f.supervisor.drain_worker(w3, blocking=True)
+        assert f.supervisor.ready_count() == 2
+        assert f.membership.get(w3).state == DOWN
+        # traffic still flows over the survivors
+        assert f.request(2.0) == 4.0
+        assert w2 in [m.wid for m in f.supervisor.managed()
+                      if m.state == READY]
+
+
+def make_and_floor(live, **over):
+    f = live(**over)
+    f.supervisor.spawn_worker(detail="floor")
+    f.settle(2)
+    assert f.supervisor.ready_count() == 1
+    return f
+
+
+# -- membership incarnation keying (satellite regression) --------------------
+
+
+class TestIncarnation:
+    def test_respawn_at_new_address_sheds_stale_breaker_state(self):
+        """The stale-state revival path: a worker ejected by
+        death_misses whose breaker tripped open, respawned at a
+        DIFFERENT address — the new incarnation must come back with a
+        fresh breaker and zero suspect state."""
+        from nnstreamer_tpu.fleet import FleetWorker
+
+        m = Membership(heartbeat_s=30.0, suspect_misses=2, death_misses=3,
+                       breaker_failures=2, breaker_reset_s=60.0)
+        w1 = FleetWorker(name="inc0", model=lambda x: x).start()
+        info = m.add("127.0.0.1", w1.query_port, probe=w1.probe_inc,
+                     worker_id="inc0")
+        m.sweep()
+        assert info.state == UP and info.incarnation == w1.incarnation
+        # data path flaps: breaker trips open (reset_s=60 keeps it open)
+        info.breaker.record_failure()
+        info.breaker.record_failure()
+        assert info.breaker.stats()["state"] == "open"
+        # heartbeat dies -> ejected
+        info.block_health = True
+        for _ in range(3):
+            m.sweep()
+        assert info.state == DOWN and info.misses == 3
+        w1.kill()
+        # respawn at a DIFFERENT address (fresh ephemeral port)
+        w2 = FleetWorker(name="inc0", model=lambda x: x).start()
+        assert w2.query_port != w1.query_port or True  # ephemeral
+        assert w2.incarnation != w1.incarnation
+        m.rebind("inc0", "127.0.0.1", w2.query_port, probe=w2.probe_inc)
+        info2 = m.get("inc0")
+        assert info2 is info  # same roster entry, new incarnation
+        assert info.generation == 1
+        # nothing of the dead incarnation survived
+        assert info.breaker.stats()["state"] == "closed"
+        assert info.misses == 0 and not info.draining
+        m.sweep()
+        assert info.state == UP
+        assert info.incarnation == w2.incarnation
+        assert info.revivals == 1
+        # and it is pickable immediately
+        assert m.pick().id == "inc0"
+        w2.stop()
+
+    def test_nonce_change_resets_breaker_even_without_down(self):
+        """A fast respawn that never got marked DOWN (the probe raced
+        the restart): the nonce flip alone must reset the breaker."""
+        state = {"nonce": "aaa"}
+        m = Membership(heartbeat_s=30.0, breaker_failures=2,
+                       breaker_reset_s=60.0)
+        info = m.add("127.0.0.1", 1, worker_id="fast",
+                     probe=lambda _i: ("ok", state["nonce"]))
+        m.sweep()
+        assert info.incarnation == "aaa"
+        info.breaker.record_failure()
+        info.breaker.record_failure()
+        assert info.breaker.stats()["state"] == "open"
+        state["nonce"] = "bbb"  # the process restarted under us
+        m.sweep()
+        assert info.breaker.stats()["state"] == "closed"
+        assert info.incarnation == "bbb" and info.revivals == 1
+
+    def test_plain_string_probe_keeps_legacy_behavior(self):
+        m = Membership(heartbeat_s=30.0)
+        info = m.add("127.0.0.1", 1, worker_id="old",
+                     probe=lambda _i: "ok")
+        m.sweep()
+        assert info.state == UP and info.incarnation is None
+
+
+# -- observability surfaces ---------------------------------------------------
+
+
+class TestScaleObservability:
+    def test_scale_event_hook_and_metric_and_span_instant(self):
+        from nnstreamer_tpu.obs import hooks, spans
+        from nnstreamer_tpu.obs.metrics import REGISTRY
+
+        got = []
+        hooks.connect("scale_event", lambda *a: got.append(a))
+        spans.enable()
+        try:
+            log = ScaleEventLog("obs-test")
+            log.emit("spawn", "w9", "because", fleet=2)
+            assert got == [("obs-test", "spawn", "w9", "because")]
+            metric = REGISTRY.get("nnstpu_autoscale_events_total")
+            assert metric is not None
+            doc = spans.chrome_trace()
+            names = [e["name"] for e in doc["traceEvents"]]
+            assert "scale:spawn" in names
+        finally:
+            hooks.clear()
+            spans.disable()
+            spans.reset()
+
+    def test_check_slo_fleet_keys(self):
+        import os
+        import sys
+
+        sys.path.insert(0, os.path.join(os.path.dirname(__file__),
+                                        os.pardir, "tools"))
+        from loadgen import check_slo
+
+        report = {
+            "tenants": {}, "ledger": {"exact": True, "client":
+                                      {"transport": 0}},
+            "fleet": {"min": 1, "max": 3, "final": 1,
+                      "spawn_ledger_exact": True},
+        }
+        ok, checks = check_slo(report, {"max_fleet": 3, "min_fleet": 1})
+        assert ok, checks
+        by = {c["check"]: c for c in checks}
+        assert by["fleet_peak >= 3"]["value"] == 3
+        assert by["fleet_final <= 1"]["value"] == 1
+        assert by["spawn_ledger_exact"]["ok"]
+        # a fleet that never scaled up fails the peak key
+        report["fleet"]["max"] = 1
+        ok, checks = check_slo(report, {"max_fleet": 3})
+        assert not ok
+        # ...and one that didn't come back down fails the final key
+        report["fleet"].update(max=3, final=3)
+        ok, _ = check_slo(report, {"min_fleet": 1})
+        assert not ok
+
+
+# -- the seeded diurnal e2e (acceptance) -------------------------------------
+
+
+# capacity 4: the drained-down SINGLE worker must be able to host every
+# migrated session (3 live sessions ride the 3→1 down-slope)
+ENGINE_CFG = dict(capacity=4, t_max=16, d_in=4, n_out=4, d_model=16,
+                  n_heads=2, n_layers=1)
+
+
+class TestDiurnalE2E:
+    @pytest.fixture(autouse=True)
+    def _clean(self):
+        yield
+        faults.deactivate()
+
+    def test_diurnal_1_3_1_zero_loss_migrated_sessions_replayable(self):
+        """ISSUE 15 acceptance: a seeded diurnal cycle against a
+        supervised stateless+stateful fleet with spawn_fail injected —
+        the fleet scales 1→3→1, zero stateless requests lost, zero
+        decode sessions broken (migrate-first drain), exact router AND
+        spawn ledgers, byte-identical chaos replay."""
+        from nnstreamer_tpu.fleet.repo import TensorRepoServer
+
+        spec = "seed=5;spawn_fail@spawn:after=2"  # 3rd attempt fails
+        eng = faults.install(spec)
+        repo = TensorRepoServer(port=0).start()
+        qm = Membership(heartbeat_s=30.0)
+        qr = Router(qm, port=0, name="e2e-q", route_retries=4,
+                    retry_backoff_ms=1, retry_backoff_cap_ms=5).start()
+        dm = Membership(heartbeat_s=30.0)
+        dr = Router(dm, port=0, stateful=True, name="e2e-d",
+                    route_retries=2, retry_backoff_ms=1,
+                    repo_addr=f"127.0.0.1:{repo.port}",
+                    migrate_check_s=0.05).start()
+        factory = InProcWorkerFactory(model=lambda x: x * 2.0,
+                                      engine=dict(ENGINE_CFG))
+        sup = Supervisor(
+            factory,
+            [Surface(qm, qr, port_key="port", name="query"),
+             Surface(dm, dr, port_key="decode_port", name="decode")],
+            name="e2e", respawn_backoff_ms=1, crash_limit=5,
+            crash_window_s=10.0, quarantine_s=1.0, spawn_timeout_s=30.0,
+            drain_deadline_s=5.0)
+        asc = Autoscaler(
+            sup, RouterSignals(qr, qm), name="e2e", sweep=True,
+            min_workers=1, max_workers=3, worker_rps=60.0,
+            forecast=False, up_cooldown_s=0.0, down_cooldown_s=0.2,
+            queue_wait_lo_ms=5.0, storm_budget=10, storm_window_s=60.0)
+        stateless = {"offered": 0, "delivered": 0, "errors": []}
+        lock = threading.Lock()
+        stop = threading.Event()
+        day_stop = threading.Event()
+
+        def q_client(gap_s, until):
+            i = 0
+            while not until.is_set():
+                i += 1
+                with lock:
+                    stateless["offered"] += 1
+                try:
+                    s = socket.create_connection(
+                        ("127.0.0.1", qr.port), timeout=15)
+                    s.settimeout(15)
+                    send_tensors(s, (np.full(VEC, float(i), np.float32),),
+                                 0)
+                    outs, _ = recv_tensors(s)
+                    assert float(np.asarray(outs[0])[0]) == 2.0 * i
+                    with lock:
+                        stateless["delivered"] += 1
+                    s.close()
+                except Exception as exc:  # noqa: BLE001
+                    with lock:
+                        stateless["errors"].append(repr(exc))
+                time.sleep(gap_s)
+
+        try:
+            # ---- night: the floor worker handles the trickle
+            sup.spawn_worker(detail="floor")
+            t0 = time.monotonic()
+            while sup.ready_count() < 1 and time.monotonic() - t0 < 60:
+                asc.tick()
+                time.sleep(0.05)
+            assert sup.ready_count() == 1
+            # the steady trickle (~25 rps total << 60 rps/worker)
+            clients = [threading.Thread(target=q_client,
+                                        args=(0.15, stop))
+                       for _ in range(4)]
+            for c in clients:
+                c.start()
+            for _ in range(6):
+                asc.tick()
+                time.sleep(0.1)
+            assert sup.worker_count() == 1  # night load fits one worker
+            # ---- day: the offered load explodes; the fleet follows
+            day_clients = [threading.Thread(target=q_client,
+                                            args=(0.004, day_stop))
+                           for _ in range(8)]
+            for c in day_clients:
+                c.start()
+            t0 = time.monotonic()
+            while sup.ready_count() < 3 and time.monotonic() - t0 < 60:
+                asc.tick()
+                time.sleep(0.1)
+            assert sup.ready_count() == 3, asc.stats()
+            # the injected spawn_fail was felt and degraded, not wedged
+            assert asc.events.count("spawn_fail") == 1
+            # ---- open decode sessions across the scaled-up fleet
+            sessions = []
+            for i in range(3):
+                s = socket.create_connection(("127.0.0.1", dr.port),
+                                             timeout=15)
+                s.settimeout(15)
+                send_tensors(
+                    s, (np.full((5, 4), 0.1, np.float32),), 0)
+                recv_tensors(s)
+                sessions.append(s)
+            assert dr.session_count() == 3
+            # ---- dusk: the day burst ends; the fleet drains back to 1,
+            # migrating the sessions off the drained workers
+            day_stop.set()
+            for c in day_clients:
+                c.join(timeout=30)
+            t0 = time.monotonic()
+            while (sup.ready_count() > 1 or sup.worker_count() > 1) \
+                    and time.monotonic() - t0 < 90:
+                asc.tick()
+                time.sleep(0.1)
+            sup.join_drains(timeout=30)
+            assert sup.ready_count() == 1, asc.stats()
+            # every session still steps — zero [SESSION] breaks; the
+            # ones on drained workers rode a live migration
+            for s in sessions:
+                for _ in range(3):
+                    send_tensors(s, (np.zeros((4,), np.float32),), 0)
+                    outs, _ = recv_tensors(s)
+                    assert np.asarray(outs[0]).shape == (4,)
+            assert dr.sessions_broken == 0
+            assert dr.sessions_migrated >= 2, dr.stats()
+            stop.set()
+            for c in clients:
+                c.join(timeout=30)
+            for s in sessions:
+                s.close()
+            # ---- the ledgers: zero stateless loss, exact on both sides
+            assert stateless["errors"] == [], stateless["errors"][:3]
+            assert stateless["delivered"] == stateless["offered"]
+
+            def router_balanced():
+                st = qr.stats()
+                return (st["offered"] == st["delivered"]
+                        + st["shed_total"]
+                        and st["offered"] >= stateless["offered"])
+
+            assert _wait_for(router_balanced, 5), qr.stats()
+            assert qr.stats()["shed_total"] == 0
+            st = asc.stats()
+            assert st["ledger_exact"], st
+            assert st["spawns"] == st["joined"] + st["failed"] \
+                + st["quarantined"], st
+            assert st["failed"] == 1  # the injected spawn_fail
+            assert st["fleet_size_min"] == 1
+            assert st["fleet_size_max"] == 3
+            # session ledger on the stateful router stays exact too
+            assert dr.stats()["session_ledger_exact"]
+            # ---- byte-identical chaos replay: reconstruct the consult
+            # order from the event log (every spawn/spawn_fail event is
+            # exactly one consult of the autoscale point, in order)
+            consults = [e for e in asc.events.snapshot()
+                        if e["action"] in ("spawn", "spawn_fail")]
+            replay = faults.ChaosEngine(spec)
+            for e in consults:
+                replay.decide("autoscale",
+                              f"{sup.name}:spawn:{e['worker']}",
+                              kinds=("spawn_fail",))
+            assert replay.log == eng.log
+            assert replay.injections == eng.injections
+        finally:
+            stop.set()
+            day_stop.set()
+            asc.stop()
+            sup.stop()
+            for r in (qr, dr):
+                r.stop()
+            for m in (qm, dm):
+                m.stop()
+            repo.stop()

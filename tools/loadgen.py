@@ -504,7 +504,15 @@ def build_model(spec, args: Optional[dict] = None):
 class InProcFleet:
     """N FleetWorkers + Membership + Router(s) inside this process —
     deterministic (no subprocess scheduling jitter), one shared flight
-    recorder (a single local collector source covers every hop)."""
+    recorder (a single local collector source covers every hop).
+
+    ``cfg["autoscale"]`` (a dict of :class:`nnstreamer_tpu.fleet.
+    Autoscaler` kwargs, e.g. ``{"min_workers": 1, "max_workers": 3,
+    "worker_rps": 40}``) puts the fleet under the SLO-driven autoscaler:
+    the initial ``workers`` are adopted by a supervisor, scale-ups spawn
+    more in-process workers, scale-downs SIGTERM-drain them
+    (migrate-first on the decode surface), and the report grows
+    ``scale_events`` + the observed fleet-size range."""
 
     def __init__(self, cfg: dict, prefix: str = "lg"):
         from nnstreamer_tpu.fleet import FleetWorker, Membership, Router
@@ -533,6 +541,7 @@ class InProcFleet:
         self.membership = Membership(heartbeat_s=30.0)
         self.decode_membership = None
         decode_cfg = cfg.get("decode")
+        autoscaled = bool(cfg.get("autoscale"))
         for i in range(int(cfg.get("workers", 2))):
             name = f"{prefix}-w{i}"
             wsched = make_sched(cfg.get("worker_sched"), name)
@@ -543,8 +552,11 @@ class InProcFleet:
                 engine=dict(decode_cfg) if decode_cfg else None,
                 decode_port=0 if decode_cfg else None, **wcfg).start()
             self.workers.append(w)
-            self.membership.add("127.0.0.1", w.query_port, probe=w.probe,
-                                worker_id=name)
+            if not autoscaled:
+                # supervised fleets register through Supervisor.adopt
+                # below (one id across every surface membership)
+                self.membership.add("127.0.0.1", w.query_port,
+                                    probe=w.probe, worker_id=name)
         self.membership.sweep()
         self.membership.start()
         rsched = make_sched(cfg.get("router_sched"), f"{prefix}-router")
@@ -555,15 +567,58 @@ class InProcFleet:
         self.decode_router = None
         if decode_cfg:
             self.decode_membership = Membership(heartbeat_s=30.0)
-            for w in self.workers:
-                self.decode_membership.add(
-                    "127.0.0.1", w.decode_port, probe=w.probe,
-                    worker_id=f"{w.name}:decode")
+            if not autoscaled:
+                for w in self.workers:
+                    self.decode_membership.add(
+                        "127.0.0.1", w.decode_port, probe=w.probe,
+                        worker_id=f"{w.name}:decode")
             self.decode_membership.sweep()
             self.decode_membership.start()
             self.decode_router = Router(
                 self.decode_membership, port=0, stateful=True,
                 name=f"{prefix}-drouter").start()
+        self.supervisor = None
+        self.autoscaler = None
+        self.t0_mono = time.monotonic()
+        asc_cfg = cfg.get("autoscale")
+        if asc_cfg:
+            from nnstreamer_tpu.fleet import (
+                Autoscaler,
+                InProcWorkerFactory,
+                RouterSignals,
+                Supervisor,
+                Surface,
+            )
+            from nnstreamer_tpu.fleet.supervisor import InProcWorkerHandle
+
+            factory = InProcWorkerFactory(
+                model=model, engine=dict(decode_cfg) if decode_cfg else None,
+                **wcfg)
+            surfaces = [Surface(self.membership, self.router,
+                                port_key="port", name="query")]
+            if self.decode_router is not None:
+                surfaces.append(Surface(
+                    self.decode_membership, self.decode_router,
+                    port_key="decode_port", name="decode"))
+            self.supervisor = Supervisor(
+                factory, surfaces, name=f"{prefix}-scale",
+                **{k: v for k, v in dict(asc_cfg).items()
+                   if k in ("crash_limit", "crash_window_s", "quarantine_s",
+                            "respawn_backoff_ms", "respawn_backoff_cap_ms",
+                            "spawn_timeout_s", "drain_deadline_s")})
+            # the initial workers join the supervised roster: adopt
+            # registers each one with EVERY surface membership under one
+            # id, so a scale-down drain finds all its surfaces
+            for w in self.workers:
+                self.supervisor.adopt(w.name, InProcWorkerHandle(w))
+            self.autoscaler = Autoscaler(
+                self.supervisor, RouterSignals(self.router, self.membership),
+                name=f"{prefix}-scale",
+                **{k: v for k, v in dict(asc_cfg).items()
+                   if k not in ("crash_limit", "crash_window_s",
+                                "quarantine_s", "respawn_backoff_ms",
+                                "respawn_backoff_cap_ms", "spawn_timeout_s",
+                                "drain_deadline_s")}).start()
 
     @property
     def query_addr(self) -> Tuple[str, int]:
@@ -580,9 +635,16 @@ class InProcFleet:
                "workers": {w.name: w.stats() for w in self.workers}}
         if self.decode_router is not None:
             out["decode_router"] = self.decode_router.stats()
+        if self.autoscaler is not None:
+            out["autoscaler"] = self.autoscaler.stats()
+            out["autoscaler"]["t0_mono"] = self.t0_mono
         return out
 
     def close(self) -> None:
+        if self.autoscaler is not None:
+            self.autoscaler.stop()
+        if self.supervisor is not None:
+            self.supervisor.stop()
         for router in (self.router, self.decode_router):
             if router is not None:
                 router.stop()
@@ -737,6 +799,32 @@ def build_report(records: List[dict], duration_s: float, t0_ns: int,
         decode_sessions["migration_aborts"] = drt.get(
             "migration_aborts", {})
 
+    # elastic-fleet accounting: the autoscaler's scale events (spawn /
+    # drain / quarantine ... with run-relative timestamps) and the
+    # observed fleet-size range, so p99-vs-fleet-size reads off one
+    # report — the same instants land on the --perfetto timeline as
+    # scale:<action> markers when spans were on
+    scale_events: List[dict] = []
+    fleet_range: dict = {}
+    asc = (server_stats or {}).get("autoscaler")
+    if asc:
+        t0_mono = asc.get("t0_mono")
+        for e in asc.get("events", []):
+            rec = {"action": e["action"], "worker": e["worker"],
+                   "detail": e["detail"]}
+            if t0_mono is not None:
+                rec["t_s"] = round(e["t"] - t0_mono, 6)
+            if "fleet" in e:
+                rec["fleet"] = e["fleet"]
+            scale_events.append(rec)
+        fleet_range = {
+            "min": asc.get("fleet_size_min"),
+            "max": asc.get("fleet_size_max"),
+            "final": asc.get("workers"),
+            "quarantined": asc.get("supervisor", {}).get("quarantined"),
+            "spawn_ledger_exact": asc.get("ledger_exact"),
+        }
+
     # per-trace attribution: join client records with collected server
     # spans by NNSQ trace id
     attribution: dict = {"joined": 0, "client_only": 0, "server_only": 0}
@@ -795,6 +883,8 @@ def build_report(records: List[dict], duration_s: float, t0_ns: int,
         "curves": curves,
         "ledger": ledger,
         "decode_sessions": decode_sessions,
+        "scale_events": scale_events,
+        "fleet": fleet_range,
         "attribution": attribution,
         "server": server_stats or {},
     }
@@ -815,7 +905,12 @@ def check_slo(report: dict, slo: dict) -> Tuple[bool, List[dict]]:
     - ``stateful_goodput_min``: completed/total decode sessions ≥ bound
       (migrated sessions count as completed — the drain gate sets 1.0);
     - ``max_broken_sessions``: sessions broken ``[SESSION]``/torn ≤
-      bound.
+      bound;
+    - ``max_fleet``: the autoscaled fleet actually scaled UP — its peak
+      observed size ≥ bound;
+    - ``min_fleet``: ...and back DOWN — its size at run end ≤ bound
+      (the diurnal elasticity gate asserts both, plus the exact spawn
+      ledger whenever either key is present).
     """
     checks: List[dict] = []
 
@@ -860,6 +955,20 @@ def check_slo(report: dict, slo: dict) -> Tuple[bool, List[dict]]:
         bound = int(slo["max_broken_sessions"])
         n = ds.get("broken", 0)
         add(f"broken_sessions <= {bound}", n <= bound, n, bound)
+    fleet = report.get("fleet") or {}
+    if "max_fleet" in slo:
+        bound = int(slo["max_fleet"])
+        peak = fleet.get("max") or 0
+        add(f"fleet_peak >= {bound}", peak >= bound, peak, bound)
+    if "min_fleet" in slo:
+        bound = int(slo["min_fleet"])
+        final = fleet.get("final")
+        add(f"fleet_final <= {bound}",
+            final is not None and final <= bound, final, bound)
+    if ("max_fleet" in slo or "min_fleet" in slo):
+        # elasticity implies the spawn ledger must balance exactly
+        add("spawn_ledger_exact", bool(fleet.get("spawn_ledger_exact")),
+            fleet.get("spawn_ledger_exact"), True)
     ok = all(c["ok"] for c in checks)
     return ok, checks
 
@@ -953,6 +1062,35 @@ SCENARIOS: Dict[str, dict] = {
     ),
     # the built-but-never-served pipelines (ROADMAP item 4): tiny
     # CPU-compilable builds of the real models behind the same fleet path
+    "diurnal-scale": dict(
+        description="elastic diurnal cycle under the SLO-driven "
+                    "autoscaler: the fleet scales up ahead of the peak "
+                    "(forecast leg) and SIGTERM-drains back down on the "
+                    "night slope — scale_events + fleet range in the "
+                    "report, min_fleet/max_fleet SLO keys gated",
+        duration_s=9.0,
+        fleet=dict(
+            workers=1,
+            worker=dict(framework="custom", batch=4, batch_window_ms=2.0,
+                        max_batch=32),
+            model_args={"sleep_ms": 0.5},
+            autoscale=dict(min_workers=1, max_workers=3, worker_rps=18.0,
+                           interval_s=0.25, up_cooldown_s=0.5,
+                           down_cooldown_s=1.0, forecast=True,
+                           forecast_horizon_s=1.5, history_window_s=3.0,
+                           queue_wait_lo_ms=30.0, storm_budget=6,
+                           storm_window_s=30.0),
+        ),
+        tenants=[
+            dict(name="daynight", workload="vision",
+                 profile=dict(kind="diurnal", rate=28.0, amp=0.9,
+                              periods=1)),
+        ],
+        slo=dict(ledger_exact=True,
+                 max_transport_errors=0,
+                 max_fleet=2,     # the peak really staffed up
+                 min_fleet=2),    # ...and the night slope drained back
+    ),
     "vit": dict(
         description="ViT classifier serving: single-shot 32x32 images "
                     "against a 2-worker jax fleet",
@@ -1088,6 +1226,16 @@ def _print_summary(report: dict) -> None:
               f"p99.9={lat.get('p999_ms', 0):8.2f}ms")
     led = report["ledger"]
     print(f"  ledger exact={led['exact']} client={led['client']}")
+    if report.get("fleet"):
+        fl = report["fleet"]
+        print(f"  fleet: {fl.get('min')} -> {fl.get('max')} -> "
+              f"{fl.get('final')} workers, "
+              f"spawn ledger exact={fl.get('spawn_ledger_exact')}")
+        for e in report.get("scale_events", []):
+            t = e.get("t_s")
+            print(f"    [{t:8.3f}s] {e['action']:<12} {e['worker']:<14} "
+                  f"{e['detail']}" if t is not None else
+                  f"    {e['action']:<12} {e['worker']:<14} {e['detail']}")
     attr = report.get("attribution", {})
     if attr.get("joined"):
         print(f"  attribution: {attr['joined']} traces joined, "

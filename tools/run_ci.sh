@@ -1232,6 +1232,199 @@ finally:
             pass
 PY
 
+run_step "Autoscale smoke (seeded spike: scale up, kill -9 + respawn, rolling drain with migrated session)" \
+  python - <<'PY'
+# ISSUE 15 acceptance, subprocess edition: one `fleet autoscale` process
+# (query router + stateful decode router + self-hosted repo + supervisor
+# + autoscaler) spawning worker subprocesses on ephemeral ports.  A
+# spike scales the fleet 1 -> 3 within the window; kill -9 of a
+# scaled-up worker mid-traffic is respawned by the supervisor
+# (warming-gated, fresh incarnation); the post-spike down-slope drains
+# back to 1 via rolling SIGTERM with the live decode sessions migrated
+# (zero [SESSION]); nnstpu_autoscale_events_total{action} and the exact
+# spawn + router ledgers are asserted.
+import jax
+jax.config.update('jax_platforms', 'cpu')
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+
+import numpy as np
+
+from nnstreamer_tpu.elements.query import recv_tensors, send_tensors
+
+DECODE = "capacity=4,t_max=8,d_in=4,n_out=4,d_model=16,n_heads=2,n_layers=1"
+
+proc = subprocess.Popen(
+    [sys.executable, "-m", "nnstreamer_tpu.fleet", "autoscale",
+     "--port", "0", "--health-port", "0", "--model", "x2",
+     "--min-workers", "1", "--max-workers", "3", "--worker-rps", "40",
+     "--warmup-spec", "float32:4", "--decode", DECODE,
+     "--platform", "cpu"],
+    stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True)
+try:
+    info = json.loads(proc.stdout.readline())
+    assert info["role"] == "autoscale" and info["repo_port"], info
+    health = info["health_port"]
+
+    def stats():
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{health}/stats.json", timeout=10) as r:
+            return json.load(r)
+
+    def asc():
+        return stats()["autoscale:autoscale"]
+
+    def wait_ready(n, timeout, cmp=lambda a, b: a >= b):
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            try:
+                if cmp(asc()["ready"], n):
+                    return True
+            except (KeyError, OSError):
+                pass
+            time.sleep(0.3)
+        return cmp(asc()["ready"], n)
+
+    assert wait_ready(1, 120), asc()   # the floor worker joined (warmed)
+
+    errors, delivered = [], [0]
+    stop = threading.Event()
+    spike = threading.Event()
+
+    def q_client(gap_s, gate):
+        i = 0
+        while not stop.is_set():
+            if gate is not None and not gate.is_set():
+                time.sleep(0.05)
+                continue
+            i += 1
+            try:
+                s = socket.create_connection(
+                    ("127.0.0.1", info["port"]), timeout=20)
+                s.settimeout(20)
+                send_tensors(s, (np.full(4, float(i), np.float32),), 0)
+                outs, _ = recv_tensors(s)
+                assert float(np.asarray(outs[0])[0]) == 2.0 * i
+                delivered[0] += 1
+                s.close()
+            except Exception as exc:  # noqa: BLE001
+                errors.append(repr(exc))
+            time.sleep(gap_s)
+
+    ths = [threading.Thread(target=q_client, args=(0.1, None))
+           for _ in range(2)]
+    ths += [threading.Thread(target=q_client, args=(0.004, spike))
+            for _ in range(8)]
+    for t in ths:
+        t.start()
+    time.sleep(2.0)
+    assert asc()["ready"] == 1, asc()  # trickle fits the floor
+
+    spike.set()                        # the seeded spike hits
+    assert wait_ready(3, 120), asc()   # scaled up within the window
+    print(f"scale-up OK: fleet at 3 within window "
+          f"(decision: {asc()['last_decision']})")
+
+    # live decode sessions across the scaled-up fleet (round-robin
+    # pins them on distinct workers, so the down-slope MUST migrate)
+    sessions = []
+    for _ in range(2):
+        s = socket.create_connection(
+            ("127.0.0.1", info["decode_port"]), timeout=30)
+        s.settimeout(30)
+        send_tensors(s, (np.full((5, 4), 0.1, np.float32),), 0)
+        recv_tensors(s)
+        sessions.append(s)
+
+    # kill -9 a scaled-up worker mid-traffic: the supervisor must
+    # respawn it (fresh incarnation, warming-gated join).  Pick one
+    # that is NOT hosting a session (the kill tests respawn, not the
+    # stateful fail-fast contract).
+    st = stats()
+    hosts = set(st.get("fleet:autoscale-decode", {})
+                .get("sessions_by_worker", {}))
+    workers = asc()["supervisor"]["workers"]
+    victim = next(w for w, snap in sorted(workers.items())
+                  if snap["state"] == "up" and snap["pid"]
+                  and w not in hosts)
+    os.kill(workers[victim]["pid"], signal.SIGKILL)
+    deadline = time.time() + 120
+    while time.time() < deadline:
+        snap = asc()
+        if snap["supervisor"]["workers"].get(victim, {}).get(
+                "restarts", 0) >= 1 and snap["ready"] >= 3:
+            break
+        time.sleep(0.3)
+    snap = asc()
+    assert snap["supervisor"]["workers"][victim]["restarts"] >= 1, snap
+    assert snap["ready"] == 3, snap
+    print(f"respawn OK: {victim} killed -9 and supervised back to ready")
+
+    spike.clear()                      # the down-slope
+    assert wait_ready(1, 120, cmp=lambda a, b: a <= b), asc()
+    # the sessions survived the rolling migrate-first drain: they still
+    # step, zero [SESSION]
+    for s in sessions:
+        for _ in range(3):
+            send_tensors(s, (np.zeros(4, np.float32),), 0)
+            outs, _ = recv_tensors(s)
+            assert np.asarray(outs[0]).shape == (4,)
+    for s in sessions:
+        s.close()
+    stop.set()
+    for t in ths:
+        t.join(timeout=60)
+
+    st = stats()
+    snap = st["autoscale:autoscale"]
+    drt = st["fleet:autoscale-decode"]
+    qrt = st["fleet:autoscale"]
+    assert errors == [], f"stateless errors: {errors[:3]}"
+    assert drt["sessions_broken"] == 0, drt
+    assert drt["sessions_migrated"] >= 1, drt
+    # ledgers: the autoscaler's own (spawns == joined+failed+quarantined)
+    # and the router's (offered == delivered + shed), both exact
+    assert snap["ledger_exact"], snap
+    assert snap["spawns"] == snap["joined"] + snap["failed"] \
+        + snap["quarantined"] + snap["pending"], snap
+    assert snap["fleet_size_min"] == 1 and snap["fleet_size_max"] == 3
+    assert qrt["offered"] == qrt["delivered"] + qrt["shed_total"], qrt
+    assert qrt["offered"] >= delivered[0]
+
+    # the metric family: every transition counted by action
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{health}/metrics", timeout=10) as r:
+        expo = r.read().decode()
+    counts = {}
+    for line in expo.splitlines():
+        if line.startswith("nnstpu_autoscale_events_total{"):
+            action = line.split('action="')[1].split('"')[0]
+            counts[action] = counts.get(action, 0) + int(float(
+                line.rsplit(" ", 1)[1]))
+    assert counts.get("spawn", 0) >= 3, counts      # floor + 2 scale-ups
+    assert counts.get("join", 0) >= 4, counts       # incl. the respawn
+    assert counts.get("respawn", 0) >= 1, counts
+    assert counts.get("drain", 0) >= 2, counts
+    print(f"autoscale smoke OK: 1->3->1 with kill -9 respawn; "
+          f"{delivered[0]} stateless requests zero-error; "
+          f"{drt['sessions_migrated']} session(s) migrated, 0 broken; "
+          f"spawn ledger {snap['spawns']}=={snap['joined']}+"
+          f"{snap['failed']}+{snap['quarantined']}; events {counts}")
+finally:
+    try:
+        proc.send_signal(signal.SIGTERM)
+        proc.wait(timeout=30)
+    except (OSError, subprocess.TimeoutExpired):
+        proc.kill()
+PY
+
 run_step "Cold-start smoke (warm a pipeline, restart the process, zero compile misses)" \
   python - <<'PY'
 # Compile-ahead acceptance gate: a warmed-then-restarted pipeline must
