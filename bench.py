@@ -1006,7 +1006,7 @@ def ladder_point(batch, dtype, ndev, image_size=224):
     return row
 
 
-def measure_mfu_ladder(wire_gate, on_accel, rep=None):
+def measure_mfu_ladder(wire_gate, on_accel, rep=None, provenance=None):
     """The on-chip ladder campaign as code: batch {8,32,128} × {fp32,
     int8} × {1,8 chips} against the BENCH_NOTES per-chip MFU targets.
 
@@ -1018,7 +1018,12 @@ def measure_mfu_ladder(wire_gate, on_accel, rep=None):
     harness tests).  Healthy cells are banked best-of into
     BENCH_TPU_CACHE.json (``merge_ladder_bank``) keyed by (config,
     batch, dtype, mesh, wire_regime), so one good tunnel window banks
-    evidence incrementally across runs."""
+    evidence incrementally across runs.
+
+    ``provenance`` (a short dict, e.g. ``{"source": "sentinel"}``) is
+    stamped onto every freshly measured cell before banking, so a
+    reader of BENCH_TPU_CACHE.json can tell an operator-launched bench
+    run from an opportunistic sentinel trigger."""
     from nnstreamer_tpu.obs import util as obs_util
 
     out = {
@@ -1070,6 +1075,8 @@ def measure_mfu_ladder(wire_gate, on_accel, rep=None):
                         cell["meets_target"] = (
                             cell["mfu"] >= LADDER_TARGETS[batch])
                     cell["measured_at"] = time.strftime("%Y-%m-%d %H:%M:%S")
+                    if provenance:
+                        cell["provenance"] = dict(provenance)
                     fresh[ladder_cell_key(batch, dtype, ndev, regime)] = \
                         dict(cell)
                     log(f"# mfu.ladder {label}: {cell}")
@@ -1096,6 +1103,47 @@ def measure_mfu_ladder(wire_gate, on_accel, rep=None):
             best["batch"], best["dtype"], best["mesh"],
             best.get("wire_regime", "fast"))
     return out
+
+
+def sentinel_ladder_run(provenance=None):
+    """Standalone mfu.ladder leg for the benchmark sentinel
+    (``tools/sentinel.py``): the sentinel just watched the wire flip
+    sick→healthy, so measure NOW, while the window is open, and bank
+    whatever comes out.
+
+    Deliberately leaner than the full bench leg: no per-cell 30 s
+    sick-wire waits (the sentinel only fires inside a healthy window —
+    if the wire re-sickens mid-ladder the cell self-records as
+    ``skipped{reason=wire}`` and the next flip retries it), and the
+    wire stamps land in the returned dict instead of a bench results
+    file.  Every fresh cell carries a ``provenance`` stamp (default
+    ``{"source": "sentinel"}``) into BENCH_TPU_CACHE.json.  Returns
+    the ``measure_mfu_ladder`` result dict; never raises."""
+    if provenance is None:
+        provenance = {"source": "sentinel"}
+    try:
+        if os.environ.get("BENCH_MFU_LADDER_ON_CPU") == "1":
+            platform = "cpu"  # forced-CPU harness mode: skip the probe
+        else:
+            platform = probe_accelerator(retries=1)
+        on_accel = platform not in (None, "cpu")
+        results = {}
+        old_retries = os.environ.get("BENCH_WIRE_LEG_RETRIES")
+        os.environ["BENCH_WIRE_LEG_RETRIES"] = "0"
+        try:
+            gate = make_wire_gate(results, on_accel)
+            out = measure_mfu_ladder(gate, on_accel, provenance=provenance)
+        finally:
+            if old_retries is None:
+                os.environ.pop("BENCH_WIRE_LEG_RETRIES", None)
+            else:
+                os.environ["BENCH_WIRE_LEG_RETRIES"] = old_retries
+        out["wire_per_leg"] = results.get("wire_per_leg", {})
+        out["platform"] = platform
+        return out
+    except Exception as exc:  # noqa: BLE001 — the sentinel must survive
+        log(f"# sentinel ladder run failed: {exc!r}")
+        return {"error": repr(exc)[:200]}
 
 
 def run_baseline_leg(which: str, timeout: float = 1800.0, drop_env=()):

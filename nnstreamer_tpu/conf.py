@@ -81,6 +81,13 @@ DEFAULTS: Dict[str, Dict[str, str]] = {
         # many seconds and publishes nnstpu_wire_* gauges (obs/util.py) —
         # sick tunnel regimes visible on /metrics during serving
         "watchdog_wire_probe_s": "0",
+        # Cost observatory (obs/costmodel.py, tracer "costmodel"): the
+        # persisted per-stage cost model the partitioner prices cuts
+        # against, its EWMA smoothing factor, and whether tracer stop()
+        # flushes the model to disk automatically.
+        "costmodel_path": "COST_MODEL.json",
+        "costmodel_alpha": "0.2",
+        "costmodel_autosave": "true",
     },
     # Host staging-buffer pool (nnstreamer_tpu/pool): the zero-copy batch
     # assembly + wire staging path.  NNSTPU_POOL_* env vars map here.

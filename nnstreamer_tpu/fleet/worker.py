@@ -341,4 +341,16 @@ class FleetWorker:
             out["decode"] = self.decode_server.stats()
         if self.engine is not None:
             out["engine"] = self.engine.stats()
+        try:
+            # per-worker view of the cost observatory: lets a fleet
+            # scrape see each worker's compute-vs-transfer split next
+            # to its serving stats (the process-global "cost_model"
+            # provider carries the same data un-scoped)
+            from ..obs import costmodel as _costmodel
+
+            cm = _costmodel.live_summaries()
+            if cm:
+                out["cost_model"] = cm
+        except Exception:  # noqa: BLE001 — stats must never fail a scrape
+            pass
         return out

@@ -21,6 +21,11 @@ both NNStreamer papers use to find on-device bottlenecks):
   ``nnstpu_mfu{device,node,bucket}``, busy/idle interval accounting
   behind ``nnstpu_device_busy_fraction``, and the shared wire-health
   probe published as ``nnstpu_wire_*`` gauges;
+- :mod:`.costmodel` — the cost observatory (``costmodel`` tracer):
+  per-stage compute-vs-transfer cost model aggregated from the hook
+  bus, exported as ``nnstpu_stage_cost_us`` gauges + the ``cost_model``
+  stats provider and persisted idempotently to ``COST_MODEL.json`` for
+  the partitioner (ROADMAP item 3);
 - :mod:`.watchdog` — pipeline health watchdog (``watchdog`` tracer):
   stalled sources, wedged queues, overdue device dispatches →
   ``/healthz`` + ``nnstpu_health`` + automatic stall flight dumps;
@@ -79,10 +84,18 @@ from .tracers import (  # noqa: F401
 from . import spans  # noqa: E402,F401
 from .spans import SpanTracer, chrome_trace, waterfall  # noqa: F401
 
-# importing .device / .watchdog registers the "device" / "watchdog" tracers
+# importing .device / .watchdog / .costmodel registers the "device" /
+# "watchdog" / "costmodel" tracers
 from . import device  # noqa: E402,F401
 from . import util  # noqa: E402,F401
 from . import watchdog  # noqa: E402,F401
+from . import costmodel  # noqa: E402,F401
+from .costmodel import (  # noqa: F401
+    CostModelTracer,
+    cost_model_path,
+    load_cost_model,
+    merge_cost_model,
+)
 from .util import (  # noqa: F401
     DeviceUsage,
     busy_fraction,

@@ -298,7 +298,16 @@ def attribute_trace(records: List[tuple]) -> Dict[str, float]:
     components used by SLO reports:
 
     - ``wire``: rtt − route (client↔router transport + stacks), falling
-      back to rtt − serve when no router was in the path;
+      back to rtt − serve when no router was in the path — only ever
+      derived when a server-side envelope span actually joined;
+    - ``unattributed``: the residual when the client RTT exceeds the
+      sum of the server legs that joined.  When NEITHER ``route`` nor
+      ``serve`` made it into the join (ring overflow, a worker flight
+      that was never collected), the old behavior charged the entire
+      RTT to ``wire`` — over-attribution that sent readers chasing
+      tunnel ghosts.  Now the uncovered remainder (rtt − queue −
+      device) is reported as explicitly UNKNOWN instead; the loadgen
+      report surfaces it as ``unattributed_us``;
     - ``route_overhead``: route − serve (router forwarding cost);
     - ``dispatch``: serve − queue − device (worker-side serve time that
       is neither queue wait nor device execution);
@@ -320,7 +329,12 @@ def attribute_trace(records: List[tuple]) -> Dict[str, float]:
     queue = legs.get("queue", 0.0)
     device = legs.get("device", 0.0)
     if rtt:
-        legs["wire"] = max(0.0, rtt - (route or serve))
+        envelope = route or serve
+        if envelope:
+            legs["wire"] = max(0.0, rtt - envelope)
+        else:
+            # no server envelope joined: the gap is unknown, not wire
+            legs["unattributed"] = max(0.0, rtt - queue - device)
     if route:
         legs["route_overhead"] = max(0.0, route - serve)
     if serve:

@@ -356,6 +356,7 @@ class DeviceTracer(Tracer):
             "hit": 0, "miss": 0, "persist_hit": 0, "evict": 0}
         self._last_compile: Optional[dict] = None
         self._mem_handle = None
+        self._busy_decay_handle = None
 
     def _install(self) -> None:
         cap = self._capacity if self._capacity is not None \
@@ -408,6 +409,11 @@ class DeviceTracer(Tracer):
         self._peak_tf = _util.peak_tflops()
         self._peak_gb = _util.peak_gbs()
         self._idle_gap_ns = int(_util.configured_idle_gap_ms() * 1e6)
+        if self._busy_decay_handle is not None:
+            # a restart while the previous stop()'s decay collector is
+            # still draining: the live collector takes over
+            self._registry.remove_collector(self._busy_decay_handle)
+            self._busy_decay_handle = None
         self._busy_handle = self._registry.add_collector(self._collect_busy)
         self._mem_handle = register_memory_gauges(self._registry)
         self._running = True
@@ -442,7 +448,36 @@ class DeviceTracer(Tracer):
         if getattr(self, "_busy_handle", None) is not None:
             self._registry.remove_collector(self._busy_handle)
             self._busy_handle = None
+            self._install_busy_decay()
         spans._deactivate()
+
+    def _install_busy_decay(self) -> None:
+        """Replace the live busy collector with a self-removing decaying
+        one: the gauge must keep tracking the (shrinking) windowed busy
+        fraction after stop() and read 0 once the window has fully
+        passed with no reaps — a frozen last-value gauge misleads any
+        idle/healthy read taken between runs (the benchmark sentinel,
+        the autoscaler's busy band).  The series stays present (CI
+        scrapes after the run), it just decays honestly."""
+        gauge = getattr(self, "_busy_gauge", None)
+        if gauge is None:
+            return
+        window_ns = int(_util.configured_busy_window_s() * 1e9)
+        deadline = now_ns() + window_ns
+        usage = self._usage
+        registry = self._registry
+
+        def decay() -> None:
+            done = now_ns() >= deadline
+            fracs = {} if done else usage.busy_fractions()
+            for device in usage.devices():
+                gauge.set(round(fracs.get(device, 0.0), 6), device=device)
+            if done:
+                registry.remove_collector(decay)
+                if self._busy_decay_handle is decay:
+                    self._busy_decay_handle = None
+
+        self._busy_decay_handle = registry.add_collector(decay)
 
     # -- hook callbacks ------------------------------------------------------
 
@@ -687,6 +722,20 @@ class DeviceTracer(Tracer):
                     d[2] += flops
                 else:
                     d[3] += 1
+            if _hooks.enabled:
+                # the cost-model feed: one emission per observed shard
+                # completion, carrying the same duration the device_exec
+                # span records (so downstream aggregates reconcile with
+                # the Perfetto trace by construction)
+                info = {"bucket": bucket, "mesh": nshards}
+                if flops:
+                    info["flops"] = flops
+                if bytes_:
+                    info["bytes"] = bytes_
+                if extra.get("mfu") is not None:
+                    info["mfu"] = extra["mfu"]
+                _hooks.emit("device_exec", pipeline_name, name, label,
+                            t0, dur, info)
         except Exception:  # noqa: BLE001 — attribution must never kill a probe
             import logging
 

@@ -82,6 +82,14 @@ must stay allocation-light):
                    the phase completes (``dur_ns`` then carries the
                    whole-phase wall time).  ``pipeline`` may be None
                    for serverless warmups (QueryServer, fleet worker).
+``device_exec``    ``(pipeline_name, node_name, device, t0_ns, dur_ns,
+                   info)`` — the device-lane reaper observed one TRUE
+                   device completion (enqueue→done; one emission per
+                   mesh shard under sharded dispatch).  ``info`` is a
+                   dict with ``bucket``/``mesh``/``flops``/``bytes``/
+                   ``mfu`` when the executable's cost profile is
+                   registered (else partial/empty) — the feed the
+                   cost-model tracer (:mod:`.costmodel`) aggregates.
 =================  ====================================================
 
 Timestamps passed through hooks are ``time.perf_counter_ns()`` — every
@@ -124,6 +132,8 @@ HOOK_SIGNATURES: Dict[str, Tuple[str, ...]] = {
     "warmup": ("pipeline", "node_name", "label", "done", "total", "dur_ns"),
     "lane_promote": ("pipeline", "task", "reason"),
     "scale_event": ("name", "action", "worker", "detail"),
+    "device_exec": ("pipeline_name", "node_name", "device", "t0_ns",
+                    "dur_ns", "info"),
 }
 
 HOOKS = tuple(HOOK_SIGNATURES)

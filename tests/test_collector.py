@@ -173,6 +173,24 @@ class TestTraceJoin:
         assert legs["queue"] == 20.0
         assert legs["device"] == 30.0
         assert legs["dispatch"] == 10.0      # serve - queue - device
+        assert "unattributed" not in legs    # envelope joined: all known
+
+    def test_rtt_without_server_envelope_is_unattributed_not_wire(self):
+        """When neither route nor serve joined (ring overflow, a worker
+        flight never collected) the RTT gap is UNKNOWN: charging it to
+        ``wire`` would send readers chasing tunnel ghosts."""
+        recs = [_rec(0, 100, "nnsq_rtt", 1, 1)]
+        legs = attribute_trace(recs)
+        assert "wire" not in legs
+        assert legs["unattributed"] == 100.0
+        # inner spans that DID join shrink the residual
+        recs += [
+            _rec(12, 20, "sched_wait", 1, 2, cat="sched"),
+            _rec(40, 30, "device_invoke", 1, 3, cat="device"),
+        ]
+        legs = attribute_trace(recs)
+        assert "wire" not in legs
+        assert legs["unattributed"] == 50.0  # rtt - queue - device
 
 
 class TestMetricsFederation:

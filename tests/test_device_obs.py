@@ -356,6 +356,56 @@ class _FakeDevice:
         }
 
 
+class TestBusyDecay:
+    def test_busy_gauge_decays_to_zero_after_stop(self, monkeypatch):
+        """Scrape-time staleness fix: once the tracer stops (and its
+        intervals age out of the window), the busy gauge must read 0 —
+        not hold the last computed fraction forever."""
+        monkeypatch.setenv("NNSTPU_OBS_BUSY_WINDOW_S", "0.3")
+        reg = MetricsRegistry()
+        got = []
+        p = Pipeline(name="busydecay")
+        src = p.add(DataSrc(data=[np.zeros(4, np.float32)] * 4, name="s"))
+        filt = p.add(TensorFilter(framework="jax", model=_jax_model(),
+                                  name="f"))
+        p.link_chain(src, filt, p.add(TensorSink(callback=got.append)))
+        tracer = p.attach_tracer(DeviceTracer(registry=reg))
+        p.run(timeout=60)
+        assert _wait_for(lambda: tracer.summary()["completed"] == 4)
+        p.stop()
+        gauge = reg.get("nnstpu_device_busy_fraction")
+        assert gauge is not None and gauge.children()
+
+        def decayed():
+            reg.collect()
+            return all(c.value == 0.0 for _, c in gauge.children())
+
+        assert _wait_for(decayed, timeout=5.0)
+        # the decay collector removed itself once the window aged out
+        reg.collect()
+        assert tracer._busy_decay_handle is None
+
+    def test_restart_replaces_leftover_decay_collector(self, monkeypatch):
+        monkeypatch.setenv("NNSTPU_OBS_BUSY_WINDOW_S", "30")
+        reg = MetricsRegistry()
+        got = []
+        p = Pipeline(name="busyrestart")
+        src = p.add(DataSrc(data=[np.zeros(4, np.float32)] * 2, name="s"))
+        filt = p.add(TensorFilter(framework="jax", model=_jax_model(),
+                                  name="f"))
+        p.link_chain(src, filt, p.add(TensorSink(callback=got.append)))
+        tracer = p.attach_tracer(DeviceTracer(registry=reg))
+        p.run(timeout=60)
+        assert _wait_for(lambda: tracer.summary()["completed"] == 2)
+        p.stop()
+        assert tracer._busy_decay_handle is not None  # long window: armed
+        tracer.start(p)  # re-attach: live collector replaces the decay
+        try:
+            assert tracer._busy_decay_handle is None
+        finally:
+            tracer.stop()
+
+
 class TestMemoryGauges:
     def test_exposition_golden(self):
         """Pin the per-device memory exposition exactly."""

@@ -1,0 +1,176 @@
+"""The benchmark sentinel (tools/sentinel.py): flip-edge detection over
+faked wire-probe sequences (exactly one trigger per sick→healthy edge),
+metric accounting, provenance-stamped ladder banking through
+``bench.sentinel_ladder_run``, and the CLI dry-run."""
+
+import json
+
+import pytest
+
+from nnstreamer_tpu.obs.export import unregister_stats
+from nnstreamer_tpu.obs.metrics import MetricsRegistry
+from tools import sentinel as sentinel_mod
+from tools.sentinel import Sentinel
+
+
+@pytest.fixture(autouse=True)
+def _no_wire_state_leak():
+    yield
+    from nnstreamer_tpu.obs import util as obs_util
+
+    obs_util.reset_wire_health()
+    unregister_stats("wire_health")
+
+
+def _seq_probe(put_ms_list):
+    """A probe_fn replaying a scripted put-latency sequence (the last
+    value repeats once the script runs out)."""
+    it = iter(put_ms_list)
+    last = [put_ms_list[-1]]
+
+    def probe():
+        ms = next(it, last[0])
+        if ms is None:
+            raise RuntimeError("probe died")
+        return {"put_150k_ms": ms, "dispatch_ms": 0.01}
+
+    return probe
+
+
+def _make(puts, **kw):
+    triggers = []
+
+    def trigger():
+        triggers.append(1)
+        return {"fresh_cells": 1}
+
+    s = Sentinel(probe_fn=_seq_probe(puts), trigger_fn=trigger,
+                 interval_s=0.0, registry=MetricsRegistry(),
+                 publish=False, **kw)
+    return s, triggers
+
+
+class TestFlipDetection:
+    def test_sick_healthy_sick_triggers_exactly_once(self):
+        # sick, sick, healthy (flip!), healthy, sick, sick — one trigger
+        s, triggers = _make([30.0, 30.0, 0.3, 0.3, 30.0, 30.0])
+        records = [s.poll_once() for _ in range(6)]
+        assert len(triggers) == 1
+        assert [r["triggered"] for r in records] == \
+            [False, False, True, False, False, False]
+        assert [r["regime"] for r in records] == \
+            ["slow", "slow", "fast", "fast", "slow", "slow"]
+
+    def test_retriggers_on_each_new_recovery(self):
+        s, triggers = _make([30.0, 0.3, 30.0, 0.3, 30.0, 0.3])
+        for _ in range(6):
+            s.poll_once()
+        assert len(triggers) == 3
+
+    def test_healthy_from_the_start_never_triggers(self):
+        s, triggers = _make([0.3, 0.3, 0.3, 0.3])
+        for _ in range(4):
+            s.poll_once()
+        assert triggers == []
+
+    def test_probe_error_does_not_fake_a_flip(self):
+        # slow, ERROR, fast: the sick→healthy transition is not
+        # witnessed (the wire may have recovered during the error),
+        # so no trigger — the next real slow→fast edge still fires
+        s, triggers = _make([30.0, None, 0.3, 30.0, 0.3])
+        recs = [s.poll_once() for _ in range(5)]
+        assert recs[1]["regime"] == "error"
+        assert [r["triggered"] for r in recs] == \
+            [False, False, False, False, True]
+        assert len(triggers) == 1
+
+    def test_trigger_failure_does_not_kill_the_loop(self):
+        def bad_trigger():
+            raise RuntimeError("bench exploded")
+
+        s = Sentinel(probe_fn=_seq_probe([30.0, 0.3, 0.3]),
+                     trigger_fn=bad_trigger, interval_s=0.0,
+                     registry=MetricsRegistry(), publish=False)
+        recs = [s.poll_once() for _ in range(3)]
+        assert recs[1]["triggered"] is True
+        assert "error" in recs[1]["ladder"]
+        assert recs[2]["triggered"] is False  # loop survived
+
+    def test_metrics_account_polls_and_triggers(self):
+        reg = MetricsRegistry()
+        s = Sentinel(probe_fn=_seq_probe([30.0, 0.3, 0.3]),
+                     trigger_fn=lambda: {}, interval_s=0.0,
+                     registry=reg, publish=False)
+        assert s.run(max_polls=3) == 3
+        polls = dict(reg.get("nnstpu_sentinel_polls_total").children())
+        assert polls[("slow",)].value == 1
+        assert polls[("fast",)].value == 2
+        trig = reg.get("nnstpu_sentinel_triggers_total")
+        assert dict(trig.children())[()].value == 1
+
+
+class TestLadderTrigger:
+    @pytest.fixture
+    def bench_mod(self, tmp_path, monkeypatch):
+        import bench
+
+        cache = str(tmp_path / "cache.json")
+        monkeypatch.setattr(bench, "TPU_CACHE_PATH", cache)
+        monkeypatch.setenv("BENCH_TPU_CACHE_PATH", cache)
+        return bench
+
+    def test_sentinel_run_banks_with_provenance(self, bench_mod,
+                                                monkeypatch):
+        """A triggered ladder run stamps provenance into every fresh
+        cell and banks idempotently (forced-CPU harness mode, grid
+        shrunk to one tiny cell)."""
+        monkeypatch.setenv("BENCH_MFU_LADDER_ON_CPU", "1")
+        monkeypatch.setattr(bench_mod, "LADDER_BATCHES", (8,))
+        monkeypatch.setattr(bench_mod, "LADDER_DTYPES", ("fp32",))
+        monkeypatch.setattr(bench_mod, "LADDER_MESHES", (1,))
+        monkeypatch.setattr(bench_mod, "LADDER_TARGETS", {8: 0.001})
+        orig = bench_mod.ladder_point
+        monkeypatch.setattr(
+            bench_mod, "ladder_point",
+            lambda b, d, n, image_size=224: orig(b, d, n, image_size=32))
+
+        out = bench_mod.sentinel_ladder_run()
+        assert out.get("error") is None
+        (cell,) = out["cells"].values()
+        assert cell["provenance"] == {"source": "sentinel"}
+        bank = bench_mod.load_ladder_bank()
+        (banked,) = bank.values()
+        assert banked["provenance"] == {"source": "sentinel"}
+        # a second run re-banks the same evidence idempotently
+        out2 = bench_mod.sentinel_ladder_run(
+            provenance={"source": "sentinel", "poll": 2})
+        assert out2["banked_cells"] == 1
+
+    def test_operator_runs_carry_no_sentinel_stamp(self, bench_mod,
+                                                   monkeypatch):
+        monkeypatch.setenv("BENCH_MFU_LADDER_ON_CPU", "1")
+        monkeypatch.setattr(bench_mod, "LADDER_BATCHES", (8,))
+        monkeypatch.setattr(bench_mod, "LADDER_DTYPES", ("fp32",))
+        monkeypatch.setattr(bench_mod, "LADDER_MESHES", (1,))
+        monkeypatch.setattr(bench_mod, "LADDER_TARGETS", {8: 0.001})
+        orig = bench_mod.ladder_point
+        monkeypatch.setattr(
+            bench_mod, "ladder_point",
+            lambda b, d, n, image_size=224: orig(b, d, n, image_size=32))
+        res = bench_mod.measure_mfu_ladder(lambda label: None,
+                                           on_accel=False)
+        (cell,) = res["cells"].values()
+        assert "provenance" not in cell
+
+
+class TestCli:
+    def test_dry_run_fires_exactly_one_trigger(self, monkeypatch,
+                                               capsys):
+        fired = []
+        monkeypatch.setattr(sentinel_mod, "_default_trigger",
+                            lambda: fired.append(1) or {"stub": True})
+        assert sentinel_mod.main(["--dry-run"]) == 0
+        assert len(fired) == 1
+        lines = [json.loads(line) for line in
+                 capsys.readouterr().out.splitlines() if line]
+        assert [r["triggered"] for r in lines] == [False, True]

@@ -870,6 +870,13 @@ def build_report(records: List[dict], duration_s: float, t0_ns: int,
             k: {"mean_ms": sum(v) / len(v) / 1e6,
                 "p99_ms": pct(sorted(v), 0.99) / 1e6}
             for k, v in sorted(legs_acc.items())}
+        # explicitly-unknown residual (client RTT not covered by any
+        # joined server envelope — see attribute_trace): surfaced on
+        # its own so a report reader cannot mistake it for wire time
+        unattr = legs_acc.get("unattributed")
+        if unattr:
+            attribution["unattributed_us"] = round(
+                sum(unattr) / len(unattr) / 1e3, 3)
         attribution["sample"] = per_trace
         attribution["collector_errors"] = collected["errors"]
 

@@ -26,6 +26,12 @@ This tool isolates where the per-stream cost lands:
   backend the ``dispatch_exit`` attribution only times the enqueue, so
   without this column device compute hides inside whichever element
   blocks first;
+- rides the cost observatory (``nnstreamer_tpu/obs/costmodel.py``)
+  over every measured run: ``cm disp`` / ``cm qwait`` columns are the
+  summed per-stage mean host-dispatch and queue-wait µs from the same
+  per-leg aggregates the ``costmodel`` tracer persists to
+  COST_MODEL.json — the sweep table and the persisted model can be
+  cross-checked against each other;
 - shows UTILIZATION, not just latency (the obs/util.py lane): ``mfu``
   (cost_analysis flops over measured device time vs the configured
   peak) and ``busy`` (windowed device_exec coverage per device)
@@ -97,6 +103,10 @@ if MESH is not None:
         os.environ["XLA_FLAGS"] = (
             _flags + " --xla_force_host_platform_device_count=8").strip()
 
+# the per-run cost-model tracers are sweep probes, not evidence: they
+# must not write COST_MODEL.json on every stop (explicit env wins)
+os.environ.setdefault("NNSTPU_OBS_COSTMODEL_AUTOSAVE", "false")
+
 import jax
 
 jax.config.update("jax_platforms", "cpu")
@@ -112,6 +122,7 @@ from nnstreamer_tpu.elements.queue import Queue
 from nnstreamer_tpu.elements.sink import TensorSink
 from nnstreamer_tpu.elements.testsrc import DataSrc
 from nnstreamer_tpu.obs import hooks
+from nnstreamer_tpu.obs.costmodel import CostModelTracer
 from nnstreamer_tpu.obs.device import DeviceTracer
 from nnstreamer_tpu.obs.metrics import MetricsRegistry
 from nnstreamer_tpu.spec import TensorSpec, TensorsSpec
@@ -246,6 +257,7 @@ def run_mux(streams, frames_per_stream, attribute=False, lanes=None,
     attr = Attribution()
     copies = CopyCount()
     dev = p.attach_tracer(DeviceTracer(registry=MetricsRegistry()))
+    cm = p.attach_tracer(CostModelTracer(registry=MetricsRegistry()))
     hooks.connect("copy", copies)
     if attribute:
         hooks.connect("dispatch_exit", attr)
@@ -297,6 +309,18 @@ def run_mux(streams, frames_per_stream, attribute=False, lanes=None,
     copies.per_shard = max(1, streams) / copies.chips
     copies.lanes = nlanes
     copies.host_threads = host_threads
+    # cost-model columns (obs/costmodel.py): the same per-stage legs
+    # the observatory persists, summed across nodes — mean host-dispatch
+    # and queue-wait µs per event, next to the fps they explain
+    cm_stages = cm.summary()["stages"]
+
+    def _leg_sum(leg):
+        vals = [st["legs"][leg]["mean_us"] for st in cm_stages.values()
+                if leg in st["legs"]]
+        return sum(vals) if vals else None
+
+    copies.cm_dispatch_us = _leg_sum("dispatch")
+    copies.cm_queue_us = _leg_sum("queue_wait")
     return fps, wall, attr, copies
 
 
@@ -373,19 +397,23 @@ def main():
     def fmt_busy(v):
         return f"{v * 100:>6.1f}%" if v is not None else f"{'-':>7}"
 
+    def fmt_cm(v):
+        return f"{v:>9.1f}" if v is not None else f"{'-':>9}"
+
     run_mux(1, 50, lanes=mode_lanes)
     base_fps, _, _, base_cp = run_mux(1, TOTAL, lanes=mode_lanes)
     print(f"\n{'streams':>7} {'lanes':>6} {'agg fps':>10} {'us/frame':>10} "
           f"{'vs 1-stream':>11} {'copy KB/fr':>11} {'allocs/fr':>10} "
           f"{'dev us/fr':>10} {'mfu':>9} {'busy':>7} {'chips':>6} "
-          f"{'b/shard':>8}")
+          f"{'b/shard':>8} {'cm disp':>9} {'cm qwait':>9}")
     print(f"{1:>7} {base_cp.lanes:>6} {base_fps:>10.0f} "
           f"{1e6 / base_fps:>10.1f} {'1.00x':>11} "
           f"{base_cp.per_frame / 1024:>11.1f} "
           f"{base_cp.allocs_per_frame:>10.3f} "
           f"{base_cp.dev_us_per_frame:>10.1f} "
           f"{fmt_mfu(base_cp.mfu)} {fmt_busy(base_cp.busy)} "
-          f"{base_cp.chips:>6} {base_cp.per_shard:>8.2f}")
+          f"{base_cp.chips:>6} {base_cp.per_shard:>8.2f} "
+          f"{fmt_cm(base_cp.cm_dispatch_us)} {fmt_cm(base_cp.cm_queue_us)}")
     results = {1: base_fps}
     last_cp = base_cp
     for s in [s for s in SWEEP if s != 1]:
@@ -397,7 +425,8 @@ def main():
               f"{fps / base_fps:>10.2f}x {cp.per_frame / 1024:>11.1f} "
               f"{cp.allocs_per_frame:>10.3f} {cp.dev_us_per_frame:>10.1f} "
               f"{fmt_mfu(cp.mfu)} {fmt_busy(cp.busy)} "
-              f"{cp.chips:>6} {cp.per_shard:>8.2f}")
+              f"{cp.chips:>6} {cp.per_shard:>8.2f} "
+              f"{fmt_cm(cp.cm_dispatch_us)} {fmt_cm(cp.cm_queue_us)}")
 
     # lane-vs-thread A/B at the widest point: re-measure in the OTHER
     # mode, then judge flatness per mode — widest vs the 8-stream point

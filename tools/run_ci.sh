@@ -873,6 +873,149 @@ print(f"utilization smoke OK: {len(mfu_series)} nnstpu_mfu series + "
       f"evidence bank idempotent")
 PY
 
+run_step "Cost-observatory smoke (stage-cost gauges, COST_MODEL.json idempotence, device-lane reconciliation, perfdiff self-compare)" \
+  env JAX_PLATFORMS=cpu \
+  python - <<'PY'
+# The pipeline cost observatory (ISSUE 16): a CPU pipeline under the
+# costmodel tracer must expose nnstpu_stage_cost_us{pipeline,node,leg}
+# series and the cost_model stats provider; its device_exec leg must
+# reconcile with the device lane's own accounting within 5%; the
+# persisted COST_MODEL.json must be idempotent across two flushes AND
+# across two whole runs; and a perfdiff self-compare must type every
+# verdict flat with exit code 0.
+import json
+import os
+import tempfile
+import time
+
+tmp = tempfile.mkdtemp(prefix="ci_costmodel_")
+os.environ["NNSTPU_OBS_COSTMODEL_PATH"] = os.path.join(tmp, "COST_MODEL.json")
+
+import jax
+jax.config.update('jax_platforms', 'cpu')
+import numpy as np
+
+from nnstreamer_tpu import Pipeline
+from nnstreamer_tpu.backends.jax_backend import JaxModel
+from nnstreamer_tpu.elements.filter import TensorFilter
+from nnstreamer_tpu.elements.queue import Queue
+from nnstreamer_tpu.elements.sink import TensorSink
+from nnstreamer_tpu.elements.testsrc import DataSrc
+from nnstreamer_tpu.obs.costmodel import CostModelTracer, load_cost_model
+from nnstreamer_tpu.obs.device import DeviceTracer
+from nnstreamer_tpu.obs.export import stats_snapshot
+from nnstreamer_tpu.obs.metrics import MetricsRegistry
+from nnstreamer_tpu.spec import TensorSpec, TensorsSpec
+from tools import perfdiff
+
+
+def run_once():
+    reg = MetricsRegistry()
+    model = JaxModel(apply=lambda params, x: x * 2,
+                     input_spec=TensorsSpec.of(
+                         TensorSpec(dtype=np.float32, shape=(4,))))
+    got = []
+    p = Pipeline(name="cicost")
+    src = p.add(DataSrc(data=[np.full(4, i, np.float32)
+                              for i in range(8)], name="s"))
+    filt = p.add(TensorFilter(framework="jax", model=model, name="f"))
+    q = p.add(Queue(max_size_buffers=4, name="q"))
+    p.link_chain(src, filt, q, p.add(TensorSink(callback=got.append,
+                                                name="out")))
+    dev = p.attach_tracer(DeviceTracer(registry=reg))
+    cm = p.attach_tracer(CostModelTracer(registry=reg))
+    p.run(timeout=120)
+    deadline = time.time() + 30
+    while time.time() < deadline and (dev.summary()["completed"] < 8
+                                      or len(got) < 8):
+        time.sleep(0.05)
+    p.stop()
+    return reg, dev, cm
+
+
+reg, dev, cm = run_once()
+
+# live series + stats provider
+reg.collect()
+labels = {k for k, _ in reg.get("nnstpu_stage_cost_us").children()}
+assert ("cicost", "f", "dispatch") in labels, sorted(labels)
+assert ("cicost", "f", "device_exec") in labels, sorted(labels)
+assert ("cicost", "q", "queue_wait") in labels, sorted(labels)
+assert "cicost" in stats_snapshot()["cost_model"]
+
+# device_exec must reconcile with the device lane (same reaper feed)
+stages = cm.stage_snapshots()
+key = [k for k in stages if "|f|" in k][0]
+leg = stages[key]["legs"]["device_exec"]
+cm_us = leg["mean_us"] * leg["count"]
+dev_us = dev.summary()["device_ns"] / 1e3
+drift = abs(cm_us - dev_us) / max(dev_us, 1e-9)
+assert drift < 0.05, (cm_us, dev_us, drift)
+
+# flush idempotence within a run
+d1, d2 = cm.flush(), cm.flush()
+assert d1["stages"][key]["legs"] == d2["stages"][key]["legs"]
+
+# idempotence across two WHOLE runs: the doc stays valid, history is
+# per-run, the pooled aggregate only grows by the second run's samples
+n1 = d2["stages"][key]["legs"]["device_exec"]["count"]
+run_once()
+doc = load_cost_model()
+pooled = doc["stages"][key]["legs"]["device_exec"]
+assert pooled["count"] > n1 and len(doc["stages"][key]["runs"]) == 2
+
+# perfdiff self-compare: every verdict flat, exit 0, nothing regressed
+rc = perfdiff.main(["--json"])
+assert rc == 0
+rep = perfdiff.report(perfdiff.diff_cost_models(doc, doc))
+assert rep["verdict"] == "flat" and rep["regressed"] == 0, rep
+assert rep["compared"] >= 3
+
+print(f"cost-observatory smoke OK: {len(labels)} stage-cost series, "
+      f"device_exec reconciled to {100 * drift:.2f}% of the device "
+      f"lane, COST_MODEL.json idempotent ({pooled['count']} pooled "
+      f"samples over 2 runs), perfdiff self-compare flat over "
+      f"{rep['compared']} legs")
+PY
+
+run_step "Sentinel dry-run (sick→healthy flip triggers exactly one provenance-stamped ladder run)" \
+  env JAX_PLATFORMS=cpu BENCH_MFU_LADDER_ON_CPU=1 \
+  python - <<'PY'
+# The benchmark sentinel (ISSUE 16): a canned sick→healthy probe
+# sequence through the real flip detector must trigger EXACTLY one
+# mfu.ladder run (forced-CPU, grid shrunk to one tiny cell), and the
+# measured cell must land in the evidence bank carrying the sentinel
+# provenance stamp — idempotently across a second dry-run.
+import json
+import os
+import tempfile
+
+tmp = tempfile.mkdtemp(prefix="ci_sentinel_")
+os.environ["BENCH_TPU_CACHE_PATH"] = os.path.join(tmp, "cache.json")
+
+from tools import sentinel
+
+assert sentinel.main(["--dry-run", "--tiny-ladder"]) == 0
+
+import bench
+
+bank = bench.load_ladder_bank()
+(cell,) = bank.values()
+assert cell["provenance"] == {"source": "sentinel"}, cell
+assert cell.get("mfu") is not None and cell["step_ms"] > 0
+
+# a second recovery re-banks best-of: still one cell, still stamped
+assert sentinel.main(["--dry-run", "--tiny-ladder"]) == 0
+bank2 = bench.load_ladder_bank()
+assert len(bank2) == 1
+(cell2,) = bank2.values()
+assert cell2["provenance"]["source"] == "sentinel"
+
+print(f"sentinel dry-run OK: one trigger per flip, banked cell "
+      f"{list(bank2)[0]} (mfu {cell2['mfu']}) stamped "
+      f"provenance={cell2['provenance']}, bank idempotent")
+PY
+
 run_step "Fleet smoke (router + 3 workers: kill -9, SIGTERM drain, /healthz convergence)" \
   python - <<'PY'
 import jax
