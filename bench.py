@@ -2447,6 +2447,211 @@ def main(standalone=False):
             log(f"# config2c cascade dynbatch fps: {cd_fps:.2f} "
                 f"({cd_batches} invokes / {n_casc} frames)")
 
+    # -- partition.ab: all-edge vs all-fleet vs the planner's split --------
+    # Among-device A/B (docs/partitioning.md): the SAME cascade chain in
+    # three placements over real NNSQ — fully local, fully offloaded to a
+    # fleet fragment worker, and wherever plan_partition puts the cut
+    # from this run's OWN measured inputs (a live CostModelTracer on the
+    # all-edge run + probe_edge_health on the candidate edge).  Every
+    # placement must reproduce the all-edge frames bitwise (the ledger
+    # stays exact across the wire), and the split run's per-frame
+    # transfer lands in the hop:{edge} leg — so the planner's pick is
+    # banked measured evidence, not a claim.  One caveat the numbers
+    # carry on a single host: the edge probe drives the whole server
+    # fragment, so transfer is priced conservatively (wire + one frame
+    # of server compute) and the planner leans all-local.
+    def leg_partition_ab():
+        import tempfile
+
+        from nnstreamer_tpu import parse_launch
+        from nnstreamer_tpu.fleet.worker import FleetWorker
+        from nnstreamer_tpu.graph.parse import split_launch
+        from nnstreamer_tpu.graph.pipeline import Pipeline
+        from nnstreamer_tpu.obs import spans as obs_spans
+        from nnstreamer_tpu.obs.collector import attribute_trace
+        from nnstreamer_tpu.obs.costmodel import CostModelTracer
+        from nnstreamer_tpu.obs.spans import SpanTracer
+        from nnstreamer_tpu.partition import (
+            PartitionDeployment,
+            plan_partition,
+        )
+        from nnstreamer_tpu.partition.deploy import probe_edge_health
+        from nnstreamer_tpu.spec import TensorSpec, TensorsSpec
+
+        n_ab = int(os.environ.get("BENCH_PARTITION_FRAMES", "24"))
+        if n_ab <= 1:
+            raise _Skipped("skipped (<2 frames)")
+        wire_gate("partition_ab")
+        tmpd = tempfile.mkdtemp(prefix="bench_partition_")
+        model_py = os.path.join(tmpd, "cascade_model.py")
+        with open(model_py, "w") as f:
+            f.write(
+                "from nnstreamer_tpu.models import cascade\n"
+                "def get_model():\n"
+                "    return cascade.build_detect_classify(\n"
+                "        num_labels=91, det_size=300, k=4, crop_size=96,\n"
+                "        num_classes=101, width_mult=0.5, seed=0)\n")
+        # queues bound each stage into its own thread so the tracer's
+        # dispatch legs are per-stage costs, not whole-downstream pushes
+        desc = (
+            f"videotestsrc num-buffers={n_ab} pattern=smpte "
+            "width=300 height=300 ! "
+            "tensor_converter name=conv ! queue name=q0 ! "
+            "tensor_transform mode=arithmetic "
+            "option=typecast:float32,add:-127.5,div:127.5 name=norm ! "
+            "queue name=q1 ! "
+            f"tensor_filter framework=jax model={model_py} name=cascade ! "
+            "tensor_sink name=out collect=true")
+        # tiny-frame runs must not pollute a banked COST_MODEL.json
+        # (tracer stop() autosaves to the configured path by default)
+        cm_env = os.environ.get("NNSTPU_OBS_COSTMODEL_PATH")
+        os.environ["NNSTPU_OBS_COSTMODEL_PATH"] = os.path.join(
+            tmpd, "COST_MODEL.json")
+
+        def run_placement(launch, tracer=None, spantracer=False):
+            # steady-state formula (run_pipeline_fps): frame 0 pays
+            # compile/startup, so the clock runs from its arrival to the
+            # LAST frame's materialized result (async dispatch means a
+            # bare sink arrival is not a completion)
+            state = {"first": None}
+
+            def on_frame(_frame):
+                if state["first"] is None:
+                    state["first"] = time.perf_counter()
+
+            p = parse_launch(launch, Pipeline("partition_ab"))
+            p.nodes["out"].connect("new-data", on_frame)
+            if tracer is not None:
+                p.attach_tracer(tracer)
+            if spantracer:
+                p.attach_tracer(SpanTracer())
+            p.start()
+            p.wait(600)
+            p.stop()
+            out = [[np.asarray(t) for t in fr.tensors]
+                   for fr in p.nodes["out"].frames]
+            done = time.perf_counter()
+            if len(out) != n_ab or state["first"] is None:
+                raise RuntimeError(
+                    f"placement delivered {len(out)}/{n_ab} frames — "
+                    "stalled or wedged split edge")
+            return (n_ab - 1) / max(1e-9, done - state["first"]), out
+
+        def assert_exact(got, placement):
+            for i, (gold, g) in enumerate(zip(golden, got)):
+                if len(gold) != len(g):
+                    raise RuntimeError(
+                        f"{placement} frame {i}: {len(g)} tensors vs "
+                        f"{len(gold)}")
+                for gt, t in zip(gold, g):
+                    np.testing.assert_array_equal(
+                        gt, t, err_msg=f"{placement} frame {i}")
+
+        worker = None
+        try:
+            # placement 1: all-edge — doubles as the cost-model harvest
+            # (the tracer rides the timed run: measuring with the
+            # observatory attached is the deployed configuration)
+            cmt = CostModelTracer()
+            edge_fps, golden = run_placement(desc, tracer=cmt)
+            snaps = cmt.stage_snapshots()
+            results["partition_ab_frames"] = n_ab
+            results["partition_ab_all_edge_fps"] = round(edge_fps, 2)
+            log(f"# partition.ab all-edge: {edge_fps:.2f} fps "
+                f"({len(snaps)} stage cost entries harvested)")
+            rep.snapshot()
+
+            # placement 2: all-fleet — cut=1, every interior stage behind
+            # the wire on a fragment worker, hop-attributed
+            _, server_desc = split_launch(desc, 1)
+            worker = FleetWorker(
+                name="bench_partition_ab", host="127.0.0.1", port=0,
+                framework="fragment", model=server_desc)
+            worker.start()
+            deadline = time.monotonic() + 120
+            while worker.probe() != "ok":
+                if time.monotonic() > deadline:
+                    raise RuntimeError("fragment worker never warmed")
+                time.sleep(0.02)
+            addr = f"127.0.0.1:{worker.query_port}"
+            spec = TensorsSpec.of(
+                TensorSpec(dtype=np.uint8, shape=(300, 300, 3)))
+            # long probe timeout: the first round trip compiles the
+            # fragment's cascade for this spec
+            health = probe_edge_health(
+                "127.0.0.1", worker.query_port, spec, n=3,
+                connect_timeout=240.0)
+            client_desc, _ = split_launch(desc, 1, client_props={
+                "name": "qc_ab", "host": "127.0.0.1",
+                "port": str(worker.query_port), "caps": "true",
+                "require_caps": "true", "edge": "ab",
+                "request_timeout": "240"})
+            obs_spans.enable(16384)
+            try:
+                fleet_fps, fleet_out = run_placement(
+                    client_desc, spantracer=True)
+                by_trace = {}
+                for r in obs_spans.snapshot():
+                    if r[0] == obs_spans.PH_COMPLETE and r[6]:
+                        by_trace.setdefault(r[6], []).append(r)
+                hops = []
+                for recs in by_trace.values():
+                    legs_at = attribute_trace(recs)
+                    if "hop:ab" in legs_at:
+                        hops.append(legs_at["hop:ab"] / 1e3)  # ns → µs
+            finally:
+                obs_spans.disable()
+            assert_exact(fleet_out, "all-fleet")
+            results["partition_ab_all_fleet_fps"] = round(fleet_fps, 2)
+            hop_us = round(sum(hops) / len(hops), 1) if hops else None
+            if hop_us is not None:
+                results["partition_ab_hop_us"] = hop_us
+            log(f"# partition.ab all-fleet: {fleet_fps:.2f} fps, ledger "
+                f"exact; hop:ab {hop_us} us/frame over {len(hops)} traces")
+            rep.snapshot()
+
+            # placement 3: the planner's pick from the harvested stage
+            # legs + the probed edge (one host: placement scale 1.0)
+            plan = plan_partition(
+                desc, pipeline="partition_ab", addr=addr, edge="ab",
+                cost_model={"schema": 1, "stages": snaps},
+                wire_health=health)
+            for s in plan.scores:
+                log(f"#   partition.ab priced cut={s.cut}: "
+                    f"{s.total_us:.0f} us/frame (client {s.client_us:.0f}"
+                    f" + server {s.server_us:.0f}"
+                    f" + transfer {s.transfer_us:.0f})")
+            dep = PartitionDeployment(
+                plan, client_props={"request_timeout": "240"}).start()
+            try:
+                planned_fps, planned_out = run_placement(
+                    dep.client_launch())
+            finally:
+                dep.stop()
+            assert_exact(planned_out, "planned")
+            results["partition_ab_planned_fps"] = round(planned_fps, 2)
+            results["partition_ab_planned_cut"] = plan.cut
+            results["partition_ab_fingerprint"] = plan.fingerprint
+            # verdict: the pick must not measure worse than either
+            # measured alternative beyond run-to-run noise
+            alts = {c: f for c, f in
+                    {None: edge_fps, 1: fleet_fps}.items()
+                    if c != plan.cut}
+            agrees = all(planned_fps >= 0.9 * f for f in alts.values())
+            results["partition_ab_planner_agrees"] = bool(agrees)
+            log(f"# partition.ab planned cut={plan.cut} "
+                f"(fingerprint {plan.fingerprint}): {planned_fps:.2f} fps"
+                f" — {'within noise of or beating' if agrees else 'MEASURABLY BEHIND'}"
+                f" the alternatives "
+                f"{ {str(c): round(f, 2) for c, f in alts.items()} }")
+        finally:
+            if worker is not None:
+                worker.stop()
+            if cm_env is None:
+                os.environ.pop("NNSTPU_OBS_COSTMODEL_PATH", None)
+            else:
+                os.environ["NNSTPU_OBS_COSTMODEL_PATH"] = cm_env
+
     # -- config #4: LSTM recurrence through repo slots ---------------------
     def leg_config4():
         n_steps = int(os.environ.get("BENCH_LSTM_STEPS", "200"))
@@ -2769,6 +2974,7 @@ def main(standalone=False):
         ("config1 quant leg", leg_config1_quant, 20.0),
         ("config2 ssd leg", leg_config2, 30.0),
         ("config2c cascade leg", leg_config2c, 30.0),
+        ("partition ab leg", leg_partition_ab, 45.0),
         ("config3 pose leg", leg_config3, 30.0),
         ("config4 lstm leg", leg_config4, 15.0),
         ("config4b seq leg", leg_config4b, 20.0),

@@ -83,6 +83,7 @@ _BUILTIN_MODULES = {
     "custom-easy": "nnstreamer_tpu.backends.custom",
     "custom": "nnstreamer_tpu.backends.custom",
     "custom-so": "nnstreamer_tpu.backends.custom_so",
+    "fragment": "nnstreamer_tpu.partition.fragment",
     "torch": "nnstreamer_tpu.backends.torch_backend",
     "torch-cpu": "nnstreamer_tpu.backends.torch_backend",
     "tensorflow-lite": "nnstreamer_tpu.backends.tf_backend",

@@ -216,6 +216,22 @@ DEFAULTS: Dict[str, Dict[str, str]] = {
         "spawn_timeout_s": "30",    # spawn + warmup deadline before the
                                     # attempt counts as failed
     },
+    # Among-device partitioning (nnstreamer_tpu/partition): the
+    # cost-model-driven auto-partitioner.  NNSTPU_PARTITION_* env vars
+    # map here.  See docs/partitioning.md.
+    "partition": {
+        "edge": "edge0",            # default partition-edge label (tags
+                                    # nnsq_rtt spans -> hop:{edge} leg)
+        "monitor_interval_s": "1.0",   # repartition monitor tick period
+        "noise_multiplier": "3.0",  # stage-cost drift beyond
+                                    # leg_std_us * this triggers replan
+        "default_cut_bytes": "150528",  # transfer bytes per frame at a
+                                    # cut when the cost model has no
+                                    # copy_bytes_per_frame for it
+        "probe_n": "4",             # round trips per edge health probe
+        "warm_timeout_s": "30",     # deploy: wait for the server
+                                    # fragment worker to report "ok"
+    },
     # Analysis instruments (nnstreamer_tpu/analysis): runtime lockdep.
     # The short env spelling NNSTPU_LOCKDEP takes precedence over the
     # NNSTPU_ANALYSIS_LOCKDEP form mapped here.

@@ -27,12 +27,22 @@ trace.  ``FLAG_TENANT`` (0x0200) marks an optional **tenant block**
 (u16 length + utf-8, ≤ 64 bytes) after the trace block: the client's
 declared tenant identity, which the scheduler's admission quotas and
 the ``tenant``-labeled SLO metrics key on (without it every client
-behind one NAT/router collapses into its peer IP).  Version gating
+behind one NAT/router collapses into its peer IP).  ``FLAG_CAPS``
+(0x0400) marks an optional **caps block** (u16 length + utf-8, ≤ 4096
+bytes) after the tenant block: a serialized
+:meth:`~nnstreamer_tpu.spec.TensorsSpec.to_caps_string` caps string —
+how a split pipeline's negotiation crosses the wire.  A caps-flagged
+negotiation probe carries the client's full negotiated input spec
+(framerate included, which the zeros frame alone cannot express), and
+the server's reply echoes the flag with the backend's negotiated
+OUTPUT spec, so the remote fragment negotiates formats exactly as an
+in-process link would (``nnstreamer_tpu/partition``).  Version gating
 keeps old peers working: senders emit the flags only after the peer
-proved it speaks them (the server echoes the trace flag on flagged
-requests; the client's flagged negotiation probe falls back to a plain
-probe when a strict-v1 server drops the connection), so a pre-trace
-peer only ever sees plain version-1 bytes.
+proved it speaks them (the server echoes the trace/caps flags on
+flagged requests; the client's flagged negotiation probe falls back to
+a plain probe when a strict-v1 — or merely pre-caps — server drops the
+connection), so a pre-trace peer only ever sees plain version-1 bytes
+and a pre-caps peer never sees the caps bit.
 
 Raw C-order bytes, no pickle — safe against untrusted peers and portable
 across hosts (same discipline as ``utils/checkpoint.py``).
@@ -72,8 +82,10 @@ VERSION = 1
 VER_MASK = 0x00FF   # low byte: protocol version
 FLAG_TRACE = 0x0100  # high-byte flag: trace-context block follows the header
 FLAG_TENANT = 0x0200  # high-byte flag: tenant-identity block follows trace
+FLAG_CAPS = 0x0400   # high-byte flag: caps-string block follows tenant
 _TRACE_BLOCK = struct.Struct("<QQI")  # trace_id, span_id, reserved
 MAX_TENANT = 64  # tenant-identity byte cap (one label value, not a payload)
+MAX_CAPS = 4096  # caps-string byte cap (a spec, not a payload)
 
 
 def _mesh_ndev() -> int:
@@ -86,6 +98,19 @@ def _mesh_ndev() -> int:
     except Exception:  # noqa: BLE001
         return 1
 ERR_SENTINEL = 0xFFFF
+
+
+def _prop_bool(value) -> bool:
+    """Parse a boolean element property that may arrive as a launch-string
+    token (``caps=true``): ``bool("false")`` is True, so strings parse."""
+    if isinstance(value, str):
+        low = value.strip().lower()
+        if low in ("1", "true", "yes", "on"):
+            return True
+        if low in ("", "0", "false", "no", "off"):
+            return False
+        raise ValueError(f"not a boolean property value: {value!r}")
+    return bool(value)
 
 
 class QueryError(RuntimeError):
@@ -146,6 +171,17 @@ class QueryMigratingError(QueryError):
     on the far side only ever see the fallback they already understand."""
 
     code = "MIGRATING"
+
+
+class CapsNegotiationUnsupported(NegotiationError):
+    """The typed cannot-split verdict: this client required full caps
+    negotiation over the wire (``require_caps=True`` — a partitioned
+    pipeline fragment cannot run against a peer that can't negotiate
+    formats), but the peer proved it does not speak :data:`FLAG_CAPS`
+    (a strict-v1 server dropped the flagged probe, or a flag-aware but
+    pre-caps server rejected the unknown bit).  Without the
+    requirement the client silently falls back to the legacy
+    zeros-probe negotiation, exactly like the trace/tenant flags."""
 
 
 # wire code -> client-side exception; unknown/absent codes stay the
@@ -230,16 +266,21 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
 def send_tensors(sock: socket.socket, tensors, pts: int,
                  trace: Optional[Tuple[int, int]] = None,
                  fault_key: str = "nnsq",
-                 tenant: Optional[str] = None) -> None:
+                 tenant: Optional[str] = None,
+                 caps: Optional[str] = None) -> None:
     """``trace=(trace_id, span_id)`` sets :data:`FLAG_TRACE` and prepends
     the trace-context block; ``tenant="team-a"`` sets :data:`FLAG_TENANT`
-    and appends the tenant block (truncated to :data:`MAX_TENANT` bytes).
-    Only send either to a peer that proved flag support (see the module
+    and appends the tenant block (truncated to :data:`MAX_TENANT` bytes);
+    ``caps="other/tensor, ..."`` sets :data:`FLAG_CAPS` and appends the
+    caps-string block (≤ :data:`MAX_CAPS` bytes — oversized raises, a
+    truncated caps string would negotiate the WRONG format).  Only send
+    any of them to a peer that proved flag support (see the module
     docstring) — a strict version-1 peer rejects any flagged header.
     ``fault_key`` names this send site to the chaos engine
     (``socket_drop``/``truncate``/``corrupt`` act here)."""
     ver = (VERSION | (FLAG_TRACE if trace is not None else 0)
-           | (FLAG_TENANT if tenant else 0))
+           | (FLAG_TENANT if tenant else 0)
+           | (FLAG_CAPS if caps else 0))
     parts = [MAGIC, struct.pack("<HHq", ver, len(tensors), pts)]
     if trace is not None:
         parts.append(_TRACE_BLOCK.pack(trace[0], trace[1], 0))
@@ -247,6 +288,12 @@ def send_tensors(sock: socket.socket, tensors, pts: int,
         t = tenant.encode()[:MAX_TENANT]
         parts.append(struct.pack("<H", len(t)))
         parts.append(t)
+    if caps:
+        c = caps.encode()
+        if len(c) > MAX_CAPS:
+            raise ValueError(f"caps block {len(c)} bytes > {MAX_CAPS}")
+        parts.append(struct.pack("<H", len(c)))
+        parts.append(c)
     for t in tensors:
         # np.asarray (not ascontiguousarray: it promotes 0-d to 1-d);
         # tobytes() below emits C-order regardless of memory layout
@@ -286,7 +333,7 @@ MAX_ERRMSG = 4096  # mirrors the cap send_error applies
 def recv_tensors(sock: socket.socket) -> Tuple[Tuple[np.ndarray, ...], int]:
     """Receive one frame, discarding any trace/tenant context (the
     pre-trace call shape — every legacy call site keeps its 2-tuple)."""
-    tensors, pts, _, _ = recv_tensors_ex(sock)
+    tensors, pts, _, _, _ = recv_tensors_full(sock)
     return tensors, pts
 
 
@@ -294,17 +341,29 @@ def recv_tensors_ex(
     sock: socket.socket,
 ) -> Tuple[Tuple[np.ndarray, ...], int, Optional[Tuple[int, int]],
            Optional[str]]:
-    """Receive one frame plus its optional wire metadata: returns
-    ``(tensors, pts, (trace_id, span_id) | None, tenant | None)``.
-    Tolerates (and consumes) the :data:`FLAG_TRACE` and
-    :data:`FLAG_TENANT` header bits; any other flag or version still
-    rejects."""
+    """Receive one frame plus trace/tenant wire metadata, discarding any
+    caps block (the pre-partition call shape — legacy extended call
+    sites keep their 4-tuple)."""
+    tensors, pts, trace, tenant, _ = recv_tensors_full(sock)
+    return tensors, pts, trace, tenant
+
+
+def recv_tensors_full(
+    sock: socket.socket,
+) -> Tuple[Tuple[np.ndarray, ...], int, Optional[Tuple[int, int]],
+           Optional[str], Optional[str]]:
+    """Receive one frame plus ALL its optional wire metadata: returns
+    ``(tensors, pts, (trace_id, span_id) | None, tenant | None,
+    caps | None)``.  Tolerates (and consumes) the :data:`FLAG_TRACE`,
+    :data:`FLAG_TENANT` and :data:`FLAG_CAPS` header bits; any other
+    flag or version still rejects."""
     head = _recv_exact(sock, 4 + 12)
     if head[:4] != MAGIC:
         raise ConnectionError(f"bad magic {head[:4]!r}")
     ver, n, pts = struct.unpack("<HHq", head[4:])
     flags = ver & ~VER_MASK
-    if (ver & VER_MASK) != VERSION or (flags & ~(FLAG_TRACE | FLAG_TENANT)):
+    if (ver & VER_MASK) != VERSION or \
+            (flags & ~(FLAG_TRACE | FLAG_TENANT | FLAG_CAPS)):
         raise ConnectionError(f"protocol version {ver} != {VERSION}")
     trace = None
     if flags & FLAG_TRACE:
@@ -317,6 +376,12 @@ def recv_tensors_ex(
         if tlen > MAX_TENANT:
             raise ConnectionError(f"tenant block {tlen} bytes > {MAX_TENANT}")
         tenant = _recv_exact(sock, tlen).decode("utf-8", "replace")
+    caps = None
+    if flags & FLAG_CAPS:
+        (clen,) = struct.unpack("<H", _recv_exact(sock, 2))
+        if clen > MAX_CAPS:
+            raise ConnectionError(f"caps block {clen} bytes > {MAX_CAPS}")
+        caps = _recv_exact(sock, clen).decode("utf-8", "replace")
     if n == ERR_SENTINEL:
         (mlen,) = struct.unpack("<I", _recv_exact(sock, 4))
         if mlen > MAX_ERRMSG:
@@ -349,7 +414,7 @@ def recv_tensors_ex(
             )
         a = np.frombuffer(_recv_exact(sock, nbytes), dtype=dtype)
         out.append(a.reshape(shape))
-    return tuple(out), pts, trace, tenant
+    return tuple(out), pts, trace, tenant, caps
 
 
 class QueryServer:
@@ -462,6 +527,32 @@ class QueryServer:
         self._backends[spec] = be  # (re-)insert as most recent
         return be
 
+    def _negotiate_caps(self, tensors, caps_str: str):
+        """Serve a :data:`FLAG_CAPS` negotiation probe: reconfigure the
+        backend with the client's full wire caps (which carry the
+        framerate the zeros frame cannot) and return ``(outs,
+        reply_caps)`` — zero frames of the negotiated output spec plus
+        its caps string.  Raises :class:`NegotiationError` (relayed as a
+        typed error frame) when the declared caps don't match the probe
+        frame or the backend rejects the spec."""
+        in_spec = TensorsSpec.from_caps_string(caps_str)
+        got = TensorsSpec.from_arrays(tensors)
+        if in_spec.intersect(got) is None:
+            raise NegotiationError(
+                f"caps probe declares {in_spec} but carries {got}")
+        with self._lock:
+            if not self._running:
+                raise RuntimeError("query server stopped")
+            be = self._backend_for(got)
+            out_spec = be.reconfigure(in_spec)
+        if not out_spec.tensors_fixed:
+            raise NegotiationError(
+                f"backend {self._framework} negotiated a non-fixed output "
+                f"spec {out_spec} for caps probe {in_spec}")
+        outs = tuple(np.zeros(tuple(t.shape), t.dtype)
+                     for t in out_spec.tensors)
+        return outs, out_spec.to_caps_string()
+
     def start(self) -> "QueryServer":
         # serverless front doors pick up NNSTPU_FAULTS the same way a
         # Pipeline.start does (chaos runs cover the serving edge too)
@@ -525,7 +616,8 @@ class QueryServer:
                     OverloadError, BreakerOpenError) -> None:
         while self._running:
             try:
-                tensors, pts, wire_trace, wire_tenant = recv_tensors_ex(conn)
+                tensors, pts, wire_trace, wire_tenant, wire_caps = \
+                    recv_tensors_full(conn)
             except (ConnectionError, OSError):
                 return
             # declared tenant identity wins over the peer-IP fallback:
@@ -561,7 +653,15 @@ class QueryServer:
                         # frame, keep the connection serving
                         item = self.scheduler.admit(
                             client, tenant=tenant, cost=max(1, cost))
-                    if self.batch:
+                    reply_caps = None
+                    if wire_caps is not None and pts == PROBE_PTS:
+                        # caps-flagged negotiation probe: negotiate the
+                        # backend against the client's full spec (rate
+                        # included) and echo the flag with the OUTPUT
+                        # caps — only flagged probes ever see the bit
+                        outs, reply_caps = self._negotiate_caps(
+                            tensors, wire_caps)
+                    elif self.batch:
                         outs = self._invoke_batched(
                             tensors, item,
                             trace=((wire_trace[0], tok[0])
@@ -580,7 +680,8 @@ class QueryServer:
                         tok = None
                     with state.lock:
                         send_tensors(conn, outs, pts, trace=reply_trace,
-                                     fault_key="nnsq.server")
+                                     fault_key="nnsq.server",
+                                     caps=reply_caps)
                 finally:
                     if item is not None:
                         self.scheduler.release(item)
@@ -1074,6 +1175,9 @@ class TensorQueryClient(Node):
         retry_jitter: float = 0.25,
         stateful: bool = False,
         tenant: str = "",
+        caps: bool = False,
+        require_caps: bool = False,
+        edge: str = "",
     ):
         """``request_timeout`` bounds EVERY blocking read after connect
         (the old behavior — block forever on a hung server — needs an
@@ -1099,7 +1203,22 @@ class TensorQueryClient(Node):
         ``tenant``-labeled scheduler metrics key on it instead of the
         peer IP.  Sent only after the negotiation probe proved the peer
         speaks header flags (the same capability gate as the trace
-        block), so old servers never see the bit."""
+        block), so old servers never see the bit.
+
+        ``caps=True`` carries full caps negotiation over the wire
+        (:data:`FLAG_CAPS`): the negotiation probe ships the upstream
+        spec as a caps string (framerate included) and the reply's caps
+        block — the backend's negotiated OUTPUT spec — becomes this
+        link's src spec, exactly as an in-process link would negotiate.
+        Version-gated like the other flags: a peer that drops the
+        flagged probe falls back to the legacy zeros-probe negotiation.
+        ``require_caps=True`` turns that fallback into the typed
+        :class:`CapsNegotiationUnsupported` verdict instead — a
+        partitioned pipeline fragment must never run against a peer
+        that cannot negotiate formats.  ``edge="edge0"`` names the
+        partition edge this link realizes: the per-frame ``nnsq_rtt``
+        spans carry it, and ``attribute_trace`` turns it into the
+        per-edge ``hop:{edge}`` latency leg."""
         super().__init__(name)
         self.add_sink_pad("sink")
         self.add_src_pad("src")
@@ -1114,6 +1233,9 @@ class TensorQueryClient(Node):
         self.retry_jitter = float(retry_jitter)
         self.stateful = bool(stateful)
         self.tenant = str(tenant)
+        self.caps = _prop_bool(caps)
+        self.require_caps = _prop_bool(require_caps)
+        self.edge = str(edge)
         self.retries_total = 0    # observability: re-sent requests
         self.reconnects = 0       # sockets dropped and re-dialed
         # deterministic per-element jitter stream (crc32: str hash() is
@@ -1125,6 +1247,8 @@ class TensorQueryClient(Node):
         # negotiation probe (False until proven — old servers must only
         # ever see plain version-1 bytes)
         self._trace_wire = False
+        # did the peer answer the caps-string probe? (FLAG_CAPS proven)
+        self._caps_wire = False
 
     def _connect(self) -> socket.socket:
         if self._interrupted:
@@ -1160,19 +1284,21 @@ class TensorQueryClient(Node):
         # rejects the header and drops the connection — we reconnect and
         # re-probe plain, leaving trace propagation off for this link.
         zeros = tuple(np.zeros(t.shape, t.dtype) for t in spec.tensors)
-        outs = None
+        outs = reply_caps = None
         first_exc: Optional[BaseException] = None
-        # a declared tenant also needs the capability probe: the tenant
-        # block rides the same header-flag machinery as the trace block
-        want_ext = _spans.enabled or bool(self.tenant)
+        # a declared tenant (or caps negotiation) also needs the
+        # capability probe: both blocks ride the same header-flag
+        # machinery as the trace block
+        want_ext = _spans.enabled or bool(self.tenant) or self.caps
         try:
-            outs = self._probe(zeros, want_trace=want_ext)
+            outs, reply_caps = self._probe(zeros, spec, want_ext=want_ext)
         except (OSError, RuntimeError) as exc:
             first_exc = exc
             if want_ext:
                 self._reset_socket()
                 try:
-                    outs = self._probe(zeros, want_trace=False)
+                    outs, reply_caps = self._probe(zeros, spec,
+                                                   want_ext=False)
                 except (OSError, RuntimeError):
                     outs = None
         if outs is None:
@@ -1180,16 +1306,36 @@ class TensorQueryClient(Node):
                 f"{self.name}: query server at {self.host}:{self.port} "
                 f"failed the negotiation probe: {first_exc}"
             ) from first_exc
+        if reply_caps is not None:
+            # the server's caps block IS the negotiated output spec —
+            # carry the upstream framerate when the reply left it open
+            out = TensorsSpec.from_caps_string(reply_caps)
+            if (out.rate is None or not out.rate) and spec.rate:
+                out = TensorsSpec(tensors=out.tensors, rate=spec.rate)
+            return {"src": out}
+        if self.caps and self.require_caps:
+            # the peer answered the probe but proved it cannot speak
+            # FLAG_CAPS: a partitioned fragment must not run on a
+            # format-blind wire — surface the typed cannot-split verdict
+            raise CapsNegotiationUnsupported(
+                f"{self.name}: query server at {self.host}:{self.port} "
+                "does not speak FLAG_CAPS caps negotiation "
+                "(require_caps=true): cannot split the pipeline here"
+            )
         return {"src": TensorsSpec.from_arrays(outs, rate=spec.rate)}
 
-    def _probe(self, zeros, want_trace: bool):
+    def _probe(self, zeros, spec: TensorsSpec, want_ext: bool):
         sock = self._connect()
-        trace = (_spans.new_trace_id(), 0) if want_trace else None
+        trace = (_spans.new_trace_id(), 0) if want_ext else None
+        caps_str = (spec.to_caps_string()
+                    if (want_ext and self.caps) else None)
         send_tensors(sock, zeros, PROBE_PTS, trace=trace,
-                     tenant=self.tenant if want_trace else None)
-        outs, _, reply_trace, _ = recv_tensors_ex(sock)
+                     tenant=self.tenant if want_ext else None,
+                     caps=caps_str)
+        outs, _, reply_trace, _, reply_caps = recv_tensors_full(sock)
         self._trace_wire = reply_trace is not None
-        return outs
+        self._caps_wire = reply_caps is not None
+        return outs, reply_caps
 
     def _reset_socket(self) -> None:
         """Drop the socket for a reconnect (NOT interrupt(): negotiation
@@ -1247,6 +1393,10 @@ class TensorQueryClient(Node):
         # server's serve span so the cross-process link is bidirectional
         tok = _spans.span_begin(ctx[0], ctx[1])
         args = {"server": f"{self.host}:{self.port}"}
+        if self.edge:
+            # partition-edge tag: attribute_trace turns tagged rtt spans
+            # into the per-edge hop:{edge} latency leg
+            args["edge"] = self.edge
         try:
             send_tensors(sock, frame.tensors, frame.pts,
                          trace=(ctx[0], tok[0]), fault_key="nnsq.client",

@@ -35,9 +35,13 @@ BUILTIN_MODELS: Dict[str, Callable] = {
 }
 
 
-def resolve_model(model):
-    """A CLI ``--model`` name -> callable; callables pass through."""
-    if isinstance(model, str):
+def resolve_model(model, framework: str = "custom"):
+    """A CLI ``--model`` name -> callable; callables pass through.
+
+    Only the ``custom`` frameworks take builtin-model names — other
+    backends (``fragment`` launch strings, ``jax`` model refs) own
+    their model argument's meaning, so it passes through untouched."""
+    if isinstance(model, str) and framework.startswith("custom"):
         try:
             return BUILTIN_MODELS[model]
         except KeyError:
@@ -77,7 +81,8 @@ class FleetWorker:
         self.name = name
         self.host = host
         self._q_kwargs = dict(
-            framework=framework, model=resolve_model(model), custom=custom,
+            framework=framework, model=resolve_model(model, framework),
+            custom=custom,
             host=host, port=int(port), batch=batch,
             batch_window_ms=batch_window_ms, max_batch=max_batch,
             scheduler=scheduler)

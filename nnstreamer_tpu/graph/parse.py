@@ -110,3 +110,99 @@ def parse_launch(description: str, pipeline: Optional[Pipeline] = None) -> Pipel
     if pending_link:
         raise ParseError(f"trailing '!' in {description!r}")
     return pipe
+
+
+# ---------------------------------------------------------------------------
+# Partition support: split a launch string at a pad boundary
+# ---------------------------------------------------------------------------
+
+def linear_chain(description: str) -> List[Tuple[str, Dict[str, str]]]:
+    """Parse ``description`` as one linear ``a ! b ! c`` chain and return
+    the ordered ``(etype, props)`` list (``name=`` preserved in props).
+
+    The partitioner only splits linear chains — tees, muxes and padrefs
+    make the cut boundary ambiguous, so they raise :class:`ParseError`
+    rather than silently mis-splitting."""
+    tokens = _tokenize(description)
+    elements: List[Tuple[str, Dict[str, str]]] = []
+    i = 0
+    expect_element = True
+    while i < len(tokens):
+        tok = tokens[i]
+        if tok == "!":
+            if expect_element:
+                raise ParseError(f"dangling '!' in {description!r}")
+            expect_element = True
+            i += 1
+            continue
+        if not expect_element:
+            raise ParseError(
+                f"non-linear pipeline (unlinked segment at {tok!r}): "
+                "partitioning needs a single a ! b ! c chain"
+            )
+        if "." in tok and "=" not in tok:
+            raise ParseError(
+                f"pad reference {tok!r}: partitioning needs a linear chain"
+            )
+        etype = tok
+        props: Dict[str, str] = {}
+        i += 1
+        while i < len(tokens) and "=" in tokens[i] and tokens[i] != "!":
+            key, _, value = tokens[i].partition("=")
+            props[key] = value
+            i += 1
+        elements.append((etype, props))
+        expect_element = False
+    if expect_element and elements:
+        raise ParseError(f"trailing '!' in {description!r}")
+    if not elements:
+        raise ParseError("empty pipeline description")
+    return elements
+
+
+def _render_chain(elements: List[Tuple[str, Dict[str, str]]]) -> str:
+    parts = []
+    for etype, props in elements:
+        toks = [etype]
+        for key, value in props.items():
+            toks.append(f"{key}={shlex.quote(str(value))}")
+        parts.append(" ".join(toks))
+    return " ! ".join(parts)
+
+
+def split_launch(
+    description: str,
+    cut: int,
+    client_props: Optional[Dict[str, str]] = None,
+) -> Tuple[str, str]:
+    """Split a linear launch string at element boundary ``cut`` into a
+    ``(client_desc, server_desc)`` fragment pair.
+
+    The client fragment keeps elements ``[0, cut)``, then a
+    ``tensor_query_client`` (with ``client_props``, e.g. host/port/
+    caps/edge), then the final element (the pipeline's sink — results
+    must land back on the client).  The server fragment is elements
+    ``[cut, n-1)`` rendered as a plain chain for a remote
+    :class:`~nnstreamer_tpu.partition.fragment.FragmentBackend` host.
+
+    Valid cuts are ``1 <= cut <= n-2``: at least the source stays
+    local and at least one element moves to the server."""
+    elements = linear_chain(description)
+    n = len(elements)
+    if n < 3:
+        raise ParseError(
+            f"cannot split a {n}-element chain: need source, at least "
+            "one offloadable stage, and a sink"
+        )
+    if not 1 <= cut <= n - 2:
+        raise ParseError(
+            f"cut {cut} out of range for {n}-element chain "
+            f"(valid: 1..{n - 2})"
+        )
+    client_elems = list(elements[:cut])
+    client_elems.append(
+        ("tensor_query_client", dict(client_props or {}))
+    )
+    client_elems.append(elements[n - 1])
+    server_desc = _render_chain(list(elements[cut:n - 1]))
+    return _render_chain(client_elems), server_desc

@@ -108,7 +108,11 @@ from .util import (  # noqa: F401
     probe_wire_health,
     publish_wire_health,
     register_cost,
+    register_wire_edge,
     roofline,
+    unregister_wire_edge,
+    wire_edges,
+    wire_health_by_addr,
     wire_regime,
 )
 from . import collector  # noqa: E402,F401

@@ -247,6 +247,7 @@ class TraceCollector:
                 if ev.get("ph") in ("s", "f"):
                     ev["id"] = int(ev["id"]) + ((i + 1) << 40)
                 merged.append(ev)
+        merged.extend(self._hop_flows(merged))
         if collected["errors"]:
             # the missing processes are part of the story: record them
             # as metadata instants instead of silently narrowing scope
@@ -257,6 +258,45 @@ class TraceCollector:
                     "args": {"error": err},
                 })
         return {"traceEvents": merged, "displayTimeUnit": "ms"}
+
+    @staticmethod
+    def _hop_flows(merged: List[dict]) -> List[dict]:
+        """Synthesize client→server flow arrows for cross-process NNSQ
+        hops: a server-side envelope span (``nnsq_serve``/``nnsq_route``)
+        whose wire-carried parent is an ``nnsq_rtt`` span in a DIFFERENT
+        process gets an ``nnsq_hop`` ``s``→``f`` pair from the client's
+        rtt row to the server's row.  Per-source flow ids never cross
+        pids by design (they are namespaced), so the partition edge —
+        the one hop that IS cross-process — draws its arrows here."""
+        by_key: Dict[Tuple[Optional[str], str], dict] = {}
+        for ev in merged:
+            if ev.get("ph") == "X":
+                a = ev.get("args") or {}
+                if a.get("span_id"):
+                    by_key[(a.get("trace_id"), a["span_id"])] = ev
+        hops: List[dict] = []
+        for ev in merged:
+            if ev.get("ph") != "X" or ev.get("name") not in (
+                    "nnsq_serve", "nnsq_route"):
+                continue
+            a = ev.get("args") or {}
+            parent = by_key.get((a.get("trace_id"), a.get("parent_id")))
+            if parent is None or parent.get("name") != "nnsq_rtt" \
+                    or parent["pid"] == ev["pid"]:
+                continue
+            # hop flow ids live above every per-source namespace
+            fid = (1 << 52) + len(hops) // 2 + 1
+            args = {"edge": (parent.get("args") or {}).get("edge", "")}
+            hops.append({"ph": "s", "id": fid, "pid": parent["pid"],
+                         "tid": parent["tid"], "ts": parent["ts"],
+                         "name": "nnsq_hop", "cat": "partition",
+                         "args": args})
+            hops.append({"ph": "f", "bp": "e", "id": fid, "pid": ev["pid"],
+                         "tid": ev["tid"],
+                         "ts": max(ev["ts"], parent["ts"]),
+                         "name": "nnsq_hop", "cat": "partition",
+                         "args": args})
+        return hops
 
     def spans_by_trace(self, collected: Optional[dict] = None
                        ) -> Dict[int, List[tuple]]:
@@ -314,7 +354,14 @@ def attribute_trace(records: List[tuple]) -> Dict[str, float]:
     - ``device_idle``: device starvation observed before this trace's
       dispatch executed (``device_idle`` flight spans — the reason arg
       on the span says whether host dispatch, queue wait, or the wire
-      starved the chip).
+      starved the chip);
+    - ``hop:{edge}``: per partition edge, the cross-process transfer
+      time of this trace's tagged round trips — each ``nnsq_rtt`` span
+      carrying an ``edge`` arg (a ``tensor_query_client`` with
+      ``edge=`` set) contributes its duration minus whatever server
+      envelope joined UNDER it (children by wire-carried parent id), so
+      a split pipeline's wire cost is attributed to its named edge
+      instead of drowning in ``wire``/``unattributed``.
 
     Derived values clamp at 0 (ring overflow can drop inner spans).
     """
@@ -323,6 +370,17 @@ def attribute_trace(records: List[tuple]) -> Dict[str, float]:
         leg = SPAN_LEGS.get(r[4])
         if leg is not None:
             legs[leg] = legs.get(leg, 0.0) + float(r[2])
+    for r in records:
+        if r[4] != "nnsq_rtt" or not isinstance(r[9], dict):
+            continue
+        edge = r[9].get("edge")
+        if not edge:
+            continue
+        covered = sum(float(c[2]) for c in records
+                      if c[4] in ("nnsq_serve", "nnsq_route")
+                      and c[8] == r[7])
+        key = f"hop:{edge}"
+        legs[key] = legs.get(key, 0.0) + max(0.0, float(r[2]) - covered)
     rtt = legs.get("rtt", 0.0)
     route = legs.get("route", 0.0)
     serve = legs.get("serve", 0.0)

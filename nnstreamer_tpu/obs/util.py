@@ -42,7 +42,7 @@ from __future__ import annotations
 import threading
 import time
 from collections import OrderedDict, deque
-from typing import Dict, Iterable, List, Optional, Tuple
+from typing import Callable, Dict, Iterable, List, Optional, Tuple
 
 from .metrics import REGISTRY, MetricsRegistry
 
@@ -343,11 +343,20 @@ class DeviceUsage:
             self._by_device.clear()
 
 
-# -- wire health: one probe, published live -----------------------------------
+# -- wire health: probes keyed per address, published live --------------------
+#
+# "local" is the host→device wire this process drives (the original
+# single-probe surface); partition edges add remote addresses — the
+# planner prices each cut at ITS edge's put rate, not a global regime.
+
+LOCAL_WIRE_ADDR = "local"
 
 _wire_lock = threading.Lock()
-_wire_last: Optional[dict] = None
+_wire_by_addr: Dict[str, dict] = {}
 _wire_registered = False
+# addr -> zero-arg prober (returns a probe_wire_health-shaped dict);
+# the watchdog's re-probe loop walks these alongside the local probe
+_wire_edges: Dict[str, Callable[[], dict]] = {}
 
 
 def wire_regime(put_ms: Optional[float]) -> str:
@@ -382,23 +391,67 @@ def probe_wire_health(n: int = 20, nbytes: int = 150_528) -> dict:
     return {"put_150k_ms": round(put_ms, 3), "dispatch_ms": round(disp_ms, 3)}
 
 
-def last_wire_health() -> Optional[dict]:
-    """The most recently published wire-health probe (with its regime
-    and timestamp), or None if nothing probed yet this process."""
+def last_wire_health(addr: str = LOCAL_WIRE_ADDR) -> Optional[dict]:
+    """The most recently published wire-health probe for ``addr`` (with
+    its regime and timestamp), or None if that address was never probed
+    this process.  Default: the local host→device wire — the shape every
+    pre-partition caller relies on."""
     with _wire_lock:
-        return dict(_wire_last) if _wire_last is not None else None
+        record = _wire_by_addr.get(addr)
+        return dict(record) if record is not None else None
+
+
+def wire_health_by_addr() -> Dict[str, dict]:
+    """Every published probe keyed by address (``"local"`` plus any
+    partition edges) — the planner's per-edge put-rate input."""
+    with _wire_lock:
+        return {addr: dict(rec) for addr, rec in _wire_by_addr.items()}
+
+
+def register_wire_edge(addr: str, prober: Callable[[], dict]) -> None:
+    """Register a remote edge's prober: the watchdog's wire re-probe
+    walks every registered edge next to the local probe, so a flipping
+    edge regime is observed without the planner polling."""
+    with _wire_lock:
+        _wire_edges[addr] = prober
+
+
+def unregister_wire_edge(addr: str) -> None:
+    with _wire_lock:
+        _wire_edges.pop(addr, None)
+
+
+def wire_edges() -> Dict[str, Callable[[], dict]]:
+    """Snapshot of registered edge probers by address."""
+    with _wire_lock:
+        return dict(_wire_edges)
+
+
+def _wire_stats() -> dict:
+    """The ``wire_health`` stats provider: the local record's flat shape
+    (unchanged from the single-probe era) plus an ``edges`` map when any
+    remote edge has been probed."""
+    by_addr = wire_health_by_addr()
+    out = dict(by_addr.get(LOCAL_WIRE_ADDR) or {})
+    edges = {a: r for a, r in by_addr.items() if a != LOCAL_WIRE_ADDR}
+    if edges:
+        out["edges"] = edges
+    return out
 
 
 def publish_wire_health(health: dict,
-                        registry: Optional[MetricsRegistry] = None) -> dict:
+                        registry: Optional[MetricsRegistry] = None,
+                        addr: str = LOCAL_WIRE_ADDR) -> dict:
     """Republish one wire-health probe as live gauges + stats provider.
 
     Sets ``nnstpu_wire_put_ms`` / ``nnstpu_wire_dispatch_ms`` /
-    ``nnstpu_wire_regime`` (0 fast, 1 slow) and registers a
-    ``wire_health`` provider in ``/stats.json`` on first publish — the
-    shared surface bench legs and the serving watchdog both feed, so a
-    sick tunnel is visible on any scrape.  Returns the stamped record."""
-    global _wire_last, _wire_registered
+    ``nnstpu_wire_regime`` (0 fast, 1 slow), all labeled by ``addr``
+    (``"local"`` = the host→device wire; partition edges publish under
+    their remote ``host:port``), and registers a ``wire_health``
+    provider in ``/stats.json`` on first publish — the shared surface
+    bench legs and the serving watchdog both feed, so a sick tunnel is
+    visible on any scrape.  Returns the stamped record."""
+    global _wire_registered
     registry = registry if registry is not None else REGISTRY
     put_ms = health.get("put_150k_ms")
     regime = wire_regime(put_ms)
@@ -406,36 +459,43 @@ def publish_wire_health(health: dict,
     record["regime"] = regime
     record["probed_at"] = time.time()
     with _wire_lock:
-        _wire_last = record
+        _wire_by_addr[addr] = record
         first = not _wire_registered
         _wire_registered = True
     if put_ms is not None:
         registry.gauge(
             "nnstpu_wire_put_ms",
-            "Host-to-device wire spot-check: ms per 150 KB flat put",
-        ).set(float(put_ms))
+            "Wire spot-check: ms per 150 KB flat put (addr: local = "
+            "host-to-device, else a partition edge's host:port)",
+            labelnames=("addr",),
+        ).set(float(put_ms), addr=addr)
     if health.get("dispatch_ms") is not None:
         registry.gauge(
             "nnstpu_wire_dispatch_ms",
-            "Host-to-device wire spot-check: ms per trivial dispatch",
-        ).set(float(health["dispatch_ms"]))
+            "Wire spot-check: ms per trivial dispatch (by addr)",
+            labelnames=("addr",),
+        ).set(float(health["dispatch_ms"]), addr=addr)
     registry.gauge(
         "nnstpu_wire_regime",
-        "Wire regime from the last spot-check (0 fast, 1 slow/sick)",
-    ).set(1.0 if regime == "slow" else 0.0)
+        "Wire regime from the last spot-check (0 fast, 1 slow/sick), "
+        "by addr",
+        labelnames=("addr",),
+    ).set(1.0 if regime == "slow" else 0.0, addr=addr)
     if first:
         from .export import register_stats
 
-        register_stats("wire_health", lambda: last_wire_health() or {})
+        register_stats("wire_health", _wire_stats)
     return dict(record)
 
 
 def reset_wire_health() -> None:
-    """Forget the last probe + provider registration (test isolation)."""
-    global _wire_last, _wire_registered
+    """Forget every probe, edge prober, and the provider registration
+    (test isolation)."""
+    global _wire_registered
     from .export import unregister_stats
 
     with _wire_lock:
-        _wire_last = None
+        _wire_by_addr.clear()
+        _wire_edges.clear()
         _wire_registered = False
     unregister_stats("wire_health")

@@ -265,13 +265,22 @@ class PipelineWatchdog(Tracer):
                     and time.monotonic() - self._last_wire_probe
                     >= self._wire_probe_s):
                 self._last_wire_probe = time.monotonic()
-                try:
-                    from . import util as _util
+                from . import util as _util
 
+                try:
                     _util.publish_wire_health(
                         _util.probe_wire_health(n=4), self._registry)
                 except Exception:  # noqa: BLE001 — a failed probe must
                     pass           # never flag health or kill the monitor
+                # partition edges re-probe on the same cadence: a remote
+                # link's regime flip is what triggers repartitioning, so
+                # it must be observed, not polled by the planner
+                for addr, prober in _util.wire_edges().items():
+                    try:
+                        _util.publish_wire_health(
+                            prober(), self._registry, addr=addr)
+                    except Exception:  # noqa: BLE001 — a dead edge is
+                        pass           # the deployer's problem, not ours
             try:
                 reasons = self._evaluate()
             except Exception:  # noqa: BLE001 — the monitor must survive

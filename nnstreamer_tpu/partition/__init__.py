@@ -1,0 +1,24 @@
+"""Among-device pipeline partitioning (ROADMAP item 3).
+
+Split one linear pipeline across machines at a measured-cost-optimal
+cut: :mod:`~nnstreamer_tpu.partition.planner` scores every candidate
+boundary from the cost observatory's per-stage legs (COST_MODEL.json)
+plus per-edge wire-health probes, :mod:`~nnstreamer_tpu.partition.
+deploy` materializes the winning :class:`~nnstreamer_tpu.partition.
+planner.PartitionPlan` (client fragment local, server fragment on a
+warming-gated :class:`~nnstreamer_tpu.fleet.worker.FleetWorker` running
+the :mod:`~nnstreamer_tpu.partition.fragment` backend), and
+:mod:`~nnstreamer_tpu.partition.monitor` re-scores on wire-regime flips
+or stage-cost drift and re-deploys through the migrate-first drain
+path.  See ``docs/partitioning.md``.
+"""
+
+from .deploy import PartitionDeployment, probe_edge_health  # noqa: F401
+from .fragment import FragmentBackend  # noqa: F401
+from .monitor import RepartitionMonitor  # noqa: F401
+from .planner import (  # noqa: F401
+    CutScore,
+    PartitionPlan,
+    plan_partition,
+    stage_cost_us,
+)

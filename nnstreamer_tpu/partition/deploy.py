@@ -1,0 +1,215 @@
+"""Materialize a :class:`~nnstreamer_tpu.partition.planner.PartitionPlan`.
+
+The client fragment stays local; the server fragment becomes a
+:class:`~nnstreamer_tpu.fleet.worker.FleetWorker` running the
+``fragment`` backend (``partition/fragment.py``) — which buys the whole
+fleet lifecycle for free: the worker is **warming-gated** (deploy waits
+for its membership probe to report ``ok`` before any client traffic),
+and a re-deploy retires the old worker through the same
+**migrate-first drain** the fleet uses everywhere (in-flight requests
+finish, idle peers get typed ``[UNAVAILABLE]`` goodbyes, live decode
+sessions migrate) — never a torn connection.
+
+The split edge is a first-class wire: :func:`probe_edge_health`
+measures its put rate with real NNSQ round trips, publishes under the
+edge's ``host:port`` address label, and registers the prober with
+``obs/util.py`` so the serving watchdog re-probes it on its wire
+cadence — regime flips on the edge reach the repartition monitor
+without polling."""
+
+from __future__ import annotations
+
+import socket
+import time
+from typing import Callable, Dict, Optional
+
+import numpy as np
+
+from ..elements.query import PROBE_PTS, recv_tensors, send_tensors
+from ..graph.parse import split_launch
+from ..obs import util as _util
+from ..spec import TensorsSpec
+from .planner import PartitionPlan
+
+_PROBE_NBYTES = 150_528
+
+
+def probe_edge_health(host: str, port: int, spec: TensorsSpec,
+                      n: int = 4, connect_timeout: float = 5.0) -> dict:
+    """Measure one partition edge with real NNSQ negotiation probes.
+
+    Sends ``n + 1`` plain ``PROBE_PTS`` zero-frames of ``spec`` and
+    times the round trips (the first — which may build the server's
+    backend for this spec — is discarded).  Returns the
+    ``probe_wire_health`` shape: ``put_150k_ms`` is the best round trip
+    normalized to the 150 KB reference payload when the probe payload
+    exceeds it (bandwidth-dominated: scaling down is sound); smaller
+    payloads report the raw round trip — latency dominates there, and
+    extrapolating a 48-byte RTT to 150 KB would brand every low-latency
+    edge "slow".  ``dispatch_ms`` is the best raw round trip."""
+    zeros = tuple(np.zeros(t.shape, t.dtype) for t in spec.tensors)
+    nbytes = max(1, sum(z.nbytes for z in zeros))
+    times = []
+    with socket.create_connection((host, int(port)),
+                                  timeout=connect_timeout) as sock:
+        for i in range(int(n) + 1):
+            t0 = time.perf_counter()
+            send_tensors(sock, zeros, PROBE_PTS)
+            recv_tensors(sock)
+            dt_ms = (time.perf_counter() - t0) * 1e3
+            if i:  # first probe pays backend build; not the wire's cost
+                times.append(dt_ms)
+    best = min(times)
+    scale = (_PROBE_NBYTES / nbytes) if nbytes >= _PROBE_NBYTES else 1.0
+    return {
+        "put_150k_ms": round(best * scale, 3),
+        "dispatch_ms": round(best, 3),
+    }
+
+
+class PartitionDeployment:
+    """One live placement: the plan, its server worker, its edge.
+
+    ``deploy = PartitionDeployment(plan).start()`` brings up the server
+    fragment (warming-gated) and ``deploy.client_launch()`` is the
+    launch string to run locally — the split edge pre-wired with
+    ``caps=true require_caps=true edge=<edge>`` so the remote fragment
+    negotiates formats over the wire and every round trip is
+    hop-attributable.  An all-local plan deploys trivially: no worker,
+    ``client_launch()`` is the original description."""
+
+    def __init__(self, plan: PartitionPlan, *,
+                 host: str = "127.0.0.1", port: int = 0,
+                 worker_name: Optional[str] = None,
+                 warm_timeout_s: Optional[float] = None,
+                 client_props: Optional[Dict[str, str]] = None,
+                 worker_factory: Optional[Callable] = None):
+        from ..conf import conf
+
+        self.plan = plan
+        self.host = host
+        self._port = int(port)
+        self._worker_name = worker_name or f"partition:{plan.edge}"
+        self.warm_timeout_s = (
+            float(warm_timeout_s) if warm_timeout_s is not None
+            else conf.get_float("partition", "warm_timeout_s", 30.0))
+        self._client_props = dict(client_props or {})
+        self._worker_factory = worker_factory or self._default_factory
+        self.worker = None
+        self.redeploys = 0          # observability: monitor-driven swaps
+        self._probe_spec: Optional[TensorsSpec] = None
+
+    @staticmethod
+    def _default_factory(name: str, host: str, port: int, server_desc: str):
+        from ..fleet.worker import FleetWorker
+
+        return FleetWorker(name=name, host=host, port=port,
+                           framework="fragment", model=server_desc)
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> "PartitionDeployment":
+        if self.plan.split:
+            self.worker = self._spawn(self.plan)
+        return self
+
+    def _spawn(self, plan: PartitionPlan):
+        _, server_desc = split_launch(plan.description, plan.cut)
+        worker = self._worker_factory(
+            self._worker_name, self.host, self._port, server_desc)
+        worker.start()
+        deadline = time.monotonic() + self.warm_timeout_s
+        while True:
+            status = worker.probe()
+            if status == "ok":
+                return worker
+            if time.monotonic() > deadline:
+                worker.stop()
+                raise TimeoutError(
+                    f"server fragment worker {worker.name} not servable "
+                    f"within {self.warm_timeout_s}s (last: {status})"
+                )
+            time.sleep(0.02)
+
+    @property
+    def addr(self) -> Optional[str]:
+        """The live edge's ``host:port`` (the wire-health label), or
+        None for an all-local deployment."""
+        if self.worker is None:
+            return None
+        return f"{self.worker.host}:{self.worker.query_port}"
+
+    def client_launch(self) -> str:
+        """The launch string to run locally under this deployment."""
+        if not self.plan.split:
+            return self.plan.description
+        props = {
+            "name": f"qc_{self.plan.edge}",
+            "host": self.worker.host,
+            "port": str(self.worker.query_port),
+            "caps": "true",
+            "require_caps": "true",
+            "edge": self.plan.edge,
+        }
+        props.update(self._client_props)
+        client_desc, _ = split_launch(self.plan.description,
+                                      self.plan.cut, client_props=props)
+        return client_desc
+
+    # -- edge health ---------------------------------------------------------
+
+    def register_edge(self, probe_spec: TensorsSpec,
+                      n: Optional[int] = None,
+                      registry=None) -> Optional[dict]:
+        """Probe the live edge once, publish under its address, and
+        register the prober for the watchdog's re-probe walk.  Needs
+        the cut boundary's input spec (what the client fragment feeds
+        the wire)."""
+        if self.worker is None:
+            return None
+        from ..conf import conf
+
+        n = int(n) if n is not None else int(
+            conf.get_float("partition", "probe_n", 4))
+        self._probe_spec = probe_spec
+        addr = self.addr
+        host, port = self.worker.host, self.worker.query_port
+
+        def prober() -> dict:
+            return probe_edge_health(host, port, probe_spec, n=n)
+
+        health = prober()
+        _util.register_wire_edge(addr, prober)
+        return _util.publish_wire_health(health, registry, addr=addr)
+
+    def _unregister_edge(self) -> None:
+        addr = self.addr
+        if addr is not None:
+            _util.unregister_wire_edge(addr)
+
+    # -- repartitioning ------------------------------------------------------
+
+    def redeploy(self, plan: PartitionPlan, registry=None) -> None:
+        """Swap to ``plan`` make-before-break: the new server fragment
+        comes up and proves servable (warming gate) while the old one
+        still serves; only then does the old worker leave through the
+        migrate-first drain path."""
+        old_worker = self.worker
+        self._unregister_edge()
+        new_worker = self._spawn(plan) if plan.split else None
+        self.plan = plan
+        self.worker = new_worker
+        if new_worker is not None and self._probe_spec is not None:
+            self.register_edge(self._probe_spec, registry=registry)
+        if old_worker is not None:
+            old_worker.drain()
+            old_worker.stop()
+        self.redeploys += 1
+
+    def stop(self, drain: bool = True) -> None:
+        self._unregister_edge()
+        if self.worker is not None:
+            if drain:
+                self.worker.drain()
+            self.worker.stop()
+            self.worker = None

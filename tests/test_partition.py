@@ -1,0 +1,567 @@
+"""Among-device pipeline partitioning: launch-string splitting, the
+fragment backend, FLAG_CAPS wire negotiation (version-gated), the
+cost-model-driven planner, deployment lifecycle, and the repartition
+monitor's exactly-one-redeploy semantics.
+
+Golden strategy throughout: a split pipeline's results must equal the
+unsplit pipeline's exactly — partitioning adds no numerics.
+"""
+
+import json
+import socket
+import struct
+import threading
+from fractions import Fraction
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu import Frame, parse_launch
+from nnstreamer_tpu.elements.query import (
+    FLAG_CAPS,
+    CapsNegotiationUnsupported,
+    QueryServer,
+    TensorQueryClient,
+    send_tensors,
+)
+from nnstreamer_tpu.graph.node import NegotiationError
+from nnstreamer_tpu.graph.parse import ParseError, linear_chain, split_launch
+from nnstreamer_tpu.obs import costmodel as obs_costmodel
+from nnstreamer_tpu.obs import spans
+from nnstreamer_tpu.obs import util as obs_util
+from nnstreamer_tpu.obs.collector import TraceCollector, attribute_trace
+from nnstreamer_tpu.obs.spans import SpanTracer
+from nnstreamer_tpu.partition import (
+    FragmentBackend,
+    PartitionDeployment,
+    RepartitionMonitor,
+    plan_partition,
+    probe_edge_health,
+)
+from nnstreamer_tpu.spec import TensorSpec, TensorsSpec
+from nnstreamer_tpu import faults
+
+F32 = np.float32
+
+
+@pytest.fixture(autouse=True)
+def _clean_partition_state():
+    yield
+    faults.deactivate()
+    obs_util.reset_wire_health()
+
+
+# -- launch-string splitting ------------------------------------------------
+
+
+class TestLinearChain:
+    def test_parse_preserves_names_and_props(self):
+        chain = linear_chain(
+            "videotestsrc num-buffers=4 ! tensor_converter name=conv ! "
+            "tensor_sink name=out collect=true")
+        assert [e for e, _ in chain] == [
+            "videotestsrc", "tensor_converter", "tensor_sink"]
+        assert chain[0][1]["num-buffers"] == "4"
+        assert chain[1][1]["name"] == "conv"
+        assert chain[2][1] == {"name": "out", "collect": "true"}
+
+    def test_padref_rejected(self):
+        with pytest.raises(ParseError, match="pad reference"):
+            linear_chain("videotestsrc ! mux.sink_0 ! tensor_sink")
+
+    def test_non_linear_rejected(self):
+        with pytest.raises(ParseError, match="non-linear"):
+            linear_chain("videotestsrc tensor_sink")
+
+    def test_empty_rejected(self):
+        with pytest.raises(ParseError):
+            linear_chain("   ")
+
+
+class TestSplitLaunch:
+    DESC = ("videotestsrc num-buffers=4 ! tensor_converter name=conv ! "
+            "tensor_transform mode=arithmetic option=mul:2.0 name=scale ! "
+            "tensor_sink name=out")
+
+    def test_split_renders_client_and_server(self):
+        client, server = split_launch(self.DESC, 2, client_props={
+            "host": "127.0.0.1", "port": "5000", "edge": "e0"})
+        assert "tensor_query_client" in client
+        assert "host=127.0.0.1" in client and "edge=e0" in client
+        assert client.startswith("videotestsrc")
+        assert client.endswith("tensor_sink name=out")
+        assert "tensor_converter name=conv" in client
+        assert server == ("tensor_transform mode=arithmetic "
+                          "option=mul:2.0 name=scale")
+
+    def test_cut_bounds(self):
+        split_launch(self.DESC, 1)
+        split_launch(self.DESC, 2)
+        for bad in (0, 3, -1):
+            with pytest.raises(ParseError, match="out of range"):
+                split_launch(self.DESC, bad)
+
+    def test_short_chain_rejected(self):
+        with pytest.raises(ParseError, match="cannot split"):
+            split_launch("videotestsrc ! tensor_sink", 1)
+
+    def test_roundtrip_reparses(self):
+        client, server = split_launch(self.DESC, 1)
+        assert [e for e, _ in linear_chain(client)] == [
+            "videotestsrc", "tensor_query_client", "tensor_sink"]
+        assert [e for e, _ in linear_chain(server)] == [
+            "tensor_converter", "tensor_transform"]
+
+
+# -- the fragment backend ---------------------------------------------------
+
+
+class TestFragmentBackend:
+    CHAIN = ("tensor_transform mode=arithmetic option=mul:2.0 name=a ! "
+             "queue ! tensor_transform mode=arithmetic option=add:1.0 name=b")
+
+    def test_invoke_matches_in_process_math(self):
+        be = FragmentBackend()
+        be.open(self.CHAIN)
+        try:
+            # the queue is elided: a thread hop is a no-op in a
+            # synchronous invoke
+            assert len(be._nodes) == 2
+            spec = TensorsSpec.of(TensorSpec(dtype=F32, shape=(4,)))
+            out_spec = be.reconfigure(spec)
+            assert out_spec.tensors_fixed
+            (out,) = be.invoke((np.full(4, 3.0, F32),))
+            np.testing.assert_allclose(np.asarray(out), 3.0 * 2.0 + 1.0)
+        finally:
+            be.close()
+
+    def test_empty_model_rejected(self):
+        with pytest.raises(ValueError):
+            FragmentBackend().open("")
+
+    def test_all_elided_rejected(self):
+        with pytest.raises(ValueError, match="no servable stages"):
+            FragmentBackend().open("queue ! queue")
+
+    def test_non_linear_stage_rejected(self):
+        with pytest.raises(ParseError, match="1-in/1-out"):
+            FragmentBackend().open("videotestsrc")
+
+
+# -- FLAG_CAPS negotiation over the wire ------------------------------------
+
+
+class TestCapsNegotiation:
+    def test_caps_probe_negotiates_spec_and_rate(self):
+        """A caps-flagged probe carries the framerate over the wire and
+        the reply caps become the src spec — what the legacy zeros
+        probe could never express."""
+        with QueryServer(framework="custom", model=lambda x: x * 2.0) as srv:
+            cli = TensorQueryClient(port=srv.port, caps=True, name="qc_caps")
+            cli.start()
+            try:
+                in_spec = TensorsSpec.of(
+                    TensorSpec(dtype=F32, shape=(4,)), rate=Fraction(30))
+                out = cli.configure({"sink": in_spec})
+                assert cli._caps_wire is True
+                assert out["src"].tensors[0].shape == (4,)
+                assert out["src"].tensors[0].dtype == np.dtype(F32)
+                assert out["src"].rate == Fraction(30)
+                got = cli.process(None, Frame.of(np.full(4, 2.0, F32), pts=7))
+                np.testing.assert_allclose(
+                    np.asarray(got.tensor(0)), 4.0)
+            finally:
+                cli.stop()
+
+
+def _strict_v1_server(model):
+    """A pre-flags NNSQ peer: the OLD exact version check (``ver != 1``
+    -> drop the connection), plain version-1 replies.  Returns
+    (listener, port, rejected_vers, stop_event)."""
+    srv = socket.create_server(("127.0.0.1", 0))
+    port = srv.getsockname()[1]
+    rejected = []
+    stop = threading.Event()
+
+    def recvn(c, n):
+        buf = b""
+        while len(buf) < n:
+            chunk = c.recv(n - len(buf))
+            if not chunk:
+                raise ConnectionError("closed")
+            buf += chunk
+        return buf
+
+    def serve():
+        srv.settimeout(0.2)
+        while not stop.is_set():
+            try:
+                conn, _ = srv.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            with conn:
+                try:
+                    while not stop.is_set():
+                        head = recvn(conn, 16)
+                        ver, n, pts = struct.unpack("<HHq", head[4:])
+                        if ver != 1:  # the old strict check, verbatim
+                            rejected.append(ver)
+                            break
+                        tensors = []
+                        for _ in range(n):
+                            (dlen,) = struct.unpack("<H", recvn(conn, 2))
+                            dt = np.dtype(recvn(conn, dlen).decode())
+                            (rank,) = struct.unpack("<H", recvn(conn, 2))
+                            shape = (struct.unpack(f"<{rank}I",
+                                                   recvn(conn, 4 * rank))
+                                     if rank else ())
+                            (nb,) = struct.unpack("<Q", recvn(conn, 8))
+                            tensors.append(np.frombuffer(
+                                recvn(conn, nb), dt).reshape(shape))
+                        outs = tuple(model(t) for t in tensors)
+                        send_tensors(conn, outs, pts)  # plain v1 bytes
+                except (ConnectionError, OSError):
+                    pass
+
+    threading.Thread(target=serve, daemon=True).start()
+    return srv, port, rejected, stop
+
+
+class TestCapsVersionGating:
+    """Mirrors the FLAG_TRACE fallback tests: old peers never parse the
+    new bit, and a fragment that NEEDS caps gets a typed verdict."""
+
+    def test_strict_v1_peer_falls_back_plain(self):
+        srv, port, rejected, stop = _strict_v1_server(lambda t: t * 2.0)
+        cli = TensorQueryClient(port=port, caps=True, name="qc_old")
+        cli.start()
+        try:
+            spec = TensorsSpec.of(
+                TensorSpec(dtype=F32, shape=(4,)), rate=Fraction(30))
+            out = cli.configure({"sink": spec})
+            # the flagged probe was refused; the plain re-probe carried
+            # the stream anyway — degraded (no rate on the wire), not torn
+            assert cli._caps_wire is False
+            assert out["src"].tensors[0].shape == (4,)
+            assert rejected and all(v & FLAG_CAPS for v in rejected)
+            got = cli.process(None, Frame.of(np.full(4, 3.0, F32), pts=0))
+            np.testing.assert_allclose(np.asarray(got.tensor(0)), 6.0)
+        finally:
+            cli.stop()
+            stop.set()
+            srv.close()
+
+    def test_require_caps_raises_typed_cannot_split(self):
+        srv, port, rejected, stop = _strict_v1_server(lambda t: t)
+        cli = TensorQueryClient(port=port, caps=True, require_caps=True,
+                                name="qc_strict")
+        cli.start()
+        try:
+            spec = TensorsSpec.of(TensorSpec(dtype=F32, shape=(4,)))
+            with pytest.raises(CapsNegotiationUnsupported):
+                cli.configure({"sink": spec})
+            assert rejected, "the flagged probe never reached the old peer"
+        finally:
+            cli.stop()
+            stop.set()
+            srv.close()
+
+    def test_verdict_is_a_negotiation_error(self):
+        # deploy/parse layers catch NegotiationError: the cannot-split
+        # verdict must flow through the same typed channel
+        assert issubclass(CapsNegotiationUnsupported, NegotiationError)
+
+
+# -- the planner ------------------------------------------------------------
+
+DESC = ("videotestsrc num-buffers=6 pattern=smpte width=4 height=4 ! "
+        "tensor_converter name=conv ! "
+        "tensor_transform mode=arithmetic option=mul:2.0 name=scale ! "
+        "tensor_transform mode=arithmetic option=add:1.0 name=bias ! "
+        "tensor_sink name=out collect=true")
+
+PEAKS = {"client": {"tflops": 0.1}, "server": {"tflops": 1.0}}
+FAST_WIRE = {"put_150k_ms": 0.5, "dispatch_ms": 0.2}
+SLOW_WIRE = {"put_150k_ms": 50.0, "dispatch_ms": 5.0}
+
+
+def _leg(mean_us, count=5, m2=400.0):
+    return {"count": count, "mean_us": float(mean_us), "m2": float(m2)}
+
+
+def _cost_model(scale_us=4000.0):
+    """A cost model that prices the split: conv cheap (no profile, 2x
+    wire bytes if it moves), scale/bias heavy with a flops profile the
+    10x-faster server roofline scales by 0.1."""
+    sk = obs_costmodel.stage_key
+    return {
+        "schema": 1,
+        "stages": {
+            sk("pl", "conv"): {
+                "legs": {"device_exec": _leg(100.0)},
+                "runs": [],
+                "copy_bytes_per_frame": 301_056.0,
+            },
+            sk("pl", "scale"): {
+                "legs": {"device_exec": _leg(scale_us)},
+                "runs": [],
+                "flops_per_frame": 1e9,
+                "copy_bytes_per_frame": 150_528.0,
+            },
+            sk("pl", "bias"): {
+                "legs": {"device_exec": _leg(3000.0)},
+                "runs": [],
+                "flops_per_frame": 1e9,
+                "copy_bytes_per_frame": 150_528.0,
+            },
+        },
+    }
+
+
+def _plan(wire=FAST_WIRE, cm=None, addr="127.0.0.1:0"):
+    return plan_partition(
+        DESC, pipeline="pl", addr=addr, edge="edge0",
+        cost_model=cm or _cost_model(), wire_health=wire, peaks=PEAKS)
+
+
+class TestPlanner:
+    def test_reproducible_and_pinned(self):
+        """Same inputs -> byte-identical plan.  The chosen cut and its
+        attribution are pinned: a planner change that moves them must
+        move this test."""
+        p1, p2 = _plan(), _plan()
+        assert p1 == p2
+        assert p1.fingerprint and p1.fingerprint == p2.fingerprint
+        # conv (100us either side, but 2x wire bytes if it moves) stays
+        # local; scale+bias (7000us local, 700us on the 10x server) move
+        assert p1.cut == 2
+        assert p1.regime == "fast"
+        assert p1.chosen.total_us == pytest.approx(2000.0)
+        assert p1.chosen.client_us == pytest.approx(100.0)
+        assert p1.chosen.server_us == pytest.approx(700.0)
+        assert p1.chosen.transfer_us == pytest.approx(1200.0)
+        assert [s.cut for s in p1.scores] == [None, 1, 2, 3]
+        assert p1.score_for(None).total_us == pytest.approx(7100.0)
+        assert [(n, p) for n, p, _ in p1.chosen.stages] == [
+            ("conv", "client"), ("scale", "server"), ("bias", "server")]
+
+    def test_unprobed_wire_never_chosen(self):
+        plan = _plan(wire=None)
+        assert plan.cut is None
+        assert plan.regime == "unknown"
+        for s in plan.scores:
+            if s.cut is not None:
+                assert s.transfer_us == float("inf")
+
+    def test_slow_wire_keeps_everything_local(self):
+        plan = _plan(wire=SLOW_WIRE)
+        assert plan.cut is None and plan.regime == "slow"
+
+    def test_empty_cost_model_ties_break_all_local(self):
+        plan = plan_partition(
+            DESC, pipeline="pl", addr="a", edge="e",
+            cost_model={"schema": 1, "stages": {}}, wire_health=FAST_WIRE)
+        # unknown stage costs are neutral: every split pays the wire for
+        # nothing, all-local wins
+        assert plan.cut is None
+
+    def test_too_short_chain_raises(self):
+        with pytest.raises(ParseError, match="cannot partition"):
+            plan_partition("videotestsrc ! tensor_sink", pipeline="p",
+                           addr="a", cost_model={"schema": 1, "stages": {}})
+
+
+# -- edge probing & deployment ----------------------------------------------
+
+
+class TestProbeEdgeHealth:
+    def test_probe_over_live_server(self):
+        with QueryServer(framework="custom", model=lambda x: x) as srv:
+            spec = TensorsSpec.of(TensorSpec(dtype=F32, shape=(4,)))
+            health = probe_edge_health("127.0.0.1", srv.port, spec, n=3)
+        assert health["put_150k_ms"] > 0
+        assert health["dispatch_ms"] > 0
+        # a sub-reference payload reports the raw RTT (latency-bound):
+        # never extrapolated up to the 150 KB reference
+        assert health["put_150k_ms"] == health["dispatch_ms"]
+
+
+class TestDeployment:
+    def test_all_local_plan_is_a_noop_deploy(self):
+        plan = _plan(wire=SLOW_WIRE)
+        dep = PartitionDeployment(plan).start()
+        try:
+            assert dep.worker is None and dep.addr is None
+            assert dep.client_launch() == DESC
+            spec = TensorsSpec.of(TensorSpec(dtype=np.uint8, shape=(4, 4, 3)))
+            assert dep.register_edge(spec) is None
+        finally:
+            dep.stop()
+
+    def test_split_runs_exact_with_hop_leg_and_chaos_ledger(self):
+        """Acceptance: the deployed split reproduces the unsplit
+        pipeline's frames exactly — through two seeded socket drops on
+        the split edge — and every per-frame trace carries the
+        ``hop:edge0`` leg attribute_trace derives for the edge."""
+        # golden reference: the unsplit pipeline, no chaos
+        ref = parse_launch(DESC.replace("num-buffers=6", "num-buffers=8"))
+        ref.start()
+        ref.wait(30)
+        ref.stop()
+        want = [np.asarray(f.tensor(0))
+                for f in ref.nodes["out"].frames]
+        assert len(want) == 8
+
+        spans.enable(4096)
+        plan = _plan()
+        assert plan.split
+        dep = PartitionDeployment(
+            plan,
+            client_props={"retries": "2", "retry_backoff_ms": "5"},
+        ).start()
+        try:
+            spec = TensorsSpec.of(TensorSpec(dtype=np.uint8, shape=(4, 4, 3)))
+            dep.register_edge(spec)
+            assert dep.addr in obs_util.wire_health_by_addr()
+
+            # chaos lands mid-stream, after the edge is up and probed
+            eng = faults.install("socket_drop@server:every=3,count=2")
+            launch = dep.client_launch().replace(
+                "num-buffers=6", "num-buffers=8")
+            pipe = parse_launch(launch)
+            pipe.attach_tracer(SpanTracer())
+            pipe.start()
+            pipe.wait(60)
+            pipe.stop()
+            got = [np.asarray(f.tensor(0))
+                   for f in pipe.nodes["out"].frames]
+            assert len(got) == 8
+            for w, g in zip(want, got):
+                np.testing.assert_array_equal(w, g)
+            # ledger exact: both seeded drops fired, both were retried
+            assert eng.injections["socket_drop"] == 2
+            assert pipe.nodes[f"qc_{plan.edge}"].retries_total == 2
+
+            # per-frame traces attribute the edge's transfer to its hop leg
+            by_trace = {}
+            for r in spans.snapshot():
+                if r[0] == spans.PH_COMPLETE and r[6]:
+                    by_trace.setdefault(r[6], []).append(r)
+            hop_traces = [
+                t for t, recs in by_trace.items()
+                if any(r[4] == "nnsq_rtt"
+                       and isinstance(r[9], dict)
+                       and r[9].get("edge") == "edge0" for r in recs)
+            ]
+            assert len(hop_traces) >= 8
+            for t in hop_traces:
+                legs = attribute_trace(by_trace[t])
+                assert "hop:edge0" in legs
+                assert legs["hop:edge0"] >= 0.0
+        finally:
+            dep.stop()
+            spans.disable()
+
+
+# -- the repartition monitor ------------------------------------------------
+
+
+class TestRepartitionMonitor:
+    def _deploy(self, tmp_path, monkeypatch, cm=None):
+        cm = cm or _cost_model()
+        path = tmp_path / "COST_MODEL.json"
+        path.write_text(json.dumps(cm))
+        monkeypatch.setenv("NNSTPU_OBS_COSTMODEL_PATH", str(path))
+        plan = _plan(cm=cm)
+        assert plan.cut == 2
+        dep = PartitionDeployment(plan).start()
+        # deterministic edge record (a real localhost probe's regime
+        # would be timing-dependent)
+        obs_util.publish_wire_health(dict(FAST_WIRE), addr=dep.addr)
+        return dep, path
+
+    def test_regime_flip_exactly_one_redeploy(self, tmp_path, monkeypatch):
+        dep, _ = self._deploy(tmp_path, monkeypatch)
+        try:
+            mon = RepartitionMonitor(dep, peaks=PEAKS)
+            assert mon.evaluate_once() is None  # steady state: no churn
+            old_worker = dep.worker
+            assert old_worker is not None
+
+            obs_util.publish_wire_health(dict(SLOW_WIRE), addr=dep.addr)
+            reason = mon.evaluate_once()
+            assert reason and "regime flip" in reason
+            # the slow edge prices every split out: fall back all-local
+            # through the migrate-first drain, exactly once
+            assert dep.plan.cut is None
+            assert dep.worker is None
+            assert dep.redeploys == 1
+            assert mon.evaluate_once() is None  # baseline advanced
+            assert mon.triggers == 1
+        finally:
+            dep.stop()
+
+    def test_cost_drift_replans_without_churn(self, tmp_path, monkeypatch):
+        """A drifted stage cost re-plans; an unchanged cut re-prices the
+        baseline but never restarts the worker."""
+        dep, path = self._deploy(tmp_path, monkeypatch)
+        try:
+            mon = RepartitionMonitor(dep, peaks=PEAKS)
+            assert mon.evaluate_once() is None
+            # scale's measured cost doubles — far past the noise band —
+            # but the 10x server still wins: same cut, new pricing
+            path.write_text(json.dumps(_cost_model(scale_us=8000.0)))
+            reason = mon.evaluate_once()
+            assert reason and "drift" in reason and "scale" in reason
+            assert dep.plan.cut == 2
+            assert dep.redeploys == 0
+            assert dep.plan.chosen.server_us == pytest.approx(1100.0)
+            assert mon.evaluate_once() is None  # re-priced: drift consumed
+        finally:
+            dep.stop()
+
+
+# -- merged-trace hop arrows ------------------------------------------------
+
+
+class TestHopFlows:
+    def _x(self, name, pid, ts, dur, trace_id, span_id, parent_id=None,
+           edge=None):
+        args = {"trace_id": trace_id, "span_id": span_id}
+        if parent_id:
+            args["parent_id"] = parent_id
+        if edge:
+            args["edge"] = edge
+        return {"ph": "X", "name": name, "pid": pid, "tid": 1,
+                "ts": ts, "dur": dur, "cat": "query", "args": args}
+
+    def test_cross_pid_serve_gets_hop_arrow(self):
+        merged = [
+            self._x("nnsq_rtt", 1, 100, 50, "a1", "b1", edge="e0"),
+            self._x("nnsq_serve", 2, 110, 30, "a1", "c1", parent_id="b1"),
+        ]
+        hops = TraceCollector._hop_flows(merged)
+        assert [h["ph"] for h in hops] == ["s", "f"]
+        s, f = hops
+        assert s["name"] == f["name"] == "nnsq_hop"
+        assert s["pid"] == 1 and f["pid"] == 2
+        assert s["id"] == f["id"] and s["id"] > (1 << 52)
+        assert s["args"]["edge"] == "e0"
+        assert f["bp"] == "e" and f["ts"] >= s["ts"]
+
+    def test_same_pid_serve_draws_nothing(self):
+        # in-process server: the per-source flow ids already cover it
+        merged = [
+            self._x("nnsq_rtt", 1, 100, 50, "a1", "b1", edge="e0"),
+            self._x("nnsq_serve", 1, 110, 30, "a1", "c1", parent_id="b1"),
+        ]
+        assert TraceCollector._hop_flows(merged) == []
+
+    def test_unrelated_spans_draw_nothing(self):
+        merged = [
+            self._x("device_exec", 1, 100, 50, "a1", "b1"),
+            self._x("nnsq_serve", 2, 110, 30, "a1", "c1", parent_id="zz"),
+        ]
+        assert TraceCollector._hop_flows(merged) == []

@@ -184,16 +184,43 @@ class TestWireHealth:
             {"put_150k_ms": 0.4, "dispatch_ms": 0.1}, reg)
         assert rec["regime"] == "fast"
         text = render_text(reg)
-        assert "nnstpu_wire_put_ms 0.4" in text
-        assert "nnstpu_wire_regime 0" in text
+        assert 'nnstpu_wire_put_ms{addr="local"} 0.4' in text
+        assert 'nnstpu_wire_regime{addr="local"} 0' in text
         from nnstreamer_tpu.obs.export import stats_snapshot
 
         snap = stats_snapshot()
         assert snap["wire_health"]["regime"] == "fast"
         # a sick probe flips the regime gauge
         obs_util.publish_wire_health({"put_150k_ms": 22.0}, reg)
-        assert "nnstpu_wire_regime 1" in render_text(reg)
+        assert 'nnstpu_wire_regime{addr="local"} 1' in render_text(reg)
         assert obs_util.last_wire_health()["regime"] == "slow"
+
+    def test_per_addr_probes_and_edge_registry(self):
+        reg = MetricsRegistry()
+        obs_util.publish_wire_health({"put_150k_ms": 0.4}, reg)
+        obs_util.publish_wire_health({"put_150k_ms": 9.0}, reg,
+                                     addr="10.0.0.2:5000")
+        text = render_text(reg)
+        assert 'nnstpu_wire_put_ms{addr="local"} 0.4' in text
+        assert 'nnstpu_wire_put_ms{addr="10.0.0.2:5000"} 9' in text
+        by_addr = obs_util.wire_health_by_addr()
+        assert by_addr["local"]["regime"] == "fast"
+        assert by_addr["10.0.0.2:5000"]["regime"] == "slow"
+        # the edge's record is addressable, never shadowing local
+        assert obs_util.last_wire_health()["regime"] == "fast"
+        assert obs_util.last_wire_health("10.0.0.2:5000")["regime"] == "slow"
+        # stats provider: flat local shape + edges map
+        from nnstreamer_tpu.obs.export import stats_snapshot
+
+        snap = stats_snapshot()["wire_health"]
+        assert snap["regime"] == "fast"
+        assert snap["edges"]["10.0.0.2:5000"]["regime"] == "slow"
+        # edge probers register/unregister for the watchdog walk
+        obs_util.register_wire_edge("10.0.0.2:5000",
+                                    lambda: {"put_150k_ms": 1.0})
+        assert "10.0.0.2:5000" in obs_util.wire_edges()
+        obs_util.unregister_wire_edge("10.0.0.2:5000")
+        assert obs_util.wire_edges() == {}
 
     def test_regime_classification(self):
         assert obs_util.wire_regime(0.3) == "fast"

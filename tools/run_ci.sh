@@ -1662,6 +1662,213 @@ finally:
     shutil.rmtree(cache, ignore_errors=True)
 PY
 
+run_step "Partition smoke (planner-pinned split of the SSD cascade across a subprocess fragment worker: merged trace hop arrows, exact ledger through seeded drops, regime flip = 1 repartition)" \
+  python - <<'PY'
+import jax
+jax.config.update('jax_platforms', 'cpu')
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+from nnstreamer_tpu import parse_launch
+from nnstreamer_tpu.graph.parse import split_launch
+from nnstreamer_tpu.obs import costmodel as obs_costmodel
+from nnstreamer_tpu.obs import spans
+from nnstreamer_tpu.obs import util as obs_util
+from nnstreamer_tpu.obs.collector import TraceCollector
+from nnstreamer_tpu.obs.spans import SpanTracer
+from nnstreamer_tpu.partition import (
+    PartitionDeployment, RepartitionMonitor, plan_partition)
+
+tmp = tempfile.mkdtemp(prefix="partition_smoke_")
+model_py = os.path.join(tmp, "cascade_model.py")
+with open(model_py, "w") as f:
+    f.write(
+        "from nnstreamer_tpu.models import cascade\n"
+        "def get_model():\n"
+        "    return cascade.build_detect_classify(\n"
+        "        num_labels=91, det_size=300, k=4, crop_size=96,\n"
+        "        num_classes=101, width_mult=0.5, seed=0)\n")
+
+DESC = (
+    "videotestsrc num-buffers=8 pattern=smpte width=300 height=300 ! "
+    "tensor_converter name=conv ! "
+    "tensor_transform mode=arithmetic option=typecast:float32,add:-127.5,"
+    "div:127.5 name=norm ! "
+    f"tensor_filter framework=jax model={model_py} name=cascade ! "
+    "tensor_sink name=out collect=true")
+
+# -- phase 0: golden reference (unsplit, in-process) ------------------------
+ref = parse_launch(DESC)
+ref.start(); ref.wait(300); ref.stop()
+want = [[np.asarray(t) for t in fr.tensors] for fr in ref.nodes["out"].frames]
+assert len(want) == 8, f"golden run produced {len(want)} frames"
+
+# -- phase 1: the planner picks the cut from measured inputs ----------------
+sk = obs_costmodel.stage_key
+COST_MODEL = {"schema": 1, "stages": {
+    # copy_bytes = what crosses the wire INTO that stage: raw video
+    # (RGBA-padded, 360 KB) into conv, packed uint8 (270 KB) into norm,
+    # normalized float32 (1.08 MB) into cascade — cut=2 is the cheapest
+    # crossing, and the 10x server roofline makes it beat all-local
+    sk("smoke", "conv"): {"legs": {"device_exec": {
+        "count": 5, "mean_us": 100.0, "m2": 400.0}}, "runs": [],
+        "copy_bytes_per_frame": 360_000.0},
+    sk("smoke", "norm"): {"legs": {"device_exec": {
+        "count": 5, "mean_us": 2000.0, "m2": 400.0}}, "runs": [],
+        "copy_bytes_per_frame": 270_000.0},
+    sk("smoke", "cascade"): {"legs": {"device_exec": {
+        "count": 5, "mean_us": 50_000.0, "m2": 400.0}}, "runs": [],
+        "flops_per_frame": 1e9, "copy_bytes_per_frame": 1_080_000.0},
+}}
+PEAKS = {"client": {"tflops": 0.1}, "server": {"tflops": 1.0}}
+FAST = {"put_150k_ms": 0.5, "dispatch_ms": 0.2}
+
+plan = plan_partition(DESC, pipeline="smoke", addr="127.0.0.1:0",
+                      edge="edge0", cost_model=COST_MODEL,
+                      wire_health=FAST, peaks=PEAKS)
+assert plan.cut == 2, f"planner chose {plan.cut}: {[ (s.cut, s.total_us) for s in plan.scores ]}"
+p2 = plan_partition(DESC, pipeline="smoke", addr="127.0.0.1:0",
+                    edge="edge0", cost_model=COST_MODEL,
+                    wire_health=FAST, peaks=PEAKS)
+assert p2 == plan and p2.fingerprint == plan.fingerprint, "plan not reproducible"
+print(f"planner: cut={plan.cut} fingerprint={plan.fingerprint} "
+      f"scores={[(s.cut, s.total_us) for s in plan.scores]}")
+
+# -- phase 2: subprocess server fragment, chaos on the split edge -----------
+_, server_desc = split_launch(DESC, plan.cut)
+env = dict(os.environ)
+env["JAX_PLATFORMS"] = "cpu"
+env["NNSTPU_FAULTS"] = "seed=7;socket_drop@server:every=3,count=2"
+proc = subprocess.Popen(
+    [sys.executable, "-m", "nnstreamer_tpu.fleet", "worker",
+     "--name", "fragw", "--port", "0", "--health-port", "0",
+     "--framework", "fragment", "--model", server_desc,
+     "--spans", "--platform", "cpu"],
+    stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True, env=env)
+try:
+    info = json.loads(proc.stdout.readline())
+    assert info["role"] == "worker"
+
+    client_desc, _ = split_launch(DESC, plan.cut, client_props={
+        "name": "qc_edge0", "host": "127.0.0.1", "port": str(info["port"]),
+        "caps": "true", "require_caps": "true", "edge": "edge0",
+        "retries": "2", "retry_backoff_ms": "5", "request_timeout": "300",
+    })
+    spans.enable(8192)
+    pipe = parse_launch(client_desc)
+    pipe.attach_tracer(SpanTracer())
+    pipe.start(); pipe.wait(300); pipe.stop()
+    got = [[np.asarray(t) for t in fr.tensors]
+           for fr in pipe.nodes["out"].frames]
+    assert len(got) == 8, f"split run produced {len(got)} frames"
+    for i, (w, g) in enumerate(zip(want, got)):
+        assert len(w) == len(g)
+        for wt, gt in zip(w, g):
+            np.testing.assert_array_equal(wt, gt, err_msg=f"frame {i}")
+    qc = pipe.nodes["qc_edge0"]
+    assert qc._caps_wire is True, "split edge did not negotiate caps"
+    assert qc.retries_total == 2, (
+        f"chaos ledger: expected exactly 2 retried drops, saw "
+        f"{qc.retries_total}")
+    print(f"split run exact through chaos: 8/8 frames, "
+          f"retries={qc.retries_total}, caps_wire={qc._caps_wire}")
+
+    # -- merged Perfetto trace: client fragment -> hop -> server fragment
+    tc = TraceCollector()
+    tc.add_local("client")
+    tc.add_http("fragw", info["trace_addr"])
+    chrome = tc.chrome_trace()
+    evs = chrome["traceEvents"]
+    pids = {}
+    for e in evs:
+        if e.get("ph") == "X":
+            pids.setdefault(e["name"], set()).add(e["pid"])
+    rtt_pids = pids.get("nnsq_rtt", set())
+    serve_pids = pids.get("nnsq_serve", set())
+    assert rtt_pids and serve_pids and rtt_pids.isdisjoint(serve_pids), (
+        f"client/server spans must sit on different pids: "
+        f"rtt={rtt_pids} serve={serve_pids}")
+    hop_s = [e for e in evs if e.get("name") == "nnsq_hop"
+             and e["ph"] == "s"]
+    hop_f = [e for e in evs if e.get("name") == "nnsq_hop"
+             and e["ph"] == "f"]
+    assert len(hop_s) >= 8 and len(hop_f) == len(hop_s), (
+        f"expected >=8 hop arrows, got s={len(hop_s)} f={len(hop_f)}")
+    by_id = {e["id"]: e for e in hop_s}
+    for f_ev in hop_f:
+        s_ev = by_id[f_ev["id"]]
+        assert s_ev["pid"] != f_ev["pid"], "hop arrow must cross pids"
+        assert s_ev["args"]["edge"] == "edge0"
+    assert all(e["pid"] in rtt_pids for e in hop_s)
+    assert all(e["pid"] in serve_pids for e in hop_f)
+    trace_path = os.path.join(tmp, "partition_smoke.trace.json")
+    with open(trace_path, "w") as f:
+        json.dump(chrome, f)
+    print(f"merged trace: {len(evs)} events, {len(hop_s)} client->server "
+          f"hop arrows ({trace_path})")
+finally:
+    proc.send_signal(signal.SIGTERM)
+    try:
+        proc.wait(timeout=30)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+spans.disable()
+
+# -- phase 3: forced wire-regime flip -> exactly one repartition ------------
+cm_path = os.path.join(tmp, "COST_MODEL.json")
+CM2 = {"schema": 1, "stages": {
+    sk("rp", "conv"): {"legs": {"device_exec": {
+        "count": 5, "mean_us": 100.0, "m2": 400.0}}, "runs": [],
+        "copy_bytes_per_frame": 301_056.0},
+    sk("rp", "scale"): {"legs": {"device_exec": {
+        "count": 5, "mean_us": 4000.0, "m2": 400.0}}, "runs": [],
+        "flops_per_frame": 1e9, "copy_bytes_per_frame": 150_528.0},
+    sk("rp", "bias"): {"legs": {"device_exec": {
+        "count": 5, "mean_us": 3000.0, "m2": 400.0}}, "runs": [],
+        "flops_per_frame": 1e9, "copy_bytes_per_frame": 150_528.0},
+}}
+with open(cm_path, "w") as f:
+    json.dump(CM2, f)
+os.environ["NNSTPU_OBS_COSTMODEL_PATH"] = cm_path
+RP_DESC = ("videotestsrc num-buffers=4 pattern=smpte width=4 height=4 ! "
+           "tensor_converter name=conv ! "
+           "tensor_transform mode=arithmetic option=mul:2.0 name=scale ! "
+           "tensor_transform mode=arithmetic option=add:1.0 name=bias ! "
+           "tensor_sink name=out")
+rp_plan = plan_partition(RP_DESC, pipeline="rp", addr="127.0.0.1:0",
+                         edge="edge1", cost_model=CM2, wire_health=FAST,
+                         peaks=PEAKS)
+assert rp_plan.cut == 2, f"repartition phase plan chose {rp_plan.cut}"
+dep = PartitionDeployment(rp_plan).start()
+try:
+    obs_util.publish_wire_health(dict(FAST), addr=dep.addr)
+    mon = RepartitionMonitor(dep, peaks=PEAKS)
+    assert mon.evaluate_once() is None, "steady state must not trigger"
+    obs_util.publish_wire_health(
+        {"put_150k_ms": 50.0, "dispatch_ms": 5.0}, addr=dep.addr)
+    reason = mon.evaluate_once()
+    assert reason and "regime flip" in reason, f"no flip trigger: {reason}"
+    assert dep.plan.cut is None and dep.worker is None
+    assert dep.redeploys == 1, f"redeploys={dep.redeploys}"
+    assert mon.evaluate_once() is None, "flip must trigger exactly once"
+    assert mon.triggers == 1
+    print(f"repartition: '{reason}' -> 1 redeploy (all-local), "
+          f"second tick quiet")
+finally:
+    dep.stop()
+    obs_util.reset_wire_health()
+print("partition smoke OK: planner-pinned split, subprocess fragment "
+      "exact through 2 seeded drops, merged trace with hop arrows, "
+      "regime flip = exactly 1 repartition")
+PY
+
 run_step "SLO gate (loadgen ci-slo: flooding tenant shed typed, well-behaved p99 held, ledger exact)" \
   python - <<'PY'
 # The production-load SLO gate (ISSUE 10): a fixed seeded scenario — an
@@ -1706,7 +1913,8 @@ run_step "Bench smoke (final JSON line parses, rc=0)" \
         BENCH_MUX_FRAMES=3 BENCH_MUX_STREAMS=2 BENCH_MUX_SWEEP=2 \
         BENCH_SSD_FRAMES=3 BENCH_POSE_FRAMES=3 BENCH_LSTM_STEPS=10 \
         BENCH_SEQ_WINDOWS=3 BENCH_MFU_BATCHES=8 BENCH_BREAKDOWN_FRAMES=6 \
-        BENCH_CASCADE_FRAMES=2 BENCH_PROBE_TIMEOUT=10 BENCH_BUDGET_S=1200 \
+        BENCH_CASCADE_FRAMES=2 BENCH_PARTITION_FRAMES=3 \
+        BENCH_PROBE_TIMEOUT=10 BENCH_BUDGET_S=1200 \
         BENCH_NOTES_PATH=/tmp/ci_bench_notes.md \
         BENCH_PARTIAL_PATH=/tmp/ci_bench_partial.json \
     python bench.py > /tmp/ci_bench_smoke.out \
