@@ -88,6 +88,31 @@ DEFAULTS: Dict[str, Dict[str, str]] = {
         "costmodel_path": "COST_MODEL.json",
         "costmodel_alpha": "0.2",
         "costmodel_autosave": "true",
+        # Tail forensics (obs/forensics.py, tracer "forensics"): completed
+        # traces whose leg decomposition exceeds the cost-model noise band
+        # are counted as outliers and (with a directory set) captured as
+        # flight-dump gallery entries, slowest-K retained under a byte cap.
+        "forensics_dir": "",            # "" = score + count, never capture
+        "forensics_keep": "8",          # gallery entries retained (slowest K)
+        "forensics_max_bytes": "16777216",  # gallery byte cap (16 MiB)
+        "forensics_sigmas": "3.0",      # noise-band sigmas (leg_band_us)
+        "forensics_min_rel": "0.10",    # noise-band relative floor
+        "forensics_min_abs_us": "5.0",  # noise-band absolute floor, µs
+        "forensics_min_samples": "32",  # live-baseline warmup before verdicts
+    },
+    # SLO burn-rate engine (obs/slo.py): declarative latency objectives
+    # evaluated at scrape time over registry histogram windows, surfaced
+    # on /alerts and the `alert` hook.  NNSTPU_SLO_* env vars map here.
+    "slo": {
+        # objectives spec: "name:metric{label=value,...}<bound_ms@target"
+        # semicolon-separated; metric defaults to nnstpu_e2e_latency_ms —
+        # e.g. "e2e:<50ms@0.999;tenantA:{tenant=A}<25ms@0.99"
+        "objectives": "",
+        "fast_window_s": "60",      # fast burn window (paging signal)
+        "slow_window_s": "600",     # slow burn window (confirmation)
+        "fast_burn": "14.0",        # firing threshold on the fast window
+        "slow_burn": "6.0",         # firing threshold on the slow window
+        "eval_interval_s": "5",     # min seconds between evaluations
     },
     # Host staging-buffer pool (nnstreamer_tpu/pool): the zero-copy batch
     # assembly + wire staging path.  NNSTPU_POOL_* env vars map here.

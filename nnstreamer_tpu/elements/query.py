@@ -725,9 +725,17 @@ class QueryServer:
         """Unbatched invoke (breaker-gated when a scheduler is attached)."""
 
         def run():
-            if _faults.enabled:
-                _faults.maybe_invoke("query_server")
             t0 = _spans.now_ns() if _spans.enabled else 0
+            if _faults.enabled:
+                # chaos "backend_invoke", consulted INSIDE the measured
+                # window: an invoke_delay/device_stall is simulating a slow
+                # device, so its sleep must land in the device_invoke span
+                # — that's what latency attribution and the tail-forensics
+                # verdicts see.  The site name carries ".filter" because
+                # this IS the worker's filter-backend invoke (the
+                # "@filter"-targeted specs the local pipelines use hit the
+                # same logical site here).
+                _faults.maybe_invoke("query_server.filter")
             with self._lock:
                 if not self._running:
                     raise RuntimeError("query server stopped")
@@ -945,9 +953,12 @@ class QueryServer:
                     chunk.append(part)
 
                 def run(chunk=chunk):
-                    if _faults.enabled:
-                        _faults.maybe_invoke("query_server")
                     t0 = _spans.now_ns() if _spans.enabled else 0
+                    if _faults.enabled:
+                        # chaos inside the measured window, same contract
+                        # as the direct path: injected device slowness
+                        # must show up as device time
+                        _faults.maybe_invoke("query_server.filter")
                     with self._lock:
                         if not self._running:
                             raise RuntimeError("server stopping")

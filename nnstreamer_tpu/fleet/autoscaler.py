@@ -49,7 +49,7 @@ import math
 import threading
 import time
 from collections import deque
-from typing import Callable, Dict, List, Optional
+from typing import Callable, Dict, Optional
 
 from .. import faults as _faults
 from .supervisor import ScaleEventLog, Supervisor
@@ -88,35 +88,10 @@ def _hist_p99(metric, prev: Dict[tuple, list],
     the *windowed* tail, not the lifetime one, which is what a control
     loop must react to.  ``prev`` holds per-child cumulative baselines
     across calls."""
-    if metric is None:
-        return 0.0
-    deltas: List[tuple] = []          # (bound, count-in-bucket)
-    for key, child in metric.children():
-        if label_filter:
-            labels = dict(zip(metric.labelnames, key))
-            if any(labels.get(k) != v for k, v in label_filter.items()):
-                continue
-        cumulative, _total, _count = child.snapshot()
-        base = prev.get(key)
-        prev[key] = [acc for _b, acc in cumulative]
-        last = 0.0
-        for i, (bound, acc) in enumerate(cumulative):
-            prior = base[i] if base and i < len(base) else 0.0
-            grown = (acc - prior) - last
-            last = acc - prior
-            if grown > 0:
-                deltas.append((bound, grown))
-    if not deltas:
-        return 0.0
-    deltas.sort()
-    total = sum(n for _b, n in deltas)
-    need = math.ceil(total * 0.99)
-    seen = 0.0
-    for bound, n in deltas:
-        seen += n
-        if seen >= need:
-            return 1e9 if bound == float("inf") else float(bound)
-    return float(deltas[-1][0])
+    from ..obs.metrics import histogram_deltas, histogram_quantile
+
+    deltas = histogram_deltas(metric, prev, label_filter)
+    return histogram_quantile(0.99, deltas, inf_value=1e9, empty_value=0.0)
 
 
 class RouterSignals:

@@ -120,9 +120,18 @@ from .collector import (  # noqa: F401
     TraceCollector,
     attribute_trace,
     federate_metrics,
+    fetch_alerts,
+    merge_alerts,
     set_process_name,
     trace_document,
 )
+
+# importing .forensics registers the "forensics" tracer; .slo is the
+# burn-rate engine behind /alerts and the `alert` hook
+from . import forensics  # noqa: E402,F401
+from . import slo  # noqa: E402,F401
+from .forensics import ForensicsEngine, ForensicsTracer  # noqa: F401
+from .slo import SloEngine, parse_objectives  # noqa: F401
 from .device import (  # noqa: F401
     DeviceTracer,
     device_memory_snapshot,

@@ -405,6 +405,11 @@ def attribute_trace(records: List[tuple]) -> Dict[str, float]:
 def _inject_label(line: str, label: str, value: str) -> str:
     """``name{a="b"} 1`` / ``name 1`` → the same sample with
     ``label="value"`` prepended to the label set."""
+    # an OpenMetrics exemplar suffix (` # {trace_id="..."} v ts`) rides
+    # after the sample value: detach it first — its braces must not be
+    # mistaken for the sample's label set — and reattach untouched
+    line, ex_sep, exemplar = line.partition(" # {")
+    suffix = ex_sep + exemplar if ex_sep else ""
     # split the sample into name[{labels}] and the value suffix
     brace = line.find("{")
     esc = value.replace("\\", r"\\").replace('"', r'\"')
@@ -413,11 +418,11 @@ def _inject_label(line: str, label: str, value: str) -> str:
         inner = line[brace + 1:close]
         rest = line[close + 1:]
         joined = f'{label}="{esc}"' + ("," + inner if inner else "")
-        return f"{line[:brace]}{{{joined}}}{rest}"
+        return f"{line[:brace]}{{{joined}}}{rest}{suffix}"
     sp = line.find(" ")
     if sp == -1:
-        return line  # not a sample line; pass through untouched
-    return f'{line[:sp]}{{{label}="{esc}"}}{line[sp:]}'
+        return line + suffix  # not a sample line; pass through untouched
+    return f'{line[:sp]}{{{label}="{esc}"}}{line[sp:]}{suffix}'
 
 
 def federate_metrics(sources: Dict[str, str],
@@ -465,6 +470,76 @@ def federate_metrics(sources: Dict[str, str],
         lines.extend(headers.get(metric, ()))
         lines.extend(samples.get(metric, ()))
     return "\n".join(lines) + ("\n" if lines else "")
+
+
+def merge_alerts(docs: Dict[str, dict]) -> dict:
+    """Fold per-worker ``/alerts`` documents (see
+    :meth:`nnstreamer_tpu.obs.slo.SloEngine.alerts_document`) into ONE
+    fleet-wide view: each objective's per-window good/total deltas are
+    summed across workers and the burn rate recomputed from the pooled
+    counts — so the router sees the fleet burning even when every
+    individual worker sits just under its threshold.  An objective also
+    reads firing fleet-wide when ANY member fires (a single saturated
+    worker is an alert, not an average)."""
+    merged: Dict[str, dict] = {}
+    for worker, doc in sorted(docs.items()):
+        for name, obj in (doc.get("objectives") or {}).items():
+            ent = merged.get(name)
+            if ent is None:
+                ent = merged[name] = {
+                    "metric": obj.get("metric"),
+                    "labels": obj.get("labels") or {},
+                    "bound_ms": obj.get("bound_ms"),
+                    "target": obj.get("target"),
+                    "windows": {},
+                    "workers": [],
+                    "workers_firing": [],
+                }
+            ent["workers"].append(worker)
+            if obj.get("state") == "firing":
+                ent["workers_firing"].append(worker)
+            for wname, win in (obj.get("windows") or {}).items():
+                agg = ent["windows"].setdefault(wname, {
+                    "window_s": win.get("window_s"),
+                    "threshold": win.get("threshold"),
+                    "good": 0.0, "total": 0.0,
+                })
+                agg["good"] += float(win.get("good") or 0.0)
+                agg["total"] += float(win.get("total") or 0.0)
+    firing: List[str] = []
+    for name, ent in merged.items():
+        budget = max(1e-9, 1.0 - float(ent.get("target") or 0.0))
+        is_firing = bool(ent["workers_firing"])
+        for win in ent["windows"].values():
+            total = win["total"]
+            bad = max(0.0, total - win["good"])
+            win["burn"] = round((bad / total) / budget, 4) if total else 0.0
+            thr = win.get("threshold")
+            if thr is not None and win["burn"] >= float(thr):
+                is_firing = True
+        ent["state"] = "firing" if is_firing else "ok"
+        if is_firing:
+            firing.append(name)
+    return {"objectives": merged, "firing": sorted(firing),
+            "workers": sorted(docs)}
+
+
+def fetch_alerts(addrs: Dict[str, str], timeout_s: float = 5.0) -> dict:
+    """HTTP convenience over :func:`merge_alerts`: fetch every worker's
+    ``/alerts`` and merge.  Unreachable workers land in ``errors``; the
+    merged view is built from whoever answered."""
+    docs: Dict[str, dict] = {}
+    errors: Dict[str, str] = {}
+    for name, addr in addrs.items():
+        try:
+            docs[name] = _http_get_json(
+                f"http://{addr}/alerts", timeout_s)
+        except Exception as exc:  # noqa: BLE001 — a dead worker != no merge
+            errors[name] = repr(exc)
+    merged = merge_alerts(docs)
+    if errors:
+        merged["errors"] = errors
+    return merged
 
 
 def fetch_metrics(addrs: Dict[str, str], timeout_s: float = 5.0,

@@ -146,6 +146,28 @@ def leg_std_us(leg: dict) -> Optional[float]:
     return math.sqrt(m2 / (n - 1))
 
 
+# noise-band floors shared by every consumer of leg aggregates
+# (tools/perfdiff regression verdicts, obs/forensics outlier scoring)
+BAND_SIGMAS = 3.0
+BAND_MIN_REL = 0.10    # 10% floor: sub-noise-floor deltas stay flat
+BAND_MIN_ABS_US = 5.0  # µs floor: scheduler jitter on tiny legs
+
+
+def leg_band_us(leg_stat: dict, sigmas: float = BAND_SIGMAS,
+                min_rel: float = BAND_MIN_REL,
+                min_abs_us: float = BAND_MIN_ABS_US) -> float:
+    """Noise band (µs) around one persisted leg aggregate's mean:
+    ``max(min_rel × |mean|, min_abs_us, sigmas × sample-std)`` — a leg
+    that historically swings 40% does not page anyone over a 10%
+    delta.  Below 2 samples only the relative/absolute floors apply."""
+    mean = float(leg_stat.get("mean_us") or 0.0)
+    band = max(min_rel * abs(mean), min_abs_us)
+    std = leg_std_us(leg_stat)
+    if std is not None:
+        band = max(band, sigmas * std)
+    return band
+
+
 def combine_legs(a: dict, b: dict) -> dict:
     """Pool two Welford aggregates ({count, mean_us, m2}) — the
     parallel-variance identity, exact regardless of merge order."""

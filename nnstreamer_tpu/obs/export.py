@@ -188,6 +188,41 @@ def degraded_snapshot() -> Dict[str, str]:
     return out
 
 
+_alerts_lock = threading.Lock()
+_alerts_provider: Optional[Callable[[], dict]] = None
+
+
+def register_alerts(fn: Callable[[], dict]) -> Callable[[], dict]:
+    """Register the ``/alerts`` document provider (the SLO burn-rate
+    engine, :mod:`nnstreamer_tpu.obs.slo`).  One provider per process —
+    a re-register replaces."""
+    global _alerts_provider
+    with _alerts_lock:
+        _alerts_provider = fn
+    return fn
+
+
+def unregister_alerts(fn: Optional[Callable] = None) -> None:
+    global _alerts_provider
+    with _alerts_lock:
+        if fn is None or _alerts_provider is fn:
+            _alerts_provider = None
+
+
+def alerts_document() -> dict:
+    """The ``/alerts`` JSON body: the registered provider's document, or
+    an empty shell when no SLO engine is installed.  A raising provider
+    becomes an ``error`` field, never a 500."""
+    with _alerts_lock:
+        fn = _alerts_provider
+    if fn is None:
+        return {"objectives": {}, "firing": []}
+    try:
+        return fn()
+    except Exception as exc:  # noqa: BLE001 — a bad provider != no endpoint
+        return {"objectives": {}, "firing": [], "error": repr(exc)}
+
+
 def health_snapshot() -> Tuple[bool, Dict[str, str]]:
     """(overall healthy, {provider: reason for each unhealthy one}).  A
     raising provider counts as unhealthy — a broken watchdog must not
@@ -257,8 +292,15 @@ def _labels(names, values, extra: str = "") -> str:
     return "{" + ",".join(pairs) + "}" if pairs else ""
 
 
-def render_text(registry: Optional[MetricsRegistry] = None) -> str:
-    """The whole registry in Prometheus text exposition format."""
+def render_text(registry: Optional[MetricsRegistry] = None,
+                exemplars: bool = False) -> str:
+    """The whole registry in Prometheus text exposition format.
+
+    ``exemplars=True`` appends each bucket's retained exemplar in
+    OpenMetrics syntax — ``... # {trace_id="<hex>"} <value> <ts>`` — so a
+    scraped p99.9 bucket links straight to its flight-recorder trace
+    (served at ``/metrics?exemplars=1``; default off, the plain 0.0.4
+    parsers must keep working)."""
     registry = registry if registry is not None else REGISTRY
     lines = []
     for metric in registry.collect():
@@ -268,10 +310,16 @@ def render_text(registry: Optional[MetricsRegistry] = None) -> str:
         for key, child in metric.children():
             if metric.kind == "histogram":
                 cumulative, total_sum, count = child.snapshot()
-                for bound, acc in cumulative:
+                ex = child.exemplars() if exemplars else None
+                for i, (bound, acc) in enumerate(cumulative):
                     le = _labels(metric.labelnames, key,
                                  extra=f'le="{_fmt(bound)}"')
-                    lines.append(f"{metric.name}_bucket{le} {acc}")
+                    line = f"{metric.name}_bucket{le} {acc}"
+                    if ex is not None and ex[i] is not None:
+                        tid, value, ts = ex[i]
+                        line += (f' # {{trace_id="{tid:x}"}}'
+                                 f" {_fmt(value)} {ts:.3f}")
+                    lines.append(line)
                 base = _labels(metric.labelnames, key)
                 lines.append(f"{metric.name}_sum{base} {_fmt(total_sum)}")
                 lines.append(f"{metric.name}_count{base} {count}")
@@ -299,6 +347,14 @@ class MetricsServer:
     def start(self) -> "MetricsServer":
         if self._httpd is not None:
             return self
+        try:
+            # any process that scrapes also evaluates: conf-declared SLO
+            # objectives come alive with the endpoint that serves them
+            from .slo import ensure_engine
+
+            ensure_engine(self.registry)
+        except Exception:  # noqa: BLE001 — a bad SLO spec must not kill /metrics
+            pass
         registry = self.registry
 
         class Handler(BaseHTTPRequestHandler):
@@ -312,9 +368,21 @@ class MetricsServer:
 
             def do_GET(self):  # noqa: N802 — http.server API
                 path = self.path.split("?")[0]
+                query = self.path.partition("?")[2] or ""
                 if path in ("/metrics", "/"):
-                    self._reply(render_text(registry).encode("utf-8"),
-                                CONTENT_TYPE)
+                    # ?exemplars=1 opts into OpenMetrics exemplar
+                    # suffixes (trace-id links); the default stays plain
+                    # 0.0.4 for strict parsers
+                    body = render_text(
+                        registry, exemplars="exemplars=1" in query)
+                    self._reply(body.encode("utf-8"), CONTENT_TYPE)
+                elif path == "/alerts":
+                    # the SLO burn-rate engine's live alert state (see
+                    # obs/slo.py); an empty shell when no objectives are
+                    # declared — collectors can probe unconditionally
+                    body = json.dumps(alerts_document(), sort_keys=True,
+                                      default=str).encode("utf-8")
+                    self._reply(body, "application/json; charset=utf-8")
                 elif path == "/healthz":
                     # JSON body: status + per-provider reasons, so fleet
                     # membership (and operators) read WHY — degraded is

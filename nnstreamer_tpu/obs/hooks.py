@@ -90,6 +90,13 @@ must stay allocation-light):
                    ``mfu`` when the executable's cost profile is
                    registered (else partial/empty) — the feed the
                    cost-model tracer (:mod:`.costmodel`) aggregates.
+``alert``          ``(name, state, severity, detail)`` — the SLO
+                   burn-rate engine (:mod:`nnstreamer_tpu.obs.slo`)
+                   changed an alert's state: ``name`` is the objective,
+                   ``state`` is ``firing`` / ``resolved``, ``severity``
+                   is ``page`` (fast window) / ``ticket`` (slow only),
+                   ``detail`` carries the burn rates and windows that
+                   crossed.
 =================  ====================================================
 
 Timestamps passed through hooks are ``time.perf_counter_ns()`` — every
@@ -134,6 +141,7 @@ HOOK_SIGNATURES: Dict[str, Tuple[str, ...]] = {
     "scale_event": ("name", "action", "worker", "detail"),
     "device_exec": ("pipeline_name", "node_name", "device", "t0_ns",
                     "dur_ns", "info"),
+    "alert": ("name", "state", "severity", "detail"),
 }
 
 HOOKS = tuple(HOOK_SIGNATURES)

@@ -24,7 +24,8 @@ from __future__ import annotations
 import bisect
 import math
 import threading
-from typing import Callable, Dict, Iterable, List, Optional, Tuple
+import time
+from typing import Callable, Dict, Iterable, List, Optional, Sequence, Tuple
 
 # Default latency buckets (milliseconds): 50 µs to 2.5 s, roughly 1-2.5-5
 # per decade — the GstShark/Prometheus-convention spacing.  Overridable
@@ -38,12 +39,15 @@ LATENCY_BUCKETS_MS = (
 
 
 def parse_buckets(value: str) -> Optional[Tuple[float, ...]]:
-    """``"0.1, 1; 10"`` → (0.1, 1.0, 10.0); empty/blank → None."""
+    """``"0.1, 1; 10"`` → (0.1, 1.0, 10.0); empty/blank → None.
+
+    Bounds are sorted AND deduplicated: a repeated bound would emit two
+    identical cumulative ``le`` series, which Prometheus rejects."""
     vals = [x.strip() for x in (value or "").replace(";", ",").split(",")
             if x.strip()]
     if not vals:
         return None
-    return tuple(sorted(float(x) for x in vals))
+    return tuple(sorted({float(x) for x in vals}))
 
 
 def configured_latency_buckets() -> Tuple[float, ...]:
@@ -71,6 +75,94 @@ def configured_latency_buckets() -> Tuple[float, ...]:
     return bounds if bounds else LATENCY_BUCKETS_MS
 
 _INF = math.inf
+
+# lazily bound obs.spans module — importing it at module top would cycle
+# (spans → tracers → metrics); bound on the first observe() that runs
+_spans = None
+
+
+def _span_context() -> Optional[Tuple[int, int]]:
+    """``(trace_id, span_id)`` of the live span on the calling thread, or
+    None — the exemplar stamp.  Cheap when tracing is off: one module-
+    global read plus an ``enabled`` check."""
+    global _spans
+    sp = _spans
+    if sp is None:
+        try:
+            from . import spans as sp
+        except ImportError:  # pragma: no cover — interpreter teardown
+            return None
+        _spans = sp
+    if not sp.enabled:
+        return None
+    return sp.current()
+
+
+def quantile_rank(sorted_values: Sequence, q: float):
+    """Ceil-based nearest-rank quantile of a pre-sorted sample:
+    ``s[max(0, ceil(q*n) - 1)]``, the smallest element ≥ ``q`` of the
+    sample.  (A floor rank returns the MAX for every n ≤ 1/(1-q),
+    biasing small-sample tails upward.)  Raises on an empty sample —
+    callers own their empty default."""
+    n = len(sorted_values)
+    if n == 0:
+        raise ValueError("quantile_rank of an empty sample")
+    return sorted_values[max(0, math.ceil(q * n) - 1)]
+
+
+def histogram_deltas(metric, prev: Dict[tuple, list],
+                     label_filter: Optional[Dict[str, str]] = None,
+                     ) -> List[Tuple[float, float]]:
+    """Per-bucket growth of a registry histogram since the last call
+    with the same ``prev`` dict — the *windowed* distribution a control
+    loop or burn-rate evaluation must react to, not the lifetime one.
+
+    ``prev`` maps child label tuple → that child's cumulative bucket
+    counts at the previous call and is updated in place; pass a throwaway
+    ``{}`` to read lifetime totals.  ``label_filter`` restricts to
+    children whose labels include every given ``name: value``.  Returns
+    sorted non-cumulative ``(le, grown)`` pairs, buckets that grew only
+    (``le`` is +Inf for the overflow bucket)."""
+    deltas: List[Tuple[float, float]] = []
+    if metric is None:
+        return deltas
+    for key, child in metric.children():
+        if label_filter:
+            labels = dict(zip(metric.labelnames, key))
+            if any(labels.get(k) != v for k, v in label_filter.items()):
+                continue
+        cumulative, _sum, _count = child.snapshot()
+        base = prev.get(key)
+        prev[key] = [acc for _b, acc in cumulative]
+        last = 0.0
+        for i, (bound, acc) in enumerate(cumulative):
+            prior = base[i] if base and i < len(base) else 0.0
+            grown = (acc - prior) - last
+            last = acc - prior
+            if grown > 0:
+                deltas.append((bound, grown))
+    deltas.sort()
+    return deltas
+
+
+def histogram_quantile(q: float, deltas: Sequence[Tuple[float, float]],
+                       inf_value: float = _INF,
+                       empty_value: float = 0.0) -> float:
+    """Nearest-rank quantile over per-bucket ``(le, count)`` deltas (as
+    produced by :func:`histogram_deltas`): the upper bound of the bucket
+    holding the q-th observation.  The +Inf bucket reports as
+    ``inf_value``; an empty window as ``empty_value``."""
+    deltas = sorted(deltas)
+    if not deltas:
+        return float(empty_value)
+    total = sum(n for _b, n in deltas)
+    need = math.ceil(total * q)
+    seen = 0.0
+    for bound, n in deltas:
+        seen += n
+        if seen >= need:
+            return float(inf_value) if bound == _INF else float(bound)
+    return float(deltas[-1][0])
 
 
 def _check_labels(labelnames: Tuple[str, ...], kv: Dict[str, str]) -> Tuple[str, ...]:
@@ -156,21 +248,36 @@ class _GaugeChild(_Value):
 
 
 class _HistogramChild:
-    __slots__ = ("_bounds", "_counts", "_sum", "_count", "_lock")
+    __slots__ = ("_bounds", "_counts", "_sum", "_count", "_lock",
+                 "_exemplars")
 
     def __init__(self, bounds: Tuple[float, ...]):
         self._bounds = bounds
         self._counts = [0] * (len(bounds) + 1)  # +1: the +Inf bucket
         self._sum = 0.0
         self._count = 0
+        # per-bucket last exemplar — (trace_id, value, unix ts) — stamped
+        # from the active span context so a scraped tail bucket links
+        # straight to its Perfetto trace; None until a traced observe hits
+        self._exemplars: List[Optional[Tuple[int, float, float]]] = \
+            [None] * (len(bounds) + 1)
         self._lock = threading.Lock()
 
     def observe(self, value: float) -> None:
         i = bisect.bisect_left(self._bounds, value)
+        ctx = _span_context()
         with self._lock:
             self._counts[i] += 1
             self._sum += value
             self._count += 1
+            if ctx is not None:
+                self._exemplars[i] = (ctx[0], value, time.time())
+
+    def exemplars(self) -> List[Optional[Tuple[int, float, float]]]:
+        """Per-bucket last exemplar, index-aligned with ``snapshot()``'s
+        cumulative pairs (the final slot is the +Inf bucket)."""
+        with self._lock:
+            return list(self._exemplars)
 
     @property
     def count(self) -> int:
@@ -219,7 +326,7 @@ class Histogram(_Metric):
         super().__init__(name, help, labelnames)
         if buckets is None:  # conf-driven default, resolved at creation
             buckets = configured_latency_buckets()
-        bounds = tuple(sorted(float(b) for b in buckets))
+        bounds = tuple(sorted({float(b) for b in buckets}))
         if not bounds:
             raise ValueError("histogram needs at least one bucket bound")
         self.buckets = bounds
@@ -251,6 +358,17 @@ class MetricsRegistry:
                 f"metric {name!r} already registered as {m.kind} "
                 f"with labels {m.labelnames}"
             )
+        buckets = kwargs.get("buckets")
+        if buckets is not None:
+            # silent bucket-schema drift corrupts every series already
+            # recorded; an explicit re-register with different bounds is
+            # the same contract violation as a label mismatch
+            bounds = tuple(sorted({float(b) for b in buckets}))
+            if bounds != m.buckets:
+                raise ValueError(
+                    f"metric {name!r} already registered with buckets "
+                    f"{m.buckets}, re-registered with {bounds}"
+                )
         return m
 
     def counter(self, name: str, help: str = "", labelnames=()) -> Counter:

@@ -186,6 +186,16 @@ def recorder_stats() -> dict:
     return _recorder.stats()
 
 
+def records_for_trace(trace_id: int,
+                      records: Optional[List[tuple]] = None) -> List[tuple]:
+    """Every retained record stamped with ``trace_id`` (complete spans,
+    instants, flow marks), time-ordered — the per-trace slice the tail-
+    forensics engine (:mod:`.forensics`) attributes and captures."""
+    if records is None:
+        records = snapshot()
+    return [r for r in records if r[6] == trace_id]
+
+
 # -- trace context -----------------------------------------------------------
 
 def new_trace_id() -> int:

@@ -16,7 +16,6 @@ tracer metrics.
 from __future__ import annotations
 
 import contextlib
-import math
 import threading
 from typing import Dict, List, Sequence
 
@@ -65,18 +64,18 @@ def summarize_ns(ns: Sequence[int]) -> Dict[str, float]:
     ``s[min(n-1, int(n*0.99))]`` floor-rank returned the MAX for every
     n ≤ 100, biasing small-sample p99 upward by the full tail.
     """
+    # the shared ceil-rank implementation (imported lazily: obs.tracers
+    # imports this module at its own import time, same as record())
+    from ..obs.metrics import quantile_rank
+
     s = sorted(ns)
     n = len(s)
-
-    def rank(q: float) -> int:
-        return s[max(0, math.ceil(q * n) - 1)]
-
     return {
         "count": n,
         "mean_ms": sum(s) / n / 1e6,
-        "p50_ms": rank(0.50) / 1e6,
-        "p90_ms": rank(0.90) / 1e6,
-        "p99_ms": rank(0.99) / 1e6,
+        "p50_ms": quantile_rank(s, 0.50) / 1e6,
+        "p90_ms": quantile_rank(s, 0.90) / 1e6,
+        "p99_ms": quantile_rank(s, 0.99) / 1e6,
         "min_ms": s[0] / 1e6,
         "max_ms": s[-1] / 1e6,
     }

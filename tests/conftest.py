@@ -68,6 +68,9 @@ def _reset_global_state():
 
     with obs_export._health_lock:  # no health verdict outlives its test
         obs_export._health_providers.clear()
+    from nnstreamer_tpu.obs import slo as obs_slo
+
+    obs_slo.reset()  # burn-rate engine singleton + its providers
     from nnstreamer_tpu import pool as _pool
 
     _pool.reset_default_pool()  # conf-driven singleton: re-read per test

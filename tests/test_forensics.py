@@ -1,0 +1,251 @@
+"""Tail forensics: outlier scoring against cost-model baselines, typed
+root-cause verdicts, the bounded capture gallery, and the live tracer."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu import Pipeline
+from nnstreamer_tpu.elements.filter import TensorFilter
+from nnstreamer_tpu.elements.sink import TensorSink
+from nnstreamer_tpu.elements.testsrc import DataSrc
+from nnstreamer_tpu.obs import spans as _spans
+from nnstreamer_tpu.obs.forensics import (
+    ForensicsEngine,
+    ForensicsTracer,
+    _Gallery,
+    baselines_from_cost_model,
+    verdict_legs_us,
+)
+from nnstreamer_tpu.obs.metrics import MetricsRegistry
+
+MS = 1e6  # ns per ms
+
+
+def rec(name, dur_ns, trace_id=0x5A, span_id=1, parent=0, args=None):
+    """One flight-layout complete-span record."""
+    return ("X", 0, dur_ns, 0, name, "t", trace_id, span_id, parent,
+            args or {})
+
+
+def outlier_records(trace_id=0x5A, device_ms=90.0):
+    """A joined trace whose device leg dominates: rtt=100ms envelope
+    serve=95ms, queue=2ms, device=``device_ms``."""
+    return [
+        rec("nnsq_rtt", 100 * MS, trace_id, span_id=1),
+        rec("nnsq_serve", 95 * MS, trace_id, span_id=2, parent=1),
+        rec("sched_wait", 2 * MS, trace_id, span_id=3, parent=2),
+        rec("device_invoke", device_ms * MS, trace_id, span_id=4, parent=2),
+    ]
+
+
+def leg(count, mean_us, m2=0.0):
+    return {"count": count, "mean_us": mean_us, "m2": m2, "ewma_us": mean_us}
+
+
+class TestLegMapping:
+    def test_verdict_vocabulary_folding(self):
+        legs = verdict_legs_us({
+            "queue": 2e6, "device": 90e6, "wire": 1e6,
+            "hop:f->g": 3e6, "dispatch": 2e6, "route_overhead": 1e6,
+            "unattributed": 5e5, "rtt": 100e6,  # rtt itself is not a leg
+        })
+        assert legs == {
+            "queue_wait": 2000.0, "device": 90000.0,
+            "wire": 4000.0,            # wire + hop:* folded together
+            "host_dispatch": 3000.0,   # dispatch + route_overhead
+            "unattributed": 500.0,
+        }
+
+    def test_cost_model_pooling_prefers_pipeline(self):
+        doc = {"stages": {
+            "a": {"pipeline": "p", "legs": {"device_exec": leg(10, 100.0)}},
+            "b": {"pipeline": "other",
+                  "legs": {"device_exec": leg(10, 9000.0)}},
+        }}
+        pooled = baselines_from_cost_model(doc, pipeline="p")
+        assert pooled["device"]["count"] == 10
+        assert pooled["device"]["mean_us"] == pytest.approx(100.0)
+        # no pipeline match -> pools everything
+        pooled_all = baselines_from_cost_model(doc, pipeline="absent")
+        assert pooled_all["device"]["count"] == 20
+
+
+class TestEngineScoring:
+    def engine(self, **kw):
+        kw.setdefault("pipeline", "p")
+        kw.setdefault("registry", MetricsRegistry())
+        kw.setdefault("cost_model", {})
+        kw.setdefault("gallery_dir", "")
+        kw.setdefault("min_samples", 8)
+        kw.setdefault("min_abs_us", 5.0)
+        return ForensicsEngine(**kw)
+
+    def test_warmup_then_outlier_verdict_names_device(self):
+        doc = {"stages": {"s": {"pipeline": "p", "legs": {
+            "device_exec": leg(100, 10_000.0)}}}}
+        eng = self.engine(cost_model=doc)
+        for _ in range(10):
+            assert eng.score_trace(0x1, 10 * MS) is None  # inliers
+        v = eng.score_trace(0x5A, 100 * MS, records=outlier_records())
+        assert v is not None
+        assert v["verdict"] == "device"
+        assert v["trace_id"] == "5a"
+        assert v["total_ms"] == pytest.approx(100.0)
+        # device excess is measured against the cost-model baseline
+        assert v["excess_ms"]["device"] < v["legs_ms"]["device"]
+        assert v["baseline_legs"]["device"]["count"] == 100
+        c = eng._outliers.labels(pipeline="p", leg="device")
+        assert c.value == 1
+        assert eng.summary()["outliers"] == {"device": 1}
+
+    def test_outliers_excluded_from_baseline(self):
+        """Slow must not become normal: the baseline mean stays at the
+        inlier level no matter how many outliers are scored."""
+        eng = self.engine()
+        for _ in range(20):
+            eng.score_trace(0x1, 10 * MS)
+        before = eng.summary()["baseline"]["total"]
+        for _ in range(50):
+            assert eng.score_trace(0x2, 500 * MS) is not None
+        after = eng.summary()["baseline"]["total"]
+        assert after["count"] == before["count"]
+        assert after["mean_us"] == pytest.approx(before["mean_us"])
+
+    def test_warming_never_flags(self):
+        eng = self.engine(min_samples=100)
+        assert eng.score_trace(0x1, 10_000 * MS) is None
+        assert eng.summary()["warming"] is True
+
+    def test_no_records_verdict_unattributed(self):
+        eng = self.engine()
+        for _ in range(10):
+            eng.score_trace(0x1, 10 * MS)
+        v = eng.score_trace(0x2, 200 * MS)  # no records, no fetch
+        assert v["verdict"] == "unattributed"
+
+    def test_fetch_lazy_only_on_outliers(self):
+        eng = self.engine()
+        calls = []
+
+        def fetch():
+            calls.append(1)
+            return outlier_records()
+
+        for _ in range(10):
+            eng.score_trace(0x1, 10 * MS, fetch=fetch)
+        assert not calls  # inliers never pay for a ring snapshot
+        v = eng.score_trace(0x5A, 100 * MS, fetch=fetch)
+        assert calls == [1]
+        assert v["verdict"] in ("device", "unattributed")
+
+    def test_gallery_capture_is_a_perfetto_doc(self, tmp_path):
+        reg = MetricsRegistry()
+        eng = self.engine(registry=reg, gallery_dir=str(tmp_path), keep=8,
+                          max_bytes=1 << 20)
+        for _ in range(10):
+            eng.score_trace(0x1, 10 * MS)
+        v = eng.score_trace(0x5A, 100 * MS, records=outlier_records())
+        assert v["capture"] and os.path.exists(v["capture"])
+        body = json.loads(open(v["capture"]).read())
+        assert body["kind"] == "forensic_capture"
+        assert body["verdict"] == v["verdict"]
+        names = {e["name"] for e in body["flight"]["traceEvents"]}
+        assert "device_invoke" in names
+        assert eng._captures.labels(pipeline="p").value == 1
+        assert eng.summary()["gallery"]["entries"] == 1
+
+
+class TestGalleryBounds:
+    def test_slowest_k_retained(self, tmp_path):
+        g = _Gallery(str(tmp_path), keep=3, max_bytes=0)
+        for i, ms in enumerate([50.0, 10.0, 90.0, 30.0, 70.0]):
+            g.add({"pipeline": "p", "trace_id": f"{i:x}",
+                   "total_ms": ms, "verdict": "device"},
+                  {"traceEvents": []})
+        s = g.summary()
+        assert s["entries"] == 3 and s["evicted"] == 2
+        kept = {json.load(open(os.path.join(str(tmp_path), f)))["total_ms"]
+                for f in os.listdir(str(tmp_path))}
+        assert kept == {50.0, 90.0, 70.0}  # slowest-K survive
+
+    def test_new_capture_may_fall_straight_out(self, tmp_path):
+        g = _Gallery(str(tmp_path), keep=1, max_bytes=0)
+        assert g.add({"pipeline": "p", "trace_id": "1", "total_ms": 90.0},
+                     {"traceEvents": []}) is not None
+        # slower entry already held: the new, faster one is the victim
+        assert g.add({"pipeline": "p", "trace_id": "2", "total_ms": 10.0},
+                     {"traceEvents": []}) is None
+        assert g.summary()["entries"] == 1
+
+    def test_byte_cap_evicts(self, tmp_path):
+        g = _Gallery(str(tmp_path), keep=100, max_bytes=400)
+        for i in range(6):
+            g.add({"pipeline": "p", "trace_id": f"{i:x}",
+                   "total_ms": float(i)}, {"traceEvents": []})
+        s = g.summary()
+        assert s["bytes"] <= 400 and s["evicted"] > 0
+        assert s["entries"] >= 1
+
+    def test_rescan_keeps_honoring_bound(self, tmp_path):
+        g1 = _Gallery(str(tmp_path), keep=2, max_bytes=0)
+        g1.add({"pipeline": "p", "trace_id": "1", "total_ms": 80.0},
+               {"traceEvents": []})
+        g1.add({"pipeline": "p", "trace_id": "2", "total_ms": 60.0},
+               {"traceEvents": []})
+        # a restarted process rescans its predecessor's captures
+        g2 = _Gallery(str(tmp_path), keep=2, max_bytes=0)
+        assert g2.summary()["entries"] == 2
+        g2.add({"pipeline": "p", "trace_id": "3", "total_ms": 70.0},
+               {"traceEvents": []})
+        s = g2.summary()
+        assert s["entries"] == 2
+        kept = {json.load(open(os.path.join(str(tmp_path), f)))["total_ms"]
+                for f in os.listdir(str(tmp_path))}
+        assert kept == {80.0, 70.0}
+
+
+class TestForensicsTracer:
+    def test_attach_by_name_and_outliers_counted(self, tmp_path):
+        """A pipeline with one artificially slow frame: the tracer's
+        cheap total gate flags it and the counter carries a verdict leg
+        (unattributed without spans/device tracing — acceptable; the CI
+        fleet path pins the 'device' verdict)."""
+        slow = {"n": 0}
+
+        def model(x):
+            slow["n"] += 1
+            if slow["n"] == 40:
+                import time as _t
+                _t.sleep(0.05)
+            return x * 2
+
+        reg = MetricsRegistry()
+        got = []
+        p = Pipeline(name="forensic_p")
+        src = p.add(DataSrc(
+            data=[np.zeros(4, np.float32) for _ in range(48)], name="s"))
+        filt = p.add(TensorFilter(framework="custom", model=model, name="f"))
+        sink = p.add(TensorSink(callback=got.append, name="out"))
+        p.link_chain(src, filt, sink)
+        tr = ForensicsTracer(registry=reg, cost_model={}, gallery_dir="",
+                             min_samples=16, min_abs_us=100.0)
+        p.attach_tracer(tr)
+        p.run(timeout=60)
+        assert len(got) == 48
+        summary = tr.summary()
+        assert summary["scored"] >= 40
+        assert sum(summary["outliers"].values()) >= 1
+        text_outliers = sum(
+            child.value for _key, child in
+            reg.get("nnstpu_tail_outliers_total").children())
+        assert text_outliers >= 1
+
+    def test_registered_in_tracer_registry(self):
+        from nnstreamer_tpu.obs.tracers import TRACERS, make_tracer
+
+        assert TRACERS["forensics"] is ForensicsTracer
+        tr = make_tracer("forensics", registry=MetricsRegistry())
+        assert isinstance(tr, ForensicsTracer)

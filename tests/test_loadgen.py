@@ -210,3 +210,63 @@ class TestDecodeScenario:
                      "max_broken_sessions": 0})
         assert not ok
         assert sum(1 for c in checks if not c["ok"]) == 2
+
+
+class TestTailForensicsUnderChaos:
+    """Satellite: a seeded ``invoke_delay`` chaos run through the real
+    2-worker fleet produces device-verdict outliers in the forensics
+    gallery, and the burn-rate engine fires on the run's histogram then
+    clears once the bad window drains."""
+
+    def test_invoke_delay_yields_device_verdicts_and_slo_cycle(
+            self, tmp_path, monkeypatch):
+        gdir = tmp_path / "gallery"
+        monkeypatch.setenv("NNSTPU_OBS_FORENSICS_DIR", str(gdir))
+        monkeypatch.setenv("NNSTPU_OBS_FORENSICS_MIN_SAMPLES", "24")
+        from nnstreamer_tpu import faults
+
+        faults.install(
+            "invoke_delay@filter:after=60,every=40,count=6,ms=80", seed=7)
+        try:
+            report = loadgen.run_scenario("ci-slo", seed=7,
+                                          duration_s=2.5)
+        finally:
+            faults.deactivate()
+        # the ledger stays exact even with the chaos engine stalling
+        # invokes mid-flight
+        assert report["ledger"]["exact"]
+        fx = report["forensics"]
+        assert fx["pipeline"] == "lg-ci-slo"
+        assert fx["scored"] > 24 and not fx["warming"]
+        assert fx["outliers"].get("device", 0) >= 1, fx["outliers"]
+        assert fx["gallery"]["entries"] >= 1
+        caps = sorted(gdir.glob("*.forensic.json"))
+        docs = [json.load(open(c)) for c in caps]
+        assert any(d["verdict"] == "device" for d in docs), \
+            [d["verdict"] for d in docs]
+        # every capture is a ready-to-open Perfetto doc for a real trace
+        dev = next(d for d in docs if d["verdict"] == "device")
+        names = {e["name"] for e in dev["flight"]["traceEvents"]}
+        assert "device_invoke" in names
+        assert any(e.get("args", {}).get("trace_id") == dev["trace_id"]
+                   for e in dev["flight"]["traceEvents"])
+
+        # burn-rate cycle over the same run's client-observed histogram:
+        # the injected 80ms stalls blow a 50ms@99.9% objective...
+        from nnstreamer_tpu.obs.metrics import REGISTRY
+        from nnstreamer_tpu.obs.slo import Objective, SloEngine
+
+        eng = SloEngine(
+            objectives=[Objective("lg", 50.0, 0.999,
+                                  labels={"pipeline": "lg-ci-slo"})],
+            registry=REGISTRY, fast_window_s=10.0, slow_window_s=60.0,
+            fast_burn=2.0, slow_burn=1.0, eval_interval_s=0.0)
+        eng.evaluate(now=0.0, force=True)
+        doc = eng.alerts_document(refresh=False)
+        assert doc["firing"] == ["lg"], doc["objectives"]["lg"]["windows"]
+        assert doc["objectives"]["lg"]["severity"] == "page"
+        # ...and the alert resolves once the bad samples age out
+        eng.evaluate(now=120.0, force=True)
+        doc = eng.alerts_document(refresh=False)
+        assert doc["firing"] == []
+        assert doc["objectives"]["lg"]["transitions"] == 2

@@ -631,3 +631,172 @@ class TestServingExport:
         finally:
             reg.remove_collector(handle)
             eng.stop()
+
+
+class TestHistogramHygiene:
+    """Satellites: duplicate bucket bounds collapse (a repeated bound
+    would emit two identical cumulative `le` series, which Prometheus
+    rejects) and re-registering with a DIFFERENT grid is an error, not a
+    silent divergence between declared and exported buckets."""
+
+    def test_duplicate_bounds_deduped(self):
+        from nnstreamer_tpu.obs.metrics import parse_buckets
+
+        reg = MetricsRegistry()
+        h = reg.histogram("h_ms", buckets=(5.0, 1.0, 5.0, 1.0))
+        assert h.buckets == (1.0, 5.0)
+        h.observe(3.0)
+        text = render_text(reg)
+        assert text.count('le="5"') == 1
+        assert parse_buckets("5, 1; 5,1") == (1.0, 5.0)
+
+    def test_bucket_drift_raises(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("h_ms", buckets=(1.0, 5.0))
+        # identical grid (any ordering/duplication) is idempotent
+        assert reg.histogram("h_ms", buckets=(5.0, 1.0, 5.0)) is h
+        assert reg.histogram("h_ms") is h  # None = accept existing
+        with pytest.raises(ValueError, match="buckets"):
+            reg.histogram("h_ms", buckets=(1.0, 2.0))
+
+
+class TestHistogramWindowHelpers:
+    """Satellite: the ONE shared windowed-delta/quantile implementation
+    (burn-rate engine, autoscaler, profiling all consume these)."""
+
+    def test_deltas_are_windowed_not_lifetime(self):
+        from nnstreamer_tpu.obs.metrics import histogram_deltas
+
+        reg = MetricsRegistry()
+        h = reg.histogram("h_ms", buckets=(10.0, 50.0), labelnames=("t",))
+        prev = {}
+        h.labels(t="a").observe(5.0)
+        h.labels(t="a").observe(100.0)
+        d1 = dict(histogram_deltas(h, prev))
+        assert d1[10.0] == 1 and d1[float("inf")] == 1
+        # second call sees only NEW observations (zero-growth buckets
+        # are elided)
+        h.labels(t="a").observe(30.0)
+        d2 = dict(histogram_deltas(h, prev))
+        assert d2 == {50.0: 1}
+
+    def test_label_filter_scopes_children(self):
+        from nnstreamer_tpu.obs.metrics import histogram_deltas
+
+        reg = MetricsRegistry()
+        h = reg.histogram("h_ms", buckets=(10.0,), labelnames=("t",))
+        h.labels(t="a").observe(5.0)
+        h.labels(t="b").observe(5.0)
+        assert sum(n for _b, n in histogram_deltas(h, {}, {"t": "a"})) == 1
+
+    def test_quantile_over_deltas(self):
+        from nnstreamer_tpu.obs.metrics import histogram_quantile
+
+        deltas = [(10.0, 90), (50.0, 9), (float("inf"), 1)]
+        assert histogram_quantile(0.50, deltas) == 10.0
+        assert histogram_quantile(0.95, deltas) == 50.0
+        assert histogram_quantile(0.999, deltas, inf_value=1e9) == 1e9
+        assert histogram_quantile(0.5, [], empty_value=-1.0) == -1.0
+
+
+class TestExemplars:
+    """Tentpole: per-bucket last-exemplar retention, stamped from the
+    active span context, exposed in OpenMetrics syntax on demand."""
+
+    def observe_traced(self, h, value):
+        from nnstreamer_tpu.obs import spans as _spans
+
+        tid = _spans.new_trace_id()
+        tok = _spans.span_begin(tid, 0)
+        try:
+            h.labels(pipeline="p").observe(value)
+        finally:
+            _spans.span_end(tok, "unit", "test")
+        return tid
+
+    def test_exemplar_stamped_from_live_span(self):
+        from nnstreamer_tpu.obs import spans as _spans
+
+        reg = MetricsRegistry()
+        h = reg.histogram("lat_ms", buckets=(1.0, 10.0),
+                          labelnames=("pipeline",))
+        _spans.enable()
+        try:
+            h.labels(pipeline="p").observe(0.5)  # no live span: no exemplar
+            tid = self.observe_traced(h, 99.0)   # lands in +Inf
+        finally:
+            _spans.reset()
+        ex = h.labels(pipeline="p").exemplars()
+        assert ex[0] is None  # enabled alone is not enough — span required
+        got_tid, value, ts = ex[2]
+        assert got_tid == tid and value == 99.0 and ts > 0
+
+    def test_no_exemplar_without_tracing(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("lat_ms", buckets=(1.0,))
+        h.observe(0.5)
+        assert h.labels().exemplars() == [None, None]
+
+    def test_openmetrics_exposition_golden(self):
+        from nnstreamer_tpu.obs import spans as _spans
+
+        reg = MetricsRegistry()
+        h = reg.histogram("lat_ms", "Latency", buckets=(1.0, 10.0),
+                          labelnames=("pipeline",))
+        _spans.enable()
+        try:
+            tid = self.observe_traced(h, 99.0)
+        finally:
+            _spans.reset()
+        plain = render_text(reg)
+        assert "# {" not in plain  # default exposition stays 0.0.4-clean
+        text = render_text(reg, exemplars=True)
+        line = next(l for l in text.splitlines() if 'le="+Inf"' in l)
+        assert line.startswith(
+            f'lat_ms_bucket{{pipeline="p",le="+Inf"}} 1 '
+            f'# {{trace_id="{tid:x}"}} 99 ')
+        # buckets that never saw a traced observe stay exemplar-free
+        assert '# {' not in next(
+            l for l in text.splitlines() if 'le="1"' in l)
+
+    def test_federation_preserves_exemplar(self):
+        from nnstreamer_tpu.obs import spans as _spans
+        from nnstreamer_tpu.obs.collector import federate_metrics
+
+        reg = MetricsRegistry()
+        h = reg.histogram("lat_ms", "Latency", buckets=(1.0,),
+                          labelnames=("pipeline",))
+        _spans.enable()
+        try:
+            tid = self.observe_traced(h, 99.0)
+        finally:
+            _spans.reset()
+        merged = federate_metrics(
+            {"w0": render_text(reg, exemplars=True)})
+        line = next(l for l in merged.splitlines() if 'le="+Inf"' in l)
+        assert line.startswith('lat_ms_bucket{worker="w0",pipeline="p"')
+        assert f'# {{trace_id="{tid:x}"}} 99 ' in line
+
+    def test_exemplar_trace_joins_merged_perfetto_doc(self):
+        """The operator workflow the tentpole exists for: scrape an
+        exemplar off a tail bucket, find that trace in the collector's
+        merged Perfetto document."""
+        from nnstreamer_tpu.obs import spans as _spans
+        from nnstreamer_tpu.obs.collector import TraceCollector
+
+        reg = MetricsRegistry()
+        h = reg.histogram("lat_ms", buckets=(1.0,),
+                          labelnames=("pipeline",))
+        col = TraceCollector()
+        col.add_local("unit")
+        _spans.enable()
+        try:
+            tid = self.observe_traced(h, 99.0)
+            doc = col.chrome_trace()
+        finally:
+            _spans.reset()
+        got_tid, _v, _ts = h.labels(pipeline="p").exemplars()[-1]
+        assert got_tid == tid
+        ids = {e.get("args", {}).get("trace_id")
+               for e in doc["traceEvents"] if e.get("ph") == "X"}
+        assert f"{tid:x}" in ids

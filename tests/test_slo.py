@@ -1,0 +1,281 @@
+"""SLO burn-rate engine: objective grammar, multi-window fire/resolve,
+the /alerts endpoint + healthz degradation, and fleet-wide federation."""
+
+import json
+import urllib.request
+
+import pytest
+
+from nnstreamer_tpu.obs import hooks
+from nnstreamer_tpu.obs import slo as slo_mod
+from nnstreamer_tpu.obs import spans as _spans
+from nnstreamer_tpu.obs.collector import merge_alerts
+from nnstreamer_tpu.obs.export import (
+    MetricsServer,
+    alerts_document,
+    health_document,
+)
+from nnstreamer_tpu.obs.metrics import MetricsRegistry
+from nnstreamer_tpu.obs.slo import Objective, SloEngine, parse_objectives
+
+
+class TestObjectiveGrammar:
+    def test_full_spec(self):
+        objs = parse_objectives(
+            "e2e:<50ms@0.999; tenantA:{tenant=A,pipeline=p}<25ms@0.99;"
+            "dev:nnstpu_device_ms{}<7.5ms@0.9")
+        assert [o.name for o in objs] == ["e2e", "tenantA", "dev"]
+        assert objs[0].metric == "nnstpu_e2e_latency_ms"  # the default
+        assert objs[0].bound_ms == 50.0 and objs[0].target == 0.999
+        assert objs[0].budget == pytest.approx(0.001)
+        assert objs[1].labels == {"tenant": "A", "pipeline": "p"}
+        assert objs[2].metric == "nnstpu_device_ms"
+        assert objs[2].bound_ms == 7.5
+        assert parse_objectives("") == []
+        assert parse_objectives(" ; ") == []
+
+    @pytest.mark.parametrize("bad", [
+        "no-colon<50ms@0.9x",          # unparseable tail
+        "e2e:<50ms@1.5",               # target out of (0,1)
+        "e2e:<0ms@0.9",                # bound must be positive
+        "e2e:{tenant}<50ms@0.9",       # label pair without '='
+        "<50ms@0.9",                   # missing name
+        "e2e:50ms@0.9",                # missing '<'
+    ])
+    def test_rejects_malformed(self, bad):
+        with pytest.raises(ValueError, match="objective"):
+            parse_objectives(bad)
+
+    def test_spec_roundtrip(self):
+        o = Objective("e2e", 50.0, 0.99, labels={"tenant": "A"})
+        assert o.spec() == {"metric": "nnstpu_e2e_latency_ms",
+                            "labels": {"tenant": "A"},
+                            "bound_ms": 50.0, "target": 0.99}
+
+
+def make_engine(reg, **kw):
+    kw.setdefault("objectives", [Objective("e2e", 50.0, 0.9)])
+    kw.setdefault("fast_window_s", 10.0)
+    kw.setdefault("slow_window_s", 60.0)
+    kw.setdefault("fast_burn", 5.0)
+    kw.setdefault("slow_burn", 2.0)
+    kw.setdefault("eval_interval_s", 0.0)
+    return SloEngine(registry=reg, **kw)
+
+
+def hist(reg):
+    return reg.histogram("nnstpu_e2e_latency_ms", "e2e",
+                         labelnames=("pipeline", "src", "sink"),
+                         buckets=(10.0, 50.0, 100.0))
+
+
+class TestBurnRate:
+    def test_fire_page_then_resolve(self):
+        reg = MetricsRegistry()
+        h = hist(reg)
+        eng = make_engine(reg)
+        alerts = []
+
+        def on_alert(*a):
+            alerts.append(a)
+
+        hooks.connect("alert", on_alert)
+        try:
+            for _ in range(20):
+                h.labels(pipeline="p", src="t", sink="k").observe(5.0)
+            eng.evaluate(now=0.0, force=True)
+            doc = eng.alerts_document(refresh=False)
+            assert doc["firing"] == []
+            assert doc["objectives"]["e2e"]["state"] == "ok"
+
+            # 100% bad over the fast window: burn = 1.0/0.1 = 10x >= 5
+            for _ in range(20):
+                h.labels(pipeline="p", src="t", sink="k").observe(500.0)
+            eng.evaluate(now=5.0, force=True)
+            doc = eng.alerts_document(refresh=False)
+            assert doc["firing"] == ["e2e"]
+            e = doc["objectives"]["e2e"]
+            assert e["state"] == "firing" and e["severity"] == "page"
+            assert e["windows"]["fast"]["burn"] >= 5.0
+            assert reg.get("nnstpu_slo_alerts_firing").labels(
+                objective="e2e").value == 1.0
+
+            # bad samples age out of both windows -> resolved
+            for _ in range(5):
+                h.labels(pipeline="p", src="t", sink="k").observe(5.0)
+            eng.evaluate(now=100.0, force=True)
+            doc = eng.alerts_document(refresh=False)
+            assert doc["firing"] == []
+            e = doc["objectives"]["e2e"]
+            assert e["state"] == "ok" and e["transitions"] == 2
+            assert [a[1] for a in alerts] == ["firing", "resolved"]
+            assert alerts[0][0] == "e2e" and alerts[0][2] == "page"
+            tr = reg.get("nnstpu_slo_alert_transitions_total")
+            assert tr.labels(objective="e2e", state="firing").value == 1
+            assert tr.labels(objective="e2e", state="resolved").value == 1
+        finally:
+            hooks.disconnect("alert", on_alert)
+
+    def test_slow_window_alone_is_a_ticket(self):
+        reg = MetricsRegistry()
+        h = hist(reg)
+        eng = make_engine(reg, fast_burn=1000.0)  # fast can never fire
+        for _ in range(10):
+            h.labels(pipeline="p", src="t", sink="k").observe(500.0)
+        eng.evaluate(now=0.0, force=True)
+        e = eng.alerts_document(refresh=False)["objectives"]["e2e"]
+        assert e["state"] == "firing" and e["severity"] == "ticket"
+
+    def test_label_filter_scopes_objective(self):
+        reg = MetricsRegistry()
+        h = hist(reg)
+        eng = make_engine(reg, objectives=[Objective(
+            "tenantA", 50.0, 0.9, labels={"src": "A"})])
+        # tenant B melts down; tenant A stays golden
+        for _ in range(50):
+            h.labels(pipeline="p", src="B", sink="k").observe(500.0)
+        for _ in range(10):
+            h.labels(pipeline="p", src="A", sink="k").observe(5.0)
+        eng.evaluate(now=0.0, force=True)
+        assert eng.alerts_document(refresh=False)["firing"] == []
+
+    def test_eval_rate_limited(self):
+        reg = MetricsRegistry()
+        hist(reg)
+        eng = make_engine(reg, eval_interval_s=5.0)
+        eng.evaluate(now=0.0, force=True)
+        ring0 = len(eng._states[0].ring)
+        eng.evaluate(now=1.0)  # inside the interval: a no-op
+        assert len(eng._states[0].ring) == ring0
+        eng.evaluate(now=6.0)
+        assert len(eng._states[0].ring) == ring0 + 1
+
+    def test_transition_emits_perfetto_instant(self):
+        reg = MetricsRegistry()
+        h = hist(reg)
+        eng = make_engine(reg)
+        _spans.enable()
+        try:
+            for _ in range(10):
+                h.labels(pipeline="p", src="t", sink="k").observe(500.0)
+            eng.evaluate(now=0.0, force=True)
+            names = [r[4] for r in _spans.snapshot()]
+            assert "alert:e2e" in names
+        finally:
+            _spans.reset()
+
+    def test_degraded_reason(self):
+        reg = MetricsRegistry()
+        h = hist(reg)
+        eng = make_engine(reg)
+        assert eng.degraded_reason() == ""
+        for _ in range(10):
+            h.labels(pipeline="p", src="t", sink="k").observe(500.0)
+        eng.evaluate(now=0.0, force=True)
+        assert "slo e2e burning (page" in eng.degraded_reason()
+
+
+class TestInstallAndEndpoint:
+    def test_install_wires_alerts_healthz_and_scrape(self):
+        reg = MetricsRegistry()
+        h = hist(reg)
+        eng = make_engine(reg).install()
+        try:
+            assert slo_mod.current_engine() is eng
+            for _ in range(10):
+                h.labels(pipeline="p", src="t", sink="k").observe(500.0)
+            doc = alerts_document()  # the export-module provider path
+            assert doc["firing"] == ["e2e"]
+            hd = health_document()
+            assert hd["status"] == "degraded"
+            assert "slo e2e burning" in hd["degraded"].get("slo", "")
+        finally:
+            eng.uninstall()
+        assert slo_mod.current_engine() is None
+        assert alerts_document() == {"objectives": {}, "firing": []}
+
+    def test_alerts_endpoint_over_http(self):
+        reg = MetricsRegistry()
+        h = hist(reg)
+        eng = make_engine(reg).install()
+        srv = MetricsServer(port=0, registry=reg)
+        srv.start()
+        try:
+            for _ in range(10):
+                h.labels(pipeline="p", src="t", sink="k").observe(500.0)
+            url = f"http://127.0.0.1:{srv.port}/alerts"
+            body = json.loads(urllib.request.urlopen(url).read())
+            assert body["firing"] == ["e2e"]
+            assert body["objectives"]["e2e"]["windows"]["fast"]["total"] == 10
+        finally:
+            srv.stop()
+            eng.uninstall()
+
+    def test_ensure_engine_from_conf(self, monkeypatch):
+        monkeypatch.setenv("NNSTPU_SLO_OBJECTIVES", "e2e:<50ms@0.99")
+        reg = MetricsRegistry()
+        hist(reg)
+        eng = slo_mod.ensure_engine(reg)
+        try:
+            assert eng is not None
+            assert [o.name for o in eng.objectives] == ["e2e"]
+            assert slo_mod.ensure_engine(reg) is eng  # singleton
+        finally:
+            slo_mod.reset()
+
+    def test_ensure_engine_bad_spec_disables(self, monkeypatch):
+        monkeypatch.setenv("NNSTPU_SLO_OBJECTIVES", "not a spec")
+        assert slo_mod.ensure_engine(MetricsRegistry()) is None
+        assert slo_mod.current_engine() is None
+
+
+class TestFederation:
+    def worker_doc(self, good, total, firing, target=0.9):
+        burn = ((total - good) / total) / (1 - target) if total else 0.0
+        return {"objectives": {"e2e": {
+            "metric": "nnstpu_e2e_latency_ms", "labels": {},
+            "bound_ms": 50.0, "target": target,
+            "state": "firing" if firing else "ok",
+            "severity": "page" if firing else "",
+            "transitions": 1 if firing else 0,
+            "windows": {
+                "fast": {"window_s": 10.0, "good": good, "total": total,
+                         "burn": round(burn, 4), "threshold": 5.0},
+                "slow": {"window_s": 60.0, "good": good, "total": total,
+                         "burn": round(burn, 4), "threshold": 2.0},
+            }}},
+            "firing": ["e2e"] if firing else []}
+
+    def test_pooled_burn_recomputed_from_counts(self):
+        # one burning worker, one golden: pooled fast burn is the
+        # fleet-wide bad fraction over budget, not either worker's view
+        merged = merge_alerts({
+            "w0": self.worker_doc(good=0, total=100, firing=True),
+            "w1": self.worker_doc(good=100, total=100, firing=False),
+        })
+        e = merged["objectives"]["e2e"]
+        assert e["windows"]["fast"]["total"] == 200
+        assert e["windows"]["fast"]["good"] == 100
+        assert e["windows"]["fast"]["burn"] == pytest.approx(5.0)
+        assert e["workers"] == ["w0", "w1"]
+        assert e["workers_firing"] == ["w0"]
+        assert merged["firing"] == ["e2e"]
+        assert merged["workers"] == ["w0", "w1"]
+
+    def test_fleet_can_fire_when_no_worker_does(self):
+        # each worker burns just under its local threshold; pooled counts
+        # push the fleet over (the reason federation exists)
+        merged = merge_alerts({
+            "w0": self.worker_doc(good=40, total=100, firing=False),
+            "w1": self.worker_doc(good=40, total=100, firing=False),
+        })
+        e = merged["objectives"]["e2e"]
+        assert e["windows"]["fast"]["burn"] == pytest.approx(6.0)
+        assert e["state"] == "firing"
+        assert merged["firing"] == ["e2e"]
+
+    def test_all_quiet(self):
+        merged = merge_alerts({
+            "w0": self.worker_doc(good=100, total=100, firing=False)})
+        assert merged["firing"] == []
+        assert merged["objectives"]["e2e"]["state"] == "ok"

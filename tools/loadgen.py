@@ -66,6 +66,7 @@ from nnstreamer_tpu.elements.query import (  # noqa: E402
     recv_tensors_ex,
     send_tensors,
 )
+from nnstreamer_tpu.obs import forensics as _forensics  # noqa: E402
 from nnstreamer_tpu.obs import spans as _spans  # noqa: E402
 from nnstreamer_tpu.obs.collector import (  # noqa: E402
     TraceCollector,
@@ -267,7 +268,8 @@ class LoadGen:
     def __init__(self, query_addr: Tuple[str, int],
                  tenants: List[dict], duration_s: float, seed: int = 7,
                  decode_addr: Optional[Tuple[str, int]] = None,
-                 max_workers: int = 64, request_timeout_s: float = 30.0):
+                 max_workers: int = 64, request_timeout_s: float = 30.0,
+                 metric_pipeline: str = "loadgen"):
         self.query_addr = query_addr
         self.decode_addr = decode_addr
         self.tenants = tenants
@@ -279,6 +281,20 @@ class LoadGen:
         self._rec_lock = threading.Lock()
         self._pools: Dict[str, _ConnPool] = {}
         self.t0_ns = 0
+        # client-observed round-trip latency into the same registry
+        # histogram LatencyTracer feeds (sink="client" disambiguates),
+        # observed INSIDE the rtt span so exemplars carry the trace id —
+        # the series the SLO burn-rate engine (obs/slo.py) evaluates
+        self.metric_pipeline = str(metric_pipeline)
+        try:
+            from nnstreamer_tpu.obs.metrics import REGISTRY as _registry
+
+            self._lat_hist = _registry.histogram(
+                "nnstpu_e2e_latency_ms",
+                "End-to-end per-frame source->sink latency (milliseconds)",
+                labelnames=("pipeline", "src", "sink"))
+        except ValueError:  # foreign registration; loadgen metrics are optional
+            self._lat_hist = None
 
     def _pool(self, tenant: str, decode: bool) -> _ConnPool:
         key = f"{tenant}:{'d' if decode else 'q'}"
@@ -334,14 +350,25 @@ class LoadGen:
                 send_tensors(sock, tensors, pts, trace=(tid, tok[0]),
                              tenant=tenant)
                 outs, _, _, _ = recv_tensors_ex(sock)
+                # observe while the rtt span is still current so the
+                # histogram exemplar is stamped with this trace id
+                self._observe_latency(
+                    tenant, (_spans.now_ns() - tok[1]) / 1e6)
             finally:
                 _spans.span_end(tok, "nnsq_rtt", "query",
                                 args={"tenant": tenant})
         else:
             tid = zlib.crc32(os.urandom(8))
+            t0 = _spans.now_ns()
             send_tensors(sock, tensors, pts, trace=(tid, 0), tenant=tenant)
             outs, _, _, _ = recv_tensors_ex(sock)
+            self._observe_latency(tenant, (_spans.now_ns() - t0) / 1e6)
         return tid, outs
+
+    def _observe_latency(self, tenant: str, ms: float) -> None:
+        if self._lat_hist is not None:
+            self._lat_hist.labels(pipeline=self.metric_pipeline,
+                                  src=tenant, sink="client").observe(ms)
 
     def _run_query(self, tenant: dict, wl: Workload, t_sched_ns: int,
                    seq: int) -> None:
@@ -670,7 +697,8 @@ def build_report(records: List[dict], duration_s: float, t0_ns: int,
                  tenants_cfg: List[dict], seed: int, scenario: str = "",
                  server_stats: Optional[dict] = None,
                  collector: Optional[TraceCollector] = None,
-                 windows: int = 6) -> dict:
+                 windows: int = 6,
+                 forensics_engine=None) -> dict:
     """The machine-readable artifact: per-tenant SLO stats, p50/p99/p99.9
     vs offered load, the exact ledger, and per-trace latency attribution
     joined via NNSQ trace ids."""
@@ -844,8 +872,13 @@ def build_report(records: List[dict], duration_s: float, t0_ns: int,
                 recs = index.get(tid)
                 if recs:
                     hit = True
-                    for k, v in attribute_trace(recs).items():
+                    tlegs = attribute_trace(recs)
+                    for k, v in tlegs.items():
                         legs[k] = legs.get(k, 0.0) + v
+                    if forensics_engine is not None:
+                        forensics_engine.score_trace(
+                            tid, int(tlegs.get("rtt") or _latency_ns(r)),
+                            records=recs)
             if not hit:
                 attribution["client_only"] += 1
                 continue
@@ -893,6 +926,8 @@ def build_report(records: List[dict], duration_s: float, t0_ns: int,
         "scale_events": scale_events,
         "fleet": fleet_range,
         "attribution": attribution,
+        "forensics": (forensics_engine.summary()
+                      if forensics_engine is not None else {}),
         "server": server_stats or {},
     }
 
@@ -1200,15 +1235,21 @@ def run_scenario(name: str, seed: int = 7,
     try:
         lg = LoadGen(fleet.query_addr, sc["tenants"], duration,
                      seed=seed, decode_addr=fleet.decode_addr,
-                     max_workers=max_workers)
+                     max_workers=max_workers, metric_pipeline=f"lg-{name}")
         if warm:
             _warm(fleet, sc["tenants"], d_in)
             _spans.clear()  # warmup spans out of the report
         records = lg.run(d_in=d_in)
+        # tail forensics rides along when a gallery dir is configured:
+        # every joined trace is scored against the cost-model baseline
+        fengine = None
+        if _forensics.configured_dir():
+            fengine = _forensics.ForensicsEngine(pipeline=f"lg-{name}")
         report = build_report(
             records, duration, lg.t0_ns, sc["tenants"], seed,
             scenario=name, server_stats=fleet.stats(),
-            collector=collector, windows=windows)
+            collector=collector, windows=windows,
+            forensics_engine=fengine)
         report["slo_spec"] = sc.get("slo", {})
         if sc.get("slo"):
             ok, checks = check_slo(report, sc["slo"])
@@ -1345,9 +1386,12 @@ def main(argv=None) -> int:
                      seed=args.seed, decode_addr=daddr,
                      max_workers=args.max_workers)
         records = lg.run(replay=replay)
+        fengine = (_forensics.ForensicsEngine(pipeline="loadgen")
+                   if _forensics.configured_dir() else None)
         report = build_report(records, duration, lg.t0_ns, tenants,
                               args.seed, scenario="",
-                              collector=collector, windows=args.windows)
+                              collector=collector, windows=args.windows,
+                              forensics_engine=fengine)
 
     _print_summary(report)
     if args.out:

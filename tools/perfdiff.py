@@ -51,9 +51,9 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from nnstreamer_tpu.obs import costmodel  # noqa: E402
 from nnstreamer_tpu.obs.metrics import REGISTRY  # noqa: E402
 
-DEFAULT_SIGMAS = 3.0
-DEFAULT_MIN_REL = 0.10    # 10% floor: sub-noise-floor deltas stay flat
-DEFAULT_MIN_ABS_US = 5.0  # µs floor: scheduler jitter on tiny legs
+DEFAULT_SIGMAS = costmodel.BAND_SIGMAS
+DEFAULT_MIN_REL = costmodel.BAND_MIN_REL
+DEFAULT_MIN_ABS_US = costmodel.BAND_MIN_ABS_US
 
 
 def _regression_counter(registry=None):
@@ -67,13 +67,11 @@ def _regression_counter(registry=None):
 def stage_band_us(leg_stat: dict, sigmas: float = DEFAULT_SIGMAS,
                   min_rel: float = DEFAULT_MIN_REL,
                   min_abs_us: float = DEFAULT_MIN_ABS_US) -> float:
-    """Noise band (µs) for one persisted stage-leg aggregate."""
-    mean = float(leg_stat.get("mean_us") or 0.0)
-    band = max(min_rel * abs(mean), min_abs_us)
-    std = costmodel.leg_std_us(leg_stat)
-    if std is not None:
-        band = max(band, sigmas * std)
-    return band
+    """Noise band (µs) for one persisted stage-leg aggregate — the one
+    implementation lives in :func:`costmodel.leg_band_us` (forensics
+    scores outliers with the same band)."""
+    return costmodel.leg_band_us(leg_stat, sigmas=sigmas, min_rel=min_rel,
+                                 min_abs_us=min_abs_us)
 
 
 def diff_cost_models(baseline: dict, current: dict,

@@ -1907,6 +1907,113 @@ print(f"SLO gate OK: flood shed {flood['typed_total']} typed of "
       f"(queue/device/serve/route/wire)")
 PY
 
+run_step "Forensics smoke (seeded invoke_delay chaos: device verdicts in the gallery, p99.9 exemplar joins its flight dump, /alerts fires then resolves, ledger exact)" \
+  python - <<'PY'
+# Tail-forensics end-to-end (ISSUE 18): seeded invoke_delay@filter
+# chaos under the ci-slo loadgen fleet must produce (a) >=1 gallery
+# capture whose typed verdict is `device` — the cost-model root-cause
+# chain working against a known-injected device stall; (b) the scraped
+# p99.9 exemplar's trace id present in a captured flight dump — the
+# scrape->trace join the exemplars exist for; (c) the SLO burn-rate
+# alert firing on the run's histogram and resolving once the bad
+# window drains; (d) an exact ledger — forensics must observe, never
+# perturb.
+import json
+import os
+import shutil
+import sys
+import time
+import urllib.request
+
+sys.path.insert(0, "tools")
+
+GDIR = "/tmp/ci_forensics"
+shutil.rmtree(GDIR, ignore_errors=True)
+os.environ["NNSTPU_OBS_FORENSICS_DIR"] = GDIR
+os.environ["NNSTPU_OBS_FORENSICS_MIN_SAMPLES"] = "24"
+os.environ["NNSTPU_SLO_OBJECTIVES"] = "lgci:{pipeline=lg-ci-slo}<50ms@0.999"
+os.environ["NNSTPU_SLO_FAST_WINDOW_S"] = "2"
+os.environ["NNSTPU_SLO_SLOW_WINDOW_S"] = "4"
+os.environ["NNSTPU_SLO_FAST_BURN"] = "2"
+os.environ["NNSTPU_SLO_SLOW_BURN"] = "1"
+os.environ["NNSTPU_SLO_EVAL_INTERVAL_S"] = "0"
+
+import loadgen  # noqa: E402
+from nnstreamer_tpu import faults  # noqa: E402
+from nnstreamer_tpu.obs.export import MetricsServer  # noqa: E402
+from nnstreamer_tpu.obs.metrics import REGISTRY  # noqa: E402
+
+faults.install("invoke_delay@filter:after=60,every=40,count=6,ms=80",
+               seed=7)
+try:
+    report = loadgen.run_scenario("ci-slo", seed=7, duration_s=2.5)
+finally:
+    faults.deactivate()
+
+# (d) ledger exact under chaos
+assert report["ledger"]["exact"], report["ledger"]
+
+# (a) device verdicts in the bounded gallery
+fx = report["forensics"]
+assert fx["scored"] > 24 and not fx["warming"], fx
+assert fx["outliers"].get("device", 0) >= 1, fx["outliers"]
+docs = [json.load(open(os.path.join(GDIR, f)))
+        for f in sorted(os.listdir(GDIR)) if f.endswith(".forensic.json")]
+dev = [d for d in docs if d["verdict"] == "device"]
+assert dev, [d["verdict"] for d in docs]
+assert fx["gallery"]["entries"] == len(docs) > 0, fx["gallery"]
+
+# (b) the p99.9 exemplar: highest non-empty bucket's exemplar across
+# the run's histogram children must name a trace whose flight dump was
+# captured
+hist = REGISTRY.get("nnstpu_e2e_latency_ms")
+best = None  # (bucket_index, value, trace_id)
+for key, child in hist.children():
+    if key and key[0] != "lg-ci-slo":
+        continue
+    for i, ex in enumerate(child.exemplars()):
+        if ex is not None and (best is None or (i, ex[1]) >
+                               (best[0], best[1])):
+            best = (i, ex[1], ex[0])
+assert best is not None, "no exemplar stamped"
+tail_tid = f"{best[2]:x}"
+captured_tids = {d["trace_id"] for d in docs}
+assert tail_tid in captured_tids, (tail_tid, captured_tids)
+cap = next(d for d in docs if d["trace_id"] == tail_tid)
+assert any(e.get("args", {}).get("trace_id") == tail_tid
+           for e in cap["flight"]["traceEvents"]), "flight dump empty"
+
+# (c) burn-rate alert: the server's scrape-time engine sees the run's
+# bad deltas at first /alerts, then resolves once the windows drain
+srv = MetricsServer(port=0, registry=REGISTRY).start()
+try:
+    url = f"http://127.0.0.1:{srv.port}/alerts"
+    doc = json.loads(urllib.request.urlopen(url).read())
+    assert doc["firing"] == ["lgci"], doc
+    assert doc["objectives"]["lgci"]["severity"] == "page", doc
+    deadline = time.time() + 15
+    while True:
+        time.sleep(1.0)
+        doc = json.loads(urllib.request.urlopen(url).read())
+        if not doc["firing"]:
+            break
+        assert time.time() < deadline, f"alert never resolved: {doc}"
+    assert doc["objectives"]["lgci"]["transitions"] == 2, doc
+    text = urllib.request.urlopen(
+        f"http://127.0.0.1:{srv.port}/metrics?exemplars=1"
+    ).read().decode()
+    assert f'# {{trace_id="{tail_tid}"}}' in text
+    assert ('nnstpu_slo_alert_transitions_total{'
+            'objective="lgci",state="resolved"} 1') in text
+finally:
+    srv.stop()
+
+print(f"forensics smoke OK: {fx['outliers']} outliers, "
+      f"{len(docs)} captures ({len(dev)} device-verdict), p99.9 exemplar "
+      f"{tail_tid} joined its flight dump, alert fired (page) and "
+      f"resolved, ledger exact")
+PY
+
 run_step "Bench smoke (final JSON line parses, rc=0)" \
   bash -c '
     env BENCH_FRAMES=10 BENCH_QUANT_FRAMES=4 BENCH_BASELINE_FRAMES=3 \
