@@ -299,7 +299,7 @@ def pin_cpu():
 
 def run_pipeline_fps(framework, model, frames, warmup=3, normalize=True,
                      decoder=None, custom="", accel=True, timeout_s=600,
-                     upload=False):
+                     upload=False, pipelined=True):
     """Stream frames through datasrc → transform(normalize) → tensor_filter
     [→ queue → tensor_decoder] → sink; frames/sec.  On the jax path the
     transform fuses into the model's XLA program, so raw uint8 crosses
@@ -308,8 +308,11 @@ def run_pipeline_fps(framework, model, frames, warmup=3, normalize=True,
     frame N's device result runs in its own thread while the source thread
     dispatches frame N+1 (the reference's queue-element pipelining;
     without it, a host decoder serializes the stream at one full device
-    round trip per frame).  ``accel=False`` keeps the normalize on host
-    numpy (the CPU-baseline configuration)."""
+    round trip per frame).  ``pipelined=False`` drops that queue — the
+    serialized chain the segment.ab leg measures, where the host decode
+    sits between device programs and its dead time shows up as
+    ``device_idle{reason=host_dispatch}`` spans.  ``accel=False`` keeps
+    the normalize on host numpy (the CPU-baseline configuration)."""
     from nnstreamer_tpu import Pipeline
     from nnstreamer_tpu.elements.decoder import TensorDecoder
     from nnstreamer_tpu.elements.filter import TensorFilter
@@ -350,7 +353,8 @@ def run_pipeline_fps(framework, model, frames, warmup=3, normalize=True,
             from nnstreamer_tpu.elements.queue import Queue
 
             mode, options = decoder
-            chain.append(p.add(Queue(max_size_buffers=64)))
+            if pipelined:
+                chain.append(p.add(Queue(max_size_buffers=64)))
             chain.append(p.add(TensorDecoder(mode=mode, **options)))
         chain.append(p.add(TensorSink(callback=sink_cb)))
         p.link_chain(*chain)
@@ -2447,6 +2451,73 @@ def main(standalone=False):
             log(f"# config2c cascade dynbatch fps: {cd_fps:.2f} "
                 f"({cd_batches} invokes / {n_casc} frames)")
 
+    # -- segment.ab: whole-segment compilation on vs off -------------------
+    # The SAME config2-shape SSD stream (fused decode head + fused-ssd
+    # decoder) twice: stock graph vs one device program per
+    # run-to-completion region (graph/segments.py — the decoder's
+    # quantize+NMS folds into the filter's XLA program).  The device lane
+    # rides both runs with a lowered idle-gap threshold so host-dispatch
+    # starvation (device_idle{reason=host_dispatch}) is priced per frame
+    # — the overhead the segment fold exists to collapse.
+    def leg_segment_ab():
+        from nnstreamer_tpu.models import ssd_mobilenet
+        from nnstreamer_tpu.obs import spans as obs_spans
+
+        n_seg = int(os.environ.get(
+            "BENCH_SEGMENT_FRAMES", os.environ.get("BENCH_SSD_FRAMES", "100")))
+        if n_seg <= 1:
+            raise _Skipped("skipped (<2 frames)")
+        ssd = ssd_mobilenet.build(num_labels=91, image_size=300,
+                                  fused_decode=100)
+        img300s = rng.integers(0, 256, (300, 300, 3)).astype(np.uint8)
+        saved = {k: os.environ.get(k) for k in
+                 ("NNSTPU_SEGMENT_ENABLED", "NNSTPU_TRACERS",
+                  "NNSTPU_OBS_DEVICE_IDLE_GAP_MS")}
+        os.environ["NNSTPU_TRACERS"] = "device"
+        # default 5 ms hides sub-ms dispatch gaps; price everything ≥50 µs
+        os.environ["NNSTPU_OBS_DEVICE_IDLE_GAP_MS"] = "0.05"
+        seg = {"frames": n_seg}
+        try:
+            for variant, enabled in (("unfused", "0"), ("segment", "1")):
+                os.environ["NNSTPU_SEGMENT_ENABLED"] = enabled
+                wire_gate(f"segment_ab_{variant}")
+                obs_spans.reset()  # fresh recorder; the tracer re-activates
+                # serialized chain (no decoder queue): the host decode's
+                # dead time between device programs is the quantity the
+                # segment variant folds away — with the queue it hides in
+                # a second thread and both variants read ~0
+                fps = run_pipeline_fps(
+                    "jax", ssd, [img300s.copy() for _ in range(n_seg)],
+                    decoder=("bounding_boxes", {
+                        "option1": "fused-ssd", "option4": "300:300",
+                        "option5": "300:300",
+                    }),
+                    pipelined=False,
+                )
+                idle = [r for r in obs_spans.snapshot()
+                        if r[0] == obs_spans.PH_COMPLETE
+                        and r[4] == "device_idle"
+                        and r[9].get("reason") == "host_dispatch"]
+                host_us = sum(r[2] for r in idle) / 1e3 / n_seg
+                seg[variant] = {
+                    "fps": round(fps, 2),
+                    "host_dispatch_us_per_frame": round(host_us, 1),
+                    "idle_gaps": len(idle),
+                }
+                log(f"# segment.ab {variant}: {fps:.2f} fps, host_dispatch "
+                    f"{host_us:.1f} us/frame ({len(idle)} gaps)")
+                rep.snapshot()
+        finally:
+            for k, v in saved.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+        if seg.get("unfused", {}).get("fps"):
+            seg["speedup"] = round(
+                seg["segment"]["fps"] / seg["unfused"]["fps"], 3)
+        results["segment_ab"] = seg
+
     # -- partition.ab: all-edge vs all-fleet vs the planner's split --------
     # Among-device A/B (docs/partitioning.md): the SAME cascade chain in
     # three placements over real NNSQ — fully local, fully offloaded to a
@@ -2974,6 +3045,7 @@ def main(standalone=False):
         ("config1 quant leg", leg_config1_quant, 20.0),
         ("config2 ssd leg", leg_config2, 30.0),
         ("config2c cascade leg", leg_config2c, 30.0),
+        ("segment ab leg", leg_segment_ab, 30.0),
         ("partition ab leg", leg_partition_ab, 45.0),
         ("config3 pose leg", leg_config3, 30.0),
         ("config4 lstm leg", leg_config4, 15.0),

@@ -56,7 +56,7 @@ def main():
     # golden: raw model (no fused head) on the same pixels, numpy decode
     raw = ssd_mobilenet.build(num_labels=LABELS, image_size=SIZE)
     from nnstreamer_tpu.decoders.bounding_boxes import (
-        DETECTION_THRESHOLD, decode_tflite_ssd,
+        DETECTION_THRESHOLD, decode_tflite_ssd, px,
     )
     from nnstreamer_tpu.elements.testsrc import VideoTestSrc
 
@@ -74,23 +74,18 @@ def main():
         boxes[single], scores[single], priors[:, single],
         k=int(single.sum())))
     dev = {
-        (max(0, int(r[0] * SIZE)), max(0, int(r[1] * SIZE)),
-         int(r[2] * SIZE), int(r[3] * SIZE)): (int(r[4]), float(r[5]))
+        (max(0, px(r[0], SIZE)), max(0, px(r[1], SIZE)),
+         px(r[2], SIZE), px(r[3], SIZE)): (int(r[4]), float(r[5]))
         for r in det if r[5] >= DETECTION_THRESHOLD
     }
 
     def match(o):
-        # the fused-XLA and numpy decodes are both float32 pipelines read
-        # through int() truncation: a coordinate landing within a ULP of
-        # an integer boundary may round apart by one pixel between them,
-        # so boxes match within ±1px per coordinate (classes exactly)
-        for key, (cls, _prob) in dev.items():
-            if cls == o.class_id and all(
-                abs(a - b) <= 1
-                for a, b in zip(key, (o.x, o.y, o.width, o.height))
-            ):
-                return True
-        return False
+        # both decodes pixelate through the shared half-up rule (px),
+        # whose rounding boundary sits at half-integers — far from the
+        # near-integer coordinates SSD's cell-center priors produce — so
+        # the comparison is EXACT, not ±1px
+        got = dev.get((o.x, o.y, o.width, o.height))
+        return got is not None and got[0] == o.class_id
 
     ok = len(ref) == len(dev) and all(match(o) for o in ref)
     print(f"golden={'OK' if ok else 'MISMATCH'} ({len(ref)} detections)")

@@ -36,7 +36,13 @@ def golden_decode(boxes, scores, priors, threshold=0.5):
     """Independent reimplementation of the tflite-ssd decode contract
     (tensordec-boundingbox.c:631-678): per box, first class (≥1) whose
     sigmoid score crosses 0.5 claims it; box geometry from priors with
-    scales 10/10/5/5; then greedy IoU-0.5 NMS by descending prob."""
+    scales 10/10/5/5; then greedy IoU-0.5 NMS by descending prob.
+    Pixel quantization follows the decoder's shared float→int rule:
+    round-half-up in float32 (``decoders/bounding_boxes.px``)."""
+
+    def px(v, size):
+        return int(np.floor(np.float32(v) * np.float32(size) + np.float32(0.5)))
+
     dets = []
     for d in range(min(len(boxes), priors.shape[1])):
         probs = 1.0 / (1.0 + np.exp(-scores[d]))
@@ -54,10 +60,10 @@ def golden_decode(boxes, scores, priors, threshold=0.5):
         dets.append({
             "class_id": cls,
             "prob": float(probs[cls]),
-            "x": max(0, int((cx - w / 2) * SIZE)),
-            "y": max(0, int((cy - h / 2) * SIZE)),
-            "w": int(w * SIZE),
-            "h": int(h * SIZE),
+            "x": max(0, px(cx - w / 2, SIZE)),
+            "y": max(0, px(cy - h / 2, SIZE)),
+            "w": px(w, SIZE),
+            "h": px(h, SIZE),
         })
     dets.sort(key=lambda o: -o["prob"])
     dets = dets[:100]  # decoder contract: NMS over the top-100 candidates

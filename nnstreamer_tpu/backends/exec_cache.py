@@ -125,12 +125,15 @@ class ExecutableCache:
 
     @staticmethod
     def make_key(spec_key, mesh_key, fingerprint: str,
-                 entry: str = "shaped") -> dict:
+                 entry: str = "shaped", tag: str = "") -> dict:
         """The full persistence key as a dict of its parts (all of which
         are validated on load).  ``entry`` distinguishes the shaped
-        executable from its flat host-wire twin."""
+        executable from its flat host-wire twin; ``tag`` carries the
+        whole-segment label (graph/segments.py) so a segment-fused
+        program and the bare model never share a cache lineage.  An
+        empty tag is omitted, keeping pre-segment entry hashes stable."""
         jv, jlv = versions()
-        return {
+        key = {
             "v": ENTRY_VERSION,
             "spec": repr(spec_key),
             "mesh": repr(mesh_key),
@@ -140,6 +143,9 @@ class ExecutableCache:
             "fingerprint": fingerprint,
             "entry": entry,
         }
+        if tag:
+            key["tag"] = tag
+        return key
 
     @staticmethod
     def _hash(key: dict) -> str:

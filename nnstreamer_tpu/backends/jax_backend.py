@@ -272,6 +272,13 @@ class JaxBackend(FilterBackend):
         # flops/bytes, stamped into device_exec spans by the DeviceTracer
         # so the reaper can compute per-dispatch MFU/roofline attribution
         self._cost_key: Optional[str] = None
+        # whole-segment compilation (graph/segments.py): when a filter's
+        # wrapper folds a run-to-completion region, the planner stamps the
+        # segment's element-chain label here so the fused executable gets
+        # its OWN cost-registry entry (model+segment, not bare model) and
+        # its own persistent exec-cache lineage — a fused program and the
+        # unfused model must never share a fingerprint
+        self.segment_label = ""
 
     # -- open/close ---------------------------------------------------------
 
@@ -620,6 +627,8 @@ class JaxBackend(FilterBackend):
             if in_spec.tensors and in_spec.tensors[0].shape:
                 bucket = int(in_spec.tensors[0].shape[0] or 0)
             name = getattr(self.model, "name", "") or self.name
+            if self.segment_label:
+                name = f"{name}+{self.segment_label}"
             fp = f"{name}:{hash(key) & 0xffffffffffff:012x}"
             return _obs_util.register_cost(
                 fp, flops=info.get("flops"), bytes=info.get("bytes"),
@@ -655,7 +664,8 @@ class JaxBackend(FilterBackend):
             return lowered.compile(), "miss"
         try:
             fp = exec_cache.fingerprint_lowered(lowered)
-            pkey = cache.make_key(lru_key[0], lru_key[1], fp, entry)
+            pkey = cache.make_key(lru_key[0], lru_key[1], fp, entry,
+                                  tag=self.segment_label)
             found = cache.lookup(pkey)
         except Exception:  # noqa: BLE001 — persistence is best-effort
             return lowered.compile(), "miss"

@@ -139,6 +139,20 @@ DEFAULTS: Dict[str, Dict[str, str]] = {
         "autotune": "true",         # consult the persistent Pallas autotune
                                     # cache for kernel block configs
     },
+    # Whole-segment compilation (graph/segments.py): fold converter
+    # pre-ops and decoder post-ops into the filter's XLA program so each
+    # run-to-completion region dispatches as ONE device executable.
+    # NNSTPU_SEGMENT_* env vars map here.  See docs/performance.md
+    # "Whole-segment compilation".
+    "segment": {
+        "enabled": "false",         # plan + fold segments in Pipeline.start
+                                    # (a pipeline's .segment_compile attr
+                                    # overrides this per instance)
+        "pallas_nms": "false",      # trace ops/nms.py's Pallas NMS kernel
+                                    # into fused detection segments instead
+                                    # of the pure-XLA form (interpret mode
+                                    # off-TPU; same bits either way)
+    },
     # Mesh-sharded dispatch (parallel/mesh.py dispatch_mesh): batch-axis
     # data parallelism over all chips.  The short env spelling NNSTPU_MESH
     # takes precedence over the NNSTPU_MESH_SPEC form mapped here.

@@ -17,6 +17,21 @@ option5 = model input ``W:H``.
 
 The heavy decode is vectorized numpy on host (detection counts are tiny);
 detections also ride in ``meta["objects"]`` for app consumption.
+
+Two additions for whole-segment compilation (``graph/segments.py``):
+
+- :func:`px` is the ONE float→int pixel-quantization rule, shared by the
+  numpy reference, the on-device lowering, and the ``fused_detection``
+  example golden.  Round-half-up in float32 — SSD cell-center priors put
+  box coordinates within ULPs of exact integers (e.g. ``0.05·300 =
+  15.0000004``), where plain ``int()`` truncation made numpy-vs-XLA
+  1-ULP differences visible as ±1px drift; half-up moves the decision
+  boundary to half-integers, far from where decoded values cluster.
+- :meth:`BoundingBoxes.device_stage` lowers the tflite-ssd decode + NMS
+  (and the fused-ssd quantize + NMS) into the upstream filter's XLA
+  program; the host side then runs only the overlay tail on a small
+  ``(K, 6)`` detections tensor.  The tf-ssd sub-mode keeps its legacy
+  truncation semantics and never lowers.
 """
 
 from __future__ import annotations
@@ -39,6 +54,15 @@ THRESHOLD_IOU = 0.5
 # can push thousands of boxes over threshold, and the reference's per-box
 # C loop never faced Python loop costs).  Matches the fused head's top-k.
 PRE_NMS_TOP_K = 100
+
+
+def px(v, size: int) -> int:
+    """float coordinate × pixel size → int pixel, round-half-up in
+    float32.  Multiply and add use only correctly-rounded basic ops, so
+    numpy and XLA produce the same float32 bit-for-bit; the device
+    lowering mirrors this as ``floor(v·size + 0.5)`` (see module
+    docstring for why the truncation rule it replaces was unstable)."""
+    return int(np.floor(np.float32(v) * np.float32(size) + np.float32(0.5)))
 
 
 @dataclasses.dataclass
@@ -97,10 +121,10 @@ def decode_tflite_ssd(
         out.append(
             DetectedObject(
                 class_id=c,
-                x=max(0, int(xmin[d] * i_width)),
-                y=max(0, int(ymin[d] * i_height)),
-                width=int(w[d] * i_width),
-                height=int(h[d] * i_height),
+                x=max(0, px(xmin[d], i_width)),
+                y=max(0, px(ymin[d], i_height)),
+                width=px(w[d], i_width),
+                height=px(h[d], i_height),
                 prob=float(scores[d, c]),
             )
         )
@@ -153,6 +177,17 @@ class BoundingBoxes(DecoderPlugin):
         self.i_width, self.i_height = _parse_wh(opts[4], 300, 300)
 
     def out_spec(self, in_spec: TensorsSpec) -> TensorsSpec:
+        if self._lowered is not None:
+            # segment-compiled: decode + NMS already ran on device inside
+            # the filter program; input is the (K, 6) detections tensor
+            if in_spec.num_tensors != 1:
+                raise ValueError(
+                    "lowered bounding_boxes needs 1 detections tensor")
+            return TensorsSpec(
+                tensors=(TensorSpec(dtype=np.uint8,
+                                    shape=(self.height, self.width, 4)),),
+                rate=in_spec.rate,
+            )
         if self.submode == "tflite-ssd":
             if in_spec.num_tensors != 2:
                 raise ValueError("tflite-ssd needs 2 tensors (boxes, scores)")
@@ -170,8 +205,118 @@ class BoundingBoxes(DecoderPlugin):
             rate=in_spec.rate,
         )
 
-    def _detect(self, frame: Frame) -> List[DetectedObject]:
+    def device_stage(self, in_spec: TensorsSpec):
+        """Segment-compile lowering (``graph/segments.py``): return
+        ``(fn(xs, jnp) -> (det,), lowered TensorsSpec)`` tracing the full
+        decode + quantize + NMS onto the device, or None to refuse
+        (tf-ssd, open/batched shapes).  The emitted ``(K, 6)`` rows are
+        ``[x, y, w, h, class, prob]`` in *integer-valued* float32 pixels,
+        score-sorted, with suppressed/invalid rows' prob zeroed — the
+        host tail in :meth:`_detect` only thresholds and draws."""
+        from ..conf import conf
+        from ..ops import nms as nms_ops
+
+        keep_impl = nms_ops.keep_fn(conf.get_bool("segment", "pallas_nms"))
+        i_w, i_h = self.i_width, self.i_height
+        ts = in_spec.tensors
+
         if self.submode == "tflite-ssd":
+            if len(ts) != 2 or self.priors is None:
+                return None
+            s0, s1 = ts[0].shape, ts[1].shape
+            if ts[0].rank != 2 or ts[1].rank != 2 \
+                    or None in s0 or None in s1 or s1[1] < 2:
+                return None
+            n = min(s0[0], s1[0], self.priors.shape[1])
+            if n < 1:
+                return None
+            k = min(n, PRE_NMS_TOP_K)
+            pri = np.asarray(self.priors[:, :n], np.float32)
+
+            def fn(xs, jnp):
+                # mirror decode_tflite_ssd op-for-op (same float32 basic
+                # ops => same bits, modulo the exp/sigmoid transcendental)
+                loc = xs[0][:n].astype(jnp.float32)
+                scores = 1.0 / (1.0 + jnp.exp(-xs[1][:n].astype(jnp.float32)))
+                ycenter = loc[:, 0] / Y_SCALE * pri[2] + pri[0]
+                xcenter = loc[:, 1] / X_SCALE * pri[3] + pri[1]
+                h = jnp.exp(loc[:, 2] / H_SCALE) * pri[2]
+                w = jnp.exp(loc[:, 3] / W_SCALE) * pri[3]
+                ymin = ycenter - h / 2.0
+                xmin = xcenter - w / 2.0
+                above = scores[:, 1:] >= DETECTION_THRESHOLD
+                valid = jnp.any(above, axis=1)
+                first_cls = jnp.argmax(above, axis=1) + 1
+                prob = jnp.take_along_axis(
+                    scores, first_cls[:, None], axis=1)[:, 0]
+                probs = jnp.where(valid, prob, 0.0)
+                # the shared px() rule, device form
+                xq = jnp.maximum(0.0, jnp.floor(xmin * i_w + 0.5))
+                yq = jnp.maximum(0.0, jnp.floor(ymin * i_h + 0.5))
+                wq = jnp.floor(w * i_w + 0.5)
+                hq = jnp.floor(h * i_h + 0.5)
+                # stable desc sort = the host's sorted(key=-prob); zeroed
+                # invalid rows sink below every >=0.5 candidate
+                order = jnp.argsort(-probs, stable=True)[:k]
+                xg, yg, wg, hg = xq[order], yq[order], wq[order], hq[order]
+                pg = probs[order]
+                cg = first_cls[order].astype(jnp.float32)
+                keep = keep_impl(xg, yg, wg, hg, pg >= DETECTION_THRESHOLD)
+                pg = jnp.where(keep, pg, 0.0)
+                return (jnp.stack([xg, yg, wg, hg, cg, pg], axis=-1),)
+
+            return fn, TensorsSpec(
+                tensors=(TensorSpec(dtype=np.float32, shape=(k, 6)),),
+                rate=in_spec.rate,
+            )
+
+        if self.submode == "fused-ssd":
+            if len(ts) != 1 or ts[0].rank != 2 \
+                    or None in ts[0].shape or ts[0].shape[1] != 6:
+                return None
+            kk = ts[0].shape[0]
+
+            def fn(xs, jnp):
+                det = xs[0].reshape(-1, 6).astype(jnp.float32)
+                probs = jnp.where(
+                    det[:, 5] >= DETECTION_THRESHOLD, det[:, 5], 0.0)
+                # the host path re-sorts through nms(); decode_topk rows
+                # are already desc so this is the identity there, but the
+                # lowering must not assume the producer's contract
+                order = jnp.argsort(-probs, stable=True)
+                det = det[order]
+                pg = probs[order]
+                xq = jnp.maximum(0.0, jnp.floor(det[:, 0] * i_w + 0.5))
+                yq = jnp.maximum(0.0, jnp.floor(det[:, 1] * i_h + 0.5))
+                wq = jnp.floor(det[:, 2] * i_w + 0.5)
+                hq = jnp.floor(det[:, 3] * i_h + 0.5)
+                keep = keep_impl(xq, yq, wq, hq, pg >= DETECTION_THRESHOLD)
+                pg = jnp.where(keep, pg, 0.0)
+                return (jnp.stack(
+                    [xq, yq, wq, hq, det[:, 4], pg], axis=-1),)
+
+            return fn, TensorsSpec(
+                tensors=(TensorSpec(dtype=np.float32, shape=(kk, 6)),),
+                rate=in_spec.rate,
+            )
+
+        return None  # tf-ssd: legacy truncation semantics, host only
+
+    def _detect(self, frame: Frame) -> List[DetectedObject]:
+        if self._lowered is not None:
+            # device rows are integer-valued float32 pixels: int() is exact
+            rows = np.asarray(frame.tensor(0), dtype=np.float32).reshape(-1, 6)
+            objs = []
+            for x, y, w, h, c, s in rows:
+                if s < DETECTION_THRESHOLD:
+                    continue  # invalid or NMS-suppressed (prob zeroed)
+                objs.append(
+                    DetectedObject(
+                        class_id=int(c), x=int(x), y=int(y),
+                        width=int(w), height=int(h), prob=float(s),
+                    )
+                )
+        elif self.submode == "tflite-ssd":
             boxes = np.asarray(frame.tensor(0), dtype=np.float32)
             scores = np.asarray(frame.tensor(1), dtype=np.float32)
             boxes = boxes.reshape(-1, boxes.shape[-1])
@@ -189,10 +334,10 @@ class BoundingBoxes(DecoderPlugin):
                 objs.append(
                     DetectedObject(
                         class_id=int(c),
-                        x=max(0, int(x * self.i_width)),
-                        y=max(0, int(y * self.i_height)),
-                        width=int(w * self.i_width),
-                        height=int(h * self.i_height),
+                        x=max(0, px(x, self.i_width)),
+                        y=max(0, px(y, self.i_height)),
+                        width=px(w, self.i_width),
+                        height=px(h, self.i_height),
                         prob=float(s),
                     )
                 )

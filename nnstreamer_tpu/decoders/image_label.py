@@ -38,10 +38,35 @@ class ImageLabeling(DecoderPlugin):
             tensors=(TensorSpec(dtype=np.uint8, shape=None),), rate=in_spec.rate
         )
 
+    def device_stage(self, in_spec: TensorsSpec):
+        """Segment-compile lowering (``graph/segments.py``): fold the
+        argmax into the classifier's XLA program, emitting a (2,) float32
+        ``[index, score]`` tensor; the host tail only looks up the label
+        string.  Both argmax implementations take the lowest index on
+        ties, so index parity with the numpy path is exact."""
+        if in_spec.num_tensors != 1 or in_spec.tensors[0].rank is None:
+            return None
+
+        def fn(xs, jnp):
+            scores = xs[0].reshape(-1)
+            idx = jnp.argmax(scores)
+            return (jnp.stack([idx.astype(jnp.float32),
+                               scores[idx].astype(jnp.float32)]),)
+
+        return fn, TensorsSpec(
+            tensors=(TensorSpec(dtype=np.float32, shape=(2,)),),
+            rate=in_spec.rate,
+        )
+
     def decode(self, frame: Frame, in_spec: TensorsSpec) -> Frame:
         del in_spec
-        scores = np.asarray(frame.tensor(0)).reshape(-1)
-        idx = int(np.argmax(scores))
+        if self._lowered is not None:
+            row = np.asarray(frame.tensor(0), dtype=np.float32).reshape(-1)
+            idx, score = int(row[0]), float(row[1])
+        else:
+            scores = np.asarray(frame.tensor(0)).reshape(-1)
+            idx = int(np.argmax(scores))
+            score = float(scores[idx])
         if self.labels is not None and idx < len(self.labels):
             label = self.labels[idx]
         else:
@@ -50,5 +75,5 @@ class ImageLabeling(DecoderPlugin):
         out = frame.with_tensors((data,))
         out.meta["label"] = label
         out.meta["label_index"] = idx
-        out.meta["score"] = float(scores[idx])
+        out.meta["score"] = score
         return out

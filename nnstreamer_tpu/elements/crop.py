@@ -26,6 +26,12 @@ Two output modes, chosen by whether a static crop size is given:
 Info tensor: ``(4,)`` or ``(N, 4)`` integer/float rows ``[x, y, w, h]``
 in pixels; raw tensor: ``(H, W, C)`` (the converter's video layout).
 
+Whole-segment compilation (``graph/segments.py``) always treats
+``tensor_crop`` as a hard region boundary: the two-pad collect
+synchronizes independently-timed streams, which no single traced
+function can express — a segment upstream of the crop and one
+downstream each compile separately, and the collect stays on the host.
+
 **Empty-region sentinel**: a row with ``w <= 0`` or ``h <= 0`` means "no
 detection here" and is skipped in both modes (the spec layer forbids
 zero-sized dims, so a detector cannot emit a ``(0, 4)`` tensor; it pads

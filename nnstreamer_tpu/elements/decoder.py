@@ -62,9 +62,29 @@ class DecoderPlugin:
     - ``init(options)`` — option1..N strings;
     - ``out_spec(in_spec) -> TensorsSpec`` — output caps (getOutCaps);
     - ``decode(frame, in_spec) -> Frame`` — the transform (decode).
+
+    Plugins MAY additionally implement the segment-compile lowering
+    (``graph/segments.py``)::
+
+        device_stage(in_spec) -> (fn, TensorsSpec) | None
+
+    where ``fn(xs, jnp) -> tuple`` traces the decode's device-friendly
+    prefix (argmax, box decode, NMS, ...) for folding into the upstream
+    ``tensor_filter``'s XLA program, and the returned spec describes the
+    small device tensor it emits.  Returning None refuses the lowering
+    (unsupported sub-mode/shape) and the planner falls back per-element.
+    When a lowering is installed the planner calls
+    :meth:`set_lowered` with that spec — ``out_spec``/``decode`` must
+    then accept the lowered tensor and run only the host tail (labels,
+    overlay drawing, meta) — and calls ``set_lowered(None)`` to restore
+    full-host decode on refusal or segment undo.
     """
 
     name = "base"
+    _lowered: Optional[TensorsSpec] = None
+
+    def set_lowered(self, spec: Optional[TensorsSpec]) -> None:
+        self._lowered = spec
 
     def init(self, options: List[str]) -> None:
         del options

@@ -217,7 +217,11 @@ class TensorFilter(Node):
                 f"{self.name}: fused pre-transform output {spec_cur} "
                 f"conflicts with input property {self._prop_in}"
             )
-        post_stages = []
+        # post stages come in two shapes: per-tensor transforms (zipped
+        # 1:1, the classic tensor_transform protocol) and N:M "multi"
+        # stages (segment-folded decoder heads, graph/segments.py) that
+        # consume the whole tensor tuple at once
+        post_stages = []  # (zip_fns | None, multi_fn | None)
         if self._fused_post:
             spec_o = self.backend.trace_output_spec(spec_cur)
             if self._prop_out is not None and self._prop_out.intersect(spec_o) is None:
@@ -225,12 +229,30 @@ class TensorFilter(Node):
                     f"{self.name}: model output {spec_o} conflicts with "
                     f"output property {self._prop_out}"
                 )
-            for tr in self._fused_post:
-                post_stages.append([tr.build_fn(t) for t in spec_o.tensors])
-                spec_o = TensorsSpec(
-                    tensors=tuple(tr.out_spec_for(t) for t in spec_o.tensors),
-                    rate=spec_o.rate,
-                )
+            post = list(self._fused_post)
+            for i, tr in enumerate(post):
+                build_multi = getattr(tr, "build_multi", None)
+                if build_multi is not None:
+                    built = build_multi(spec_o)
+                    if built is None:
+                        # per-element fallback: the stage refused this
+                        # geometry, so drop it AND the rest of the chain
+                        # (later stages consume its output), telling each
+                        # to restore its host path
+                        for rest in post[i:]:
+                            refuse = getattr(rest, "on_refuse", None)
+                            if refuse is not None:
+                                refuse()
+                        break
+                    mfn, spec_o = built
+                    post_stages.append((None, mfn))
+                else:
+                    post_stages.append(
+                        ([tr.build_fn(t) for t in spec_o.tensors], None))
+                    spec_o = TensorsSpec(
+                        tensors=tuple(tr.out_spec_for(t) for t in spec_o.tensors),
+                        rate=spec_o.rate,
+                    )
 
         def wrapper(orig):
             def fn(*xs):
@@ -239,8 +261,17 @@ class TensorFilter(Node):
                 out = orig(*xs)
                 single = not isinstance(out, (tuple, list))
                 outs = (out,) if single else tuple(out)
-                for stage in post_stages:
-                    outs = tuple(f(x, jnp) for f, x in zip(stage, outs))
+                multi_used = False
+                for zip_fns, multi_fn in post_stages:
+                    if multi_fn is not None:
+                        outs = tuple(multi_fn(outs, jnp))
+                        multi_used = True
+                    else:
+                        outs = tuple(f(x, jnp) for f, x in zip(zip_fns, outs))
+                if multi_used:
+                    # an N:M stage dissolved the model's output structure;
+                    # emit the stage tuple as-is
+                    return outs[0] if len(outs) == 1 else outs
                 if single:
                     return outs[0]
                 if hasattr(out, "_fields"):  # namedtuple output

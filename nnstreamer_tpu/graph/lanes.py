@@ -361,7 +361,12 @@ class LaneRuntime:
     def _segment_blocking(self, node) -> bool:
         """True when any element in the fused chain downstream of
         ``node`` (up to the next decoupling boundary) declares
-        ``LANE_BLOCKING`` — the static blocking-boundary rule."""
+        ``LANE_BLOCKING`` — the static blocking-boundary rule.  An
+        instance-level ``lane_blocking`` attribute overrides the class
+        flag in either direction: the segment planner
+        (``graph/segments.py``) clears it on decoders whose heavy decode
+        moved into the device program, and raises it on decoders left
+        running host NMS behind a fused boundary."""
         seen = set()
         stack = [node]
         while stack:
@@ -369,7 +374,9 @@ class LaneRuntime:
             if id(n) in seen:
                 continue
             seen.add(id(n))
-            if getattr(n, "LANE_BLOCKING", False):
+            hint = getattr(n, "lane_blocking", None)
+            blocking = getattr(n, "LANE_BLOCKING", False) if hint is None else hint
+            if blocking:
                 return True
             if n is not node and getattr(n, "lane_task", None) is not None:
                 continue  # next boundary: a fresh task owns that segment

@@ -14,6 +14,13 @@ filter whose backend compiles ``post∘model∘pre`` as ONE XLA program:
 
 Called automatically from ``Pipeline.start`` (disable with
 ``pipeline.auto_fuse = False``).
+
+Transform fusion is the *adjacent-element* rewrite; whole-segment
+compilation (:mod:`.segments`, conf ``[segment] enabled``) builds on the
+same wrapper machinery to fold an entire run-to-completion region —
+trivial converters and lowerable decoder heads included — into one
+device program.  ``_hop_transparent``/``_splice_out`` below are shared
+with that planner.
 """
 
 from __future__ import annotations

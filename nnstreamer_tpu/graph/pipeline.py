@@ -90,6 +90,10 @@ class Pipeline:
         self.name = name
         self.nodes: Dict[str, Node] = {}
         self.auto_fuse = True  # fold transforms into XLA filters on start
+        # whole-segment compilation (graph/segments.py): None defers to
+        # [segment] enabled; True/False pins it for this pipeline
+        self.segment_compile: Optional[bool] = None
+        self._segment_undos: List = []
         self.state = "NULL"  # NULL → PLAYING → STOPPED
         self.threads: List[threading.Thread] = []
         self._eos_leaves: set = set()
@@ -461,6 +465,15 @@ class Pipeline:
             from .optimize import fuse_transforms
 
             fuse_undos = fuse_transforms(self)
+            # whole-segment compilation ([segment] enabled or
+            # pipeline.segment_compile): fold converter pre-ops and
+            # decoder device heads into the filter program too.  Undos
+            # ride on self._segment_undos (stop() restores the user's
+            # graph for renegotiation); the failure path below runs them
+            # via restore_segments so they never fire twice.
+            from .segments import fuse_segments
+
+            fuse_segments(self)
         for node in self.nodes.values():
             for pad in list(node.sink_pads.values()) + list(node.src_pads.values()):
                 pad.eos = False
@@ -516,6 +529,9 @@ class Pipeline:
                     pass
             for tracer in self._tracers:
                 tracer.stop()  # failed start: no hook may stay connected
+            from .segments import restore_segments
+
+            restore_segments(self)
             for undo in reversed(fuse_undos):
                 undo()
             raise
@@ -672,6 +688,14 @@ class Pipeline:
         self.threads.clear()
         for node in self.nodes.values():
             node.stop()
+        # segment folds are per-run: restore the user's graph so the next
+        # start renegotiates (and re-plans) from the original topology —
+        # the renegotiation half of the segment undo contract.  (Transform
+        # fusion predates this and stays folded across stop, its
+        # long-standing observable behavior.)
+        from .segments import restore_segments
+
+        restore_segments(self)
         # detach tracers from the hook bus (accumulated data stays readable
         # through stats(); a re-start reconnects them)
         for tracer in self._tracers:

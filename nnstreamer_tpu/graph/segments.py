@@ -1,0 +1,321 @@
+"""Whole-segment compilation: one device program per run-to-completion region.
+
+``graph/optimize.py`` folds adjacent elementwise transforms into the
+filter's XLA program; this module extends that discipline to the WHOLE
+run-to-completion region the lane runtime already treats as one task unit
+(``graph/lanes.py``): trivially-configured ``tensor_converter`` pre-ops
+and decoder device heads (``bounding_boxes`` decode + NMS,
+``image_labeling`` argmax — see ``DecoderPlugin.device_stage``) compile
+into the SAME jitted program as the model.  Each frame then costs one
+host→device dispatch for the whole region instead of one per element —
+the ``device_idle{reason=host_dispatch}`` leg the device tracer prices
+collapses toward zero (TVM's operator fusion at pipeline granularity).
+
+Segment boundaries (where a region cuts) are exactly the lane
+runtime's task boundaries:
+
+- **sources** and **queues** (a queue decouples threads; the fold hops
+  it like transform fusion does — the *spec* is transparent even though
+  the thread boundary is not, so the queue feeds the fused program raw
+  frames);
+- **fan points** — tee, mux, demux, tensor_if, crop's multi-pad collect:
+  folding across would move work onto sibling branches' streams;
+- **wire edges** — NNSQ query client/server, repo sink/src: the tensor
+  leaves the process;
+- **elements with no device lowering** — non-trivial converter configs
+  (frames-per-tensor batching, protobuf, input-dim reinterpretation),
+  host-only transforms, decoders whose plugin refuses
+  ``device_stage`` — recorded per element in the plan's ``fallbacks``
+  so the miss is observable, and the walk stops there.
+
+Folding is mechanical reuse of the transform-fusion machinery: spliced
+converters become identity pre-stages (their trivial config is a
+spec-preserving pass-through; a config the fold would mis-model refuses
+above), decoders STAY in the graph but flip to lowered mode — the device
+emits their small ``(K, 6)``/``(2,)`` head tensor and the host node runs
+only the overlay/label tail.  Note the fold assumes frames carry no
+``meta["stride"]`` raster padding (no in-tree source emits it; a strided
+external source negotiates a different spec and fails loudly at start).
+
+Undo closures restore the unfused graph — on failed start (with the
+transform-fusion undos), on ``Pipeline.stop`` (so renegotiation via a
+fresh ``start`` re-plans against the current graph), and per-element at
+configure time when a stage refuses its negotiated geometry
+(``TensorFilter._install_fusion`` drops the stage and calls
+``on_refuse``, flipping the decoder back to host decode).
+
+Serving integration: the filter backend's ``segment_label`` tags the
+fused executable's cost-registry fingerprint (own roofline-attributed
+``device_exec`` spans — one per segment dispatch) and its persistent
+``exec_cache`` key; ``warmup_plan()`` needs no changes — fused filters
+already rebuild the whole wrapper per dynbatch bucket in ``warm_spec``.
+
+Enable with ``[segment] enabled`` (``NNSTPU_SEGMENT_ENABLED=1``) or
+per-pipeline via ``pipeline.segment_compile = True``; the ``segment``
+hook narrates installs/restores.  See docs/performance.md
+"Whole-segment compilation".
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Tuple
+
+from ..obs import hooks as _hooks
+from .node import Node
+from .optimize import _hop_transparent, _is_fusable_filter, _splice_out
+from .pipeline import Pipeline
+
+__all__ = [
+    "SegmentPlan", "plan_segments", "fuse_segments", "segments_enabled",
+]
+
+
+def segments_enabled(pipeline: Pipeline) -> bool:
+    """Per-pipeline ``segment_compile`` attr (True/False) overrides the
+    ``[segment] enabled`` conf knob (default off)."""
+    override = getattr(pipeline, "segment_compile", None)
+    if override is not None:
+        return bool(override)
+    from ..conf import conf
+
+    return conf.get_bool("segment", "enabled")
+
+
+# Recognized blocking boundaries and why they cut a segment; anything
+# else unrecognized cuts with "no device lowering".
+_BOUNDARY_REASONS = {
+    "Tee": "fan-out",
+    "TensorMux": "n-to-1 sync",
+    "TensorDemux": "1-to-n fan",
+    "TensorIf": "control branch",
+    "TensorCrop": "multi-pad collect",
+    "TensorRepoSink": "repo edge",
+    "TensorRepoSrc": "repo edge",
+    "TensorQueryClient": "nnsq wire edge",
+    "TensorQueryServerSink": "nnsq wire edge",
+    "TensorQueryServerSrc": "nnsq wire edge",
+}
+
+
+@dataclasses.dataclass
+class SegmentPlan:
+    """One filter's run-to-completion region: what folds, what cut the
+    walk, and which recognized elements could not lower (observability +
+    the planning tests read this; ``fuse_segments`` executes it)."""
+
+    filter: str
+    pre: List[str]                      # converters folded as identity pre-ops
+    post: List[str]                     # decoder lowered as device head (≤1)
+    cuts: List[Tuple[str, str]]         # (node, reason) boundaries hit
+    fallbacks: List[Tuple[str, str]]    # (node, reason) refused lowerings
+
+    @property
+    def label(self) -> str:
+        """Cost/exec-cache tag for the fused program: the folded region's
+        element names in stream order."""
+        return "+".join(self.pre + [self.filter] + self.post)
+
+    @property
+    def folds(self) -> bool:
+        return bool(self.pre or self.post)
+
+
+def _trivial_converter(node: Node) -> bool:
+    """A converter whose negotiated transform is the identity: single
+    tensor through, no re-batching, no byte reinterpretation, no
+    protobuf framing.  (Timestamp synthesis and stride stripping are
+    no-ops for every in-tree source — see module docstring.)"""
+    from ..elements.converter import TensorConverter
+
+    return (
+        isinstance(node, TensorConverter)
+        and node.frames_per_tensor == 1
+        and not node.input_format
+        and node.input_spec is None
+        and len(node.sink_pads) == 1
+        and len(node.src_pads) == 1
+    )
+
+
+def _boundary(node: Node) -> Tuple[str, bool]:
+    """(reason, is_fallback): classify why ``node`` stops the fold walk.
+    ``is_fallback`` marks elements a fuller lowering COULD fold one day
+    (recognized op, unsupported config) vs structural boundaries."""
+    if not node.sink_pads:
+        return "source", False
+    reason = _BOUNDARY_REASONS.get(type(node).__name__)
+    if reason is not None:
+        return reason, False
+    from ..elements.converter import TensorConverter
+    from ..elements.transform import TensorTransform
+
+    if isinstance(node, TensorConverter):
+        return "non-trivial converter config", True
+    if isinstance(node, TensorTransform):
+        return "host transform (acceleration off)", True
+    return "no device lowering", False
+
+
+def plan_segments(pipeline: Pipeline) -> List[SegmentPlan]:
+    """Walk the graph (read-only) and describe each jax filter's
+    segment: which neighbors fold, where the region cuts, and which
+    recognized ops refuse.  Transform fusion has usually already folded
+    adjacent transforms when this runs from ``Pipeline.start``, so the
+    walk meets converters/decoders directly (hopping queue/upload
+    plumbing exactly like ``fuse_transforms``)."""
+    from ..elements.decoder import TensorDecoder
+
+    plans: List[SegmentPlan] = []
+    for filt in [n for n in pipeline.nodes.values() if _is_fusable_filter(n)]:
+        pre: List[str] = []
+        cuts: List[Tuple[str, str]] = []
+        fallbacks: List[Tuple[str, str]] = []
+        pad = _hop_transparent(filt.sink_pads["sink"].peer, "up")
+        while pad is not None:
+            node = pad.node
+            if _trivial_converter(node):
+                pre.insert(0, node.name)
+                pad = _hop_transparent(
+                    next(iter(node.sink_pads.values())).peer, "up")
+                continue
+            reason, is_fb = _boundary(node)
+            (fallbacks if is_fb else cuts).append((node.name, reason))
+            break
+
+        post: List[str] = []
+        pad = _hop_transparent(filt.src_pads["src"].peer, "down")
+        if pad is not None:
+            node = pad.node
+            if isinstance(node, TensorDecoder):
+                if getattr(type(node.plugin), "device_stage", None) is not None:
+                    # folded as a device head; the node stays in the graph
+                    # as the host tail (and may still refuse per-geometry
+                    # at configure — _install_fusion's on_refuse path)
+                    post.append(node.name)
+                else:
+                    fallbacks.append((
+                        node.name,
+                        f"decoder {node.mode!r} has no device lowering",
+                    ))
+            else:
+                reason, is_fb = _boundary(node)
+                (fallbacks if is_fb else cuts).append((node.name, reason))
+        plans.append(SegmentPlan(
+            filter=filt.name, pre=pre, post=post,
+            cuts=cuts, fallbacks=fallbacks,
+        ))
+    return plans
+
+
+class _IdentityStage:
+    """A spliced trivial converter, as a per-tensor fused stage (the
+    ``tensor_transform`` stage protocol: ``build_fn``/``out_spec_for``).
+    Identity on device — the converter's host work was a pass-through."""
+
+    def __init__(self, name: str):
+        self.name = name
+
+    def build_fn(self, spec):
+        del spec
+        return lambda x, jnp: x
+
+    def out_spec_for(self, spec):
+        return spec
+
+
+class _DecoderStage:
+    """A decoder folded as a device head: the N:M fused-stage protocol
+    (``build_multi``/``on_refuse``, see ``TensorFilter._install_fusion``).
+    Success flips the plugin to lowered mode so the downstream node —
+    which stays in the graph — negotiates against the head's small
+    output tensor and runs only the host tail."""
+
+    def __init__(self, dec):
+        self.dec = dec
+        self.name = dec.name
+
+    def build_multi(self, spec):
+        plugin = self.dec.plugin
+        try:
+            built = plugin.device_stage(spec)
+        except Exception:  # refusal must degrade, never kill negotiation
+            built = None
+        if built is None:
+            plugin.set_lowered(None)
+            self.dec.lane_blocking = True  # host decode stays: heavy task
+            return None
+        fn, out_spec = built
+        plugin.set_lowered(out_spec)
+        self.dec.lane_blocking = False  # the heavy decode moved on-device
+        return fn, out_spec
+
+    def on_refuse(self):
+        self.dec.plugin.set_lowered(None)
+        self.dec.lane_blocking = True
+
+
+def fuse_segments(pipeline: Pipeline) -> List:
+    """Execute the plan: splice trivial converters into identity
+    pre-stages, attach decoder device heads as post-stages, and tag the
+    backend with the segment label.  Returns undo closures (run in
+    reverse) restoring the unfused graph; they are also stashed on
+    ``pipeline._segment_undos`` so ``Pipeline.stop`` restores the
+    user's graph for renegotiation.  No-op unless ``segments_enabled``."""
+    undos: List = []
+    if not segments_enabled(pipeline):
+        return undos
+    for plan in plan_segments(pipeline):
+        if not plan.folds:
+            continue
+        filt = pipeline.nodes[plan.filter]
+        for name in plan.pre:
+            undos.append(_splice_out(pipeline, pipeline.nodes[name]))
+        dec = pipeline.nodes[plan.post[0]] if plan.post else None
+
+        old_pre, old_post = list(filt._fused_pre), list(filt._fused_post)
+        new_pre = [_IdentityStage(n) for n in plan.pre] + old_pre
+        new_post = old_post + ([_DecoderStage(dec)] if dec is not None else [])
+        filt.set_fused_transforms(new_pre, new_post)
+        be = filt.backend
+        prev_label = getattr(be, "segment_label", "")
+        be.segment_label = plan.label
+        prev_hint = getattr(dec, "lane_blocking", None) if dec is not None else None
+        if _hooks.enabled:
+            _hooks.emit(
+                "segment", pipeline.name, filt.name, plan.label,
+                f"pre={len(plan.pre)} post={len(plan.post)} "
+                f"fallbacks={len(plan.fallbacks)}",
+                "install",
+            )
+
+        def undo_install(f=filt, d=dec, b=be, prev=prev_label,
+                         hint=prev_hint, op=old_pre, opost=old_post,
+                         label=plan.label, pname=pipeline.name):
+            f.set_fused_transforms(op, opost)
+            if not op and not opost and hasattr(b, "set_wrapper"):
+                f._fusion_dirty = False  # nothing fused: plain reconfigure
+                b.set_wrapper(None)
+            b.segment_label = prev
+            if d is not None:
+                d.plugin.set_lowered(None)
+                if hint is None:
+                    d.__dict__.pop("lane_blocking", None)
+                else:
+                    d.lane_blocking = hint
+            if _hooks.enabled:
+                _hooks.emit("segment", pname, f.name, label, "", "restore")
+
+        undos.append(undo_install)
+    pipeline._segment_undos = list(undos)
+    return undos
+
+
+def restore_segments(pipeline: Pipeline) -> None:
+    """Run (and clear) the pipeline's stashed segment undos — the
+    renegotiation hook: ``Pipeline.stop`` calls this so the next start
+    re-plans against the graph the user built."""
+    undos = getattr(pipeline, "_segment_undos", None) or []
+    pipeline._segment_undos = []
+    for undo in reversed(undos):
+        undo()

@@ -30,6 +30,13 @@ Activation: conf ``[compile] warmup`` / ``NNSTPU_COMPILE_WARMUP=1``
 ladders it will never hit), or explicitly via ``pipeline.warmup()``.
 Fleet workers run the same machinery per worker and only report ready to
 membership after it completes (``fleet/worker.py``).
+
+Whole-segment compilation (:mod:`.segments`) needs no special casing
+here: segment folds install *before* warmup runs in ``Pipeline.start``,
+and ``TensorFilter.warm_spec`` rebuilds the full fused wrapper (pre +
+model + post + lowered decoder tail) per bucket, so every enumerated
+dynbatch geometry warms the SEGMENT executable — tagged with the
+segment's label in the persistent cache — not the bare model.
 """
 
 from __future__ import annotations

@@ -90,6 +90,14 @@ must stay allocation-light):
                    ``mfu`` when the executable's cost profile is
                    registered (else partial/empty) — the feed the
                    cost-model tracer (:mod:`.costmodel`) aggregates.
+``segment``        ``(pipeline_name, filter_name, label, detail,
+                   action)`` — whole-segment compilation
+                   (:mod:`nnstreamer_tpu.graph.segments`) installed or
+                   restored a fused region on a filter: ``label`` is the
+                   segment's element-chain tag (also the cost-registry /
+                   exec-cache tag), ``detail`` summarizes the fold
+                   (pre/post/fallback counts; empty on restore),
+                   ``action`` is ``install`` / ``restore``.
 ``alert``          ``(name, state, severity, detail)`` — the SLO
                    burn-rate engine (:mod:`nnstreamer_tpu.obs.slo`)
                    changed an alert's state: ``name`` is the objective,
@@ -141,6 +149,7 @@ HOOK_SIGNATURES: Dict[str, Tuple[str, ...]] = {
     "scale_event": ("name", "action", "worker", "detail"),
     "device_exec": ("pipeline_name", "node_name", "device", "t0_ns",
                     "dur_ns", "info"),
+    "segment": ("pipeline_name", "filter_name", "label", "detail", "action"),
     "alert": ("name", "state", "severity", "detail"),
 }
 

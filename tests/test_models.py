@@ -92,7 +92,7 @@ class TestSSD:
         boxes with exactly one above-threshold class, where both coincide:
         geometry, class, and score must match."""
         from nnstreamer_tpu.decoders.bounding_boxes import (
-            DETECTION_THRESHOLD, decode_tflite_ssd,
+            DETECTION_THRESHOLD, decode_tflite_ssd, px,
         )
 
         rng = np.random.default_rng(3)
@@ -112,8 +112,10 @@ class TestSSD:
         dev = {}
         for x, y, w, h, c, sc in det:
             if sc >= DETECTION_THRESHOLD:
-                key = (max(0, int(x * 300)), max(0, int(y * 300)),
-                       int(w * 300), int(h * 300))
+                # the shared half-up pixel rule (px) makes this EXACT:
+                # both paths pixelate identically, no ±1px tolerance
+                key = (max(0, px(x, 300)), max(0, px(y, 300)),
+                       px(w, 300), px(h, 300))
                 dev[key] = (int(c), float(sc))
         assert len(ref) == len(dev)  # same survivor set
         for o in ref:

@@ -1,0 +1,394 @@
+"""Whole-segment compilation (graph/segments.py): planning boundaries,
+undo/restore lifecycle, per-element fallback, fused-vs-unfused parity,
+and the serving integration (segment-tagged cost keys, one device_exec
+span per segment dispatch)."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from nnstreamer_tpu import Pipeline
+from nnstreamer_tpu.backends.jax_backend import JaxModel
+from nnstreamer_tpu.elements.converter import TensorConverter
+from nnstreamer_tpu.elements.decoder import (
+    DecoderPlugin, TensorDecoder, register_decoder,
+)
+from nnstreamer_tpu.elements.filter import TensorFilter
+from nnstreamer_tpu.elements.mux import TensorMux
+from nnstreamer_tpu.elements.sink import TensorSink
+from nnstreamer_tpu.elements.tee import Tee
+from nnstreamer_tpu.elements.tensor_if import TensorIf
+from nnstreamer_tpu.elements.testsrc import DataSrc
+from nnstreamer_tpu.graph import segments
+from nnstreamer_tpu.graph.node import Node
+from nnstreamer_tpu.models import mobilenet_v2, ssd_mobilenet
+from nnstreamer_tpu.obs import hooks, spans
+from nnstreamer_tpu.spec import TensorSpec, TensorsSpec
+
+DT = jnp.float32
+
+
+def _double_model(shape=(4,)):
+    return JaxModel(
+        apply=lambda params, x: x * 2,
+        input_spec=TensorsSpec.of(TensorSpec(dtype=np.float32, shape=shape)),
+    )
+
+
+def _plan_for(p, filt):
+    plans = {pl.filter: pl for pl in segments.plan_segments(p)}
+    return plans[filt.name]
+
+
+class TestPlanning:
+    def test_tee_cuts_both_directions(self):
+        p = Pipeline()
+        src = p.add(DataSrc(data=[np.zeros(4, np.float32)]))
+        tee = p.add(Tee())
+        filt = p.add(TensorFilter(framework="jax", model=_double_model()))
+        tee2 = p.add(Tee())
+        s1, s2, s3 = (p.add(TensorSink(collect=True)) for _ in range(3))
+        p.link(src, tee)
+        p.link(tee, filt)
+        p.link(tee, s1)
+        p.link(filt, tee2)
+        p.link(tee2, s2)
+        p.link(tee2, s3)
+        plan = _plan_for(p, filt)
+        assert not plan.folds
+        assert (tee.name, "fan-out") in plan.cuts
+        assert (tee2.name, "fan-out") in plan.cuts
+
+    def test_mux_cuts(self):
+        p = Pipeline()
+        a = p.add(DataSrc(data=[np.zeros(4, np.float32)]))
+        b = p.add(DataSrc(data=[np.zeros(4, np.float32)]))
+        mux = p.add(TensorMux(sync_mode="nosync"))
+        model = JaxModel(apply=lambda params, x, y: x + y)
+        filt = p.add(TensorFilter(framework="jax", model=model))
+        sink = p.add(TensorSink(collect=True))
+        p.link(a, f"{mux.name}.sink_0")
+        p.link(b, f"{mux.name}.sink_1")
+        p.link_chain(mux, filt, sink)
+        plan = _plan_for(p, filt)
+        assert not plan.pre
+        assert (mux.name, "n-to-1 sync") in plan.cuts
+
+    def test_tensor_if_cuts(self):
+        p = Pipeline()
+        src = p.add(DataSrc(data=[np.ones(4, np.float32)]))
+        tif = p.add(TensorIf(threshold=0.0))
+        filt = p.add(TensorFilter(framework="jax", model=_double_model()))
+        tif2 = p.add(TensorIf(threshold=0.0))
+        sink = p.add(TensorSink(collect=True))
+        p.link_chain(src, tif, filt, tif2, sink)
+        plan = _plan_for(p, filt)
+        assert not plan.folds
+        assert (tif.name, "control branch") in plan.cuts
+        assert (tif2.name, "control branch") in plan.cuts
+
+    def test_trivial_converter_folds_nontrivial_refuses(self):
+        def build(fpt):
+            p = Pipeline()
+            shape = (4,) if fpt == 1 else (2, 4)
+            model = JaxModel(
+                apply=lambda params, x: x * 2,
+                input_spec=TensorsSpec.of(
+                    TensorSpec(dtype=np.float32, shape=shape)),
+            )
+            src = p.add(DataSrc(data=[np.zeros(4, np.float32)] * 2))
+            conv = p.add(TensorConverter(frames_per_tensor=fpt))
+            filt = p.add(TensorFilter(framework="jax", model=model))
+            sink = p.add(TensorSink(collect=True))
+            p.link_chain(src, conv, filt, sink)
+            return p, conv, filt
+
+        p, conv, filt = build(1)
+        plan = _plan_for(p, filt)
+        assert plan.pre == [conv.name]
+
+        p, conv, filt = build(2)
+        plan = _plan_for(p, filt)
+        assert not plan.pre
+        assert (conv.name, "non-trivial converter config") in plan.fallbacks
+
+    def test_decoder_without_lowering_is_a_fallback(self):
+        # direct_video has no device_stage: recorded, never folded
+        p = Pipeline()
+        src = p.add(DataSrc(data=[np.zeros((8, 8, 3), np.float32)]))
+        model = JaxModel(apply=lambda params, x: (x * 255).astype(jnp.uint8))
+        filt = p.add(TensorFilter(framework="jax", model=model))
+        dec = p.add(TensorDecoder(mode="direct_video"))
+        sink = p.add(TensorSink(collect=True))
+        p.link_chain(src, filt, dec, sink)
+        plan = _plan_for(p, filt)
+        assert not plan.post
+        assert any(n == dec.name for n, _ in plan.fallbacks)
+
+
+class TestRestoreLifecycle:
+    def _cascade(self):
+        model = ssd_mobilenet.build(num_labels=5, image_size=96, dtype=DT,
+                                    fused_decode=32)
+        x = np.random.default_rng(1).random((96, 96, 3), np.float32)
+        p = Pipeline()
+        p.segment_compile = True
+        src = p.add(DataSrc(data=[x]))
+        conv = p.add(TensorConverter())
+        filt = p.add(TensorFilter(framework="jax", model=model))
+        dec = p.add(TensorDecoder(mode="bounding_boxes", option1="fused-ssd",
+                                  option4="96:96", option5="96:96"))
+        sink = p.add(TensorSink(collect=True))
+        p.link_chain(src, conv, filt, dec, sink)
+        return p, conv, filt, dec, sink
+
+    def test_stop_restores_unfused_graph(self):
+        events = []
+        hooks.connect("segment", lambda *a: events.append(a))
+        p, conv, filt, dec, sink = self._cascade()
+        p.run(timeout=180)
+        assert sink.num_frames == 1
+        # converter respliced into the graph, decoder back to host mode
+        assert conv.name in p.nodes
+        assert conv.src_pads["src"].peer is not None
+        assert dec.plugin._lowered is None
+        assert not filt._fused_pre and not filt._fused_post
+        assert filt.backend.segment_label == ""
+        assert "lane_blocking" not in dec.__dict__
+        assert not p._segment_undos
+        actions = [e[-1] for e in events]
+        assert actions == ["install", "restore"]
+
+    def test_failed_start_restores_unfused_graph(self):
+        class _Exploder(Node):
+            def __init__(self):
+                super().__init__("exploder")
+                self.add_sink_pad("sink")
+
+            def configure(self, in_specs):
+                raise RuntimeError("negotiation boom")
+
+        model = ssd_mobilenet.build(num_labels=5, image_size=96, dtype=DT,
+                                    fused_decode=32)
+        p = Pipeline()
+        p.segment_compile = True
+        src = p.add(DataSrc(
+            data=[np.zeros((96, 96, 3), np.float32)]))
+        conv = p.add(TensorConverter())
+        filt = p.add(TensorFilter(framework="jax", model=model))
+        dec = p.add(TensorDecoder(mode="bounding_boxes", option1="fused-ssd",
+                                  option4="96:96", option5="96:96"))
+        boom = p.add(_Exploder())
+        p.link_chain(src, conv, filt, dec, boom)
+        with pytest.raises(Exception, match="negotiation boom"):
+            p.start()
+        assert conv.src_pads["src"].peer is not None
+        assert dec.plugin._lowered is None
+        assert not filt._fused_pre and not filt._fused_post
+        assert filt.backend.segment_label == ""
+        assert not p._segment_undos
+
+    def test_disabled_by_default(self):
+        p, conv, filt, dec, sink = self._cascade()
+        p.segment_compile = None  # fall back to conf (default off)
+        p.run(timeout=180)
+        assert sink.num_frames == 1
+        assert dec.plugin._lowered is None
+        assert not filt._fused_post
+
+
+@register_decoder("seg_test_refuser")
+class _RefusingPlugin(DecoderPlugin):
+    """A decoder that advertises device_stage but refuses every
+    geometry — the per-element fallback path at configure time."""
+
+    def init(self, options):
+        self.stage_calls = 0
+
+    def out_spec(self, in_spec):
+        return in_spec
+
+    def device_stage(self, in_spec):
+        self.stage_calls += 1
+        return None
+
+    def decode(self, frame, in_spec):
+        frame.meta["host_decoded"] = True
+        return frame
+
+
+class TestPerElementFallback:
+    def test_refusing_decoder_falls_back_to_host(self):
+        p = Pipeline()
+        p.segment_compile = True
+        src = p.add(DataSrc(data=[np.ones(4, np.float32)] * 3))
+        filt = p.add(TensorFilter(framework="jax", model=_double_model()))
+        dec = p.add(TensorDecoder(mode="seg_test_refuser"))
+        sink = p.add(TensorSink(collect=True))
+        p.link_chain(src, filt, dec, sink)
+        plan = _plan_for(p, filt)
+        assert plan.post == [dec.name]  # plan-time optimism
+        p.run(timeout=120)
+        # configure-time refusal: host decode ran, frames intact
+        assert dec.plugin.stage_calls >= 1
+        assert sink.num_frames == 3
+        assert all(f.meta.get("host_decoded") for f in sink.frames)
+        np.testing.assert_array_equal(
+            np.asarray(sink.frames[0].tensor(0)), np.full(4, 2, np.float32))
+
+
+class TestParity:
+    def _run_cascade(self, seg, x, model):
+        p = Pipeline()
+        p.segment_compile = seg
+        src = p.add(DataSrc(data=[x]))
+        conv = p.add(TensorConverter())
+        filt = p.add(TensorFilter(framework="jax", model=model))
+        dec = p.add(TensorDecoder(mode="bounding_boxes", option1="fused-ssd",
+                                  option4="96:96", option5="96:96"))
+        sink = p.add(TensorSink(collect=True))
+        p.link_chain(src, conv, filt, dec, sink)
+        p.run(timeout=180)
+        return sink.frames[0]
+
+    def test_ssd_cascade_bitwise(self):
+        """config #2 shape: converter + SSD + fused-ssd decoder — the
+        fused segment must be BITWISE identical to the unfused path
+        (canvas bytes and every object field)."""
+        model = ssd_mobilenet.build(num_labels=5, image_size=96, dtype=DT,
+                                    fused_decode=32)
+        x = np.random.default_rng(7).random((96, 96, 3), np.float32)
+        f0 = self._run_cascade(False, x, model)
+        f1 = self._run_cascade(True, x, model)
+        o0 = [(o.x, o.y, o.width, o.height, o.class_id, o.prob)
+              for o in f0.meta["objects"]]
+        o1 = [(o.x, o.y, o.width, o.height, o.class_id, o.prob)
+              for o in f1.meta["objects"]]
+        assert o0 == o1 and o0  # non-trivial survivor set
+        assert (np.asarray(f0.tensor(0)).tobytes()
+                == np.asarray(f1.tensor(0)).tobytes())
+
+    def test_image_label_parity(self):
+        model = mobilenet_v2.build(num_classes=10, width_mult=0.35,
+                                   image_size=64, dtype=DT)
+        x = np.random.default_rng(0).random((64, 64, 3), np.float32)
+        metas = []
+        for seg in (False, True):
+            p = Pipeline()
+            p.segment_compile = seg
+            src = p.add(DataSrc(data=[x]))
+            filt = p.add(TensorFilter(framework="jax", model=model))
+            dec = p.add(TensorDecoder(mode="image_labeling"))
+            sink = p.add(TensorSink(collect=True))
+            p.link_chain(src, filt, dec, sink)
+            p.run(timeout=120)
+            metas.append(sink.frames[0].meta)
+        assert metas[0]["label_index"] == metas[1]["label_index"]
+        assert metas[0]["score"] == metas[1]["score"]
+
+    def test_lstm_recurrent_parity(self):
+        """The recurrent repo-slot topology: repo edges + mux/demux/tee
+        cut everything (nothing folds), and the trajectory is identical
+        with segments enabled."""
+        from nnstreamer_tpu.elements.demux import TensorDemux
+        from nnstreamer_tpu.elements.repo import TensorRepoSink, TensorRepoSrc
+        from nnstreamer_tpu.models import lstm
+
+        H, n = 4, 3
+        model = lstm.build_cell(input_size=H, hidden_size=H)
+        xs = [np.full((H,), 0.1 * (i + 1), np.float32) for i in range(n)]
+        caps = TensorsSpec.of(
+            TensorSpec.from_dims_string(f"{H}:1:1:1", "float32"))
+
+        outs = []
+        for seg, slot in ((False, 20), (True, 30)):
+            p = Pipeline()
+            p.segment_compile = seg
+            h_src = p.add(TensorRepoSrc(name="h_src", slot_index=slot,
+                                        caps=caps))
+            c_src = p.add(TensorRepoSrc(name="c_src", slot_index=slot + 1,
+                                        caps=caps))
+            x_src = p.add(DataSrc(name="x_src", data=xs))
+            mux = p.add(TensorMux(sync_mode="nosync"))
+            filt = p.add(TensorFilter(framework="jax", model=model))
+            demux = p.add(TensorDemux())
+            tee = p.add(Tee())
+            h_sink = p.add(TensorRepoSink(name="h_sink", slot_index=slot))
+            c_sink = p.add(TensorRepoSink(name="c_sink", slot_index=slot + 1))
+            out = p.add(TensorSink(collect=True))
+            p.link(h_src, f"{mux.name}.sink_0")
+            p.link(c_src, f"{mux.name}.sink_1")
+            p.link(x_src, f"{mux.name}.sink_2")
+            p.link(mux, filt)
+            p.link(filt, demux)
+            p.link(f"{demux.name}.src_0", tee)
+            p.link(tee, h_sink)
+            p.link(tee, out)
+            p.link(f"{demux.name}.src_1", c_sink)
+            plan = _plan_for(p, filt)
+            assert not plan.folds  # mux/demux cut; repo edges stay host
+            p.start()
+            assert out.wait_eos(timeout=60)
+            p.stop()
+            assert out.num_frames == n
+            outs.append([np.asarray(f.tensor(0)).tobytes()
+                         for f in out.frames])
+        assert outs[0] == outs[1]
+
+
+class TestServingIntegration:
+    def test_segment_label_tags_cost_key_while_playing(self):
+        model = mobilenet_v2.build(num_classes=10, width_mult=0.35,
+                                   image_size=64, dtype=DT)
+        x = np.random.default_rng(0).random((64, 64, 3), np.float32)
+        p = Pipeline()
+        p.segment_compile = True
+        src = p.add(DataSrc(data=[x]))
+        filt = p.add(TensorFilter(framework="jax", model=model))
+        dec = p.add(TensorDecoder(mode="image_labeling"))
+        sink = p.add(TensorSink(collect=True))
+        p.link_chain(src, filt, dec, sink)
+        p.start()
+        try:
+            label = f"{filt.name}+{dec.name}"
+            assert filt.backend.segment_label == label
+            # the fused executable's cost fingerprint carries the segment
+            # label: its device_exec spans attribute to the SEGMENT, and
+            # it never collides with the bare model's entry
+            assert label in (filt.backend.cost_key() or "")
+        finally:
+            assert sink.wait_eos(timeout=60)
+            p.stop()
+        assert filt.backend.segment_label == ""
+
+    def test_one_device_exec_span_per_segment_dispatch(self):
+        from nnstreamer_tpu.obs.device import DeviceTracer
+
+        model = mobilenet_v2.build(num_classes=10, width_mult=0.35,
+                                   image_size=64, dtype=DT)
+        data = [np.random.default_rng(i).random((64, 64, 3), np.float32)
+                for i in range(4)]
+        p = Pipeline(name="segspans")
+        p.segment_compile = True
+        src = p.add(DataSrc(data=data))
+        filt = p.add(TensorFilter(framework="jax", model=model))
+        dec = p.add(TensorDecoder(mode="image_labeling"))
+        sink = p.add(TensorSink(collect=True))
+        p.link_chain(src, filt, dec, sink)
+        tracer = p.attach_tracer(DeviceTracer())
+        p.run(timeout=120)
+        assert sink.num_frames == len(data)
+        deadline_ok = False
+        import time
+        for _ in range(200):
+            if tracer.summary()["completed"] == len(data):
+                deadline_ok = True
+                break
+            time.sleep(0.05)
+        assert deadline_ok
+        execs = [r for r in spans.snapshot()
+                 if r[0] == "X" and r[4] == "device_exec"]
+        # the WHOLE segment (model + argmax head) is one program → one
+        # device_exec span per frame, no per-element extras
+        assert len(execs) == len(data)

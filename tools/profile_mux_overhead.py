@@ -25,7 +25,10 @@ This tool isolates where the per-stream cost lands:
   probe per dispatch yields a ``dev us/fr`` column — on an async
   backend the ``dispatch_exit`` attribution only times the enqueue, so
   without this column device compute hides inside whichever element
-  blocks first;
+  blocks first — and a ``hostdisp`` column: summed
+  ``device_idle{reason=host_dispatch}`` span µs per frame (gaps where
+  the chip sat starved with nothing enqueued — the dead time
+  whole-segment compilation folds away, docs/performance.md);
 - rides the cost observatory (``nnstreamer_tpu/obs/costmodel.py``)
   over every measured run: ``cm disp`` / ``cm qwait`` columns are the
   summed per-stage mean host-dispatch and queue-wait µs from the same
@@ -106,6 +109,9 @@ if MESH is not None:
 # the per-run cost-model tracers are sweep probes, not evidence: they
 # must not write COST_MODEL.json on every stop (explicit env wins)
 os.environ.setdefault("NNSTPU_OBS_COSTMODEL_AUTOSAVE", "false")
+# the hostdisp column prices every starvation gap ≥50 µs — the default
+# 5 ms floor is tuned for alerting, not for a µs-scale identity sweep
+os.environ.setdefault("NNSTPU_OBS_DEVICE_IDLE_GAP_MS", "0.05")
 
 import jax
 
@@ -122,6 +128,7 @@ from nnstreamer_tpu.elements.queue import Queue
 from nnstreamer_tpu.elements.sink import TensorSink
 from nnstreamer_tpu.elements.testsrc import DataSrc
 from nnstreamer_tpu.obs import hooks
+from nnstreamer_tpu.obs import spans as obs_spans
 from nnstreamer_tpu.obs.costmodel import CostModelTracer
 from nnstreamer_tpu.obs.device import DeviceTracer
 from nnstreamer_tpu.obs.metrics import MetricsRegistry
@@ -256,6 +263,7 @@ def run_mux(streams, frames_per_stream, attribute=False, lanes=None,
                    p.add(TensorSink(name=f"o{i}", callback=cb)))
     attr = Attribution()
     copies = CopyCount()
+    obs_spans.reset()  # fresh recorder per run; the tracer re-activates
     dev = p.attach_tracer(DeviceTracer(registry=MetricsRegistry()))
     cm = p.attach_tracer(CostModelTracer(registry=MetricsRegistry()))
     hooks.connect("copy", copies)
@@ -292,6 +300,13 @@ def run_mux(streams, frames_per_stream, attribute=False, lanes=None,
     dsum = dev.summary()
     copies.dev_us_per_frame = dsum["device_ns"] / 1e3 / max(1, total_in)
     copies.dev_dispatches = dsum["completed"]
+    # host-dispatch starvation: device_idle spans whose gap began with an
+    # empty probe queue — dead time between device programs that
+    # whole-segment compilation (graph/segments.py) exists to remove
+    idle = [r for r in obs_spans.snapshot()
+            if r[0] == obs_spans.PH_COMPLETE and r[4] == "device_idle"
+            and r[9].get("reason") == "host_dispatch"]
+    copies.hostdisp_us = sum(r[2] for r in idle) / 1e3 / max(1, total_in)
     # utilization columns (obs/util.py lane): aggregate MFU and mean
     # busy fraction across the devices this config touched — so the
     # 1→8 stream sweep shows whether added streams buy chip utilization
@@ -404,13 +419,14 @@ def main():
     base_fps, _, _, base_cp = run_mux(1, TOTAL, lanes=mode_lanes)
     print(f"\n{'streams':>7} {'lanes':>6} {'agg fps':>10} {'us/frame':>10} "
           f"{'vs 1-stream':>11} {'copy KB/fr':>11} {'allocs/fr':>10} "
-          f"{'dev us/fr':>10} {'mfu':>9} {'busy':>7} {'chips':>6} "
-          f"{'b/shard':>8} {'cm disp':>9} {'cm qwait':>9}")
+          f"{'dev us/fr':>10} {'hostdisp':>9} {'mfu':>9} {'busy':>7} "
+          f"{'chips':>6} {'b/shard':>8} {'cm disp':>9} {'cm qwait':>9}")
     print(f"{1:>7} {base_cp.lanes:>6} {base_fps:>10.0f} "
           f"{1e6 / base_fps:>10.1f} {'1.00x':>11} "
           f"{base_cp.per_frame / 1024:>11.1f} "
           f"{base_cp.allocs_per_frame:>10.3f} "
           f"{base_cp.dev_us_per_frame:>10.1f} "
+          f"{base_cp.hostdisp_us:>9.1f} "
           f"{fmt_mfu(base_cp.mfu)} {fmt_busy(base_cp.busy)} "
           f"{base_cp.chips:>6} {base_cp.per_shard:>8.2f} "
           f"{fmt_cm(base_cp.cm_dispatch_us)} {fmt_cm(base_cp.cm_queue_us)}")
@@ -424,6 +440,7 @@ def main():
         print(f"{s:>7} {cp.lanes:>6} {fps:>10.0f} {1e6 / fps:>10.1f} "
               f"{fps / base_fps:>10.2f}x {cp.per_frame / 1024:>11.1f} "
               f"{cp.allocs_per_frame:>10.3f} {cp.dev_us_per_frame:>10.1f} "
+              f"{cp.hostdisp_us:>9.1f} "
               f"{fmt_mfu(cp.mfu)} {fmt_busy(cp.busy)} "
               f"{cp.chips:>6} {cp.per_shard:>8.2f} "
               f"{fmt_cm(cp.cm_dispatch_us)} {fmt_cm(cp.cm_queue_us)}")
@@ -489,6 +506,10 @@ def main():
           f"{cp.dev_us_per_frame:.1f} us/frame over {cp.dev_dispatches} "
           f"probed dispatches (device lane; host attribution above times "
           f"the enqueue only)")
+    print(f"  host-dispatch starvation at {widest} streams: "
+          f"{cp.hostdisp_us:.1f} us/frame of device_idle with an empty "
+          f"probe queue (the gap whole-segment compilation folds away; "
+          f"docs/performance.md)")
     mfu_s = f"{cp.mfu * 100:.3f}%" if cp.mfu is not None \
         else "n/a (no cost_analysis)"
     busy_s = f"{cp.busy * 100:.1f}%" if cp.busy is not None else "n/a"

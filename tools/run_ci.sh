@@ -127,9 +127,39 @@ assert len(got2) == 16, got2
 rep2 = lockdep.report()
 assert rep2["cycles"] == [], lockdep.format_report()
 assert rep2["blocking_calls"] == [], lockdep.format_report()
+
+# 4) whole-segment compilation is clean: a jax filter with a decoder
+# folded into its program (graph/segments.py — fusion install under the
+# filter lock, undo closures on stop) must add no order cycle and no
+# blocking call under lock
+from nnstreamer_tpu.backends.jax_backend import JaxModel
+from nnstreamer_tpu.elements.decoder import TensorDecoder
+from nnstreamer_tpu.spec import TensorSpec, TensorsSpec
+
+lockdep.reset()
+W = np.random.default_rng(0).standard_normal((8, 10)).astype(np.float32)
+seg_model = JaxModel(apply=lambda p, x: x @ W,
+                     input_spec=TensorsSpec.of(
+                         TensorSpec(dtype=np.float32, shape=(8,))))
+got3 = []
+p3 = Pipeline(name="ci_lockdep_seg")
+p3.segment_compile = True
+src3 = p3.add(DataSrc(data=[np.full(8, i, np.float32) for i in range(8)],
+                      name="s"))
+filt3 = p3.add(TensorFilter(framework="jax", model=seg_model, name="f"))
+dec3 = p3.add(TensorDecoder(mode="image_labeling", name="d"))
+p3.link_chain(src3, filt3, dec3, p3.add(TensorSink(callback=got3.append,
+                                                   name="out")))
+p3.run(timeout=120)
+assert len(got3) == 8, got3
+assert dec3.plugin._lowered is None, "segment fold not undone on stop"
+rep3 = lockdep.report()
+assert rep3["cycles"] == [], lockdep.format_report()
+assert rep3["blocking_calls"] == [], lockdep.format_report()
 print(f"lockdep smoke OK: seeded cycle detected, pipeline clean over "
       f"{rep['sites']} lock sites / {rep['edges']} order edges; lane "
-      f"runtime clean over {rep2['sites']} sites / {rep2['edges']} edges")
+      f"runtime clean over {rep2['sites']} sites / {rep2['edges']} edges; "
+      f"segment-folded pipeline clean over {rep3['sites']} sites")
 PY
 
 # NOTE: on this host the axon sitecustomize makes the JAX_PLATFORMS env
@@ -1658,6 +1688,123 @@ try:
     print(f"cold-start smoke OK: cold={cold['compiles']} -> "
           f"warm={warm['compiles']} (zero misses after restart); "
           f"all {warm['compile_spans']} compile spans on the warmup track")
+finally:
+    shutil.rmtree(cache, ignore_errors=True)
+PY
+
+run_step "Segment smoke (whole-segment compilation: one device_exec per dispatch, host-dispatch dead time within budget, zero compile misses after warm restart)" \
+  python - <<'PY'
+# Whole-segment acceptance gate (graph/segments.py): the SSD pipeline
+# with the tflite-ssd decoder folded into the filter's program must
+# (a) run exactly one device_exec span per frame — the whole
+#     converter→model→decode region is ONE device program;
+# (b) cut device_idle{reason=host_dispatch} dead time to ≤10% of the
+#     unfused run's (the fold removes the 1917-anchor host decode from
+#     between device programs; only the overlay tail remains);
+# (c) serve a warm process restart with zero compile misses — the fused
+#     executable persists under its composite (StableHLO sha + segment
+#     label) cache key like any other program.
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+
+DRIVER = r'''
+import json, os, tempfile
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+from nnstreamer_tpu import Pipeline, make
+from nnstreamer_tpu.elements.filter import TensorFilter
+from nnstreamer_tpu.elements.sink import TensorSink
+from nnstreamer_tpu.elements.testsrc import DataSrc
+from nnstreamer_tpu.models import ssd_mobilenet
+from nnstreamer_tpu.obs import spans
+from nnstreamer_tpu.obs.metrics import REGISTRY
+
+N = 6
+rng = np.random.default_rng(0)
+frames = [rng.integers(0, 256, (300, 300, 3)).astype(np.uint8)
+          for _ in range(N)]
+model = ssd_mobilenet.build(num_labels=91, image_size=300)
+priors_path = ssd_mobilenet.write_priors_file(
+    os.path.join(tempfile.mkdtemp(prefix="ci_segment_priors_"),
+                 "priors.txt"))
+got = []
+p = Pipeline(name="ci_segment")
+src = p.add(DataSrc(data=frames))
+conv = p.add(make("tensor_converter"))
+norm = p.add(make("tensor_transform", mode="arithmetic",
+                  option="typecast:float32,add:-127.5,div:127.5"))
+filt = p.add(TensorFilter(framework="jax", model=model))
+dec = p.add(make("tensor_decoder", mode="bounding_boxes",
+                 option1="tflite-ssd", option3=priors_path,
+                 option4="300:300", option5="300:300"))
+sink = p.add(TensorSink(callback=got.append))
+p.link_chain(src, conv, norm, filt, dec, sink)
+p.start()
+label = filt.backend.segment_label  # sampled while PLAYING
+p.wait(300)
+p.stop()
+assert len(got) == N, f"delivered {len(got)}/{N} frames"
+
+rows = spans.snapshot()
+execs = [r for r in rows if r[0] == spans.PH_COMPLETE
+         and r[4] == "device_exec"]
+idle = [r for r in rows if r[0] == spans.PH_COMPLETE
+        and r[4] == "device_idle"
+        and r[9].get("reason") == "host_dispatch"]
+c = REGISTRY.get("nnstpu_compile_total")
+compiles = ({k[0]: int(v.value) for k, v in dict(c.children()).items()}
+            if c else {})
+print(json.dumps({
+    "frames": len(got), "execs": len(execs), "label": label,
+    "host_us_per_frame": sum(r[2] for r in idle) / 1e3 / N,
+    "compiles": compiles,
+}))
+'''
+
+base = dict(os.environ,
+            JAX_PLATFORMS="cpu",
+            NNSTPU_TRACERS="device",
+            NNSTPU_OBS_DEVICE_IDLE_GAP_MS="0.05")
+
+def child(label, **env):
+    proc = subprocess.run([sys.executable, "-c", DRIVER],
+                          env=dict(base, **env),
+                          capture_output=True, text=True, timeout=600)
+    assert proc.returncode == 0, (label, proc.stderr[-800:])
+    return json.loads(proc.stdout.strip().splitlines()[-1])
+
+cache = tempfile.mkdtemp(prefix="ci_segment_")
+try:
+    unf = child("unfused", NNSTPU_SEGMENT_ENABLED="0")
+    assert unf["label"] == "", unf
+    seg_env = {"NNSTPU_SEGMENT_ENABLED": "1",
+               "NNSTPU_COMPILE_CACHE_DIR": cache,
+               "NNSTPU_COMPILE_WARMUP": "1"}
+    cold = child("segment-cold", **seg_env)
+    assert cold["label"], "segment did not fold (empty segment label)"
+    # (a) one device program per segment dispatch
+    assert cold["execs"] == cold["frames"], cold
+    # (b) the fold removes the host decode from between device programs
+    budget = 0.10 * unf["host_us_per_frame"]
+    assert cold["host_us_per_frame"] <= budget, \
+        (f"fused host-dispatch {cold['host_us_per_frame']:.0f} us/frame "
+         f"> 10% of unfused {unf['host_us_per_frame']:.0f}")
+    assert cold["compiles"].get("miss", 0) > 0, cold  # really compiled
+    # (c) warm restart: the fused executable reconstructs, never recompiles
+    warm = child("segment-warm", **seg_env)
+    assert warm["label"] == cold["label"], (warm, cold)
+    assert warm["compiles"].get("miss", 0) == 0, \
+        f"warm restart still compiling: {warm['compiles']}"
+    assert warm["compiles"].get("persist_hit", 0) > 0, warm
+    print(f"segment smoke OK: label={cold['label']!r}, "
+          f"{cold['execs']}/{cold['frames']} device_exec, host-dispatch "
+          f"{unf['host_us_per_frame']:.0f} -> {cold['host_us_per_frame']:.0f} "
+          f"us/frame, warm restart compiles={warm['compiles']}")
 finally:
     shutil.rmtree(cache, ignore_errors=True)
 PY
