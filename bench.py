@@ -981,11 +981,35 @@ def ladder_point(batch, dtype, ndev, image_size=224):
     jax.block_until_ready(jitted(x))  # warm + step estimate
     est = time.perf_counter() - t0
     n = max(2, min(20, int(1.5 / max(est, 1e-4))))
-    t0 = time.perf_counter()
-    for _ in range(n):
-        out = jitted(x)
-    jax.block_until_ready(out)
-    step = (time.perf_counter() - t0) / n
+
+    def reps():
+        t0 = time.perf_counter()
+        for _ in range(n):
+            out = jitted(x)
+        jax.block_until_ready(out)
+        return time.perf_counter() - t0
+
+    profile_summary = None
+    if os.environ.get("BENCH_LADDER_PROFILE") == "1":
+        # BENCH_LADDER_PROFILE=1: wrap the timed reps in a deep-profiling
+        # window (obs/profiler.py) so the banked cell carries the op-level
+        # WHY next to its MFU sample.  A busy window (or any capture
+        # failure) degrades to an unprofiled measurement — the ladder's
+        # numbers must never depend on the profiler.
+        try:
+            from nnstreamer_tpu.obs.profiler import profiled_window
+
+            with profiled_window(
+                    label=f"ladder:b{batch}/{dtype}/x{ndev}",
+                    trigger="bench") as holder:
+                elapsed = reps()
+            profile_summary = holder.get("summary")
+        except Exception as exc:  # noqa: BLE001 — measure unprofiled
+            log(f"# ladder profile capture skipped: {exc!r}")
+            elapsed = reps()
+    else:
+        elapsed = reps()
+    step = elapsed / n
     peak = obs_util.peak_tflops() * (2.0 if dtype == "int8" else 1.0)
     # both peaks scale by ndev: MFU normalizes per chip and the ridge
     # point stays the single-chip ratio
@@ -1007,6 +1031,14 @@ def ladder_point(batch, dtype, ndev, image_size=224):
         row["achieved_gbs"] = round(rl["achieved_gbs"], 2)
     if rl["intensity"] is not None:
         row["intensity"] = round(rl["intensity"], 2)
+    if profile_summary is not None:
+        row["op_table"] = {
+            "capture_id": profile_summary.get("capture_id"),
+            "parser": profile_summary.get("parser"),
+            "device_planes": profile_summary.get("device_planes"),
+            "ops": profile_summary.get("ops") or [],
+            "op_categories": profile_summary.get("op_categories") or {},
+        }
     return row
 
 

@@ -159,13 +159,22 @@ class ExecutableCache:
 
     # -- store ---------------------------------------------------------------
 
-    def store(self, key: dict, payload: Optional[bytes]) -> bool:
+    def store(self, key: dict, payload: Optional[bytes],
+              extra: Optional[dict] = None) -> bool:
         """Persist one entry (``payload=None`` writes a meta-only witness
         for programs that cannot serialize — the load path then reports a
         clean miss instead of re-attempting export every process).
-        Best-effort: any I/O failure is logged and swallowed."""
+        ``extra`` merges additional sidecar facts into the meta (e.g. the
+        backend's ``{"hbm": memory_analysis bytes}``) — load validation
+        only iterates the KEY's parts, so sidecar keys can never fail a
+        lookup; read them back with :meth:`load_meta`.  Best-effort: any
+        I/O failure is logged and swallowed."""
         meta_path, payload_path = self._paths(key)
         meta = dict(key)
+        if extra:
+            for part, val in extra.items():
+                if part not in meta:  # key parts stay authoritative
+                    meta[part] = val
         meta["payload"] = "export" if payload is not None else "none"
         meta["payload_bytes"] = len(payload) if payload is not None else 0
         try:
@@ -238,6 +247,23 @@ class ExecutableCache:
         """The stored ``jax.export`` payload for ``key``, or None."""
         found = self.lookup(key)
         return found[1] if found is not None else None
+
+    def load_meta(self, key: dict) -> Optional[dict]:
+        """The full persisted meta dict (key parts + sidecar extras like
+        ``hbm``) when a valid entry exists for ``key``, else None — the
+        deep-profiling lane reads the HBM ledger of a warm entry from
+        here without reconstructing the executable."""
+        meta_path, payload_path = self._paths(key)
+        try:
+            with open(meta_path, "rb") as f:
+                meta = json.loads(f.read().decode("utf-8"))
+        except (OSError, ValueError):
+            return None
+        for part, want in key.items():
+            if meta.get(part) != want:
+                self._evict(meta_path, payload_path)
+                return None
+        return meta
 
     def has(self, key: dict) -> bool:
         """Meta-level presence (payload not read) — warmup planning."""

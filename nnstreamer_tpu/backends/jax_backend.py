@@ -535,7 +535,7 @@ class JaxBackend(FilterBackend):
         register_degraded(self._degraded_key, self._degraded_fn)
 
     def _compile_impl(self, in_spec: TensorsSpec) -> TensorsSpec:
-        from ..obs.device import cost_info, record_compile
+        from ..obs.device import cost_info, memory_info, record_compile
 
         if _faults.enabled:
             # chaos point "backend_compile" (kind compile_raise): drives
@@ -602,7 +602,8 @@ class JaxBackend(FilterBackend):
         out_spec = _spec_from_outputs(outs if not self._single_output else (outs,))
         self._out_spec = out_spec
         info = cost_info(aot) if aot is not None else {}
-        self._cost_key = self._register_cost(key, in_spec, info)
+        hbm = memory_info(aot) if aot is not None else {}
+        self._cost_key = self._register_cost(key, in_spec, info, hbm)
         self._cache[key] = (
             jitted, self._flat_compiled, self._wire_shapes, out_spec,
             self._single_output, self._in_shardings,
@@ -614,12 +615,17 @@ class JaxBackend(FilterBackend):
         record_compile(self, key, result, time.perf_counter_ns() - t0, info)
         return out_spec
 
-    def _register_cost(self, key, in_spec: TensorsSpec, info: dict) -> str:
+    def _register_cost(self, key, in_spec: TensorsSpec, info: dict,
+                       hbm: Optional[dict] = None) -> str:
         """Register this entry's cost_analysis() profile with the
         utilization lane (obs/util.py), keyed by a per-process executable
-        fingerprint, and return the key.  Cost-less entries (CPU hosts
-        where cost_analysis() is flaky) register too — their dispatches
-        must show up as ``mfu=None``, not vanish.  Never raises."""
+        fingerprint, and return the key.  ``hbm`` is the executable's
+        ``memory_analysis()`` footprint (obs/device.py ``memory_info``) —
+        recorded on the same registry entry so the deep-profiling lane's
+        HBM ledger and ``nnstpu_executable_hbm_bytes`` read straight out
+        of the cost registry.  Cost-less entries (CPU hosts where
+        cost_analysis() is flaky) register too — their dispatches must
+        show up as ``mfu=None``, not vanish.  Never raises."""
         try:
             from ..obs import util as _obs_util
 
@@ -634,7 +640,8 @@ class JaxBackend(FilterBackend):
                 fp, flops=info.get("flops"), bytes=info.get("bytes"),
                 bucket=bucket, model=name,
                 devices=int(self._mesh.devices.size)
-                if self._mesh is not None else 1)
+                if self._mesh is not None else 1,
+                **({"hbm": dict(hbm)} if hbm else {}))
         except Exception:  # noqa: BLE001 — attribution must not cost a compile
             return ""
 
@@ -690,7 +697,13 @@ class JaxBackend(FilterBackend):
             # (the XLA binary cache still carries their bits)
             payload = exec_cache.serialize_entry(
                 getattr(jitted, "__wrapped__", jitted), structs)
-        cache.store(pkey, payload)
+        try:
+            from ..obs.device import memory_info as _mem_info
+
+            hbm = _mem_info(compiled)
+        except Exception:  # noqa: BLE001 — the ledger is best-effort
+            hbm = {}
+        cache.store(pkey, payload, extra={"hbm": hbm} if hbm else None)
         return compiled, "miss"
 
     # -- compile-ahead warmup ------------------------------------------------

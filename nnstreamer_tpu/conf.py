@@ -99,6 +99,26 @@ DEFAULTS: Dict[str, Dict[str, str]] = {
         "forensics_min_rel": "0.10",    # noise-band relative floor
         "forensics_min_abs_us": "5.0",  # noise-band absolute floor, µs
         "forensics_min_samples": "32",  # live-baseline warmup before verdicts
+        # Deep profiling lane (obs/profiler.py): on-demand XPlane capture
+        # windows + per-op attribution + HBM forensics.  The gallery holds
+        # the newest profile_keep captures under profile_max_bytes; the
+        # watchdog auto-trigger (profile_auto) fires a profile_auto_seconds
+        # window, at most once per profile_auto_cooldown_s, when a
+        # dispatch's device time exceeds the profile_sigmas/profile_min_rel/
+        # profile_min_abs_us noise band after profile_min_samples.  See
+        # docs/observability.md "Deep profiling lane".
+        "profile_dir": "",              # capture gallery ("" = process temp)
+        "profile_keep": "4",            # gallery entries retained (newest K)
+        "profile_max_bytes": "67108864",  # gallery byte cap (64 MiB)
+        "profile_default_seconds": "2.0",  # window when none requested
+        "profile_top_k": "20",          # op rows kept in the summary table
+        "profile_auto": "false",        # watchdog-triggered auto-capture
+        "profile_auto_seconds": "1.0",  # auto-capture window length
+        "profile_auto_cooldown_s": "120",  # min seconds between auto-captures
+        "profile_sigmas": "3.0",        # degrade noise-band sigmas
+        "profile_min_rel": "0.10",      # degrade noise-band relative floor
+        "profile_min_abs_us": "50.0",   # degrade noise-band absolute floor, µs
+        "profile_min_samples": "32",    # per-executable warmup before verdicts
     },
     # SLO burn-rate engine (obs/slo.py): declarative latency objectives
     # evaluated at scrape time over registry histogram windows, surfaced

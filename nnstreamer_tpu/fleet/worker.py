@@ -210,6 +210,21 @@ class FleetWorker:
             return None
         return f"{self.metrics_server.host}:{self.metrics_server.port}"
 
+    # -- deep profiling ------------------------------------------------------
+
+    def profile(self, seconds: Optional[float] = None,
+                frames: Optional[int] = None) -> dict:
+        """Capture one deep-profiling window on this worker
+        (obs/profiler.py) and return the parsed summary — the in-process
+        twin of ``GET /profile`` on :attr:`trace_addr` (the remote path:
+        ``obs.collector.fetch_profile(worker.trace_addr, seconds=...)``).
+        Raises the profiler's typed ``ProfileBusyError`` when a capture
+        already holds the window."""
+        from ..obs.profiler import capture_profile
+
+        return capture_profile(seconds=seconds, frames=frames,
+                               trigger="fleet")
+
     # -- membership probe (in-process fleets) --------------------------------
 
     def probe(self, _info=None) -> str:

@@ -702,14 +702,12 @@ class Pipeline:
             tracer.stop()
         if self._xplane_tracing:
             self._xplane_tracing = False
-            try:
-                import jax
+            # the deep-profiling lane owns the stop/parse/bank half too:
+            # the summary lands in the capture gallery, failures surface
+            # through the health hook + degraded registry (never raises)
+            from ..obs import profiler as _profiler
 
-                jax.profiler.stop_trace()
-            except Exception as exc:  # noqa: BLE001
-                import warnings
-
-                warnings.warn(f"xplane trace stop failed: {exc!r}", stacklevel=2)
+            _profiler.stop_whole_run(self)
 
     def run(self, timeout: Optional[float] = None) -> None:
         """start() + wait() + stop() — convenience for finite streams."""
@@ -725,7 +723,6 @@ class Pipeline:
     def _post_negotiate_hooks(self) -> None:
         """Conf-driven observability at PLAYING: profiling enable + dot dump
         (the GST_DEBUG_DUMP_DOT_DIR analog, ``tools/debugging/``)."""
-        import os
         import warnings
 
         from ..conf import conf
@@ -740,13 +737,16 @@ class Pipeline:
             trace_dir = conf.get_path("common", "xplane_trace_dir", "")
             if trace_dir:
                 # device-level xplane trace (jax.profiler) for the whole
-                # PLAYING interval — SURVEY §5's HawkTracer/GstShark analog;
-                # stopped (and flushed to disk) in stop()
-                import jax
+                # PLAYING interval — SURVEY §5's HawkTracer/GstShark analog,
+                # run through the deep-profiling lane (obs/profiler.py):
+                # one start/stop implementation, raw artifacts under the
+                # user's trace_dir, parsed summary in the capture gallery,
+                # /profile answers a typed 409 while this trace holds the
+                # window; stopped (and flushed to disk) in stop()
+                from ..obs import profiler as _profiler
 
-                os.makedirs(trace_dir, exist_ok=True)
-                jax.profiler.start_trace(trace_dir)
-                self._xplane_tracing = True
+                self._xplane_tracing = _profiler.start_whole_run(
+                    self, trace_dir)
             self._dump_dot("PLAYING")
         except Exception as exc:  # noqa: BLE001
             warnings.warn(f"observability hooks failed: {exc!r}", stacklevel=2)
@@ -790,6 +790,15 @@ class Pipeline:
             raise PipelineError(
                 "warmup() needs a started pipeline (negotiated specs)")
         self.warmup_report = execute(collect_plan(self), pipeline=self)
+        try:
+            # HBM residency check over the warmed executables (typed
+            # HbmCapacityWarning + degraded reason when over capacity —
+            # advisory, never a failure; see obs/profiler.py)
+            from ..obs.profiler import check_hbm_capacity
+
+            self.warmup_report["hbm"] = check_hbm_capacity(self)
+        except Exception:  # noqa: BLE001 — the residency check is advisory
+            pass
         return self.warmup_report
 
     def attach_tracer(self, tracer):
@@ -895,6 +904,18 @@ class Pipeline:
                     # "otherData" is the trace-event format's sidecar slot:
                     # what the device allocators held when the graph died
                     doc["otherData"] = {"device_memory": mem}
+            except Exception:  # noqa: BLE001 — the dump matters more
+                pass
+            try:
+                from ..obs.profiler import hbm_ledger
+
+                ledger = hbm_ledger()
+                if ledger:
+                    # the per-executable memory_analysis() ledger next to
+                    # the live allocator stats: an OOM verdict can name
+                    # the largest resident executable, not just the
+                    # device that died
+                    doc.setdefault("otherData", {})["hbm_ledger"] = ledger
             except Exception:  # noqa: BLE001 — the dump matters more
                 pass
             with open(path, "w") as f:

@@ -187,9 +187,20 @@ def execute(items: List[WarmupItem], pipeline=None,
 def run_warmup(pipeline, force: bool = False) -> Optional[dict]:
     """The ``Pipeline.start`` entry point: no-op unless ``[compile]
     warmup`` is on (or ``force``); otherwise collect + execute and stash
-    the report on ``pipeline.warmup_report``."""
+    the report on ``pipeline.warmup_report``.  After the ladder compiles,
+    the deep-profiling lane's HBM residency check runs: every warmed
+    executable's ``memory_analysis()`` resident estimate summed against
+    device capacity — over budget is a typed ``HbmCapacityWarning`` (+ a
+    degraded reason on ``/healthz``) BEFORE the pipeline starts PLAYING,
+    never a start failure."""
     if not force and not configured():
         return None
     report = execute(collect_plan(pipeline), pipeline=pipeline)
     pipeline.warmup_report = report
+    try:
+        from ..obs.profiler import check_hbm_capacity
+
+        report["hbm"] = check_hbm_capacity(pipeline)
+    except Exception:  # noqa: BLE001 — the residency check is advisory
+        pass
     return report

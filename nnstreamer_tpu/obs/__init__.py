@@ -127,14 +127,32 @@ from .collector import (  # noqa: F401
 )
 
 # importing .forensics registers the "forensics" tracer; .slo is the
-# burn-rate engine behind /alerts and the `alert` hook
+# burn-rate engine behind /alerts and the `alert` hook; .profiler is the
+# deep-profiling lane (XPlane capture gallery + per-op attribution + HBM
+# forensics) — importing it also installs the nnstpu_executable_hbm_bytes
+# scrape collector
 from . import forensics  # noqa: E402,F401
 from . import slo  # noqa: E402,F401
+from . import profiler  # noqa: E402,F401
 from .forensics import ForensicsEngine, ForensicsTracer  # noqa: F401
 from .slo import SloEngine, parse_objectives  # noqa: F401
+from .profiler import (  # noqa: F401
+    DegradeDetector,
+    HbmCapacityWarning,
+    ProfileBusyError,
+    ProfileGallery,
+    annotate_chrome_trace,
+    capture_profile,
+    check_hbm_capacity,
+    hbm_ledger,
+    parse_capture_dir,
+    parse_xspace,
+    profiled_window,
+)
 from .device import (  # noqa: F401
     DeviceTracer,
     device_memory_snapshot,
+    memory_info,
     record_compile,
     register_memory_gauges,
 )

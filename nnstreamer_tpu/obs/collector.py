@@ -257,7 +257,18 @@ class TraceCollector:
                     "name": f"source_missing:{name}", "cat": "collector",
                     "args": {"error": err},
                 })
-        return {"traceEvents": merged, "displayTimeUnit": "ms"}
+        doc = {"traceEvents": merged, "displayTimeUnit": "ms"}
+        try:
+            # the deep-profiling lane's drill-down: the most recent
+            # capture's top-K op table rides under otherData and every
+            # matching device_exec span gets a profile_capture arg — the
+            # "which fused op" answer next to the span that asked it
+            from .profiler import annotate_chrome_trace
+
+            annotate_chrome_trace(doc)
+        except Exception:  # noqa: BLE001 — annotation is best-effort
+            pass
+        return doc
 
     @staticmethod
     def _hop_flows(merged: List[dict]) -> List[dict]:
@@ -540,6 +551,38 @@ def fetch_alerts(addrs: Dict[str, str], timeout_s: float = 5.0) -> dict:
     if errors:
         merged["errors"] = errors
     return merged
+
+
+def fetch_profile(addr: str, seconds: Optional[float] = None,
+                  frames: Optional[int] = None,
+                  timeout_s: float = 60.0) -> dict:
+    """Trigger a deep-profiling capture on a remote worker
+    (``GET /profile`` on its metrics address — the same trace-addr
+    plumbing the collector federates traces over) and return the parsed
+    summary.  The endpoint blocks for the capture window, so
+    ``timeout_s`` must exceed it.  A busy worker (HTTP 409) raises
+    :class:`~nnstreamer_tpu.obs.profiler.ProfileBusyError`."""
+    import urllib.error
+
+    params = []
+    if seconds is not None:
+        params.append(f"seconds={seconds}")
+    if frames is not None:
+        params.append(f"frames={frames}")
+    url = f"http://{addr}/profile" + (
+        "?" + "&".join(params) if params else "")
+    try:
+        return _http_get_json(url, timeout_s)
+    except urllib.error.HTTPError as exc:
+        if exc.code == 409:
+            from .profiler import ProfileBusyError
+
+            try:
+                active = json.loads(exc.read().decode("utf-8")).get("active")
+            except Exception:  # noqa: BLE001 — body is advisory
+                active = None
+            raise ProfileBusyError(active) from exc
+        raise
 
 
 def fetch_metrics(addrs: Dict[str, str], timeout_s: float = 5.0,

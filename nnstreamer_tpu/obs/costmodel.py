@@ -321,6 +321,11 @@ class CostModelTracer(Tracer):
         # emits queue_push without enqueuing anything)
         self._qstamps: Dict[str, "collections.deque"] = {}
         self._qskip: Dict[str, int] = {}
+        # pops owed a stamp: the queue makes an item poppable BEFORE its
+        # queue_push hook fires, so a fast consumer's pop can arrive
+        # first — that pop is counted as ~0 residency and the late stamp
+        # retired here, keeping the FIFO pairing exact
+        self._qowed: Dict[str, int] = {}
         self._gauge = None
         self._collect_handle = None
         self._run_id = f"{os.getpid()}-{id(self):x}-{now_ns():x}"
@@ -425,6 +430,12 @@ class CostModelTracer(Tracer):
                 # before it entered the queue; this push changed nothing
                 self._qskip[node.name] -= 1
                 return
+            if self._qowed.get(node.name, 0) > 0:
+                # the item's pop already raced past this hook and was
+                # sampled as ~0 residency — retire the debt instead of
+                # stamping, so later pops pair with their own pushes
+                self._qowed[node.name] -= 1
+                return
             dq = self._qstamps.get(node.name)
             if dq is None:
                 dq = self._qstamps[node.name] = collections.deque(
@@ -438,8 +449,15 @@ class CostModelTracer(Tracer):
         with self._lock:
             dq = self._qstamps.get(node.name)
             stamp = dq.popleft() if dq else None
+            if stamp is None:
+                # no stamp yet: this pop overtook its push hook, so the
+                # residency was below the hook gap — a TRUE ~0, not an
+                # unmeasured leg (the push/pop pair did happen)
+                self._qowed[node.name] = self._qowed.get(node.name, 0) + 1
         if stamp is not None:
             self._leg(node.name, "queue_wait", max(0, now_ns() - stamp) / 1e3)
+        else:
+            self._leg(node.name, "queue_wait", 0.0)
 
     def _on_queue_drop(self, node, reason) -> None:
         if node.pipeline is not self._pipeline:

@@ -170,6 +170,28 @@ def cost_info(compiled) -> dict:
     return info
 
 
+def memory_info(compiled) -> dict:
+    """Per-executable HBM footprint out of an AOT
+    ``Compiled.memory_analysis()`` (``CompiledMemoryStats``): argument/
+    output/temp/alias/generated-code bytes, as
+    ``{"argument_bytes": ..., "output_bytes": ..., ...}``; {} when the
+    runtime doesn't expose it.  Recorded alongside the cost registry at
+    compile time — the feed behind ``nnstpu_executable_hbm_bytes`` and
+    the OOM flight dump's HBM ledger (obs/profiler.py)."""
+    try:
+        ma = compiled.memory_analysis()
+    except Exception:  # noqa: BLE001 — optional on many backends
+        return {}
+    if ma is None:
+        return {}
+    info = {}
+    for kind in ("argument", "output", "temp", "alias", "generated_code"):
+        val = getattr(ma, f"{kind}_size_in_bytes", None)
+        if isinstance(val, (int, float)) and val >= 0:
+            info[f"{kind}_bytes"] = int(val)
+    return info
+
+
 def record_compile(backend, key, result: str, dur_ns: int = 0,
                    info: Optional[dict] = None,
                    registry: Optional[MetricsRegistry] = None) -> None:
@@ -187,9 +209,12 @@ def record_compile(backend, key, result: str, dur_ns: int = 0,
         if result in ("miss", "persist_hit"):
             hist.observe(dur_ns / 1e9, phase=phase)
             if info:
-                if info.get("flops"):
+                # cost_analysis() reports negative sentinels for ops it
+                # cannot cost (custom calls / host callbacks) — a counter
+                # rejects those, so only true positives accumulate
+                if (info.get("flops") or 0) > 0:
                     flops_c.inc(info["flops"])
-                if info.get("bytes"):
+                if (info.get("bytes") or 0) > 0:
                     bytes_c.inc(info["bytes"])
         if spans.enabled and result in ("miss", "persist_hit"):
             args = {"key": repr(key), "backend": type(backend).__name__,
@@ -267,10 +292,39 @@ def _mesh_shards(head):
     return out
 
 
+# Peak-watermark deltas: the instantaneous gauges miss transient spikes
+# between scrapes, so every snapshot folds the observed high-water mark
+# into a per-device watermark that the peak gauge drains at scrape time.
+_peak_lock = threading.Lock()
+_peak_watermarks: Dict[str, int] = {}
+
+# allocator peak-reset spellings, probed in order (most allocators have
+# none — the watermark then carries the since-start peak, still honest)
+_PEAK_RESET_METHODS = ("reset_memory_stats", "clear_memory_stats",
+                       "reset_peak_memory_stats")
+
+
+def _observe_peaks(snapshot: Dict[str, Dict[str, int]]) -> None:
+    with _peak_lock:
+        for dev, stats in snapshot.items():
+            seen = max(stats.get("peak_bytes_in_use", 0),
+                       stats.get("bytes_in_use", 0))
+            if seen > _peak_watermarks.get(dev, 0):
+                _peak_watermarks[dev] = seen
+
+
+def reset_peak_watermarks() -> None:
+    """Drop every tracked watermark (test isolation)."""
+    with _peak_lock:
+        _peak_watermarks.clear()
+
+
 def device_memory_snapshot(devices=None) -> Dict[str, Dict[str, int]]:
     """Per-device ``memory_stats()`` snapshot ({"tpu:0": {bytes_in_use:
     ...}}), for /metrics collectors and error flight dumps.  Devices
-    without allocator stats (CPU) are omitted."""
+    without allocator stats (CPU) are omitted.  Every snapshot also
+    feeds the peak watermarks behind
+    ``nnstpu_device_memory_peak_bytes``."""
     if devices is None:
         try:
             import jax
@@ -290,6 +344,7 @@ def device_memory_snapshot(devices=None) -> Dict[str, Dict[str, int]]:
                 if isinstance(stats.get(k), (int, float))}
         if kept:
             out[_device_label(d)] = kept
+    _observe_peaks(out)
     return out
 
 
@@ -297,18 +352,58 @@ def register_memory_gauges(registry: Optional[MetricsRegistry] = None,
                            devices=None):
     """Sample per-device memory into ``nnstpu_device_memory_bytes``
     gauges at every scrape (a registry collector — pull-style, no
-    poller).  Returns the collector handle for ``remove_collector``."""
+    poller).  Returns the collector handle for ``remove_collector``.
+
+    Also exports ``nnstpu_device_memory_peak_bytes{device}``: the
+    highest ``peak_bytes_in_use`` observed since the LAST scrape (any
+    snapshot between scrapes feeds the watermark).  After each read the
+    tracked watermark resets to zero and, where the allocator supports a
+    peak reset (probed: ``reset_memory_stats`` /
+    ``clear_memory_stats`` / ``reset_peak_memory_stats``), the
+    device-side peak resets too — making the series a true
+    between-scrapes high-water mark instead of a since-start maximum."""
     registry = registry if registry is not None else REGISTRY
     gauge = registry.gauge(
         "nnstpu_device_memory_bytes",
         "Per-device allocator stats (bytes), sampled at scrape time",
         labelnames=("device", "kind"),
     )
+    peak_gauge = registry.gauge(
+        "nnstpu_device_memory_peak_bytes",
+        "Per-device peak bytes in use observed since the last scrape "
+        "(watermark drained at read; allocator peak reset where supported)",
+        labelnames=("device",),
+    )
 
     def collect():
-        for dev, stats in device_memory_snapshot(devices).items():
+        snapshot = device_memory_snapshot(devices)
+        for dev, stats in snapshot.items():
             for kind, val in stats.items():
                 gauge.set(val, device=dev, kind=kind)
+        with _peak_lock:
+            drained = {dev: _peak_watermarks.pop(dev, 0)
+                       for dev in snapshot}
+        for dev, peak in drained.items():
+            peak_gauge.set(peak, device=dev)
+        devs = devices
+        if devs is None:
+            try:
+                import jax
+
+                devs = jax.devices()
+            except Exception:  # noqa: BLE001
+                devs = ()
+        for d in devs:
+            if _device_label(d) not in drained:
+                continue
+            for meth in _PEAK_RESET_METHODS:
+                fn = getattr(d, meth, None)
+                if callable(fn):
+                    try:
+                        fn()
+                    except Exception:  # noqa: BLE001 — reset is best-effort
+                        pass
+                    break
 
     return registry.add_collector(collect)
 
@@ -728,6 +823,10 @@ class DeviceTracer(Tracer):
                 # span records (so downstream aggregates reconcile with
                 # the Perfetto trace by construction)
                 info = {"bucket": bucket, "mesh": nshards}
+                if cost_key:
+                    # the join key the deep-profiling lane (fingerprint
+                    # watch, DegradeDetector) keys its baselines by
+                    info["cost_key"] = cost_key
                 if flops:
                     info["flops"] = flops
                 if bytes_:

@@ -401,6 +401,52 @@ class MetricsServer:
                     body = json.dumps(doc, default=str,
                                       sort_keys=True).encode("utf-8")
                     self._reply(body, "application/json; charset=utf-8")
+                elif path == "/profile":
+                    # on-demand deep-profiling window (obs/profiler.py):
+                    # blocks this handler thread for the capture window
+                    # (the server is threading — scrapes keep flowing),
+                    # serialized process-wide with a typed 409 when a
+                    # capture (or whole-run trace) already runs
+                    from . import profiler
+
+                    params = dict(
+                        p.split("=", 1) for p in query.split("&")
+                        if "=" in p)
+                    try:
+                        seconds = (float(params["seconds"])
+                                   if "seconds" in params else None)
+                        frames = (int(params["frames"])
+                                  if "frames" in params else None)
+                    except ValueError:
+                        self._reply(
+                            json.dumps({"error": "bad_request",
+                                        "detail": f"unparseable query "
+                                                  f"{query!r}"}
+                                       ).encode("utf-8"),
+                            "application/json; charset=utf-8", status=400)
+                        return
+                    try:
+                        summary = profiler.capture_profile(
+                            seconds=seconds, frames=frames,
+                            trigger="http", registry=registry)
+                        body = json.dumps(summary, sort_keys=True,
+                                          default=str).encode("utf-8")
+                        self._reply(body,
+                                    "application/json; charset=utf-8")
+                    except profiler.ProfileBusyError as exc:
+                        body = json.dumps(
+                            {"error": "busy", "active": exc.active},
+                            sort_keys=True).encode("utf-8")
+                        self._reply(body,
+                                    "application/json; charset=utf-8",
+                                    status=409)
+                    except Exception as exc:  # noqa: BLE001 — typed 500
+                        body = json.dumps(
+                            {"error": "capture_failed",
+                             "detail": repr(exc)}).encode("utf-8")
+                        self._reply(body,
+                                    "application/json; charset=utf-8",
+                                    status=500)
                 elif path == "/trace.json":
                     # flight-recorder snapshot + clock stamp: the feed
                     # the cluster trace collector merges and aligns;

@@ -105,6 +105,14 @@ must stay allocation-light):
                    is ``page`` (fast window) / ``ticket`` (slow only),
                    ``detail`` carries the burn rates and windows that
                    crossed.
+``profile``        ``(pipeline_name, action, detail)`` — the deep-
+                   profiling lane (:mod:`nnstreamer_tpu.obs.profiler`)
+                   moved a capture through its lifecycle: ``action`` is
+                   ``start`` / ``end`` / ``abort`` / ``error`` /
+                   ``hbm_over_capacity``; ``detail`` carries the
+                   capture id plus the op/frame counts (or the failure
+                   reason).  ``pipeline_name`` may be empty for
+                   backend-level windows (bench, ``device_trace``).
 =================  ====================================================
 
 Timestamps passed through hooks are ``time.perf_counter_ns()`` — every
@@ -151,6 +159,7 @@ HOOK_SIGNATURES: Dict[str, Tuple[str, ...]] = {
                     "dur_ns", "info"),
     "segment": ("pipeline_name", "filter_name", "label", "detail", "action"),
     "alert": ("name", "state", "severity", "detail"),
+    "profile": ("pipeline_name", "action", "detail"),
 }
 
 HOOKS = tuple(HOOK_SIGNATURES)

@@ -153,6 +153,14 @@ def clear_costs() -> None:
         _costs.clear()
 
 
+def cost_entries() -> Dict[str, dict]:
+    """Every registered cost profile, keyed by executable fingerprint
+    (entries are copies).  The deep-profiling lane reads this to join
+    XPlane op tables and build the per-executable HBM ledger."""
+    with _cost_lock:
+        return {k: dict(v) for k, v in _costs.items()}
+
+
 # -- roofline math ------------------------------------------------------------
 
 def roofline(flops: Optional[float], bytes_: Optional[float], dur_s: float,

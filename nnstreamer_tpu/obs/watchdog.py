@@ -95,6 +95,12 @@ class PipelineWatchdog(Tracer):
         self._stop_evt = threading.Event()
         self._monitor: Optional[threading.Thread] = None
         self._health_fn = None
+        # deep-profiling auto-capture: armed at install when [obs]
+        # profile_auto is on (the conf read happens there, not here, so
+        # attach-then-start picks up late env changes)
+        self._profile_auto = False
+        self._profile_detector = None
+        self._auto_captures = 0
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -142,6 +148,28 @@ class PipelineWatchdog(Tracer):
         # a sick tunnel regime is visible on /metrics DURING serving
         self._wire_probe_s = self._conf_float("watchdog_wire_probe_s", 0.0)
         self._last_wire_probe = 0.0
+        # [obs] profile_auto: when a dispatch's device time degrades
+        # beyond the perfdiff noise band, auto-trigger a deep-profiling
+        # capture (obs/profiler.py) so the regression's op-level evidence
+        # is banked while the regression is still happening — at most
+        # one capture per profile_auto_cooldown_s
+        self._profile_auto = False
+        self._profile_detector = None
+        self._profile_auto_s = self._conf_float("profile_auto_seconds", 1.0)
+        self._profile_cooldown_s = self._conf_float(
+            "profile_auto_cooldown_s", 120.0)
+        self._last_auto_profile = 0.0
+        self._auto_captures = 0
+        try:
+            self._profile_auto = conf.get_bool("obs", "profile_auto", False)
+        except ValueError:
+            self._profile_auto = False
+        if self._profile_auto:
+            from .profiler import DegradeDetector
+
+            self._profile_detector = DegradeDetector()
+            self._connect("device_exec",
+                          self._profile_detector.on_device_exec)
         self._gauge = self._registry.gauge(
             "nnstpu_health",
             "Pipeline health as judged by the watchdog (1 healthy, "
@@ -281,6 +309,13 @@ class PipelineWatchdog(Tracer):
                             prober(), self._registry, addr=addr)
                     except Exception:  # noqa: BLE001 — a dead edge is
                         pass           # the deployer's problem, not ours
+            if self._profile_detector is not None:
+                verdict = self._profile_detector.degraded()
+                if (verdict
+                        and time.monotonic() - self._last_auto_profile
+                        >= self._profile_cooldown_s):
+                    self._last_auto_profile = time.monotonic()
+                    self._auto_capture(verdict)
             try:
                 reasons = self._evaluate()
             except Exception:  # noqa: BLE001 — the monitor must survive
@@ -301,6 +336,35 @@ class PipelineWatchdog(Tracer):
                             "watchdog recovery failed")
             else:
                 self._recovered()
+
+    def _auto_capture(self, verdict: str) -> None:
+        """Spawn one watchdog-triggered deep-profiling window in the
+        background (the monitor tick must not block for the capture);
+        a capture already in flight (typed busy) simply skips — the
+        cooldown clock has been stamped either way."""
+        import logging
+
+        logging.getLogger("nnstreamer_tpu.obs").warning(
+            "watchdog: device-time degradation (%s) — auto-triggering "
+            "profile capture", verdict)
+
+        def run():
+            from . import profiler
+
+            try:
+                profiler.capture_profile(
+                    seconds=self._profile_auto_s, pipeline=self._pipeline,
+                    trigger="watchdog", registry=self._registry)
+                with self._lock:
+                    self._auto_captures += 1
+            except profiler.ProfileBusyError:
+                pass
+            except Exception:  # noqa: BLE001 — the capture is best-effort
+                logging.getLogger("nnstreamer_tpu.obs").exception(
+                    "watchdog auto-capture failed")
+
+        threading.Thread(target=run, daemon=True,
+                         name=f"wd-profile:{self._pipeline.name}").start()
 
     def _flip(self, reasons: List[str], dump: bool = True) -> None:
         with self._lock:
@@ -398,6 +462,12 @@ class PipelineWatchdog(Tracer):
                 "recover": bool(self._recover),
                 "recoveries": self._recoveries,
             }
+            if self._profile_auto:
+                out["profile_auto"] = {
+                    "captures": self._auto_captures,
+                    "verdicts": (self._profile_detector.verdicts
+                                 if self._profile_detector else 0),
+                }
         # degraded-but-serving reasons (e.g. a cpu-fallback backend) ride
         # the watchdog's summary too: stats.json readers see WHY a worker
         # is deprioritized without scraping /healthz separately

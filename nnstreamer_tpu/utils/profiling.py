@@ -106,11 +106,14 @@ def profiled():
 
 @contextlib.contextmanager
 def device_trace(logdir: str):
-    """Capture an XLA/TPU xplane trace (jax.profiler) around a region."""
-    import jax
+    """Capture an XLA/TPU xplane trace (jax.profiler) around a region.
 
-    jax.profiler.start_trace(logdir)
-    try:
+    Routed through the deep-profiling lane's process-wide capture lock
+    (obs/profiler.py) so a concurrent capture raises its typed
+    ``ProfileBusyError`` instead of jax's opaque double-start crash; the
+    raw artifacts land under the caller's ``logdir`` as before."""
+    from ..obs.profiler import profiled_window
+
+    with profiled_window(label="device_trace", logdir=logdir,
+                         trigger="manual", parse=False):
         yield
-    finally:
-        jax.profiler.stop_trace()

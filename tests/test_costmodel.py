@@ -136,8 +136,10 @@ class TestCostModelTracer:
         assert f["legs"]["dispatch"]["mean_us"] > 0
         assert f["bucket"] == 4 and f["mesh"] == 1
         assert f["compute_us"] is not None
-        # queue residency lands on the QUEUE node, from the push/pop FIFO
-        assert stages["q"]["legs"]["queue_wait"]["count"] == 6
+        # queue residency lands on the QUEUE node, from the push/pop
+        # FIFO — one sample per pop: 6 frames + the EOS event (a pop
+        # that overtakes its push hook still counts, as ~0 residency)
+        assert stages["q"]["legs"]["queue_wait"]["count"] == 7
         assert stages["q"]["legs"]["queue_wait"]["mean_us"] > 0
         # events (EOS) are not frames
         assert f["frames"] == 6

@@ -421,6 +421,11 @@ class TestMemoryGauges:
             " 4096",
             'nnstpu_device_memory_bytes{device="tpu:0",'
             'kind="peak_bytes_in_use"} 2048',
+            "# HELP nnstpu_device_memory_peak_bytes Per-device peak bytes "
+            "in use observed since the last scrape (watermark drained at "
+            "read; allocator peak reset where supported)",
+            "# TYPE nnstpu_device_memory_peak_bytes gauge",
+            'nnstpu_device_memory_peak_bytes{device="tpu:0"} 2048',
         ]) + "\n"
         assert render_text(reg) == expected
 

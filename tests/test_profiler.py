@@ -1,0 +1,678 @@
+"""Deep profiling lane: windowed XPlane capture, per-op attribution,
+HBM forensics (docs/observability.md, "Deep profiling lane").
+
+Everything here runs on the CPU backend; CPU artifacts carry only host
+planes, so device-plane assertions are gated on ``device_planes > 0``
+exactly as the docs prescribe for TPU-only checks.
+"""
+
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu import Pipeline
+from nnstreamer_tpu.elements.filter import TensorFilter
+from nnstreamer_tpu.elements.sink import TensorSink
+from nnstreamer_tpu.elements.testsrc import DataSrc
+from nnstreamer_tpu.obs import hooks, profiler
+from nnstreamer_tpu.obs.export import render_text
+from nnstreamer_tpu.obs.metrics import MetricsRegistry
+from nnstreamer_tpu.obs.profiler import (
+    HbmCapacityWarning,
+    ProfileBusyError,
+    ProfileGallery,
+    categorize_op,
+    parse_capture_dir,
+    parse_text_events,
+    parse_xspace,
+)
+
+
+@pytest.fixture(autouse=True)
+def _isolated_gallery(tmp_path, monkeypatch):
+    """Every test gets its own gallery dir and a clean capture memory."""
+    monkeypatch.setenv("NNSTPU_OBS_PROFILE_DIR", str(tmp_path / "gallery"))
+    profiler.reset_gallery()
+    with profiler._last_lock:
+        profiler._recent.clear()
+    yield
+    profiler.reset_gallery()
+    with profiler._last_lock:
+        profiler._recent.clear()
+
+
+def slow_pipeline(got, n=6, sleep_s=0.03, name="prof"):
+    def slow(x):
+        time.sleep(sleep_s)
+        return x * 2
+
+    p = Pipeline(name=name)
+    src = p.add(DataSrc(data=[np.full(4, i, np.float32) for i in range(n)]))
+    filt = p.add(TensorFilter(framework="custom", model=slow, name="double"))
+    sink = p.add(TensorSink(callback=got.append))
+    p.link_chain(src, filt, sink)
+    return p
+
+
+# -- proto wire parsing -------------------------------------------------------
+
+
+def _varint(v):
+    out = bytearray()
+    while True:
+        b = v & 0x7F
+        v >>= 7
+        if v:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _field(fno, payload):
+    """Length-delimited field (wire type 2)."""
+    return _varint((fno << 3) | 2) + _varint(len(payload)) + payload
+
+
+def _vfield(fno, v):
+    """Varint field (wire type 0)."""
+    return _varint(fno << 3) + _varint(v)
+
+
+def _xspace(plane_name, events, metadata):
+    """Hand-build an XSpace proto: one plane, one line.
+
+    ``events`` = [(metadata_id, duration_ps, occurrences)], ``metadata``
+    = {id: name} — the exact field numbers the walker documents."""
+    meta_entries = b""
+    for mid, name in metadata.items():
+        em = _vfield(1, mid) + _field(2, name.encode())
+        meta_entries += _field(4, _vfield(1, mid) + _field(2, em))
+    evs = b""
+    for mid, dur_ps, occ in events:
+        evs += _field(4, _vfield(1, mid) + _vfield(3, dur_ps) + _vfield(5, occ))
+    line = _field(2, b"line0") + evs
+    plane = (_field(2, plane_name.encode()) + _field(3, line) + meta_entries)
+    return _field(1, plane)
+
+
+class TestXplaneParsing:
+    def test_parse_xspace_hand_built_proto(self):
+        data = _xspace(
+            "/device:TPU:0",
+            events=[(1, 5_000_000, 2), (2, 1_000_000, 1)],
+            metadata={1: "fusion.3", 2: "copy.1"},
+        )
+        planes = parse_xspace(data)
+        assert len(planes) == 1
+        assert planes[0]["name"] == "/device:TPU:0"
+        assert planes[0]["ops"]["fusion.3"] == [5_000_000, 2]
+        assert planes[0]["ops"]["copy.1"] == [1_000_000, 1]
+
+    def test_parse_capture_dir_prefers_device_planes(self, tmp_path):
+        host = _xspace("/host:CPU", [(1, 9_000_000, 1)], {1: "python_call"})
+        dev = _xspace("/device:TPU:0", [(1, 2_000_000, 3)], {1: "dot.7"})
+        (tmp_path / "a.xplane.pb").write_bytes(host + dev)
+        parsed = parse_capture_dir(str(tmp_path))
+        assert parsed["parser"] == "wire"
+        assert parsed["device_planes"] == 1
+        names = [row["name"] for row in parsed["ops"]]
+        assert names == ["dot.7"]  # host plane ignored when a device plane exists
+        assert parsed["ops"][0]["category"] == "matmul"
+        assert parsed["op_categories"]["matmul"] == pytest.approx(2.0)
+
+    def test_text_fallback_on_undecodable_artifact(self, tmp_path):
+        # not a proto: the wire walk must fail over to the printable-run
+        # scan, counts only, parser marked "text"
+        (tmp_path / "b.xplane.pb").write_bytes(
+            b"\xff\xff garbage jit_model.dot_general \xff more convolution.2 \xff")
+        parsed = parse_capture_dir(str(tmp_path))
+        assert parsed["parser"] == "text"
+        assert parsed["ops_total"] >= 1
+        assert all(row["dur_us"] == 0 for row in parsed["ops"])
+
+    def test_parse_text_events_filters_noise(self):
+        counts = parse_text_events(b"\x00\x01jit_step.fusion\x00!!!???\x00")
+        assert "jit_step.fusion" in counts
+        assert all(not k.startswith("!") for k in counts)
+
+    def test_categorize_op(self):
+        assert categorize_op("jit_m.dot_general.3") == "matmul"
+        assert categorize_op("convolution.2") == "conv"
+        assert categorize_op("loop_add_fusion") == "fusion"
+        assert categorize_op("copy-start.1") == "infeed"
+        assert categorize_op("transpose.5") == "copy"
+        assert categorize_op("tanh.0") == "elementwise"
+        assert categorize_op("while") == "other"
+
+
+# -- gallery ------------------------------------------------------------------
+
+
+class TestGallery:
+    def _add(self, gal, cid, payload_bytes, when):
+        os.makedirs(gal.capture_dir(cid), exist_ok=True)
+        with open(os.path.join(gal.capture_dir(cid), "x.xplane.pb"), "wb") as f:
+            f.write(b"\0" * payload_bytes)
+        return gal.add(cid, {"capture_id": cid, "started_unix": when})
+
+    def test_newest_k_retained(self, tmp_path):
+        gal = ProfileGallery(str(tmp_path), keep=2, max_bytes=1 << 20)
+        for i in range(4):
+            self._add(gal, f"cap{i}", 10, when=1000.0 + i)
+        assert gal.entries() == ["cap2", "cap3"]
+        assert gal.evicted == 2
+        assert not os.path.exists(gal.summary_path("cap0"))
+        assert not os.path.isdir(gal.capture_dir("cap0"))
+
+    def test_byte_cap_evicts_oldest(self, tmp_path):
+        gal = ProfileGallery(str(tmp_path), keep=10, max_bytes=3000)
+        self._add(gal, "old", 2000, when=1.0)
+        self._add(gal, "new", 2000, when=2.0)
+        assert gal.entries() == ["new"]
+        assert gal.evicted == 1
+        assert gal.summary()["bytes"] <= 3000
+
+    def test_rescan_across_restart(self, tmp_path):
+        gal = ProfileGallery(str(tmp_path), keep=4, max_bytes=1 << 20)
+        self._add(gal, "a", 10, when=1.0)
+        self._add(gal, "b", 10, when=2.0)
+        # a new process: same dir, tighter bound — predecessor's captures
+        # still honor it
+        gal2 = ProfileGallery(str(tmp_path), keep=1, max_bytes=1 << 20)
+        assert gal2.entries() == ["a", "b"]
+        self._add(gal2, "c", 10, when=3.0)
+        assert gal2.entries() == ["c"]
+
+
+# -- capture windows ----------------------------------------------------------
+
+
+class TestCaptureWindow:
+    def test_capture_on_cpu_parses_and_banks(self):
+        reg = MetricsRegistry()
+        got = []
+        p = slow_pipeline(got)
+        p.start()
+        try:
+            summary = profiler.capture_profile(seconds=0.3, registry=reg)
+        finally:
+            p.stop()
+        assert summary["parser"] in ("wire", "text")
+        assert summary["ops_total"] > 0
+        assert summary["summary_path"] and os.path.exists(summary["summary_path"])
+        assert summary["capture_id"] in profiler.gallery().entries()
+        banked = json.load(open(summary["summary_path"]))
+        assert banked["capture_id"] == summary["capture_id"]
+        if summary["device_planes"] > 0:  # TPU/GPU only
+            assert any(pl.startswith("/device:") for pl in summary["planes"])
+        text = render_text(reg)
+        assert 'nnstpu_profile_captures_total{trigger="manual",' \
+               'outcome="ok"}' in text
+        assert profiler.last_capture()["capture_id"] == summary["capture_id"]
+
+    def test_concurrent_capture_raises_typed_busy(self):
+        with profiler.profiled_window(label="holder", parse=False):
+            with pytest.raises(ProfileBusyError) as ei:
+                profiler.capture_profile(seconds=0.05)
+            assert ei.value.status == 409
+            assert ei.value.active["trigger"] == "manual"
+            assert profiler.active_capture() is not None
+        assert profiler.active_capture() is None
+
+    def test_pipeline_stop_abandons_window_cleanly(self):
+        reg = MetricsRegistry()
+        got = []
+        p = slow_pipeline(got, name="abandon")
+        p.start()
+        stopper = threading.Timer(0.2, p.stop)
+        stopper.start()
+        try:
+            t0 = time.monotonic()
+            summary = profiler.capture_profile(
+                seconds=30.0, pipeline=p, registry=reg)
+            assert time.monotonic() - t0 < 15.0, "abandon must end the window"
+            assert summary["aborted"]
+            assert "PLAYING" in summary["aborted"]
+        finally:
+            stopper.join()
+            p.stop()
+        # the lock is free again: the next capture must not see busy
+        profiler.capture_profile(seconds=0.05, registry=reg)
+
+    def test_frames_window_counts_device_exec(self):
+        reg = MetricsRegistry()
+
+        def feed():
+            # emitted mid-window from another thread, the way the device
+            # reaper does (signature: hooks.py device_exec)
+            time.sleep(0.1)
+            for _ in range(3):
+                hooks.emit("device_exec", "p", "n", "cpu:0", 0, 1_000_000,
+                           {"cost_key": "m:000000000001"})
+
+        t = threading.Thread(target=feed)
+        t.start()
+        try:
+            summary = profiler.capture_profile(frames=3, registry=reg)
+        finally:
+            t.join()
+        assert summary["frames_observed"] >= 3
+        assert "m:000000000001" in summary["executables"]
+
+
+# -- fingerprint join + Perfetto drill-down -----------------------------------
+
+
+class TestAttribution:
+    def test_single_fingerprint_attributes_all_rows(self):
+        parsed = {"ops": [{"name": "dot.1", "category": "matmul",
+                           "dur_us": 5.0, "count": 1}]}
+        profiler._attribute_executables(
+            parsed, {"mobilenet:0000000000ab": {"dur_us": 9.0,
+                                               "dispatches": 3}})
+        assert parsed["ops"][0]["executable"] == "mobilenet:0000000000ab"
+
+    def test_model_name_match_beats_dominant(self):
+        parsed = {"ops": [
+            {"name": "jit_resnet.dot.1", "category": "matmul",
+             "dur_us": 5.0, "count": 1},
+            {"name": "unrelated.add", "category": "elementwise",
+             "dur_us": 1.0, "count": 1},
+        ]}
+        observed = {
+            "resnet:00000000000a": {"dur_us": 1.0, "dispatches": 1},
+            "bert:00000000000b": {"dur_us": 99.0, "dispatches": 9},
+        }
+        profiler._attribute_executables(parsed, observed)
+        assert parsed["ops"][0]["executable"] == "resnet:00000000000a"
+        assert parsed["ops"][1]["executable"] == "bert:00000000000b"  # dominant
+
+    def test_annotate_chrome_trace_joins_device_exec_spans(self):
+        profiler._remember({
+            "capture_id": "cap-join", "trigger": "manual", "parser": "wire",
+            "ops": [{"name": "dot.1", "category": "matmul", "dur_us": 5.0,
+                     "count": 1, "executable": "m:00000000000a"}],
+            "op_categories": {"matmul": 5.0},
+            "executables": {"m:00000000000a": {"dur_us": 5.0,
+                                               "dispatches": 1}},
+        })
+        doc = {"traceEvents": [
+            {"ph": "X", "name": "device_exec",
+             "args": {"cost_key": "m:00000000000a"}},
+            {"ph": "X", "name": "device_exec",
+             "args": {"cost_key": "other:00000000000b"}},
+            {"ph": "X", "name": "dispatch", "args": {}},
+        ]}
+        out = profiler.annotate_chrome_trace(doc)
+        drill = out["otherData"]["profile_drilldown"]
+        assert drill["capture_id"] == "cap-join"
+        assert out["traceEvents"][0]["args"]["profile_capture"] == "cap-join"
+        assert "profile_capture" not in out["traceEvents"][1]["args"]
+        assert "profile_capture" not in out["traceEvents"][2]["args"]
+
+    def test_op_gauges_keyed_by_executable_and_category(self):
+        reg = MetricsRegistry()
+        profiler._export_op_gauges({
+            "ops": [
+                {"name": "dot.1", "category": "matmul", "dur_us": 5.0,
+                 "count": 1, "executable": "m:00000000000a"},
+                {"name": "dot.2", "category": "matmul", "dur_us": 7.0,
+                 "count": 1, "executable": "m:00000000000a"},
+            ]}, reg)
+        line = next(l for l in render_text(reg).splitlines()
+                    if l.startswith("nnstpu_op_time_us{"))
+        assert 'executable="m:00000000000a"' in line
+        assert 'op_category="matmul"' in line
+        assert float(line.rsplit(" ", 1)[1]) == pytest.approx(12.0)
+
+
+# -- whole-run fold (`[common] xplane_trace_dir`) -----------------------------
+
+
+class TestWholeRunFold:
+    def test_raw_artifacts_in_trace_dir_and_summary_banked(
+            self, tmp_path, monkeypatch):
+        trace_dir = tmp_path / "xplane"
+        monkeypatch.setenv("NNSTPU_COMMON_XPLANE_TRACE_DIR", str(trace_dir))
+        got = []
+        slow_pipeline(got, name="wrun").run(timeout=60)
+        assert len(got) == 6
+        files = [os.path.join(r, f)
+                 for r, _, fs in os.walk(trace_dir) for f in fs]
+        assert files, "raw artifacts must stay under the user's trace_dir"
+        last = profiler.last_capture()
+        assert last["trigger"] == "whole_run"
+        assert last["ops_total"] > 0
+        # summary banked in the gallery; the raw tree is NOT gallery-owned
+        assert os.path.exists(
+            profiler.gallery().summary_path(last["capture_id"]))
+        assert not os.path.isdir(
+            profiler.gallery().capture_dir(last["capture_id"]))
+
+    def test_profile_is_busy_while_whole_run_active(self, tmp_path):
+        p = Pipeline(name="busyrun")
+        assert profiler.start_whole_run(p, str(tmp_path / "t"))
+        try:
+            with pytest.raises(ProfileBusyError) as ei:
+                profiler.capture_profile(seconds=0.05)
+            assert ei.value.active["whole_run"] is True
+        finally:
+            summary = profiler.stop_whole_run(p)
+        assert summary is not None and summary["trigger"] == "whole_run"
+        profiler.capture_profile(seconds=0.05)  # lock released
+
+    def test_start_failure_surfaces_health_not_exception(self, monkeypatch):
+        health = []
+        hooks.connect("health", lambda *a: health.append(a))
+        p = Pipeline(name="sick")
+        # hold the lock: start_whole_run must take the busy path
+        with profiler.profiled_window(label="holder", parse=False):
+            assert profiler.start_whole_run(p, "/nonexistent/d") is False
+        assert profiler.stop_whole_run(p) is None  # never started
+        assert health, "failure must surface on the health hook"
+        _pipeline, healthy, reason = health[0]
+        assert healthy is True  # degraded evidence, not a broken pipeline
+        assert "xplane" in reason
+        from nnstreamer_tpu.obs.export import (health_document,
+                                               unregister_degraded)
+
+        try:
+            assert any(k.startswith("xplane:") for k in
+                       health_document()["degraded"])
+        finally:
+            unregister_degraded("xplane:sick")
+
+
+# -- HTTP: /profile + collector client ----------------------------------------
+
+
+class TestProfileEndpoint:
+    @pytest.fixture
+    def server(self):
+        from nnstreamer_tpu.obs.export import MetricsServer
+
+        srv = MetricsServer(port=0)
+        srv.start()
+        yield f"127.0.0.1:{srv.port}"
+        srv.stop()
+
+    def test_get_profile_200(self, server):
+        with urllib.request.urlopen(
+                f"http://{server}/profile?seconds=0.1", timeout=30) as r:
+            assert r.status == 200
+            body = json.loads(r.read())
+        assert body["trigger"] == "http"
+        assert body["requested_seconds"] == pytest.approx(0.1)
+        assert "ops_total" in body
+
+    def test_get_profile_409_and_fetch_profile_mapping(self, server):
+        from nnstreamer_tpu.obs.collector import fetch_profile
+
+        with profiler.profiled_window(label="holder", parse=False):
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(
+                    f"http://{server}/profile?seconds=0.1", timeout=30)
+            assert ei.value.code == 409
+            assert json.loads(ei.value.read())["error"] == "busy"
+            with pytest.raises(ProfileBusyError) as bi:
+                fetch_profile(server, seconds=0.1, timeout_s=30)
+            assert bi.value.active["trigger"] == "manual"
+
+    def test_get_profile_400_on_bad_params(self, server):
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(
+                f"http://{server}/profile?seconds=banana", timeout=30)
+        assert ei.value.code == 400
+        assert json.loads(ei.value.read())["error"] == "bad_request"
+
+
+# -- HBM forensics ------------------------------------------------------------
+
+
+def _register_fake_executable(fp="model:00000000000a",
+                              output=1024, temp=2048, code=512):
+    from nnstreamer_tpu.obs import util as obs_util
+
+    obs_util.register_cost(
+        fp, flops=1e6, bytes=1e4,
+        hbm={"argument_bytes": 4096, "output_bytes": output,
+             "temp_bytes": temp, "alias_bytes": 0,
+             "generated_code_bytes": code})
+    return fp
+
+
+class TestHbmForensics:
+    @pytest.fixture(autouse=True)
+    def _clean_costs(self):
+        from nnstreamer_tpu.obs import util as obs_util
+
+        obs_util.clear_costs()
+        yield
+        obs_util.clear_costs()
+
+    def test_memory_info_from_real_compile(self):
+        import jax
+        import jax.numpy as jnp
+
+        from nnstreamer_tpu.obs.device import memory_info
+
+        c = jax.jit(lambda x: jnp.dot(x, x)).lower(
+            jnp.ones((16, 16), jnp.float32)).compile()
+        mi = memory_info(c)
+        assert mi["argument_bytes"] > 0
+        assert set(mi) == {"argument_bytes", "output_bytes", "temp_bytes",
+                           "alias_bytes", "generated_code_bytes"}
+
+    def test_ledger_names_largest_resident(self):
+        _register_fake_executable("small:00000000000a", output=10, temp=10,
+                                  code=10)
+        _register_fake_executable("big:00000000000b", output=9000, temp=9000,
+                                  code=100)
+        ledger = profiler.hbm_ledger()
+        assert ledger["largest_resident"] == "big:00000000000b"
+        # resident excludes argument bytes (streamed/donated inputs)
+        assert ledger["executables"]["small:00000000000a"][
+            "resident_bytes"] == 30
+        assert ledger["resident_estimate_bytes"] == 30 + 18100
+
+    def test_capacity_check_warns_typed_never_raises(self):
+        _register_fake_executable()
+        p = Pipeline(name="cap")
+        with pytest.warns(HbmCapacityWarning):
+            report = profiler.check_hbm_capacity(pipeline=p, capacity_bytes=1)
+        assert report["over_capacity"] is True
+        assert report["largest_resident"] == "model:00000000000a"
+        assert p.hbm_report is report
+        from nnstreamer_tpu.obs.export import (health_document,
+                                               unregister_degraded)
+
+        try:
+            assert any(k.startswith("hbm:") for k in
+                       health_document()["degraded"])
+        finally:
+            unregister_degraded("hbm:cap")
+
+    def test_capacity_check_clean_under_capacity(self):
+        _register_fake_executable()
+        report = profiler.check_hbm_capacity(capacity_bytes=1 << 40)
+        assert report["over_capacity"] is False
+
+    def test_hbm_gauges_exported_per_kind(self):
+        _register_fake_executable()
+        reg = MetricsRegistry()
+        profiler.register_hbm_gauges(reg)
+        by_kind = {}
+        for line in render_text(reg).splitlines():
+            if (line.startswith("nnstpu_executable_hbm_bytes{")
+                    and 'executable="model:00000000000a"' in line):
+                kind = line.split('kind="', 1)[1].split('"', 1)[0]
+                by_kind[kind] = float(line.rsplit(" ", 1)[1])
+        assert by_kind["output_bytes"] == 1024
+        assert by_kind["resident_bytes"] == 1024 + 2048 + 512
+
+    def test_flight_dump_embeds_ledger_on_injected_fault(
+            self, tmp_path, monkeypatch):
+        _register_fake_executable("crash:00000000000c", output=7777)
+        monkeypatch.setenv("NNSTPU_OBS_FLIGHT_DUMP_DIR", str(tmp_path))
+        monkeypatch.setenv("NNSTPU_TRACERS", "spans")
+        from nnstreamer_tpu import faults
+        from nnstreamer_tpu.graph.pipeline import PipelineError
+
+        faults.install("invoke_raise@boom:after=1", seed=7)
+        try:
+            p = Pipeline(name="oomish")
+            src = p.add(DataSrc(data=[np.ones(4, np.float32)] * 3, name="s"))
+            filt = p.add(TensorFilter(framework="custom",
+                                      model=lambda x: x, name="boom"))
+            p.link_chain(src, filt, p.add(TensorSink(name="out")))
+            with pytest.raises(PipelineError):
+                p.run(timeout=30)
+        finally:
+            faults.deactivate()
+        doc = json.loads((tmp_path / "oomish.error.trace.json").read_text())
+        ledger = doc["otherData"]["hbm_ledger"]
+        assert ledger["largest_resident"] == "crash:00000000000c"
+        assert "crash:00000000000c" in ledger["executables"]
+
+    def test_warmup_report_carries_capacity_check(self):
+        got = []
+        p = slow_pipeline(got, n=2, sleep_s=0.0, name="warm")
+        p.start()
+        try:
+            p.warmup()
+            assert "hbm" in p.warmup_report
+            assert "over_capacity" in p.warmup_report["hbm"]
+        finally:
+            p.stop()
+
+
+# -- peak watermarks ----------------------------------------------------------
+
+
+class _FakeDevice:
+    def __init__(self, platform, ordinal, peak):
+        self.platform = platform
+        self.id = ordinal
+        self.peak = peak
+        self.resets = 0
+
+    def memory_stats(self):
+        return {"bytes_in_use": 10, "peak_bytes_in_use": self.peak,
+                "bytes_limit": 1000}
+
+    def reset_memory_stats(self):
+        self.resets += 1
+        self.peak = 0
+
+
+class TestPeakWatermarks:
+    def test_peak_gauge_drains_and_resets_device(self):
+        from nnstreamer_tpu.obs import device as obs_device
+
+        obs_device.reset_peak_watermarks()
+        dev = _FakeDevice("tpu", 0, peak=777)
+        reg = MetricsRegistry()
+        handle = obs_device.register_memory_gauges(reg, devices=[dev])
+
+        def peak():
+            line = next(
+                l for l in render_text(reg).splitlines()
+                if l.startswith("nnstpu_device_memory_peak_bytes{")
+                and 'device="tpu:0"' in l)
+            return float(line.rsplit(" ", 1)[1])
+
+        try:
+            assert peak() == 777
+            assert dev.resets >= 1, "allocator peak reset must be probed"
+            # watermark drained: a second scrape reports the NEW interval
+            dev.peak = 42
+            assert peak() == 42
+        finally:
+            reg.remove_collector(handle)
+            obs_device.reset_peak_watermarks()
+
+    def test_snapshot_accumulates_watermark_between_scrapes(self):
+        from nnstreamer_tpu.obs import device as obs_device
+
+        obs_device.reset_peak_watermarks()
+        try:
+            dev = _FakeDevice("tpu", 3, peak=500)
+            obs_device.device_memory_snapshot(devices=[dev])
+            dev.peak = 100  # allocator peak dropped (e.g. reset elsewhere)
+            obs_device.device_memory_snapshot(devices=[dev])
+            with obs_device._peak_lock:
+                assert obs_device._peak_watermarks["tpu:3"] == 500
+        finally:
+            obs_device.reset_peak_watermarks()
+
+
+# -- degrade detection (watchdog auto-capture trigger) ------------------------
+
+
+class TestDegradeDetector:
+    def _feed(self, det, dur_us, n=1, key="m:00000000000a"):
+        for _ in range(n):
+            det.on_device_exec("p", "node", "tpu:0", 0, int(dur_us * 1e3),
+                               {"cost_key": key})
+
+    def test_arms_only_beyond_noise_band(self):
+        det = profiler.DegradeDetector(sigmas=3.0, min_rel=0.10,
+                                       min_abs_us=50.0, min_samples=8)
+        self._feed(det, 1000.0, n=8)
+        assert det.degraded() is None  # baseline warmup, nothing armed
+        self._feed(det, 1010.0)  # inside band (min_rel floor = 100µs)
+        assert det.degraded() is None
+        self._feed(det, 2000.0)  # way out
+        verdict = det.degraded()
+        assert verdict is not None and "m:00000000000a" in verdict
+        assert det.degraded() is None, "verdict must clear on read"
+        assert det.verdicts == 1
+
+    def test_watchdog_auto_capture_on_injected_regression(self, monkeypatch):
+        monkeypatch.setenv("NNSTPU_OBS_PROFILE_AUTO", "true")
+        monkeypatch.setenv("NNSTPU_OBS_PROFILE_AUTO_SECONDS", "0.1")
+        monkeypatch.setenv("NNSTPU_OBS_PROFILE_AUTO_COOLDOWN_S", "0")
+        monkeypatch.setenv("NNSTPU_OBS_PROFILE_MIN_SAMPLES", "8")
+        monkeypatch.setenv("NNSTPU_OBS_WATCHDOG_INTERVAL_S", "0.05")
+        from nnstreamer_tpu.obs.watchdog import PipelineWatchdog
+
+        got = []
+        p = slow_pipeline(got, n=2, sleep_s=0.0, name="wdprof")
+        reg = MetricsRegistry()
+        wd = PipelineWatchdog(registry=reg)
+        p.attach_tracer(wd)
+        p.start()
+        try:
+            assert wd._profile_detector is not None
+            # a steady baseline, then one dispatch far beyond the band —
+            # the regression a real roofline degradation produces
+            for _ in range(12):
+                hooks.emit("device_exec", "wdprof", "n", "cpu:0", 0,
+                           1_000_000, {"cost_key": "wd:00000000000d"})
+            hooks.emit("device_exec", "wdprof", "n", "cpu:0", 0,
+                       50_000_000, {"cost_key": "wd:00000000000d"})
+            deadline = time.monotonic() + 15.0
+            while time.monotonic() < deadline:
+                with wd._lock:
+                    if wd._auto_captures >= 1:
+                        break
+                time.sleep(0.05)
+            assert wd._auto_captures >= 1, "watchdog must auto-capture"
+            assert wd.summary()["profile_auto"]["captures"] >= 1
+        finally:
+            p.stop()
+        last = profiler.last_capture()
+        assert last is not None and last["trigger"] == "watchdog"
+
+    def test_stats_provider_reports_gallery_and_last(self):
+        profiler.capture_profile(seconds=0.05, registry=MetricsRegistry())
+        st = profiler.stats()
+        assert st["gallery"]["entries"] >= 1
+        assert st["last_capture"]["trigger"] == "manual"

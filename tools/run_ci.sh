@@ -2161,6 +2161,166 @@ print(f"forensics smoke OK: {fx['outliers']} outliers, "
       f"resolved, ledger exact")
 PY
 
+run_step "Profiling smoke (/profile capture joined to the cost registry, HBM series, watchdog auto-capture on injected regression, gallery idempotence)" \
+  env NNSTPU_TRACERS="spans,device" NNSTPU_METRICS_PORT=0 \
+      NNSTPU_OBS_PROFILE_DIR=/tmp/ci_profile_gallery \
+      NNSTPU_OBS_PROFILE_KEEP=4 \
+      NNSTPU_OBS_PROFILE_AUTO=true \
+      NNSTPU_OBS_PROFILE_AUTO_SECONDS=0.5 \
+      NNSTPU_OBS_PROFILE_AUTO_COOLDOWN_S=0 \
+      NNSTPU_OBS_PROFILE_MIN_SAMPLES=8 \
+  python - <<'PY'
+# Deep-profiling lane end-to-end (ISSUE 20): (a) GET /profile?seconds=1
+# against a serving CPU pipeline must produce an on-disk artifact and a
+# parsed op table whose executable fingerprints JOIN the cost registry;
+# (b) the scrape must carry the per-executable HBM series recorded at
+# compile time; (c) a fault-injected device-time regression (the chaos
+# engine's invoke_delay rule, routed through jax.pure_callback so the
+# sleep lands INSIDE device execution where the DegradeDetector
+# watches) must auto-trigger a watchdog capture; (d) the gallery must
+# be idempotent across two runs — a rescan sees the same entries and
+# keeps honoring the bound.
+import json
+import os
+import shutil
+import time
+import urllib.request
+
+import jax
+jax.config.update('jax_platforms', 'cpu')
+import numpy as np
+
+from nnstreamer_tpu import Pipeline, faults
+from nnstreamer_tpu.backends.jax_backend import JaxModel
+from nnstreamer_tpu.elements.filter import TensorFilter
+from nnstreamer_tpu.elements.sink import TensorSink
+from nnstreamer_tpu.elements.testsrc import DataSrc
+from nnstreamer_tpu.obs import export, profiler
+from nnstreamer_tpu.obs.util import cost_entries
+from nnstreamer_tpu.obs.watchdog import PipelineWatchdog
+from nnstreamer_tpu.spec import TensorSpec, TensorsSpec
+
+GDIR = "/tmp/ci_profile_gallery"
+shutil.rmtree(GDIR, ignore_errors=True)
+profiler.reset_gallery()
+
+
+def host_op(x):
+    # the chaos point: with no rule armed this is a cheap pacing sleep;
+    # an installed invoke_delay@devcb rule sleeps HERE, inside the
+    # device computation
+    faults.maybe_invoke("devcb")
+    time.sleep(0.02)
+    return np.asarray(x) * 2
+
+
+def make_pipeline(name, frames):
+    model = JaxModel(
+        apply=lambda p_, x: jax.pure_callback(
+            host_op, jax.ShapeDtypeStruct(x.shape, x.dtype), x),
+        input_spec=TensorsSpec.of(TensorSpec(dtype=np.float32, shape=(8,))))
+    got = []
+    p = Pipeline(name=name)
+    src = p.add(DataSrc(
+        data=[np.full(8, i, np.float32) for i in range(frames)], name="s"))
+    filt = p.add(TensorFilter(framework="jax", model=model, name="devcb"))
+    p.link_chain(src, filt, p.add(TensorSink(callback=got.append,
+                                             name="out")))
+    return p, got
+
+
+# -- (a) on-demand /profile against a serving pipeline ------------------
+p, got = make_pipeline("ci_prof", frames=120)
+p.start()
+try:
+    server = export._server
+    assert server is not None, \
+        "NNSTPU_METRICS_PORT did not start the endpoint"
+    while len(got) < 5:
+        time.sleep(0.02)
+    with urllib.request.urlopen(
+            f"http://{server.host}:{server.port}/profile?seconds=1",
+            timeout=60) as resp:
+        summary = json.loads(resp.read())
+    deadline = time.time() + 120
+    while len(got) < 120 and time.time() < deadline:
+        time.sleep(0.05)
+    assert len(got) == 120, len(got)
+finally:
+    p.stop()
+assert summary["trigger"] == "http", summary["trigger"]
+assert summary["ops_total"] > 0, summary
+assert os.path.isdir(summary["artifact_dir"])
+assert profiler.find_xplane_files(summary["artifact_dir"]), \
+    "no raw xplane artifacts on disk"
+assert os.path.exists(summary["summary_path"])
+fps = set(summary["executables"])
+assert fps, "no executable fingerprints observed during the window"
+registry_keys = set(cost_entries())
+assert fps <= registry_keys, (fps, registry_keys)
+attributed = {row.get("executable") for row in summary["ops"]}
+assert attributed <= registry_keys | {""}, attributed
+assert attributed & registry_keys, \
+    "op table rows did not join the cost registry"
+
+# -- (b) compile-time HBM series on the scrape --------------------------
+with urllib.request.urlopen(server.url, timeout=30) as resp:
+    body = resp.read().decode("utf-8")
+assert "nnstpu_executable_hbm_bytes" in body, body[:400]
+hbm_lines = [l for l in body.splitlines()
+             if l.startswith("nnstpu_executable_hbm_bytes{")]
+assert any(f'executable="{fp}"' in l for fp in fps for l in hbm_lines), \
+    hbm_lines[:5]
+assert "nnstpu_op_time_us" in body
+assert 'nnstpu_profile_captures_total{trigger="http",outcome="ok"}' in body
+
+# -- (c) watchdog auto-capture on the injected regression ---------------
+# ~30 clean baseline frames arm the Welford baseline (min_samples=8),
+# then 8 injected 200ms delays inside device execution blow the
+# perfdiff noise band
+faults.install("invoke_delay@devcb:after=30,every=1,count=8,ms=200",
+               seed=7)
+try:
+    p2, got2 = make_pipeline("ci_prof_auto", frames=60)
+    wd = p2.attach_tracer(PipelineWatchdog(interval_s=0.05))
+    p2.start()
+    try:
+        assert wd._profile_detector is not None, \
+            "NNSTPU_OBS_PROFILE_AUTO=true did not arm the detector"
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            with wd._lock:
+                if wd._auto_captures >= 1:
+                    break
+            time.sleep(0.05)
+        with wd._lock:
+            auto = wd._auto_captures
+        assert auto >= 1, "watchdog never auto-captured on the regression"
+    finally:
+        p2.stop()
+finally:
+    faults.deactivate()
+wd_caps = [s for s in profiler.recent_captures()
+           if s["trigger"] == "watchdog"]
+assert wd_caps, "no watchdog-triggered capture banked"
+assert wd.summary()["profile_auto"]["captures"] >= 1
+
+# -- (d) gallery idempotence across two runs ----------------------------
+before = profiler.gallery().entries()
+assert before and len(before) <= 4, before
+profiler.reset_gallery()  # "restart": force a rescan from disk
+after = profiler.gallery().entries()
+assert after == before, (before, after)
+profiler.capture_profile(seconds=0.1)
+assert len(profiler.gallery().entries()) <= 4
+
+export.shutdown_server()
+print(f"profiling smoke OK: /profile joined {len(fps)} fingerprint(s) to "
+      f"the cost registry, {len(hbm_lines)} HBM series, "
+      f"{auto} watchdog auto-capture(s), gallery stable at "
+      f"{len(after)} entries")
+PY
+
 run_step "Bench smoke (final JSON line parses, rc=0)" \
   bash -c '
     env BENCH_FRAMES=10 BENCH_QUANT_FRAMES=4 BENCH_BASELINE_FRAMES=3 \

@@ -178,7 +178,12 @@ def _dry_run_probe_fn():
 
 def _tiny_ladder_trigger():
     """Shrink the ladder grid to one 32×32 fp32/mesh-1 cell so the CI
-    dry-run leg measures + banks in seconds, not minutes."""
+    dry-run leg measures + banks in seconds, not minutes.  The cell is
+    measured with ``BENCH_LADDER_PROFILE=1``: the sick→healthy window
+    this trigger fires in is exactly when op-level evidence is worth
+    banking, so the cell carries a deep-profiling op table next to its
+    MFU sample, provenance-stamped like everything else the sentinel
+    banks (a busy capture window degrades to an unprofiled cell)."""
     import bench
 
     bench.LADDER_BATCHES = (8,)
@@ -189,7 +194,15 @@ def _tiny_ladder_trigger():
     bench.ladder_point = (
         lambda batch, dtype, ndev, image_size=224:
         orig_point(batch, dtype, ndev, image_size=32))
-    return bench.sentinel_ladder_run()
+    old_profile = os.environ.get("BENCH_LADDER_PROFILE")
+    os.environ["BENCH_LADDER_PROFILE"] = "1"
+    try:
+        return bench.sentinel_ladder_run()
+    finally:
+        if old_profile is None:
+            os.environ.pop("BENCH_LADDER_PROFILE", None)
+        else:
+            os.environ["BENCH_LADDER_PROFILE"] = old_profile
 
 
 # -------------------------------------------------------------------- CLI
